@@ -1,0 +1,34 @@
+"""gllm-tpu: a TPU-native distributed LLM inference/serving engine.
+
+Built from scratch on JAX/XLA/Pallas with the capability surface of
+gty111/gLLM (continuous batching, chunked prefill, paged KV cache with prefix
+caching, token-throttling pipeline scheduling, TP/PP/EP/DP parallelism, an
+OpenAI-compatible server) — re-architected for TPU: single-controller SPMD
+over a device mesh, jit-compiled bucketed step functions instead of CUDA
+graphs, Pallas ragged paged attention, and XLA ICI collectives instead of
+NCCL.
+"""
+
+from gllm_tpu.config import (CacheConfig, EngineConfig, ParallelConfig,
+                             SchedulerConfig)
+from gllm_tpu.sampling_params import SamplingParams
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "CacheConfig",
+    "EngineConfig",
+    "ParallelConfig",
+    "SamplingParams",
+    "SchedulerConfig",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy import so `import gllm_tpu` works without pulling jax (fast CLI /
+    # pure-control-plane uses).
+    if name == "LLM":
+        from gllm_tpu.engine.llm import LLM
+        return LLM
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,92 @@
+"""Engine configuration.
+
+One dataclass is the single config schema for the whole engine — the TPU-native
+equivalent of the reference's constructor-kwarg threading
+(/root/reference/gllm/llm_engine.py:34-75) and CLI flag surface
+(/root/reference/gllm/entrypoints/api_server.py:267-508).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from gllm_tpu.utils import cdiv
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    """Scheduling policy knobs (reference: scheduler.py:16-163, api_server flags
+    --schedule-method/--maxd/--maxp/--minp/--iterp)."""
+
+    schedule_method: str = "chunked_prefill"  # chunked_prefill | token_throttling | split_pd
+    max_decode_seqs: int = 256            # --maxd: decode seqs per batch
+    max_prefill_tokens: int = 2048        # --maxp: prefill token budget per batch
+    min_prefill_tokens: int = 128         # --minp: throttling lower clamp
+    iter_smooth: int = 16                 # --iterp: waiting-token smoothing divisor
+    init_new_token_ratio: float = 0.7     # adaptive KV admission ramp start
+    min_new_token_ratio: float = 0.1      # ramp floor
+    new_token_ratio_decay_steps: int = 600
+    # KV free-ratio reserve used by token throttling's prefill budget ramp
+    # (reference scheduler.py:613-696).
+    throttle_reserve: float = 0.2
+
+
+@dataclasses.dataclass
+class CacheConfig:
+    """Paged KV cache geometry (reference: memory_manager.py, --page-size,
+    --gpu-memory-util)."""
+
+    page_size: int = 16
+    memory_util: float = 0.9              # fraction of free HBM given to KV
+    num_pages: Optional[int] = None       # explicit override (tests/benchmarks)
+    kv_cache_dtype: str = "auto"          # auto | bfloat16 | float32
+    enable_prefix_caching: bool = False
+
+
+@dataclasses.dataclass
+class ParallelConfig:
+    """Mesh geometry. The reference exposes --pp/--tp/--dp/--enable-ep
+    (dist_utils.py:149-263); on TPU these become named mesh axes over which
+    jit/GSPMD lays out shardings and inserts ICI collectives."""
+
+    pp: int = 1
+    tp: int = 1
+    dp: int = 1
+    enable_ep: bool = False
+
+    @property
+    def world_size(self) -> int:
+        return self.pp * self.tp * self.dp
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: str = ""
+    tokenizer: Optional[str] = None
+    dtype: str = "bfloat16"
+    seed: int = 0
+    max_model_len: int = 4096
+    max_num_seqs: int = 256
+    load_format: str = "auto"             # auto | dummy (weight-less bring-up,
+                                          # reference api_server.py:293-299)
+    enforce_eager: bool = False           # disable donation/async tricks (debug)
+    attention_impl: str = "auto"          # auto | pallas | xla
+    scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
+    cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+
+    @property
+    def max_pages_per_seq(self) -> int:
+        return cdiv(self.max_model_len, self.cache.page_size)
+
+    def validate(self) -> None:
+        if self.cache.page_size <= 0:
+            raise ValueError("page_size must be positive")
+        if self.scheduler.max_prefill_tokens < self.cache.page_size:
+            raise ValueError("max_prefill_tokens must cover at least one page")
+        if self.scheduler.schedule_method not in (
+            "chunked_prefill", "token_throttling", "split_pd",
+        ):
+            raise ValueError(
+                f"unknown schedule_method {self.scheduler.schedule_method!r}")

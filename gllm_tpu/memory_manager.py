@@ -1,0 +1,209 @@
+"""Paged KV-cache bookkeeping + hash-chain prefix cache.
+
+Host-side page accounting for the HBM KV arrays owned by the ModelRunner. This
+is the TPU-native analogue of the reference MemoryManager / PrefixMemoryManager
+(/root/reference/gllm/memory_manager.py):
+
+- pages are fixed-size slabs of KV slots; a sequence's ``page_table`` lists its
+  page ids in order; flat KV slot = page_id * page_size + offset.
+- page id 0 is reserved as the *dummy page*: padded batch rows and padded
+  tokens write there (reference memory_manager.py:522 uses a dummy page the
+  same way for CUDA-graph padding).
+- prefix cache (reference memory_manager.py:858-1272): a chained per-page hash
+  (O(page) to extend, :898-917) keys full pages for reuse; pages are
+  ref-counted (:1250-1262); a cached page *survives refcount 0* and remains
+  reusable until the allocator re-mints it for other content (:1254-1262); an
+  8-token canary guards against hash collisions (:920-935).
+- registration of freshly computed pages is decoupled from allocation and
+  driven by the scheduler after outputs land (:1055-1079) so in-flight
+  (placeholder) tokens never poison cache keys.
+
+Differences from the reference are deliberate: there is no per-GPU process, so
+one manager serves all local devices of a replica; KV sizing from live HBM
+telemetry happens in the runner, which passes ``num_pages`` here.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+from gllm_tpu.id_allocator import IDAllocator
+from gllm_tpu.sequence import Sequence
+from gllm_tpu.utils import cdiv
+
+# Tokens stored per cached page to verify against hash collisions
+# (reference memory_manager.py:920-935).
+_CANARY_TOKENS = 8
+
+
+def _chain_hash(prev: bytes, token_ids: List[int], extra_key: bytes = b"") -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(prev)
+    h.update(extra_key)
+    h.update(b"".join(t.to_bytes(4, "little", signed=True) for t in token_ids))
+    return h.digest()
+
+
+class MemoryManager:
+    """Plain paged allocator (no prefix reuse)."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (one is the dummy page)")
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.dummy_page = 0
+        # Page 0 reserved for padding writes.
+        self.allocator = IDAllocator(num_pages - 1, start=1)
+        self.ref_count: Dict[int, int] = {}
+
+    # ---- stats ------------------------------------------------------------
+
+    @property
+    def num_free_pages(self) -> int:
+        return self.allocator.num_free
+
+    @property
+    def free_ratio(self) -> float:
+        return self.allocator.num_free / self.allocator.num_total
+
+    # ---- allocation -------------------------------------------------------
+
+    def pages_needed(self, seq: Sequence, num_new_tokens: int) -> int:
+        return cdiv(seq.num_computed_tokens + num_new_tokens,
+                    self.page_size) - len(seq.page_table)
+
+    def can_allocate(self, num_pages: int) -> bool:
+        return self.num_free_pages >= num_pages
+
+    def _mint_page(self) -> int:
+        return self.allocator.allocate()
+
+    def allocate_seq_pages(self, seq: Sequence, num_new_tokens: int) -> None:
+        """Extend ``seq.page_table`` to cover computed+num_new_tokens tokens.
+
+        Caller must have checked ``can_allocate(pages_needed(...))``.
+        """
+        for _ in range(self.pages_needed(seq, num_new_tokens)):
+            page = self._mint_page()
+            self.ref_count[page] = 1
+            seq.page_table.append(page)
+
+    def match_prefix(self, seq: Sequence) -> int:
+        """Prefix-cache hook; no-op without prefix caching."""
+        return 0
+
+    def register_computed_pages(self, seq: Sequence) -> None:
+        """Prefix-cache hook; no-op without prefix caching."""
+
+    def free_seq(self, seq: Sequence) -> None:
+        for page in seq.page_table:
+            self._release_page(page)
+        seq.page_table = []
+
+    def _release_page(self, page: int) -> None:
+        self.ref_count[page] -= 1
+        if self.ref_count[page] == 0:
+            del self.ref_count[page]
+            self.allocator.free(page)
+
+
+class PrefixMemoryManager(MemoryManager):
+    """Paged allocator with page-granular hash-keyed KV reuse."""
+
+    def __init__(self, num_pages: int, page_size: int):
+        super().__init__(num_pages, page_size)
+        # hash digest -> page id (only fully computed pages).
+        self.hash_to_page: Dict[bytes, int] = {}
+        # page id -> (hash digest, canary token ids)
+        self.page_meta: Dict[int, Tuple[bytes, Tuple[int, ...]]] = {}
+        # per-seq chained hash of the last registered page, for O(page)
+        # extension (reference memory_manager.py:898-917 caches the chain on
+        # the sequence; we key it by seq id here).
+        self._seq_chain: Dict[int, Tuple[int, bytes]] = {}  # seq_id -> (num_pages_hashed, digest)
+        self.hit_tokens = 0
+        self.query_tokens = 0
+
+    # A page in the free list may still carry cache metadata; minting it for
+    # new content must drop the stale key (reference :1254-1262).
+    def _mint_page(self) -> int:
+        page = self.allocator.allocate()
+        meta = self.page_meta.pop(page, None)
+        if meta is not None:
+            digest = meta[0]
+            if self.hash_to_page.get(digest) == page:
+                del self.hash_to_page[digest]
+        return page
+
+    def _page_tokens(self, seq: Sequence, page_idx: int) -> List[int]:
+        s = page_idx * self.page_size
+        return seq.token_ids[s:s + self.page_size]
+
+    def match_prefix(self, seq: Sequence, extra_key: bytes = b"") -> int:
+        """Claim cached pages covering the longest matching prompt prefix.
+
+        Returns the number of cached tokens (always < prompt_len so at least
+        one token is computed to produce logits — same guarantee the reference
+        keeps). Claimed pages get ref_count++ and enter seq.page_table.
+        """
+        assert seq.num_computed_tokens == 0 and not seq.page_table
+        self.query_tokens += seq.prompt_len
+        # Only whole pages are cacheable; leave >=1 token to compute.
+        max_pages = (seq.prompt_len - 1) // self.page_size
+        matched_digest = b"root"
+        matched = 0
+        for i in range(max_pages):
+            tokens = self._page_tokens(seq, i)
+            digest = _chain_hash(matched_digest, tokens, extra_key)
+            page = self.hash_to_page.get(digest)
+            if page is None:
+                break
+            _, canary = self.page_meta[page]
+            if tuple(tokens[:_CANARY_TOKENS]) != canary:
+                break  # hash collision
+            if self.allocator.is_free(page):
+                self.allocator.allocate_id(page)
+            self.ref_count[page] = self.ref_count.get(page, 0) + 1
+            seq.page_table.append(page)
+            matched += 1
+            matched_digest = digest
+        seq.num_computed_tokens = matched * self.page_size
+        seq.num_cached_tokens = seq.num_computed_tokens
+        if matched:
+            self._seq_chain[seq.seq_id] = (matched, matched_digest)
+        self.hit_tokens += seq.num_computed_tokens
+        return seq.num_computed_tokens
+
+    def register_computed_pages(self, seq: Sequence, extra_key: bytes = b"") -> None:
+        """Register hashes for fully computed pages of ``seq``.
+
+        Called by the scheduler *after* outputs for a step landed, so only real
+        (non-placeholder) tokens are ever hashed (reference :1055-1079).
+        """
+        full_pages = seq.num_computed_tokens // self.page_size
+        n_hashed, digest = self._seq_chain.get(seq.seq_id, (0, b"root"))
+        for i in range(n_hashed, min(full_pages, len(seq.page_table))):
+            tokens = self._page_tokens(seq, i)
+            digest = _chain_hash(digest, tokens, extra_key)
+            page = seq.page_table[i]
+            existing = self.hash_to_page.get(digest)
+            if existing is None:
+                self.hash_to_page[digest] = page
+                self.page_meta[page] = (digest, tuple(tokens[:_CANARY_TOKENS]))
+            n_hashed = i + 1
+        self._seq_chain[seq.seq_id] = (n_hashed, digest)
+
+    def free_seq(self, seq: Sequence) -> None:
+        super().free_seq(seq)
+        self._seq_chain.pop(seq.seq_id, None)
+
+    @property
+    def cache_hit_rate(self) -> float:
+        return self.hit_tokens / self.query_tokens if self.query_tokens else 0.0
+
+
+def make_memory_manager(num_pages: int, page_size: int,
+                        enable_prefix_caching: bool) -> MemoryManager:
+    cls = PrefixMemoryManager if enable_prefix_caching else MemoryManager
+    return cls(num_pages, page_size)

@@ -1,0 +1,43 @@
+"""Per-request sampling parameters.
+
+Mirrors the parameter surface accepted by the reference engine
+(/root/reference/gllm/llm_engine.py:610-645 and entrypoints/protocol.py):
+temperature / top_p / top_k / repetition_penalty / max_tokens / ignore_eos /
+stop token ids / logprobs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = -1                      # -1 = disabled
+    repetition_penalty: float = 1.0
+    max_tokens: int = 16
+    min_tokens: int = 0
+    ignore_eos: bool = False
+    stop_token_ids: List[int] = dataclasses.field(default_factory=list)
+    logprobs: Optional[int] = None       # top-N logprobs per output token
+    prompt_logprobs: Optional[int] = None
+    seed: Optional[int] = None
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature == 0.0
+
+    def validate(self) -> None:
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if not 0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        if self.top_k == 0 or self.top_k < -1:
+            raise ValueError("top_k must be -1 (disabled) or >= 1")
+        if self.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        if self.repetition_penalty <= 0:
+            raise ValueError("repetition_penalty must be > 0")

@@ -1,0 +1,355 @@
+"""Continuous-batching scheduler.
+
+TPU-native re-design of the reference scheduler
+(/root/reference/gllm/scheduler.py:16-783). Semantics preserved:
+
+- unified token accounting: each step computes tokens
+  ``[computed, computed+n)`` for every scheduled sequence; a sequence whose
+  chunk reaches the end of its known tokens samples a next token. Prefill and
+  decode are the same code path (chunked prefill, reference :386-520).
+- three policies: ``chunked_prefill`` (default), ``token_throttling`` (the
+  SC'25 contribution — prefill budget ramps with KV free ratio + waiting-token
+  smoothing, decode budget split across pipeline microbatches, reference
+  :613-696), ``split_pd`` (pure-prefill else pure-decode batches).
+- SGLang-style adaptive admission: a waiting sequence is admitted only if the
+  cache can hold its chunk plus ``new_token_ratio`` of its expected output;
+  the ratio decays from init to min over steps and resets on preemption
+  (reference :28-45,109-163).
+- largest-first preemption under memory pressure (reference :254-314);
+  preempted sequences return to the head of the waiting queue.
+- abort handling (reference :316-337).
+
+What deliberately does NOT carry over: the reference replicates this scheduler
+deterministically on every TP rank ("column driver") because each GPU is its
+own process. On TPU a single host process drives all local chips through one
+jit'd program, so exactly one scheduler instance exists per DP replica and the
+deterministic-jitter / lockstep machinery is unnecessary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+from gllm_tpu.config import EngineConfig
+from gllm_tpu.memory_manager import MemoryManager
+from gllm_tpu.sequence import Sequence, SequenceStatus
+from gllm_tpu.utils import cdiv
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class ScheduledSeq:
+    seq: Sequence
+    num_new_tokens: int          # tokens computed this step
+    computed_before: int         # seq.num_computed_tokens when scheduled
+
+    @property
+    def samples(self) -> bool:
+        """True when this chunk reaches the end of known tokens → the step
+        produces logits for this sequence and samples a token."""
+        return (self.computed_before + self.num_new_tokens
+                == self.seq.num_tokens)
+
+
+@dataclasses.dataclass
+class ScheduledBatch:
+    items: List[ScheduledSeq]
+
+    @property
+    def num_seqs(self) -> int:
+        return len(self.items)
+
+    @property
+    def total_tokens(self) -> int:
+        return sum(it.num_new_tokens for it in self.items)
+
+    @property
+    def num_decode(self) -> int:
+        return sum(1 for it in self.items if it.num_new_tokens == 1
+                   and not it.seq.is_prefilling)
+
+
+@dataclasses.dataclass
+class SeqOutput:
+    """One step's result for one sequence (engine-facing)."""
+    seq: Sequence
+    new_token_id: Optional[int]
+    finish_reason: Optional[str]
+
+
+class Scheduler:
+    def __init__(self, config: EngineConfig, memory_manager: MemoryManager,
+                 pp_size: int = 1):
+        self.config = config
+        self.sched_cfg = config.scheduler
+        self.mm = memory_manager
+        self.pp_size = max(1, pp_size)
+
+        self.waiting: Deque[Sequence] = deque()
+        self.running: List[Sequence] = []
+        self._aborted_ids: set[int] = set()
+
+        self.new_token_ratio = self.sched_cfg.init_new_token_ratio
+        self._ratio_decay = (
+            (self.sched_cfg.init_new_token_ratio
+             - self.sched_cfg.min_new_token_ratio)
+            / max(1, self.sched_cfg.new_token_ratio_decay_steps))
+        # Rotating offset so decode seqs beyond the per-batch cap are served
+        # round-robin (single-controller analogue of the reference's
+        # deterministic rotating jitter, scheduler.py:368-384).
+        self._decode_offset = 0
+        self._last_stats_time = 0.0
+        self.num_preemptions = 0
+
+    # ---- intake -----------------------------------------------------------
+
+    def add_seq(self, seq: Sequence) -> None:
+        if seq.num_tokens + 1 > self.config.max_model_len:
+            raise ValueError(
+                f"prompt of {seq.num_tokens} tokens exceeds max_model_len "
+                f"{self.config.max_model_len}")
+        seq.status = SequenceStatus.WAITING
+        self.waiting.append(seq)
+
+    def abort_seq(self, seq_id: int) -> None:
+        self._aborted_ids.add(seq_id)
+
+    @property
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    @property
+    def num_unfinished(self) -> int:
+        return len(self.waiting) + len(self.running)
+
+    # ---- policy budgets ---------------------------------------------------
+
+    def _prefill_token_budget(self) -> int:
+        cfg = self.sched_cfg
+        if cfg.schedule_method != "token_throttling":
+            return cfg.max_prefill_tokens
+        # Token throttling (reference scheduler.py:613-696): ramp the prefill
+        # budget with the KV free ratio so prefill backs off as the cache
+        # fills, and smooth it against the amount of waiting prefill work so
+        # pipeline microbatches carry comparable token counts.
+        reserve = cfg.throttle_reserve
+        ramp = (self.mm.free_ratio - reserve) / max(1e-6, 1.0 - reserve)
+        ramp = min(1.0, max(0.0, ramp))
+        budget = int(cfg.max_prefill_tokens * ramp)
+        wait_tokens = sum(s.num_remaining_tokens for s in self.waiting)
+        wait_tokens += sum(s.num_remaining_tokens for s in self.running
+                           if s.num_remaining_tokens > 1)
+        smooth = wait_tokens // max(1, cfg.iter_smooth)
+        budget = min(budget, max(smooth, cfg.min_prefill_tokens))
+        return max(cfg.min_prefill_tokens, min(budget, cfg.max_prefill_tokens))
+
+    def _decode_budget(self) -> int:
+        cfg = self.sched_cfg
+        if cfg.schedule_method == "token_throttling" and self.pp_size > 1:
+            # Split decode work evenly over the pp_size microbatches in
+            # flight (reference scheduler.py:368-384).
+            n_decode = sum(1 for s in self.running
+                           if s.num_remaining_tokens == 1)
+            return min(cfg.max_decode_seqs,
+                       max(1, cdiv(n_decode, self.pp_size)))
+        return cfg.max_decode_seqs
+
+    # ---- preemption -------------------------------------------------------
+
+    def _preempt_one(self, protect: set[int]) -> bool:
+        """Free memory by preempting the largest unprotected running seq."""
+        victims = [s for s in self.running if s.seq_id not in protect]
+        if not victims:
+            return False
+        victim = max(victims, key=lambda s: s.num_tokens)
+        self.running.remove(victim)
+        self.mm.free_seq(victim)
+        victim.preempt()
+        self.waiting.appendleft(victim)
+        self.num_preemptions += 1
+        self.new_token_ratio = self.sched_cfg.init_new_token_ratio
+        logger.debug("preempted seq %d (%d tokens)", victim.seq_id,
+                     victim.num_tokens)
+        return True
+
+    def _allocate_with_preemption(self, seq: Sequence, n_tokens: int,
+                                  protect: set[int]) -> bool:
+        need = self.mm.pages_needed(seq, n_tokens)
+        while not self.mm.can_allocate(need):
+            if not self._preempt_one(protect):
+                return False
+            if seq.status == SequenceStatus.PREEMPTED:
+                return False  # preempted ourselves — nothing left to take
+        self.mm.allocate_seq_pages(seq, n_tokens)
+        return True
+
+    # ---- main entry -------------------------------------------------------
+
+    def schedule_once(self) -> Optional[ScheduledBatch]:
+        self._process_aborts()
+        self._decay_ratio()
+
+        decode_ready = [s for s in self.running if s.num_remaining_tokens == 1]
+        prefill_mid = [s for s in self.running if s.num_remaining_tokens > 1]
+        has_prefill_work = bool(prefill_mid or self.waiting)
+
+        items: List[ScheduledSeq] = []
+        if self.sched_cfg.schedule_method == "split_pd" and has_prefill_work:
+            self._schedule_prefill(items, self._prefill_token_budget())
+            if not items:  # could not admit anything → fall back to decode
+                self._schedule_decode(items, decode_ready)
+        elif self.sched_cfg.schedule_method == "split_pd":
+            self._schedule_decode(items, decode_ready)
+        else:
+            self._schedule_decode(items, decode_ready)
+            self._schedule_prefill(items, self._prefill_token_budget())
+
+        self._maybe_log_stats()
+        return ScheduledBatch(items) if items else None
+
+    def _schedule_decode(self, items: List[ScheduledSeq],
+                         decode_ready: List[Sequence]) -> None:
+        budget = self._decode_budget()
+        if not decode_ready:
+            return
+        # Rotate so capped decode scheduling is fair across iterations.
+        off = self._decode_offset % len(decode_ready)
+        orderd = decode_ready[off:] + decode_ready[:off]
+        self._decode_offset += budget
+        protect = {it.seq.seq_id for it in items}
+        for seq in orderd[:budget]:
+            protect.add(seq.seq_id)
+            if not self._allocate_with_preemption(seq, 1, protect):
+                protect.discard(seq.seq_id)
+                if seq.status == SequenceStatus.RUNNING:
+                    # No victim available — preempt this seq itself so the
+                    # system always makes progress (last-resort
+                    # self-preemption, reference scheduler.py:254-314).
+                    self.running.remove(seq)
+                    self.mm.free_seq(seq)
+                    seq.preempt()
+                    self.waiting.appendleft(seq)
+                    self.num_preemptions += 1
+                    self.new_token_ratio = self.sched_cfg.init_new_token_ratio
+                continue
+            items.append(ScheduledSeq(seq, 1, seq.num_computed_tokens))
+
+    def _schedule_prefill(self, items: List[ScheduledSeq],
+                          token_budget: int) -> None:
+        protect = {it.seq.seq_id for it in items}
+        max_seqs = self.config.max_num_seqs
+
+        # 1) continue partially prefilled running seqs (already admitted).
+        for seq in [s for s in self.running if s.num_remaining_tokens > 1]:
+            if token_budget <= 0 or len(items) >= max_seqs:
+                break
+            n = min(seq.num_remaining_tokens, token_budget)
+            protect.add(seq.seq_id)
+            if not self._allocate_with_preemption(seq, n, protect):
+                protect.discard(seq.seq_id)
+                continue
+            items.append(ScheduledSeq(seq, n, seq.num_computed_tokens))
+            token_budget -= n
+
+        # 2) admit from the waiting queue, FIFO with head-of-line blocking
+        #    (matches the reference; no starvation of long prompts).
+        while (self.waiting and token_budget > 0
+               and len(self.running) < self.config.max_num_seqs
+               and len(items) < max_seqs):
+            seq = self.waiting[0]
+            if seq.seq_id in self._aborted_ids:
+                self.waiting.popleft()
+                self._finish_abort(seq)
+                continue
+            if seq.num_computed_tokens == 0 and not seq.page_table:
+                self.mm.match_prefix(seq)
+            n = min(seq.num_remaining_tokens, token_budget)
+            # Adaptive admission: reserve room for the chunk plus
+            # new_token_ratio of the expected decode output.
+            est_extra = int(seq.sampling_params.max_tokens
+                            * self.new_token_ratio)
+            need = self.mm.pages_needed(seq, n) + cdiv(
+                est_extra, self.mm.page_size)
+            if not self.mm.can_allocate(need):
+                break
+            self.mm.allocate_seq_pages(seq, n)
+            self.waiting.popleft()
+            seq.status = SequenceStatus.RUNNING
+            self.running.append(seq)
+            items.append(ScheduledSeq(seq, n, seq.num_computed_tokens))
+            token_budget -= n
+
+    # ---- output path ------------------------------------------------------
+
+    def process_output(self, batch: ScheduledBatch,
+                       sampled_tokens: List[int],
+                       eos_token_id: Optional[int]) -> List[SeqOutput]:
+        """Advance state after a step. ``sampled_tokens[i]`` is the sampled
+        token for batch item i (ignored for items that don't sample)."""
+        outputs: List[SeqOutput] = []
+        for it, tok in zip(batch.items, sampled_tokens):
+            seq = it.seq
+            if seq.seq_id in self._aborted_ids:
+                continue  # handled in _process_aborts
+            if seq.status is not SequenceStatus.RUNNING:
+                continue  # preempted after scheduling (shouldn't happen)
+            seq.num_computed_tokens = it.computed_before + it.num_new_tokens
+            new_token: Optional[int] = None
+            finish: Optional[str] = None
+            if it.samples:
+                seq.append_token(int(tok))
+                new_token = int(tok)
+                finish = seq.check_finish(eos_token_id)
+            self.mm.register_computed_pages(seq)
+            if finish is not None:
+                seq.status = SequenceStatus.FINISHED
+                seq.finish_reason = finish
+                self.running.remove(seq)
+                self.mm.free_seq(seq)
+            outputs.append(SeqOutput(seq, new_token, finish))
+        return outputs
+
+    # ---- aborts / stats ---------------------------------------------------
+
+    def _finish_abort(self, seq: Sequence) -> None:
+        seq.status = SequenceStatus.ABORTED
+        seq.finish_reason = "abort"
+        self.mm.free_seq(seq)
+        self._aborted_ids.discard(seq.seq_id)
+
+    def _process_aborts(self) -> None:
+        if not self._aborted_ids:
+            return
+        for seq in [s for s in self.running
+                    if s.seq_id in self._aborted_ids]:
+            self.running.remove(seq)
+            self._finish_abort(seq)
+        for seq in [s for s in self.waiting
+                    if s.seq_id in self._aborted_ids]:
+            self.waiting.remove(seq)
+            self._finish_abort(seq)
+
+    def _decay_ratio(self) -> None:
+        self.new_token_ratio = max(self.sched_cfg.min_new_token_ratio,
+                                   self.new_token_ratio - self._ratio_decay)
+
+    def _maybe_log_stats(self) -> None:
+        # 1 Hz stats line (reference scheduler.py:576-603).
+        now = time.monotonic()
+        if now - self._last_stats_time < 1.0:
+            return
+        self._last_stats_time = now
+        n_decode = sum(1 for s in self.running if s.num_remaining_tokens == 1)
+        n_prefill = len(self.running) - n_decode
+        util = 1.0 - self.mm.free_ratio
+        hit = getattr(self.mm, "cache_hit_rate", None)
+        logger.info(
+            "sched: wait=%d run=%d prefill=%d decode=%d kv_util=%.1f%%%s",
+            len(self.waiting), len(self.running), n_prefill, n_decode,
+            util * 100.0,
+            f" cache_hit={hit*100.0:.1f}%" if hit is not None else "")

@@ -1,0 +1,109 @@
+"""Per-request sequence state.
+
+TPU-native analogue of the reference Sequence
+(/root/reference/gllm/sequence.py:8-177): all known token ids (prompt +
+generated), the count of tokens whose KV is resident (``num_computed_tokens``),
+the page table, sampling params, and lifecycle status. Prefill and decode are
+unified: every schedule step computes tokens [computed, computed+n); a step
+whose chunk reaches the end of the known tokens produces logits and samples.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List, Optional
+
+from gllm_tpu.sampling_params import SamplingParams
+
+
+class SequenceStatus(enum.Enum):
+    WAITING = enum.auto()
+    RUNNING = enum.auto()
+    PREEMPTED = enum.auto()
+    FINISHED = enum.auto()
+    ABORTED = enum.auto()
+
+
+class Sequence:
+    def __init__(
+        self,
+        seq_id: int,
+        prompt_token_ids: List[int],
+        sampling_params: Optional[SamplingParams] = None,
+        arrival_time: float = 0.0,
+    ):
+        self.seq_id = seq_id
+        self.token_ids: List[int] = list(prompt_token_ids)
+        # raw vs dynamic prompt length: multimodal models splice placeholder
+        # spans, growing the effective prompt (reference sequence.py raw_prompt_len).
+        self.raw_prompt_len = len(prompt_token_ids)
+        self.prompt_len = len(prompt_token_ids)
+        self.sampling_params = sampling_params or SamplingParams()
+        self.arrival_time = arrival_time
+
+        self.status = SequenceStatus.WAITING
+        self.num_computed_tokens = 0
+        self.page_table: List[int] = []
+        # Pages whose contents came from the prefix cache (KV already valid).
+        self.num_cached_tokens = 0
+        self.finish_reason: Optional[str] = None
+        # Incremental detokenization state (reference sequence.py detokenize_inc).
+        self.last_detok_offset = 0
+        self.output_text = ""
+
+    # ---- token accounting -------------------------------------------------
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.token_ids)
+
+    @property
+    def num_output_tokens(self) -> int:
+        return len(self.token_ids) - self.prompt_len
+
+    @property
+    def output_token_ids(self) -> List[int]:
+        return self.token_ids[self.prompt_len:]
+
+    @property
+    def num_remaining_tokens(self) -> int:
+        """Tokens not yet computed into the KV cache."""
+        return len(self.token_ids) - self.num_computed_tokens
+
+    @property
+    def is_prefilling(self) -> bool:
+        return self.num_computed_tokens < self.prompt_len
+
+    def append_token(self, token_id: int) -> None:
+        self.token_ids.append(token_id)
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def preempt(self) -> None:
+        """Return to waiting state; KV pages are released by the caller
+        (reference sequence.py preempt + scheduler.py:254-314)."""
+        self.status = SequenceStatus.PREEMPTED
+        self.num_computed_tokens = 0
+        self.num_cached_tokens = 0
+        self.page_table = []
+
+    def check_finish(self, eos_token_id: Optional[int]) -> Optional[str]:
+        """EOS / stop-token / length check after a token was appended."""
+        sp = self.sampling_params
+        last = self.token_ids[-1]
+        if self.num_output_tokens >= sp.min_tokens:
+            if not sp.ignore_eos and eos_token_id is not None and last == eos_token_id:
+                return "stop"
+            if last in sp.stop_token_ids:
+                return "stop"
+        if self.num_output_tokens >= sp.max_tokens:
+            return "length"
+        return None
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status in (SequenceStatus.FINISHED, SequenceStatus.ABORTED)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"Sequence(id={self.seq_id}, tokens={self.num_tokens}, "
+                f"computed={self.num_computed_tokens}, status={self.status.name})")

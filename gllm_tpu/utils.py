@@ -1,0 +1,35 @@
+"""Small shared helpers (shape bucketing, math).
+
+The bucketing helpers implement the static-shape discipline XLA wants: every
+jit-compiled step function sees only a small set of padded shapes, mirroring the
+reference engine's power-of-two CUDA-graph buckets
+(/root/reference/gllm/model_runner.py:471-489).
+"""
+
+from __future__ import annotations
+
+
+def cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+def round_up(x: int, multiple: int) -> int:
+    return cdiv(x, multiple) * multiple
+
+
+def next_pow2(x: int, minimum: int = 1) -> int:
+    """Smallest power of two >= max(x, minimum)."""
+    v = max(x, minimum, 1)
+    return 1 << (v - 1).bit_length()
+
+
+def bucket_size(x: int, minimum: int, maximum: int) -> int:
+    """Pad ``x`` to a power-of-two bucket, clamped to [minimum, maximum].
+
+    Keeps the number of distinct compiled shapes logarithmic in the range —
+    the XLA-compilation-cache analogue of the reference's CUDA-graph bucket
+    table (/root/reference/gllm/model_runner.py:1525-1615).
+    """
+    if x > maximum:
+        raise ValueError(f"size {x} exceeds maximum bucket {maximum}")
+    return min(next_pow2(x, minimum), maximum)

@@ -1,0 +1,14 @@
+"""Test harness: force CPU jax with 8 virtual devices.
+
+Multi-device TP/DP/EP/PP logic is tested on a virtual CPU mesh (the reference
+tests its distributed modes as multi-process single-host for the same reason —
+SURVEY.md §4). Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,200 @@
+"""Scheduler unit tests: pure-Python, no device needed."""
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.memory_manager import make_memory_manager
+from gllm_tpu.sampling_params import SamplingParams
+from gllm_tpu.scheduler import Scheduler
+from gllm_tpu.sequence import Sequence, SequenceStatus
+
+EOS = 2
+
+
+def make_engine(num_pages=64, page_size=4, maxp=16, maxd=8,
+                method="chunked_prefill", prefix=False, max_num_seqs=32):
+    cfg = EngineConfig(
+        max_model_len=num_pages * page_size,
+        max_num_seqs=max_num_seqs,
+        scheduler=SchedulerConfig(schedule_method=method,
+                                  max_prefill_tokens=maxp,
+                                  min_prefill_tokens=4,
+                                  max_decode_seqs=maxd),
+        cache=CacheConfig(page_size=page_size, num_pages=num_pages,
+                          enable_prefix_caching=prefix),
+    )
+    mm = make_memory_manager(num_pages, page_size, prefix)
+    return cfg, mm, Scheduler(cfg, mm)
+
+
+def run_steps(sched, n_steps, sample_token=7, eos=EOS):
+    """Drive the scheduler with a fake model that always samples
+    ``sample_token``. Returns all SeqOutputs."""
+    outs = []
+    for _ in range(n_steps):
+        batch = sched.schedule_once()
+        if batch is None:
+            break
+        tokens = [sample_token] * batch.num_seqs
+        outs.extend(sched.process_output(batch, tokens, eos))
+    return outs
+
+
+def test_prefill_then_decode_until_length():
+    _, _, sched = make_engine()
+    seq = Sequence(0, list(range(10)), SamplingParams(max_tokens=3))
+    sched.add_seq(seq)
+
+    batch = sched.schedule_once()
+    assert batch.num_seqs == 1
+    assert batch.items[0].num_new_tokens == 10
+    assert batch.items[0].samples
+    sched.process_output(batch, [7], EOS)
+    assert seq.num_computed_tokens == 10
+    assert seq.token_ids[-1] == 7
+
+    # two more decode steps hit max_tokens=3
+    run_steps(sched, 10)
+    assert seq.status is SequenceStatus.FINISHED
+    assert seq.finish_reason == "length"
+    assert seq.output_token_ids == [7, 7, 7]
+    assert not sched.has_unfinished
+    assert sched.mm.num_free_pages == sched.mm.allocator.num_total
+
+
+def test_eos_finishes():
+    _, _, sched = make_engine()
+    seq = Sequence(0, [1, 3, 4], SamplingParams(max_tokens=50))
+    sched.add_seq(seq)
+    run_steps(sched, 5, sample_token=EOS)
+    assert seq.finish_reason == "stop"
+    assert seq.output_token_ids == [EOS]
+
+
+def test_chunked_prefill_spans_iterations():
+    _, _, sched = make_engine(maxp=8)
+    seq = Sequence(0, list(range(20)), SamplingParams(max_tokens=2))
+    sched.add_seq(seq)
+
+    b1 = sched.schedule_once()
+    assert b1.items[0].num_new_tokens == 8
+    assert not b1.items[0].samples
+    sched.process_output(b1, [0], EOS)
+    assert seq.num_computed_tokens == 8
+    assert seq.num_tokens == 20  # no token appended mid-prefill
+
+    b2 = sched.schedule_once()
+    assert b2.items[0].num_new_tokens == 8
+    sched.process_output(b2, [0], EOS)
+
+    b3 = sched.schedule_once()
+    assert b3.items[0].num_new_tokens == 4
+    assert b3.items[0].samples
+    sched.process_output(b3, [9], EOS)
+    assert seq.token_ids[-1] == 9
+
+
+def test_decode_and_prefill_mixed_batch():
+    _, _, sched = make_engine(maxp=16)
+    a = Sequence(0, list(range(4)), SamplingParams(max_tokens=10))
+    sched.add_seq(a)
+    run_steps(sched, 1)  # a prefilled, now decoding
+    b = Sequence(1, list(range(6)), SamplingParams(max_tokens=10))
+    sched.add_seq(b)
+    batch = sched.schedule_once()
+    kinds = {it.seq.seq_id: it.num_new_tokens for it in batch.items}
+    assert kinds == {0: 1, 1: 6}
+
+
+def test_preemption_under_pressure_and_recovery():
+    # 8 usable pages of 4 tokens = 32 KV slots. Both seqs pass adaptive
+    # admission (new_token_ratio under-reserves), then their decode growth
+    # (2 × 20 tokens = 10 pages) collides → preemption must kick in and both
+    # must still run to completion.
+    _, mm, sched = make_engine(num_pages=9, page_size=4, maxp=32)
+    a = Sequence(0, list(range(4)), SamplingParams(max_tokens=16))
+    b = Sequence(1, list(range(4)), SamplingParams(max_tokens=16))
+    sched.add_seq(a)
+    sched.add_seq(b)
+    outs = run_steps(sched, 60)
+    # Both must finish despite preemptions; all pages returned.
+    assert a.status is SequenceStatus.FINISHED
+    assert b.status is SequenceStatus.FINISHED
+    assert sched.num_preemptions > 0
+    assert mm.num_free_pages == mm.allocator.num_total
+    assert len(a.output_token_ids) == 16
+    assert len(b.output_token_ids) == 16
+
+
+def test_abort_waiting_and_running():
+    _, mm, sched = make_engine()
+    a = Sequence(0, list(range(4)), SamplingParams(max_tokens=50))
+    b = Sequence(1, list(range(4)), SamplingParams(max_tokens=50))
+    sched.add_seq(a)
+    sched.add_seq(b)
+    run_steps(sched, 2)
+    sched.abort_seq(0)  # running
+    sched.abort_seq(1)  # running
+    sched.schedule_once()
+    assert a.status is SequenceStatus.ABORTED
+    assert b.status is SequenceStatus.ABORTED
+    assert mm.num_free_pages == mm.allocator.num_total
+    assert not sched.has_unfinished
+
+
+def test_decode_cap_rotates_fairly():
+    _, _, sched = make_engine(maxd=2, maxp=64)
+    seqs = [Sequence(i, list(range(4)), SamplingParams(max_tokens=50))
+            for i in range(4)]
+    for s in seqs:
+        sched.add_seq(s)
+    run_steps(sched, 1)  # all prefill in one batch
+    for _ in range(8):
+        batch = sched.schedule_once()
+        assert batch.num_seqs <= 2
+        sched.process_output(batch, [7] * batch.num_seqs, EOS)
+    # every seq decoded roughly equally
+    counts = [s.num_output_tokens for s in seqs]
+    assert max(counts) - min(counts) <= 1
+
+
+def test_split_pd_batches_are_pure():
+    _, _, sched = make_engine(method="split_pd")
+    a = Sequence(0, list(range(4)), SamplingParams(max_tokens=10))
+    sched.add_seq(a)
+    b1 = sched.schedule_once()  # pure prefill
+    assert all(it.seq.is_prefilling for it in b1.items)
+    sched.process_output(b1, [7], EOS)
+    b = Sequence(1, list(range(4)), SamplingParams(max_tokens=10))
+    sched.add_seq(b)
+    b2 = sched.schedule_once()  # prefill work exists → prefill-only batch
+    assert [it.seq.seq_id for it in b2.items] == [1]
+    sched.process_output(b2, [7], EOS)
+    b3 = sched.schedule_once()  # now pure decode
+    assert sorted(it.seq.seq_id for it in b3.items) == [0, 1]
+    assert all(it.num_new_tokens == 1 for it in b3.items)
+
+
+def test_token_throttling_budget_shrinks_as_cache_fills():
+    cfg, mm, sched = make_engine(num_pages=17, page_size=4, maxp=32,
+                                 method="token_throttling")
+    # empty cache → full budget
+    full = sched._prefill_token_budget()
+    a = Sequence(0, list(range(48)), SamplingParams(max_tokens=4))
+    sched.add_seq(a)
+    run_steps(sched, 1)
+    pressured = sched._prefill_token_budget()
+    assert pressured <= full
+
+
+def test_prefix_cache_via_scheduler():
+    _, mm, sched = make_engine(prefix=True, maxp=64)
+    a = Sequence(0, list(range(16)), SamplingParams(max_tokens=2))
+    sched.add_seq(a)
+    run_steps(sched, 10)
+    assert a.status is SequenceStatus.FINISHED
+    b = Sequence(1, list(range(16)), SamplingParams(max_tokens=2))
+    sched.add_seq(b)
+    batch = sched.schedule_once()
+    # 3 full pages (12 tokens) of the prompt hit the cache.
+    assert batch.items[0].num_new_tokens == 4
+    assert batch.items[0].computed_before == 12
+    assert b.num_cached_tokens == 12
