@@ -1,0 +1,112 @@
+"""Ragged paged attention — dispatch + XLA reference implementation.
+
+This is the core attention path, covering what the reference gets from
+sgl_kernel's ``flash_attn_with_kvcache`` / ``flash_attn_varlen_func``
+(/root/reference/gllm/layers/attention.py:92-140): one varlen call serving a
+mixed batch of prefill chunks and decode rows against the paged KV cache, with
+causal masking relative to each sequence's already-computed context (chunked
+prefill attends to all cached tokens plus the causal part of its own chunk).
+
+Two implementations:
+- ``xla``: gather-based reference. Runs on any backend (CPU tests, fallback),
+  numerically the oracle for the Pallas kernel.
+- ``pallas``: the TPU kernel (gllm_tpu/ops/pallas/ragged_paged_attention.py),
+  double-buffered DMA over HBM KV pages.
+
+Metadata layout (built by the runner, all padded to static bucket shapes):
+- cu_q_lens: [S+1] int32 — cumulative query lengths (padded seqs repeat the
+  last value → q_len 0)
+- kv_lens:   [S] int32 — per-seq total context AFTER this step's tokens
+- page_table:[S, max_pages] int32 — padded entries point at the dummy page
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class AttentionMetadata(NamedTuple):
+    cu_q_lens: jnp.ndarray    # [S+1] int32
+    kv_lens: jnp.ndarray      # [S] int32
+    page_table: jnp.ndarray   # [S, max_pages] int32
+    num_seqs: jnp.ndarray     # [] int32 (informational; padding is masked
+                              # out via q_len == 0 rows)
+
+
+NEG_INF = float("-inf")
+
+
+@functools.partial(jax.jit, static_argnames=("max_q_len", "scale", "impl"))
+def paged_attention(
+    q: jnp.ndarray,            # [T, Hq, D]
+    k_cache: jnp.ndarray,      # [num_pages, page_size, Hkv, D]
+    v_cache: jnp.ndarray,
+    metadata: AttentionMetadata,
+    *,
+    scale: float,
+    max_q_len: int,
+    impl: str = "xla",
+) -> jnp.ndarray:
+    if impl == "xla":
+        return _xla_paged_attention(q, k_cache, v_cache, metadata,
+                                    scale=scale, max_q_len=max_q_len)
+    if impl == "pallas":
+        try:
+            from gllm_tpu.ops.pallas.ragged_paged_attention import (
+                ragged_paged_attention)
+        except ImportError as e:  # kernel not built yet / wrong platform
+            raise NotImplementedError(
+                "pallas ragged paged attention kernel unavailable; "
+                "use impl='xla'") from e
+        return ragged_paged_attention(q, k_cache, v_cache, metadata,
+                                      scale=scale, max_q_len=max_q_len)
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def _xla_paged_attention(q, k_cache, v_cache, md: AttentionMetadata, *,
+                         scale: float, max_q_len: int):
+    T, num_q_heads, head_dim = q.shape
+    num_pages, page_size, num_kv_heads, _ = k_cache.shape
+    S, max_pages = md.page_table.shape
+    group = num_q_heads // num_kv_heads
+    max_kv = max_pages * page_size
+
+    q_lens = md.cu_q_lens[1:] - md.cu_q_lens[:-1]                    # [S]
+    # Gather per-seq query rows → [S, Qmax, Hq, D]
+    local_q = jnp.arange(max_q_len, dtype=jnp.int32)                 # [Qmax]
+    q_idx = jnp.clip(md.cu_q_lens[:-1, None] + local_q[None, :], 0, T - 1)
+    q_valid = local_q[None, :] < q_lens[:, None]                     # [S, Qmax]
+    qg = q[q_idx]                                                    # [S,Qmax,Hq,D]
+
+    # Gather per-seq KV pages → [S, max_kv, Hkv, D]
+    kg = k_cache[md.page_table].reshape(S, max_kv, num_kv_heads, head_dim)
+    vg = v_cache[md.page_table].reshape(S, max_kv, num_kv_heads, head_dim)
+
+    # Causal+context mask: query at local index t has absolute position
+    # kv_len - q_len + t; key j is visible iff j <= that position.
+    kv_pos = jnp.arange(max_kv, dtype=jnp.int32)                     # [K]
+    q_pos = (md.kv_lens[:, None] - q_lens[:, None] + local_q[None, :])
+    visible = (kv_pos[None, None, :] <= q_pos[:, :, None])           # [S,Q,K]
+    visible &= (kv_pos[None, None, :] < md.kv_lens[:, None, None])
+    visible &= q_valid[:, :, None]
+
+    qg = qg.reshape(S, max_q_len, num_kv_heads, group, head_dim)
+    scores = jnp.einsum("sqhgd,skhd->shgqk", qg.astype(jnp.float32),
+                        kg.astype(jnp.float32)) * scale
+    scores = jnp.where(visible[:, None, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # Rows with no visible keys (padding) produce NaN-free zeros:
+    probs = jnp.where(visible[:, None, None, :, :], probs, 0.0)
+    out = jnp.einsum("shgqk,skhd->sqhgd", probs, vg.astype(jnp.float32))
+    out = out.reshape(S, max_q_len, num_q_heads, head_dim).astype(q.dtype)
+
+    # Scatter back to the ragged token layout. Padded/invalid rows carry
+    # zeros and clipped duplicate indices — scatter-add keeps it exact.
+    out = jnp.where(q_valid[:, :, None, None], out, 0)
+    flat = jnp.zeros_like(q)
+    return flat.at[q_idx.reshape(-1)].add(
+        out.reshape(S * max_q_len, num_q_heads, head_dim))

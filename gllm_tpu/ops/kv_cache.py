@@ -1,0 +1,33 @@
+"""Paged KV cache device arrays + write path.
+
+TPU-native equivalent of the reference's reshape_and_cache_flash Triton kernel
+(/root/reference/gllm/layers/ops/cache_kernels.py): new K/V rows are scattered
+into the paged cache at per-token flat slot indices. Under jit with buffer
+donation the scatter lowers to an in-place dynamic-update — no cache copy
+(SURVEY.md §7 hard part 4).
+
+Layout: [num_pages, page_size, num_kv_heads, head_dim] per layer per K/V.
+Flat slot = page_id * page_size + offset; slot 0..page_size-1 live in the
+dummy page (page 0) and absorb writes from padded tokens.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def write_kv(k_cache: jnp.ndarray, v_cache: jnp.ndarray,
+             k: jnp.ndarray, v: jnp.ndarray,
+             slot_mapping: jnp.ndarray):
+    """Scatter new K/V rows into the paged cache.
+
+    k_cache/v_cache: [num_pages, page_size, Hkv, D]
+    k/v:             [T, Hkv, D] (this step's projected keys/values, post-rope)
+    slot_mapping:    [T] int32 flat slots (padding → dummy-page slots)
+    """
+    num_pages, page_size, hkv, d = k_cache.shape
+    flat_k = k_cache.reshape(num_pages * page_size, hkv, d)
+    flat_v = v_cache.reshape(num_pages * page_size, hkv, d)
+    flat_k = flat_k.at[slot_mapping].set(k.astype(flat_k.dtype))
+    flat_v = flat_v.at[slot_mapping].set(v.astype(flat_v.dtype))
+    return (flat_k.reshape(k_cache.shape), flat_v.reshape(v_cache.shape))

@@ -1,0 +1,97 @@
+"""Rotary position embeddings.
+
+Covers the reference's RotaryEmbedding family
+(/root/reference/gllm/layers/rotary_embedding.py): base NeoX-style rotation
+plus linear / llama3 frequency scaling. YaRN (DeepSeek MLA) and mrope
+(vision models) extend these tables in later modules.
+
+Design: the cos/sin table is precomputed once per model ([max_pos, rot_dim/2],
+float32) and gathered by token position inside the jit'd step — a cheap
+[T, rot_dim/2] gather that XLA fuses; no per-layer recompute.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+
+def _base_inv_freq(rot_dim: int, theta: float) -> jnp.ndarray:
+    return 1.0 / (theta ** (jnp.arange(0, rot_dim, 2, dtype=jnp.float32)
+                            / rot_dim))
+
+
+def _llama3_scale_inv_freq(inv_freq: jnp.ndarray,
+                           scaling: Dict[str, Any]) -> jnp.ndarray:
+    """Llama-3.x rope scaling (reference rotary_embedding.py Llama3 variant)."""
+    factor = scaling.get("factor", 8.0)
+    low_factor = scaling.get("low_freq_factor", 1.0)
+    high_factor = scaling.get("high_freq_factor", 4.0)
+    orig_max = scaling.get("original_max_position_embeddings", 8192)
+
+    low_wavelen = orig_max / low_factor
+    high_wavelen = orig_max / high_factor
+    wavelen = 2 * math.pi / inv_freq
+    # three bands: scale fully / don't scale / smooth interpolation
+    smooth = ((orig_max / wavelen - low_factor)
+              / (high_factor - low_factor))
+    scaled = jnp.where(
+        wavelen > low_wavelen, inv_freq / factor,
+        jnp.where(wavelen < high_wavelen, inv_freq,
+                  (1 - smooth) * inv_freq / factor + smooth * inv_freq))
+    return scaled
+
+
+def compute_rope_cos_sin(
+    rot_dim: int,
+    max_position: int,
+    theta: float = 10000.0,
+    rope_scaling: Optional[Dict[str, Any]] = None,
+) -> jnp.ndarray:
+    """Returns [max_position, rot_dim] table: concat(cos, sin) halves."""
+    inv_freq = _base_inv_freq(rot_dim, theta)
+    positions = jnp.arange(max_position, dtype=jnp.float32)
+    if rope_scaling:
+        rtype = rope_scaling.get("rope_type",
+                                 rope_scaling.get("type", "default"))
+        if rtype in ("linear",):
+            positions = positions / rope_scaling.get("factor", 1.0)
+        elif rtype in ("llama3",):
+            inv_freq = _llama3_scale_inv_freq(inv_freq, rope_scaling)
+        elif rtype in ("default", "mrope", None):
+            pass
+        else:
+            raise NotImplementedError(f"rope scaling type {rtype!r}")
+    freqs = jnp.outer(positions, inv_freq)          # [max_pos, rot_dim/2]
+    return jnp.concatenate([jnp.cos(freqs), jnp.sin(freqs)], axis=-1)
+
+
+def apply_rope(q: jnp.ndarray, k: jnp.ndarray, positions: jnp.ndarray,
+               cos_sin: jnp.ndarray):
+    """NeoX-style (rotate-half) rotary embedding.
+
+    q: [T, Hq, D], k: [T, Hkv, D], positions: [T] int32,
+    cos_sin: [max_pos, rot_dim] precomputed table. rot_dim may be < D
+    (partial rotary, e.g. ChatGLM); the tail passes through.
+    """
+    rot_dim = cos_sin.shape[-1]
+    half = rot_dim // 2
+    cs = cos_sin[positions]                          # [T, rot_dim]
+    cos = cs[:, :half][:, None, :]                   # [T, 1, half]
+    sin = cs[:, half:][:, None, :]
+
+    def rotate(x):
+        x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
+        x1, x2 = x_rot[..., :half], x_rot[..., half:]
+        x1f = x1.astype(jnp.float32)
+        x2f = x2.astype(jnp.float32)
+        o1 = x1f * cos - x2f * sin
+        o2 = x2f * cos + x1f * sin
+        out = jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+        if x_pass.shape[-1]:
+            out = jnp.concatenate([out, x_pass], axis=-1)
+        return out
+
+    return rotate(q), rotate(k)

@@ -1,0 +1,94 @@
+"""On-device sampling.
+
+Covers the reference Sampler (/root/reference/gllm/layers/sampler.py:22-106):
+greedy fast path (argmax, temperature skipped), fused top-k/top-p sampling
+(sgl_kernel top_k_top_p_sampling_from_probs → here a sorted-mask + Gumbel
+argmax, one fused XLA program), scaling repetition penalty
+(layers/repetition_penalty.py Triton kernel → a masked elementwise op over a
+token-presence mask), and logprob computation.
+
+Everything is batched over the padded seq axis with per-seq parameters so one
+compiled program serves any mix of greedy/sampled requests.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class SamplingMetadata(NamedTuple):
+    temperature: jnp.ndarray       # [S] f32; 0.0 → greedy
+    top_p: jnp.ndarray             # [S] f32 in (0, 1]
+    top_k: jnp.ndarray             # [S] i32; >= vocab → disabled
+    # Scaling repetition penalty (reference repetition_penalty.py:40-80):
+    # penalty > 1 scales positive logits down / negative up for seen tokens.
+    repetition_penalty: jnp.ndarray   # [S] f32
+    step_key: jnp.ndarray          # PRNG key for this step
+
+
+def apply_repetition_penalty(logits: jnp.ndarray,
+                             presence_mask: Optional[jnp.ndarray],
+                             penalty: jnp.ndarray) -> jnp.ndarray:
+    """presence_mask: [S, V] bool — tokens that appeared in the sequence."""
+    if presence_mask is None:
+        return logits
+    p = penalty[:, None]
+    penalized = jnp.where(logits > 0, logits / p, logits * p)
+    return jnp.where(presence_mask, penalized, logits)
+
+
+def _topk_topp_mask(logits: jnp.ndarray, top_k: jnp.ndarray,
+                    top_p: jnp.ndarray) -> jnp.ndarray:
+    """Mask logits outside the per-row top-k / top-p nucleus to -inf."""
+    vocab = logits.shape[-1]
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]          # desc
+    # top-k threshold value per row; top_k <= 0 is the "disabled" sentinel
+    # (SamplingParams uses -1) → treat as full vocab.
+    top_k = jnp.where(top_k <= 0, vocab, top_k)
+    k_idx = jnp.clip(top_k - 1, 0, vocab - 1)
+    kth = jnp.take_along_axis(sorted_logits, k_idx[:, None], axis=-1)
+    keep_k = logits >= kth
+
+    # top-p: keep the smallest prefix of sorted probs whose mass reaches p.
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumsum = jnp.cumsum(sorted_probs, axis=-1)
+    # entry i kept iff cumulative mass *before* it is < p
+    keep_sorted = (cumsum - sorted_probs) < top_p[:, None]
+    # threshold = smallest kept logit in sorted order
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf),
+                     axis=-1, keepdims=True)
+    keep_p = logits >= thresh
+
+    return jnp.where(keep_k & keep_p, logits, -jnp.inf)
+
+
+def sample(logits: jnp.ndarray, md: SamplingMetadata,
+           presence_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """logits: [S, V] → sampled token ids [S] int32."""
+    logits = apply_repetition_penalty(logits.astype(jnp.float32),
+                                      presence_mask,
+                                      md.repetition_penalty)
+    greedy_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.maximum(md.temperature, 1e-6)[:, None]
+    scaled = _topk_topp_mask(logits / temp, md.top_k, md.top_p)
+    # Gumbel-max == categorical sampling, stays fused on device.
+    gumbel = jax.random.gumbel(md.step_key, scaled.shape, dtype=jnp.float32)
+    sampled = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+
+    return jnp.where(md.temperature == 0.0, greedy_tokens, sampled)
+
+
+def compute_logprobs(logits: jnp.ndarray, token_ids: jnp.ndarray,
+                     top_n: int):
+    """Log-softmax based logprobs (reference sampler.py:71-91).
+
+    Returns (chosen_logprob [S], top_ids [S, top_n], top_logprobs [S, top_n]).
+    """
+    logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    chosen = jnp.take_along_axis(logprobs, token_ids[:, None], axis=-1)[:, 0]
+    top_vals, top_ids = jax.lax.top_k(logprobs, top_n)
+    return chosen, top_ids.astype(jnp.int32), top_vals

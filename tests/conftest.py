@@ -2,13 +2,22 @@
 
 Multi-device TP/DP/EP/PP logic is tested on a virtual CPU mesh (the reference
 tests its distributed modes as multi-process single-host for the same reason —
-SURVEY.md §4). Must run before jax is imported anywhere.
+SURVEY.md §4). Must run before any test imports jax.
+
+The bench host's axon sitecustomize force-registers the TPU PJRT plugin and
+overrides ``jax_platforms`` to "axon,cpu", which would make tests dial the
+(single-session) TPU tunnel and hang — so we both set the env var for child
+processes and override the config directly.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

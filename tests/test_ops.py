@@ -1,0 +1,263 @@
+"""Kernel-level tests: ops vs straightforward dense references."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gllm_tpu.ops import (apply_rope, compute_rope_cos_sin,
+                          fused_add_rms_norm, paged_attention, rms_norm,
+                          silu_and_mul, write_kv)
+from gllm_tpu.ops.attention import AttentionMetadata
+from gllm_tpu.ops.sampling import SamplingMetadata, sample
+
+
+def test_rms_norm_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((6, 32)).astype(np.float32)
+    w = rng.standard_normal(32).astype(np.float32)
+    got = rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-6)
+    want = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_fused_add_rms_norm():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((4, 16)).astype(np.float32))
+    r = jnp.asarray(rng.standard_normal((4, 16)).astype(np.float32))
+    w = jnp.ones(16, jnp.float32)
+    normed, new_r = fused_add_rms_norm(x, r, w)
+    np.testing.assert_allclose(new_r, x + r, rtol=1e-6)
+    np.testing.assert_allclose(normed, rms_norm(x + r, w), rtol=1e-6)
+
+
+def test_silu_and_mul():
+    x = jnp.asarray(np.linspace(-3, 3, 24, dtype=np.float32).reshape(2, 12))
+    got = silu_and_mul(x)
+    g, u = np.split(np.asarray(x), 2, axis=-1)
+    want = g / (1 + np.exp(-g)) * u
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_rope_rotation_preserves_norm_and_position0_identity():
+    cs = compute_rope_cos_sin(rot_dim=8, max_position=32)
+    q = jnp.asarray(np.random.default_rng(2).standard_normal(
+        (5, 2, 8)).astype(np.float32))
+    k = q.copy()
+    pos = jnp.asarray([0, 1, 2, 3, 4], jnp.int32)
+    q_rot, k_rot = apply_rope(q, k, pos, cs)
+    # position 0 → identity
+    np.testing.assert_allclose(q_rot[0], q[0], atol=1e-6)
+    # rotation preserves norms
+    np.testing.assert_allclose(np.linalg.norm(q_rot, axis=-1),
+                               np.linalg.norm(q, axis=-1), rtol=1e-5)
+    # relative property: <rope(q,m), rope(k,n)> depends only on m-n
+    q1, k1 = apply_rope(q, k, jnp.asarray([3, 4, 5, 6, 7], jnp.int32), cs)
+    d0 = np.einsum("hd,hd->h", np.asarray(q_rot[2]), np.asarray(k_rot[0]))
+    d1 = np.einsum("hd,hd->h", np.asarray(q1[2]), np.asarray(k1[0]))
+    np.testing.assert_allclose(d0, d1, rtol=1e-4)
+
+
+def test_llama3_rope_scaling_changes_low_freqs_only():
+    scaling = {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+               "high_freq_factor": 4.0,
+               "original_max_position_embeddings": 64}
+    base = compute_rope_cos_sin(64, 128)
+    scaled = compute_rope_cos_sin(64, 128, rope_scaling=scaling)
+    assert not np.allclose(base, scaled)
+    # highest-frequency component (index 0) is unchanged
+    np.testing.assert_allclose(base[:, 0], scaled[:, 0], rtol=1e-6)
+
+
+def test_write_kv_scatter():
+    k_cache = jnp.zeros((4, 2, 1, 4), jnp.float32)  # 4 pages × 2 slots
+    v_cache = jnp.zeros_like(k_cache)
+    k_new = jnp.arange(3 * 1 * 4, dtype=jnp.float32).reshape(3, 1, 4)
+    v_new = -k_new
+    slots = jnp.asarray([2, 3, 6], jnp.int32)  # page1 slot0/1, page3 slot0
+    k2, v2 = write_kv(k_cache, v_cache, k_new, v_new, slots)
+    np.testing.assert_allclose(k2[1, 0, 0], k_new[0, 0])
+    np.testing.assert_allclose(k2[1, 1, 0], k_new[1, 0])
+    np.testing.assert_allclose(k2[3, 0, 0], k_new[2, 0])
+    np.testing.assert_allclose(v2[3, 0, 0], v_new[2, 0])
+    assert np.asarray(k2[0]).sum() == 0  # untouched pages stay zero
+
+
+def _dense_reference(q_all, k_all, v_all, scale):
+    """Plain causal attention over full sequences (numpy, f32)."""
+    Tq, Hq, D = q_all.shape
+    Tk = k_all.shape[0]
+    Hkv = k_all.shape[1]
+    group = Hq // Hkv
+    out = np.zeros_like(q_all)
+    for h in range(Hq):
+        kh = k_all[:, h // group]
+        vh = v_all[:, h // group]
+        scores = q_all[:, h] @ kh.T * scale
+        offset = Tk - Tq  # queries are the LAST Tq positions
+        mask = np.tril(np.ones((Tq, Tk)), k=offset).astype(bool)
+        scores = np.where(mask, scores, -np.inf)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        out[:, h] = p @ vh
+    return out
+
+
+def _build_paged(seqs, page_size, num_pages, Hkv, D, rng):
+    """Lay per-seq KV into a paged cache; returns caches + metadata pieces."""
+    k_cache = np.zeros((num_pages, page_size, Hkv, D), np.float32)
+    v_cache = np.zeros((num_pages, page_size, Hkv, D), np.float32)
+    page_tables = []
+    next_page = 1  # page 0 = dummy
+    for k_all, v_all in seqs:
+        kv_len = k_all.shape[0]
+        n_pages = -(-kv_len // page_size)
+        pages = list(range(next_page, next_page + n_pages))
+        next_page += n_pages
+        for i in range(kv_len):
+            p, o = pages[i // page_size], i % page_size
+            k_cache[p, o] = k_all[i]
+            v_cache[p, o] = v_all[i]
+        page_tables.append(pages)
+    max_pages = max(len(p) for p in page_tables)
+    pt = np.zeros((len(seqs), max_pages), np.int32)
+    for i, pages in enumerate(page_tables):
+        pt[i, :len(pages)] = pages
+    return k_cache, v_cache, pt
+
+
+@pytest.mark.parametrize("impl", ["xla"])
+def test_paged_attention_mixed_batch_vs_dense(impl):
+    """3 seqs: a decode row, a chunked-prefill continuation, a fresh prefill."""
+    rng = np.random.default_rng(7)
+    Hq, Hkv, D, page = 4, 2, 16, 4
+    scale = D ** -0.5
+    # (kv_len_total, q_len) — q tokens are the last q_len positions
+    shapes = [(9, 1), (11, 5), (6, 6)]
+    seq_kv, q_rows, want_rows = [], [], []
+    for kv_len, q_len in shapes:
+        k_all = rng.standard_normal((kv_len, Hkv, D)).astype(np.float32)
+        v_all = rng.standard_normal((kv_len, Hkv, D)).astype(np.float32)
+        q_all = rng.standard_normal((q_len, Hq, D)).astype(np.float32)
+        seq_kv.append((k_all, v_all))
+        q_rows.append(q_all)
+        want_rows.append(_dense_reference(q_all, k_all, v_all, scale))
+
+    k_cache, v_cache, pt = _build_paged(seq_kv, page, 16, Hkv, D, rng)
+    T = sum(q for _, q in shapes)
+    T_pad = 16
+    q = np.zeros((T_pad, Hq, D), np.float32)
+    q[:T] = np.concatenate(q_rows, axis=0)
+    cu = np.zeros(len(shapes) + 1, np.int32)
+    cu[1:] = np.cumsum([qq for _, qq in shapes])
+    md = AttentionMetadata(
+        cu_q_lens=jnp.asarray(cu),
+        kv_lens=jnp.asarray([kv for kv, _ in shapes], jnp.int32),
+        page_table=jnp.asarray(pt),
+        num_seqs=jnp.asarray(len(shapes), jnp.int32),
+    )
+    out = paged_attention(jnp.asarray(q), jnp.asarray(k_cache),
+                          jnp.asarray(v_cache), md, scale=scale,
+                          max_q_len=8, impl=impl)
+    out = np.asarray(out)
+    want = np.concatenate(want_rows, axis=0)
+    np.testing.assert_allclose(out[:T], want, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(out[T:], 0.0)  # padded rows untouched
+
+
+def test_paged_attention_padded_seqs_ignored():
+    rng = np.random.default_rng(3)
+    Hq, Hkv, D, page = 2, 1, 8, 4
+    k_all = rng.standard_normal((5, Hkv, D)).astype(np.float32)
+    v_all = rng.standard_normal((5, Hkv, D)).astype(np.float32)
+    q_all = rng.standard_normal((1, Hq, D)).astype(np.float32)
+    k_cache, v_cache, pt = _build_paged([(k_all, v_all)], page, 8, Hkv, D, rng)
+    # pad to 4 seq rows
+    pt_pad = np.zeros((4, pt.shape[1]), np.int32)
+    pt_pad[0] = pt[0]
+    q = np.zeros((4, Hq, D), np.float32)
+    q[0] = q_all[0]
+    md = AttentionMetadata(
+        cu_q_lens=jnp.asarray([0, 1, 1, 1, 1], jnp.int32),
+        kv_lens=jnp.asarray([5, 0, 0, 0], jnp.int32),
+        page_table=jnp.asarray(pt_pad),
+        num_seqs=jnp.asarray(1, jnp.int32),
+    )
+    out = np.asarray(paged_attention(jnp.asarray(q), jnp.asarray(k_cache),
+                                     jnp.asarray(v_cache), md,
+                                     scale=D ** -0.5, max_q_len=1))
+    want = _dense_reference(q_all, k_all, v_all, D ** -0.5)
+    np.testing.assert_allclose(out[0], want[0], rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(out[1:], 0.0)
+    assert not np.isnan(out).any()
+
+
+class TestSampling:
+    def _md(self, S, temp, top_p=1.0, top_k=1 << 30, seed=0):
+        return SamplingMetadata(
+            temperature=jnp.full((S,), temp, jnp.float32),
+            top_p=jnp.full((S,), top_p, jnp.float32),
+            top_k=jnp.full((S,), top_k, jnp.int32),
+            repetition_penalty=jnp.ones((S,), jnp.float32),
+            step_key=jax.random.key(seed),
+        )
+
+    def test_greedy(self):
+        logits = jnp.asarray([[0.1, 3.0, -1.0], [5.0, 0.0, 0.0]])
+        toks = sample(logits, self._md(2, 0.0))
+        assert toks.tolist() == [1, 0]
+
+    def test_top_k_restricts_support(self):
+        logits = jnp.asarray(
+            np.random.default_rng(0).standard_normal((1, 64)).astype(np.float32))
+        top2 = set(np.asarray(jnp.argsort(logits[0])[-2:]).tolist())
+        md = self._md(1, 1.0, top_k=2)
+        seen = set()
+        for s in range(50):
+            md2 = md._replace(step_key=jax.random.key(s))
+            seen.add(int(sample(logits, md2)[0]))
+        assert seen <= top2 and len(seen) == 2
+
+    def test_top_p_restricts_support(self):
+        # one dominant token (p≈0.97) → top_p=0.5 keeps only it
+        logits = jnp.asarray([[10.0, 3.0, 2.0, 1.0]])
+        md = self._md(1, 1.0, top_p=0.5)
+        for s in range(20):
+            md2 = md._replace(step_key=jax.random.key(s))
+            assert int(sample(logits, md2)[0]) == 0
+
+    def test_mixed_greedy_and_random_rows(self):
+        logits = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (4, 32)).astype(np.float32))
+        md = SamplingMetadata(
+            temperature=jnp.asarray([0.0, 1.0, 0.0, 1.0]),
+            top_p=jnp.ones((4,)),
+            top_k=jnp.full((4,), 1 << 30, jnp.int32),
+            repetition_penalty=jnp.ones((4,)),
+            step_key=jax.random.key(0),
+        )
+        toks = sample(logits, md)
+        assert int(toks[0]) == int(jnp.argmax(logits[0]))
+        assert int(toks[2]) == int(jnp.argmax(logits[2]))
+
+    def test_repetition_penalty_discourages_seen_tokens(self):
+        logits = jnp.asarray([[2.0, 1.9]])
+        presence = jnp.asarray([[True, False]])
+        md = self._md(1, 0.0)._replace(
+            repetition_penalty=jnp.asarray([10.0], jnp.float32))
+        toks = sample(logits, md, presence_mask=presence)
+        assert int(toks[0]) == 1
+
+
+def test_top_k_minus_one_means_disabled():
+    # SamplingParams uses -1 as the "disabled" sentinel; the op must not
+    # silently degrade to greedy.
+    logits = jnp.asarray([[1.0, 1.0, 1.0, 1.0]])
+    md = SamplingMetadata(
+        temperature=jnp.asarray([1.0]), top_p=jnp.asarray([1.0]),
+        top_k=jnp.asarray([-1], jnp.int32),
+        repetition_penalty=jnp.ones((1,)), step_key=jax.random.key(0))
+    seen = {int(sample(logits, md._replace(step_key=jax.random.key(s)))[0])
+            for s in range(40)}
+    assert len(seen) > 1  # uniform logits → multiple tokens reachable
