@@ -1,0 +1,31 @@
+"""Device-side batch descriptor.
+
+TPU-native analogue of the reference InputData
+(/root/reference/gllm/input_data.py:13-802): per-step batch metadata laid out
+in flat padded arrays with *static bucketed shapes*, so each (token-bucket,
+seq-bucket, max-q-len) combination maps to exactly one compiled program —
+the jit-compilation-cache counterpart of the reference's persistent device
+buffers + CUDA-graph signature discipline.
+
+The host-side builder lives in gllm_tpu/runner/prepare.py; this module only
+defines the structure the jit'd step function consumes.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from gllm_tpu.ops.attention import AttentionMetadata
+from gllm_tpu.ops.sampling import SamplingMetadata
+
+
+class StepBatch(NamedTuple):
+    token_ids: jnp.ndarray       # [T] int32, padded with 0
+    positions: jnp.ndarray       # [T] int32 (absolute position in sequence)
+    slot_mapping: jnp.ndarray    # [T] int32 flat KV slots (padding → dummy)
+    logits_indices: jnp.ndarray  # [S] int32 index of last token per seq in
+                                 # the token buffer (padded rows repeat 0)
+    attn: AttentionMetadata
+    sampling: SamplingMetadata

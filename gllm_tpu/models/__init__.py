@@ -1,0 +1,11 @@
+"""Model zoo: functional jax models in the stacked-scan layout.
+
+See gllm_tpu/models/dense.py for the canonical decoder shape (reference
+counterpart: /root/reference/gllm/models/qwen2.py) and registry.py for the
+architecture table.
+"""
+
+from gllm_tpu.models.config import ModelConfig, from_hf_config
+from gllm_tpu.models.registry import ModelDef, get_model_def
+
+__all__ = ["ModelConfig", "ModelDef", "from_hf_config", "get_model_def"]

@@ -1,0 +1,114 @@
+"""Model configuration, parsed from HF config.json dicts.
+
+The reference threads serving decisions through the HF config object
+(/root/reference/gllm/model_loader.py:188-334 propagate_*). We instead parse
+into one frozen dataclass that the functional model code closes over — every
+field is static at trace time, which is what jit wants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    architecture: str
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    rope_scaling: Optional[Dict[str, Any]] = None
+    max_position: int = 8192
+    tie_word_embeddings: bool = False
+    attention_bias: bool = False      # qwen2-style qkv bias
+    qk_norm: bool = False             # qwen3-style per-head q/k RMSNorm
+    eos_token_id: Optional[int] = None
+    bos_token_id: Optional[int] = None
+    hidden_act: str = "silu"
+    # MoE fields (0 experts → dense). See gllm_tpu/models/moe.py.
+    num_experts: int = 0
+    num_experts_per_tok: int = 0
+    moe_intermediate_size: int = 0
+    num_shared_experts: int = 0
+    norm_topk_prob: bool = True
+    decoder_sparse_step: int = 1      # every Nth layer is MoE (qwen2-moe)
+    mlp_only_layers: Tuple[int, ...] = ()
+    shared_expert_intermediate_size: int = 0
+
+    # Pipeline-parallel stage slice (rank-aware model construction like the
+    # reference's per-stage layer builds, qwen2.py:186-270). Full model by
+    # default.
+    first_layer: int = 0
+    last_layer: int = -1              # exclusive; -1 → num_layers
+
+    @property
+    def stage_layers(self) -> Tuple[int, int]:
+        last = self.num_layers if self.last_layer < 0 else self.last_layer
+        return (self.first_layer, last)
+
+    @property
+    def num_stage_layers(self) -> int:
+        a, b = self.stage_layers
+        return b - a
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.first_layer == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_layers[1] == self.num_layers
+
+
+def _first_eos(v) -> Optional[int]:
+    if isinstance(v, list):
+        return v[0] if v else None
+    return v
+
+
+def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
+    """Parse an HF config.json dict into a ModelConfig."""
+    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    num_heads = hf["num_attention_heads"]
+    hidden = hf["hidden_size"]
+    head_dim = hf.get("head_dim") or hidden // num_heads
+    qk_norm = arch in ("Qwen3ForCausalLM", "Qwen3MoeForCausalLM")
+    attention_bias = hf.get("attention_bias",
+                            arch in ("Qwen2ForCausalLM",
+                                     "Qwen2MoeForCausalLM"))
+    return ModelConfig(
+        architecture=arch,
+        vocab_size=hf["vocab_size"],
+        hidden_size=hidden,
+        num_layers=hf["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=hf.get("num_key_value_heads", num_heads),
+        head_dim=head_dim,
+        intermediate_size=hf["intermediate_size"],
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-6),
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rope_scaling=hf.get("rope_scaling"),
+        max_position=hf.get("max_position_embeddings", 8192),
+        tie_word_embeddings=hf.get("tie_word_embeddings", False),
+        attention_bias=attention_bias,
+        qk_norm=qk_norm,
+        eos_token_id=_first_eos(hf.get("eos_token_id")),
+        bos_token_id=_first_eos(hf.get("bos_token_id")),
+        hidden_act=hf.get("hidden_act", "silu"),
+        num_experts=hf.get("num_experts",
+                           hf.get("num_local_experts", 0) or 0),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 0) or 0,
+        moe_intermediate_size=hf.get("moe_intermediate_size", 0) or 0,
+        norm_topk_prob=hf.get("norm_topk_prob", True),
+        decoder_sparse_step=hf.get("decoder_sparse_step", 1),
+        mlp_only_layers=tuple(hf.get("mlp_only_layers", []) or []),
+        shared_expert_intermediate_size=hf.get(
+            "shared_expert_intermediate_size", 0) or 0,
+    )

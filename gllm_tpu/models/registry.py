@@ -1,0 +1,69 @@
+"""Architecture registry.
+
+Maps HF ``architectures[0]`` strings to model definitions, like the
+reference's architecture→class table (/root/reference/gllm/model_loader.py:
+499-536). A ModelDef bundles the functional pieces the runner needs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict
+
+from gllm_tpu.models.config import ModelConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDef:
+    family: str
+    init_params: Callable
+    forward: Callable
+    compute_logits: Callable
+    make_rope_table: Callable
+    load_params: Callable          # (model_dir, cfg, dtype) -> params
+    init_kv_cache: Callable
+
+
+def _dense_def() -> ModelDef:
+    from gllm_tpu.models import dense, loader
+    return ModelDef(
+        family="dense",
+        init_params=dense.init_params,
+        forward=dense.forward,
+        compute_logits=dense.compute_logits,
+        make_rope_table=dense.make_rope_table,
+        load_params=loader.load_dense_params,
+        init_kv_cache=dense.init_kv_cache,
+    )
+
+
+_DENSE_ARCHS = (
+    "LlamaForCausalLM",
+    "MistralForCausalLM",
+    "Qwen2ForCausalLM",
+    "Qwen3ForCausalLM",
+)
+
+
+def get_model_def(cfg: ModelConfig) -> ModelDef:
+    if cfg.architecture in _DENSE_ARCHS:
+        return _dense_def()
+    if cfg.architecture in _MOE_ARCHS:
+        from gllm_tpu.models.registry_moe import moe_def
+        return moe_def()
+    raise NotImplementedError(
+        f"architecture {cfg.architecture!r} not supported yet; "
+        f"dense: {_DENSE_ARCHS}, moe: {_MOE_ARCHS}")
+
+
+_MOE_ARCHS = (
+    "MixtralForCausalLM",
+    "Qwen2MoeForCausalLM",
+    "Qwen3MoeForCausalLM",
+)
+
+
+def supported_architectures() -> Dict[str, str]:
+    out = {a: "dense" for a in _DENSE_ARCHS}
+    out.update({a: "moe" for a in _MOE_ARCHS})
+    return out

@@ -1,0 +1,180 @@
+"""Greedy-equivalence oracle vs HuggingFace transformers (CPU torch).
+
+The reference's de-facto correctness standard is output equivalence under
+greedy decoding (SURVEY.md §4: DSA dense-vs-sparse oracle, disagg
+byte-identical requirement). Here: our functional paged-cache model must
+reproduce HF logits on the same random weights — prefill AND a decode step
+through the paged KV cache.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import torch
+
+from gllm_tpu.batching import StepBatch
+from gllm_tpu.models import dense
+from gllm_tpu.models.config import from_hf_config
+from gllm_tpu.ops.attention import AttentionMetadata
+from gllm_tpu.ops.sampling import SamplingMetadata
+
+TINY = dict(
+    vocab_size=128, hidden_size=64, num_hidden_layers=3,
+    num_attention_heads=4, num_key_value_heads=2, intermediate_size=112,
+    max_position_embeddings=256, rms_norm_eps=1e-6, rope_theta=10000.0,
+    tie_word_embeddings=False,
+)
+
+
+def hf_model_and_cfg(arch):
+    if arch == "LlamaForCausalLM":
+        from transformers import LlamaConfig, LlamaForCausalLM
+        hf_cfg = LlamaConfig(**TINY, attention_bias=False)
+        model = LlamaForCausalLM(hf_cfg)
+    elif arch == "Qwen2ForCausalLM":
+        from transformers import Qwen2Config, Qwen2ForCausalLM
+        hf_cfg = Qwen2Config(**TINY)
+        model = Qwen2ForCausalLM(hf_cfg)
+    elif arch == "Qwen3ForCausalLM":
+        from transformers import Qwen3Config, Qwen3ForCausalLM
+        hf_cfg = Qwen3Config(**TINY, head_dim=16)
+        model = Qwen3ForCausalLM(hf_cfg)
+    else:
+        raise ValueError(arch)
+    model.eval()
+    d = hf_cfg.to_dict()
+    d["architectures"] = [arch]
+    return model, from_hf_config(d)
+
+
+def copy_params_to_torch(params, model, cfg):
+    """Write our random jax params into the HF torch model."""
+    sd = {}
+    sd["model.embed_tokens.weight"] = np.asarray(params["embed"],
+                                                 np.float32)
+    sd["model.norm.weight"] = np.asarray(params["final_norm"], np.float32)
+    if not cfg.tie_word_embeddings:
+        sd["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T
+    lp = params["layers"]
+    names = {
+        "q_proj": "self_attn.q_proj.weight", "k_proj": "self_attn.k_proj.weight",
+        "v_proj": "self_attn.v_proj.weight", "o_proj": "self_attn.o_proj.weight",
+        "gate_proj": "mlp.gate_proj.weight", "up_proj": "mlp.up_proj.weight",
+        "down_proj": "mlp.down_proj.weight",
+    }
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        for ours, hf in names.items():
+            sd[pre + hf] = np.asarray(lp[ours][i], np.float32).T
+        sd[pre + "input_layernorm.weight"] = np.asarray(
+            lp["input_norm"][i], np.float32)
+        sd[pre + "post_attention_layernorm.weight"] = np.asarray(
+            lp["post_attn_norm"][i], np.float32)
+        if "q_bias" in lp:
+            sd[pre + "self_attn.q_proj.bias"] = np.asarray(lp["q_bias"][i],
+                                                           np.float32)
+            sd[pre + "self_attn.k_proj.bias"] = np.asarray(lp["k_bias"][i],
+                                                           np.float32)
+            sd[pre + "self_attn.v_proj.bias"] = np.asarray(lp["v_bias"][i],
+                                                           np.float32)
+        if "q_norm" in lp:
+            sd[pre + "self_attn.q_norm.weight"] = np.asarray(lp["q_norm"][i],
+                                                             np.float32)
+            sd[pre + "self_attn.k_norm.weight"] = np.asarray(lp["k_norm"][i],
+                                                             np.float32)
+    missing, unexpected = model.load_state_dict(
+        {k: torch.from_numpy(v.copy()) for k, v in sd.items()}, strict=False)
+    # tied lm_head may report as missing; nothing else should be
+    assert not unexpected, unexpected
+    assert all("lm_head" in m or "rotary" in m for m in missing), missing
+
+
+def run_ours(params, cfg, token_ids, page_size=4, decode_steps=2):
+    """Prefill all tokens, then greedy-decode a few steps. Returns logits of
+    every produced step, [1 + decode_steps, V]."""
+    num_pages = 32
+    kv = dense.init_kv_cache(cfg, num_pages, page_size, jnp.float32)
+    cos_sin = dense.make_rope_table(cfg)
+    dummy_sampling = SamplingMetadata(
+        temperature=jnp.zeros((1,)), top_p=jnp.ones((1,)),
+        top_k=jnp.full((1,), -1, jnp.int32),
+        repetition_penalty=jnp.ones((1,)), step_key=jax.random.key(0))
+
+    all_logits = []
+    tokens = list(token_ids)
+    computed = 0
+    for step in range(1 + decode_steps):
+        new = tokens[computed:]
+        T = len(new)
+        n_pages_needed = (len(tokens) + page_size - 1) // page_size
+        pt = np.arange(1, 1 + n_pages_needed, dtype=np.int32)[None, :]
+        batch = StepBatch(
+            token_ids=jnp.asarray(new, jnp.int32),
+            positions=jnp.arange(computed, computed + T, dtype=jnp.int32),
+            slot_mapping=jnp.asarray(
+                [page_size + i for i in range(computed, computed + T)],
+                jnp.int32),  # pages 1.. contiguous → slot = page_size + pos
+            logits_indices=jnp.asarray([T - 1], jnp.int32),
+            attn=AttentionMetadata(
+                cu_q_lens=jnp.asarray([0, T], jnp.int32),
+                kv_lens=jnp.asarray([len(tokens)], jnp.int32),
+                page_table=jnp.asarray(pt),
+                num_seqs=jnp.asarray(1, jnp.int32)),
+            sampling=dummy_sampling,
+        )
+        hidden, residual, kv = dense.forward(
+            params, kv, batch, cfg, cos_sin=cos_sin, max_q_len=T)
+        logits = dense.compute_logits(params, hidden, residual, batch, cfg)
+        all_logits.append(np.asarray(logits[0]))
+        tokens.append(int(np.argmax(all_logits[-1])))
+        computed = len(tokens) - 1
+    return np.stack(all_logits), tokens
+
+
+@pytest.mark.parametrize(
+    "arch", ["LlamaForCausalLM", "Qwen2ForCausalLM", "Qwen3ForCausalLM"])
+def test_prefill_and_decode_match_hf(arch):
+    torch.manual_seed(0)
+    hf, cfg = hf_model_and_cfg(arch)
+    params = dense.init_params(cfg, seed=0, dtype=jnp.float32)
+    copy_params_to_torch(params, hf, cfg)
+
+    prompt = [5, 17, 93, 41, 2, 77, 8]
+    ours_logits, ours_tokens = run_ours(params, cfg, prompt, decode_steps=3)
+
+    # HF greedy continuation over the same tokens
+    hf_tokens = list(prompt)
+    hf_logits = []
+    with torch.no_grad():
+        for _ in range(4):
+            out = hf(torch.tensor([hf_tokens])).logits[0, -1]
+            hf_logits.append(out.numpy())
+            hf_tokens.append(int(out.argmax()))
+
+    np.testing.assert_allclose(ours_logits, np.stack(hf_logits),
+                               rtol=5e-4, atol=5e-4)
+    assert ours_tokens == hf_tokens
+
+
+def test_llama3_rope_scaling_end_to_end():
+    torch.manual_seed(1)
+    from transformers import LlamaConfig, LlamaForCausalLM
+    scaling = {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+               "high_freq_factor": 4.0,
+               "original_max_position_embeddings": 64}
+    hf_cfg = LlamaConfig(**{**TINY, "rope_scaling": scaling},
+                         attention_bias=False)
+    hf = LlamaForCausalLM(hf_cfg)
+    hf.eval()
+    d = hf_cfg.to_dict()
+    d["architectures"] = ["LlamaForCausalLM"]
+    cfg = from_hf_config(d)
+    params = dense.init_params(cfg, seed=3, dtype=jnp.float32)
+    copy_params_to_torch(params, hf, cfg)
+    prompt = [9, 8, 7, 6, 5, 4]
+    ours_logits, _ = run_ours(params, cfg, prompt, decode_steps=0)
+    with torch.no_grad():
+        want = hf(torch.tensor([prompt])).logits[0, -1].numpy()
+    np.testing.assert_allclose(ours_logits[0], want, rtol=5e-4, atol=5e-4)
