@@ -1,0 +1,1 @@
+"""Engine frontends: offline LLM and (async) serving engine."""

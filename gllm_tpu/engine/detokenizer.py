@@ -1,0 +1,32 @@
+"""Incremental detokenization.
+
+Streaming-safe decode: the reference tracks per-sequence offsets and only
+emits text once it is not a partial multi-byte sequence
+(/root/reference/gllm/sequence.py detokenize_inc). Standard two-offset
+algorithm: ``prefix_offset`` marks the start of the token window used for
+context, ``read_offset`` the first token whose text has not been emitted.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+REPLACEMENT = "�"
+
+
+def detokenize_incrementally(
+    tokenizer,
+    token_ids: List[int],
+    prefix_offset: int,
+    read_offset: int,
+) -> Tuple[str, int, int]:
+    """Returns (new_text, new_prefix_offset, new_read_offset)."""
+    prefix_text = tokenizer.decode(token_ids[prefix_offset:read_offset],
+                                   skip_special_tokens=False)
+    full_text = tokenizer.decode(token_ids[prefix_offset:],
+                                 skip_special_tokens=False)
+    if len(full_text) > len(prefix_text) and not full_text.endswith(
+            REPLACEMENT):
+        return (full_text[len(prefix_text):],
+                read_offset, len(token_ids))
+    return "", prefix_offset, read_offset

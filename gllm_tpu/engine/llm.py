@@ -1,0 +1,213 @@
+"""Offline LLM engine.
+
+TPU-native analogue of the reference LLM frontend
+(/root/reference/gllm/llm_engine.py:33-697) with the process topology
+collapsed: the reference spawns one worker process per GPU and speaks zmq;
+on TPU a single controller process drives all local chips through one
+jit-compiled program, so ``LLM`` owns the scheduler and runner directly and
+the zmq/IPC layer only reappears for multi-host pipeline stages
+(gllm_tpu/distributed/).
+
+Public surface mirrors the reference: ``generate(prompts | prompt_token_ids,
+sampling_params)`` and ``chat(messages)``; per-request outputs carry text,
+token ids, finish reason, and usage.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Callable, List, Optional, Sequence as Seq, Union
+
+from gllm_tpu.config import EngineConfig
+from gllm_tpu.memory_manager import make_memory_manager
+from gllm_tpu.models.config import ModelConfig, from_hf_config
+from gllm_tpu.sampling_params import SamplingParams
+from gllm_tpu.scheduler import Scheduler, SeqOutput
+from gllm_tpu.sequence import Sequence
+from gllm_tpu.engine.detokenizer import detokenize_incrementally
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    seq_id: int
+    prompt_token_ids: List[int]
+    output_token_ids: List[int]
+    text: str
+    finish_reason: Optional[str]
+    num_prompt_tokens: int = 0
+    num_output_tokens: int = 0
+
+    @property
+    def finished(self) -> bool:
+        return self.finish_reason is not None
+
+
+class LLM:
+    def __init__(
+        self,
+        model: str = "",
+        *,
+        config: Optional[EngineConfig] = None,
+        model_cfg: Optional[ModelConfig] = None,
+        params=None,
+        tokenizer=None,
+        **overrides,
+    ):
+        if config is None:
+            config = EngineConfig(model=model)
+            for k, v in overrides.items():
+                if hasattr(config, k):
+                    setattr(config, k, v)
+                elif hasattr(config.scheduler, k):
+                    setattr(config.scheduler, k, v)
+                elif hasattr(config.cache, k):
+                    setattr(config.cache, k, v)
+                elif hasattr(config.parallel, k):
+                    setattr(config.parallel, k, v)
+                else:
+                    raise TypeError(f"unknown engine option {k!r}")
+        config.validate()
+        self.config = config
+
+        if model_cfg is None:
+            from gllm_tpu.models.loader import load_hf_config
+            model_cfg = from_hf_config(load_hf_config(config.model))
+        self.model_cfg = model_cfg
+
+        self.tokenizer = tokenizer
+        if self.tokenizer is None and config.model and config.tokenizer != "":
+            try:
+                from transformers import AutoTokenizer
+                self.tokenizer = AutoTokenizer.from_pretrained(
+                    config.tokenizer or config.model, local_files_only=True)
+            except Exception:
+                logger.warning("no tokenizer loaded; token-id I/O only")
+
+        from gllm_tpu.runner.runner import ModelRunner
+        self.runner = ModelRunner(config, model_cfg, params=params)
+        self.memory_manager = make_memory_manager(
+            self.runner.num_pages, config.cache.page_size,
+            config.cache.enable_prefix_caching)
+        self.scheduler = Scheduler(config, self.memory_manager,
+                                   pp_size=config.parallel.pp)
+        self.eos_token_id = model_cfg.eos_token_id
+        if self.eos_token_id is None and self.tokenizer is not None:
+            self.eos_token_id = self.tokenizer.eos_token_id
+        self._next_seq_id = 0
+
+    # ---- intake -----------------------------------------------------------
+
+    def _allocate_seq(self, token_ids: List[int],
+                      sp: SamplingParams) -> Sequence:
+        sp.validate()
+        seq = Sequence(self._next_seq_id, token_ids, sp,
+                       arrival_time=time.monotonic())
+        self._next_seq_id += 1
+        return seq
+
+    def encode(self, prompt: str) -> List[int]:
+        if self.tokenizer is None:
+            raise ValueError("no tokenizer available; pass prompt_token_ids")
+        return self.tokenizer.encode(prompt)
+
+    # ---- main loops -------------------------------------------------------
+
+    def step(self) -> List[SeqOutput]:
+        """One engine iteration: schedule → device step → process output."""
+        batch = self.scheduler.schedule_once()
+        if batch is None:
+            return []
+        tokens = self.runner.step(batch)
+        return self.scheduler.process_output(batch, tokens.tolist(),
+                                             self.eos_token_id)
+
+    def generate(
+        self,
+        prompts: Optional[Union[str, Seq[str]]] = None,
+        sampling_params: Optional[Union[SamplingParams,
+                                        Seq[SamplingParams]]] = None,
+        prompt_token_ids: Optional[Seq[List[int]]] = None,
+        stream_cb: Optional[Callable[[SeqOutput], None]] = None,
+    ) -> List[RequestOutput]:
+        if prompts is not None and isinstance(prompts, str):
+            prompts = [prompts]
+        if prompt_token_ids is None:
+            prompt_token_ids = [self.encode(p) for p in prompts]
+        n = len(prompt_token_ids)
+        if sampling_params is None:
+            sampling_params = SamplingParams()
+        if isinstance(sampling_params, SamplingParams):
+            sampling_params = [dataclasses.replace(sampling_params)
+                               for _ in range(n)]
+        elif len(sampling_params) != n:
+            raise ValueError(
+                f"{len(sampling_params)} sampling_params for {n} prompts")
+
+        seqs = [self._allocate_seq(ids, sp)
+                for ids, sp in zip(prompt_token_ids, sampling_params)]
+        for s in seqs:
+            self.scheduler.add_seq(s)
+
+        while self.scheduler.has_unfinished:
+            for out in self.step():
+                if out.new_token_id is not None and self.tokenizer is not None:
+                    self._stream_detokenize(out.seq)
+                if stream_cb is not None and out.new_token_id is not None:
+                    stream_cb(out)
+
+        return [self._finalize(s) for s in seqs]
+
+    def chat(self, messages: List[dict],
+             sampling_params: Optional[SamplingParams] = None,
+             **kwargs) -> RequestOutput:
+        """Apply the tokenizer chat template and generate
+        (reference llm_engine.py:647)."""
+        if self.tokenizer is None:
+            raise ValueError("chat() requires a tokenizer")
+        ids = self.tokenizer.apply_chat_template(
+            messages, add_generation_prompt=True, **kwargs)
+        return self.generate(prompt_token_ids=[ids],
+                             sampling_params=sampling_params)[0]
+
+    # ---- output -----------------------------------------------------------
+
+    def _stream_detokenize(self, seq: Sequence) -> str:
+        text, seq.detok_prefix_offset, seq.detok_read_offset = (
+            detokenize_incrementally(self.tokenizer, seq.token_ids,
+                                     seq.detok_prefix_offset,
+                                     seq.detok_read_offset))
+        seq.output_text += text
+        return text
+
+    def _finalize(self, seq: Sequence) -> RequestOutput:
+        text = seq.output_text
+        if self.tokenizer is not None:
+            if seq.detok_read_offset < seq.num_tokens:
+                # Flush tokens still held back by the partial-character
+                # check — emit them even if they end incomplete.
+                done = self.tokenizer.decode(
+                    seq.token_ids[seq.detok_prefix_offset:
+                                  seq.detok_read_offset])
+                full = self.tokenizer.decode(
+                    seq.token_ids[seq.detok_prefix_offset:])
+                text += full[len(done):]
+                seq.detok_read_offset = seq.num_tokens
+                seq.output_text = text
+            elif not text:
+                text = self.tokenizer.decode(seq.output_token_ids)
+        return RequestOutput(
+            seq_id=seq.seq_id,
+            prompt_token_ids=seq.token_ids[:seq.prompt_len],
+            output_token_ids=seq.output_token_ids,
+            text=text,
+            finish_reason=seq.finish_reason,
+            num_prompt_tokens=seq.prompt_len,
+            num_output_tokens=seq.num_output_tokens,
+        )
+
+    def abort(self, seq_id: int) -> None:
+        self.scheduler.abort_seq(seq_id)

@@ -1,0 +1,1 @@
+"""Execution engine: host-side batch preparation + jit-compiled device step."""

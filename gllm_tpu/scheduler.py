@@ -109,10 +109,21 @@ class Scheduler:
     # ---- intake -----------------------------------------------------------
 
     def add_seq(self, seq: Sequence) -> None:
+        if seq.num_tokens == 0:
+            raise ValueError("empty prompt")
         if seq.num_tokens + 1 > self.config.max_model_len:
             raise ValueError(
                 f"prompt of {seq.num_tokens} tokens exceeds max_model_len "
                 f"{self.config.max_model_len}")
+        # Reject work that can never fit the KV pool even running alone —
+        # otherwise the engine loop would spin on None batches forever.
+        max_len = min(seq.num_tokens + seq.sampling_params.max_tokens,
+                      self.config.max_model_len)
+        need = cdiv(max_len, self.mm.page_size)
+        if need > self.mm.allocator.num_total:
+            raise ValueError(
+                f"request needs {need} KV pages but the pool has only "
+                f"{self.mm.allocator.num_total}")
         seq.status = SequenceStatus.WAITING
         self.waiting.append(seq)
 
@@ -270,9 +281,13 @@ class Scheduler:
                 self.mm.match_prefix(seq)
             n = min(seq.num_remaining_tokens, token_budget)
             # Adaptive admission: reserve room for the chunk plus
-            # new_token_ratio of the expected decode output.
+            # new_token_ratio of the expected decode output. When nothing is
+            # running and nothing else got scheduled, drop the reservation —
+            # admitting the head seq is the only way to make progress.
             est_extra = int(seq.sampling_params.max_tokens
                             * self.new_token_ratio)
+            if not self.running and not items:
+                est_extra = 0
             need = self.mm.pages_needed(seq, n) + cdiv(
                 est_extra, self.mm.page_size)
             if not self.mm.can_allocate(need):
@@ -305,6 +320,11 @@ class Scheduler:
                 seq.append_token(int(tok))
                 new_token = int(tok)
                 finish = seq.check_finish(eos_token_id)
+                # Hard cap: the KV layout (page_table width, rope table) is
+                # sized for max_model_len; never decode past it.
+                if (finish is None
+                        and seq.num_tokens >= self.config.max_model_len):
+                    finish = "length"
             self.mm.register_computed_pages(seq)
             if finish is not None:
                 seq.status = SequenceStatus.FINISHED

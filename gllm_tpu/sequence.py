@@ -47,8 +47,10 @@ class Sequence:
         # Pages whose contents came from the prefix cache (KV already valid).
         self.num_cached_tokens = 0
         self.finish_reason: Optional[str] = None
-        # Incremental detokenization state (reference sequence.py detokenize_inc).
-        self.last_detok_offset = 0
+        # Incremental detokenization state (reference sequence.py
+        # detokenize_inc): window start / first-unemitted-token offsets.
+        self.detok_prefix_offset = len(prompt_token_ids)
+        self.detok_read_offset = len(prompt_token_ids)
         self.output_text = ""
 
     # ---- token accounting -------------------------------------------------
