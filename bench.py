@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Headline benchmark: synthetic-ShareGPT offline throughput.
+
+Mirrors the reference's measurement harness
+(/root/reference/examples/batch_inference.py:56-74 — offline ShareGPT
+reqs/s + output tok/s) with a synthetic, zero-egress workload: a
+Llama-3.2-1B-shaped dummy-weight model served by the full engine
+(continuous batching + chunked prefill + paged KV) on one chip.
+
+Prints exactly ONE JSON line to stdout:
+  {"metric": "sharegpt_output_tok_s_per_chip", "value": N, "unit": "tok/s",
+   "vs_baseline": N / 2000.0}
+
+vs_baseline denominator: BASELINE.json's flagship target (2000 output tok/s
+for Llama-3-70B PP=8 on v5e-8 — i.e. ~250 tok/s/chip × 8; a 1B model on one
+chip should beat it by a wide margin; it is the round-over-round yardstick).
+
+Usage: python bench.py            # real chip (axon/tpu)
+       python bench.py --tiny     # CPU smoke (small model, small workload)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def build_workload(rng, n_requests, max_model_len, tiny=False):
+    """Synthetic ShareGPT-like length distribution."""
+    from gllm_tpu.sampling_params import SamplingParams
+    prompts, params = [], []
+    for _ in range(n_requests):
+        if tiny:
+            p_len = int(rng.integers(8, 64))
+            o_len = int(rng.integers(8, 32))
+        else:
+            p_len = int(min(max(rng.lognormal(5.2, 0.8), 16), 1024))
+            o_len = int(min(max(rng.lognormal(4.8, 0.7), 16), 512))
+        p_len = min(p_len, max_model_len - o_len - 1)
+        prompts.append(rng.integers(1, 30000, size=p_len).tolist())
+        params.append(SamplingParams(temperature=0.0, max_tokens=o_len,
+                                     ignore_eos=True))
+    return prompts, params
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tiny", action="store_true",
+                    help="CPU smoke test (small model/workload)")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    if args.tiny:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(os.path.dirname(__file__) or ".",
+                                       ".jax_cache"))
+    import numpy as np
+    import jax
+    if args.tiny:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:
+        pass
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+
+    from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.models.config import ModelConfig
+
+    if args.tiny:
+        model_cfg = ModelConfig(
+            architecture="LlamaForCausalLM", vocab_size=2048,
+            hidden_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+            head_dim=32, intermediate_size=256, max_position=512)
+        engine_cfg = EngineConfig(
+            load_format="dummy", dtype="float32", max_model_len=512,
+            max_num_seqs=32,
+            scheduler=SchedulerConfig(max_prefill_tokens=128,
+                                      max_decode_seqs=16),
+            cache=CacheConfig(page_size=4, num_pages=512))
+        n_requests = args.requests or 8
+    else:
+        # Llama-3.2-1B shape (BASELINE config 1), dummy weights.
+        model_cfg = ModelConfig(
+            architecture="LlamaForCausalLM", vocab_size=128256,
+            hidden_size=2048, num_layers=16, num_heads=32, num_kv_heads=8,
+            head_dim=64, intermediate_size=8192, max_position=4096,
+            rope_theta=500000.0, tie_word_embeddings=True)
+        engine_cfg = EngineConfig(
+            load_format="dummy", dtype="bfloat16", max_model_len=2048,
+            max_num_seqs=256,
+            scheduler=SchedulerConfig(max_prefill_tokens=1024,
+                                      max_decode_seqs=128),
+            cache=CacheConfig(page_size=16, memory_util=0.85))
+        n_requests = args.requests or 48
+
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    t0 = time.monotonic()
+    llm = LLM(config=engine_cfg, model_cfg=model_cfg)
+    log(f"engine up in {time.monotonic() - t0:.1f}s "
+        f"({llm.runner.num_pages} KV pages)")
+
+    rng = np.random.default_rng(args.seed)
+    prompts, params = build_workload(rng, n_requests,
+                                     engine_cfg.max_model_len,
+                                     tiny=args.tiny)
+    total_out = sum(p.max_tokens for p in params)
+    total_in = sum(len(p) for p in prompts)
+    log(f"workload: {n_requests} reqs, {total_in} prompt tokens, "
+        f"{total_out} output tokens")
+
+    # Warmup pass: same workload → compiles every bucket the measured pass
+    # will hit (the reference warms its CUDA graphs the same way).
+    t0 = time.monotonic()
+    llm.generate(prompt_token_ids=prompts, sampling_params=params)
+    log(f"warmup pass: {time.monotonic() - t0:.1f}s")
+
+    t0 = time.monotonic()
+    outs = llm.generate(prompt_token_ids=prompts, sampling_params=params)
+    dt = time.monotonic() - t0
+
+    out_tokens = sum(o.num_output_tokens for o in outs)
+    assert out_tokens == total_out, (out_tokens, total_out)
+    value = out_tokens / dt
+    log(f"measured pass: {dt:.2f}s → {value:.1f} output tok/s "
+        f"({n_requests / dt:.2f} req/s)")
+    print(json.dumps({
+        "metric": "sharegpt_output_tok_s_per_chip",
+        "value": round(value, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(value / 2000.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,11 @@ class LLM:
         prompt_token_ids: Optional[Seq[List[int]]] = None,
         stream_cb: Optional[Callable[[SeqOutput], None]] = None,
     ) -> List[RequestOutput]:
+        if prompts is not None and prompt_token_ids is not None:
+            raise ValueError(
+                "pass either prompts or prompt_token_ids, not both")
+        if prompts is None and prompt_token_ids is None:
+            raise ValueError("pass prompts or prompt_token_ids")
         if prompts is not None and isinstance(prompts, str):
             prompts = [prompts]
         if prompt_token_ids is None:

@@ -31,6 +31,7 @@ from gllm_tpu.models.config import ModelConfig
 from gllm_tpu.ops import (apply_rope, compute_rope_cos_sin,
                           fused_add_rms_norm, paged_attention, rms_norm,
                           silu_and_mul, write_kv)
+from gllm_tpu.parallel.mesh import shard_hint
 
 Params = Dict[str, Any]
 
@@ -111,9 +112,9 @@ def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
         q = q + lp["q_bias"]
         k = k + lp["k_bias"]
         v = v + lp["v_bias"]
-    q = q.reshape(T, Hq, D)
-    k = k.reshape(T, Hkv, D)
-    v = v.reshape(T, Hkv, D)
+    q = shard_hint(q.reshape(T, Hq, D), None, "tp", None)
+    k = shard_hint(k.reshape(T, Hkv, D), None, "tp", None)
+    v = shard_hint(v.reshape(T, Hkv, D), None, "tp", None)
     if cfg.qk_norm:
         # per-head RMSNorm over D (reference qwen3.py adds q/k norms)
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
@@ -128,8 +129,8 @@ def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
 
 
 def _mlp(lp, x):
-    gate = x @ lp["gate_proj"]
-    up = x @ lp["up_proj"]
+    gate = shard_hint(x @ lp["gate_proj"], None, "tp")
+    up = shard_hint(x @ lp["up_proj"], None, "tp")
     fused = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
     return fused @ lp["down_proj"]
 
@@ -194,7 +195,10 @@ def compute_logits(params: Params, hidden: jnp.ndarray,
     sel = rms_norm(sel, params["final_norm"], cfg.rms_norm_eps)
     head = (params["embed"].T if cfg.tie_word_embeddings
             else params["lm_head"])
-    return (sel @ head).astype(jnp.float32)                 # [S, V]
+    # All-gather the vocab-sharded logits before sampling (the reference's
+    # logits all-gather, vocab_parallel_embedding.py): the sampler sorts over
+    # the full vocab per row.
+    return shard_hint((sel @ head).astype(jnp.float32), None, None)
 
 
 def make_rope_table(cfg: ModelConfig) -> jnp.ndarray:

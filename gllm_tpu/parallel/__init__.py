@@ -1,0 +1,23 @@
+"""Parallelism: device meshes, parameter shardings, collective layout.
+
+TPU-native replacement for the reference's NCCL process-group machinery
+(/root/reference/gllm/dist_utils.py): instead of per-GPU processes with
+explicit communicators, one controller process lays a
+``jax.sharding.Mesh`` over the chips and annotates shardings; XLA inserts
+the ICI collectives (psum / all-gather / reduce-scatter / collective-permute)
+that NCCL calls performed by hand. The reference's dual-communicator trick,
+custom NVLink all-reduce, and zmq TP fan-out all collapse into GSPMD.
+"""
+
+from gllm_tpu.parallel.mesh import make_mesh, mesh_context, shard_hint
+from gllm_tpu.parallel.shardings import (dense_param_specs, kv_cache_specs,
+                                         shard_params)
+
+__all__ = [
+    "dense_param_specs",
+    "kv_cache_specs",
+    "make_mesh",
+    "mesh_context",
+    "shard_hint",
+    "shard_params",
+]

@@ -1,0 +1,86 @@
+"""Parameter / cache sharding specs for the dense family.
+
+The Megatron TP recipe, expressed as mesh-axis shardings instead of the
+reference's ColumnParallelLinear/RowParallelLinear module wrappers
+(/root/reference/gllm/layers/linear.py, vocab_parallel_embedding.py):
+
+- q/k/v projections: output (head) dim sharded over ``tp`` → column parallel
+- o_proj / down_proj: input dim sharded over ``tp`` → row parallel; XLA
+  inserts the psum the reference issues manually per layer
+  (dist_utils.py:572-602)
+- gate/up: column parallel
+- embedding + lm_head: vocab-sharded (vocab-parallel embedding with padded
+  shards + all-gathered logits → here GSPMD's gather/psum handles the
+  masked lookup, and the runner constrains logits to replicated)
+- KV cache: sharded over the kv-head axis when divisible, else replicated
+  (small-Hkv models replicate KV like the reference's TP head-division
+  bookkeeping, layers/modules/attention.py:32)
+
+DP shards nothing here: attention-DP replicas hold full weights (reference
+DP design) and split the *token/sequence* axes of each batch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gllm_tpu.models.config import ModelConfig
+from gllm_tpu.parallel.mesh import AXIS_TP
+
+
+def _tp_if(divisible: bool):
+    return AXIS_TP if divisible else None
+
+
+def dense_param_specs(cfg: ModelConfig, tp: int) -> dict:
+    """PartitionSpec pytree matching gllm_tpu.models.dense param layout."""
+    qkv_ok = (cfg.num_heads * cfg.head_dim) % tp == 0
+    kv_ok = (cfg.num_kv_heads * cfg.head_dim) % tp == 0
+    inter_ok = cfg.intermediate_size % tp == 0
+    vocab_ok = cfg.vocab_size % tp == 0
+
+    layers = {
+        "input_norm": P(None, None),
+        "q_proj": P(None, None, _tp_if(qkv_ok)),
+        "k_proj": P(None, None, _tp_if(kv_ok)),
+        "v_proj": P(None, None, _tp_if(kv_ok)),
+        "o_proj": P(None, _tp_if(qkv_ok), None),
+        "post_attn_norm": P(None, None),
+        "gate_proj": P(None, None, _tp_if(inter_ok)),
+        "up_proj": P(None, None, _tp_if(inter_ok)),
+        "down_proj": P(None, _tp_if(inter_ok), None),
+    }
+    if cfg.attention_bias:
+        layers["q_bias"] = P(None, _tp_if(qkv_ok))
+        layers["k_bias"] = P(None, _tp_if(kv_ok))
+        layers["v_bias"] = P(None, _tp_if(kv_ok))
+    if cfg.qk_norm:
+        layers["q_norm"] = P(None, None)
+        layers["k_norm"] = P(None, None)
+    specs = {"layers": layers}
+    if cfg.is_first_stage:
+        specs["embed"] = P(_tp_if(vocab_ok), None)
+    if cfg.is_last_stage:
+        specs["final_norm"] = P(None)
+        if not cfg.tie_word_embeddings:
+            specs["lm_head"] = P(None, _tp_if(vocab_ok))
+    return specs
+
+
+def kv_cache_specs(cfg: ModelConfig, tp: int):
+    from gllm_tpu.models.dense import KVCache
+    kv_heads_ok = cfg.num_kv_heads % tp == 0
+    spec = P(None, None, None, _tp_if(kv_heads_ok), None)
+    return KVCache(spec, spec)
+
+
+def shard_params(params, specs, mesh: Optional[Mesh]):
+    """Place a param pytree onto the mesh with the given specs."""
+    if mesh is None:
+        return params
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs)

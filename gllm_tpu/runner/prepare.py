@@ -24,7 +24,7 @@ from gllm_tpu.config import EngineConfig
 from gllm_tpu.ops.attention import AttentionMetadata
 from gllm_tpu.ops.sampling import SamplingMetadata
 from gllm_tpu.scheduler import ScheduledBatch
-from gllm_tpu.utils import bucket_size
+from gllm_tpu.utils import bucket_size, cdiv
 
 
 class BatchBuilder:
@@ -40,17 +40,30 @@ class BatchBuilder:
                             sc.max_decode_seqs + sc.max_prefill_tokens)
         self.max_pages_per_seq = config.max_pages_per_seq
 
-    def shape_signature(self, batch: ScheduledBatch) -> Tuple[int, int, int]:
-        """(T_bucket, S_bucket, max_q_len) — the compile-cache key."""
-        t = bucket_size(batch.total_tokens, 16, self.max_tokens)
+    def shape_signature(self, batch: ScheduledBatch) -> Tuple[int, int, int,
+                                                              int]:
+        """(T_bucket, S_bucket, max_q_len, pages_bucket) — the compile key.
+
+        pages_bucket bounds the page-table width (and thus the attention
+        gather extent) by the *live* maximum context in this batch instead
+        of max_model_len — decode cost tracks actual sequence lengths.
+        """
         s = bucket_size(batch.num_seqs, 8, self.max_seqs)
         max_q = max(it.num_new_tokens for it in batch.items)
-        q = 1 if max_q == 1 else t
-        return t, s, q
+        if max_q == 1:
+            t, q = s, 1          # pure decode: one token per seq
+        else:
+            t = bucket_size(batch.total_tokens, 16, self.max_tokens)
+            q = t
+        max_pages = max(
+            cdiv(it.computed_before + it.num_new_tokens, self.page_size)
+            for it in batch.items)
+        p = bucket_size(max_pages, 4, self.max_pages_per_seq)
+        return t, s, q, p
 
     def build(self, batch: ScheduledBatch, step_key):
         """Returns (StepBatch, max_q_len, presence_mask_or_None)."""
-        t_pad, s_pad, max_q = self.shape_signature(batch)
+        t_pad, s_pad, max_q, p_pad = self.shape_signature(batch)
         page = self.page_size
 
         tokens = np.zeros(t_pad, np.int32)
@@ -58,7 +71,7 @@ class BatchBuilder:
         slots = np.zeros(t_pad, np.int32)          # padding → dummy page slot
         cu = np.zeros(s_pad + 1, np.int32)
         kv_lens = np.zeros(s_pad, np.int32)
-        page_table = np.zeros((s_pad, self.max_pages_per_seq), np.int32)
+        page_table = np.zeros((s_pad, p_pad), np.int32)
         logits_idx = np.zeros(s_pad, np.int32)
         temperature = np.zeros(s_pad, np.float32)
         top_p = np.ones(s_pad, np.float32)

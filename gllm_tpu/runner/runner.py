@@ -45,6 +45,9 @@ class ModelRunner:
                  params=None, mesh=None):
         self.config = config
         self.model_cfg = model_cfg
+        if mesh is None and config.parallel.world_size > 1:
+            from gllm_tpu.parallel.mesh import make_mesh
+            mesh = make_mesh(dp=config.parallel.dp, tp=config.parallel.tp)
         self.mesh = mesh
         self.dtype = _DTYPES[config.dtype]
         self.model_def = get_model_def(model_cfg)
@@ -65,11 +68,24 @@ class ModelRunner:
                 config.model, model_cfg, dtype=self.dtype)
         self.cos_sin = self.model_def.make_rope_table(model_cfg)
 
+        if self.mesh is not None:
+            from gllm_tpu.parallel.shardings import (dense_param_specs,
+                                                     shard_params)
+            specs = dense_param_specs(model_cfg, config.parallel.tp)
+            self.params = shard_params(self.params, specs, self.mesh)
+
         self.num_pages = (config.cache.num_pages
                           or self.determine_num_pages())
         self.kv = self.model_def.init_kv_cache(
             model_cfg, self.num_pages, config.cache.page_size,
             self._kv_dtype())
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from gllm_tpu.parallel.shardings import kv_cache_specs
+            kspecs = kv_cache_specs(model_cfg, config.parallel.tp)
+            self.kv = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                self.kv, kspecs)
         logger.info("KV cache: %d pages × %d tokens (%s)", self.num_pages,
                     config.cache.page_size, self._kv_dtype().__name__)
         self._step_fn = self._build_step_fn()
@@ -93,10 +109,15 @@ class ModelRunner:
         return self.dtype if kd == "auto" else _DTYPES[kd]
 
     def _kv_bytes_per_page(self) -> int:
+        """Per-DEVICE bytes per page (the cache shards over kv heads when
+        divisible, so each chip holds 1/tp of every page)."""
         cfg, page = self.model_cfg, self.config.cache.page_size
         itemsize = jnp.dtype(self._kv_dtype()).itemsize
+        tp = self.config.parallel.tp
+        shards = tp if (self.mesh is not None
+                        and cfg.num_kv_heads % tp == 0) else 1
         return (2 * cfg.num_stage_layers * page * cfg.num_kv_heads
-                * cfg.head_dim * itemsize)
+                * cfg.head_dim * itemsize) // shards
 
     def determine_num_pages(self) -> int:
         """Size the KV pool from live device memory after model load
@@ -149,32 +170,58 @@ class ModelRunner:
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
         batch, max_q, presence_mask = self.builder.build(sched_batch,
                                                          step_key)
-        tokens, self.kv = self._step_fn(self.params, self.kv, batch,
-                                        self.cos_sin, presence_mask,
-                                        max_q_len=max_q)
+        from gllm_tpu.parallel.mesh import mesh_context
+        with mesh_context(self.mesh):
+            tokens, self.kv = self._step_fn(self.params, self.kv, batch,
+                                            self.cos_sin, presence_mask,
+                                            max_q_len=max_q)
         return np.asarray(tokens)[:sched_batch.num_seqs]
 
-    def warmup(self, decode_buckets: Optional[Tuple[int, ...]] = None):
+    def warmup(self, decode_buckets: Optional[Tuple[int, ...]] = None,
+               page_buckets: Optional[Tuple[int, ...]] = None):
         """Pre-compile the hot decode shapes (reference capture_graph loop
-        model_runner.py:1525-1615)."""
+        model_runner.py:1525-1615).
+
+        The compile key is (seq-bucket, page-bucket); warming the full grid
+        is quadratic in compiles, so by default we warm every seq bucket at
+        the largest page bucket plus the largest seq bucket at every page
+        bucket — the shapes live decode traffic hits first.
+        """
         from gllm_tpu.sampling_params import SamplingParams
         from gllm_tpu.scheduler import ScheduledSeq
         from gllm_tpu.sequence import Sequence
 
-        if decode_buckets is None:
-            buckets, b = [], 8
-            while b < self.config.scheduler.max_decode_seqs:
-                buckets.append(b)
+        def pow2_range(lo, hi):
+            out, b = [], lo
+            while b < hi:
+                out.append(b)
                 b *= 2
-            buckets.append(self.config.scheduler.max_decode_seqs)
-            decode_buckets = tuple(buckets)
-        for nseq in decode_buckets:
+            out.append(hi)
+            return tuple(out)
+
+        maxd = self.config.scheduler.max_decode_seqs
+        if decode_buckets is None:
+            decode_buckets = pow2_range(8, maxd)
+        if page_buckets is None:
+            page_buckets = pow2_range(4, min(self.config.max_pages_per_seq,
+                                             self.num_pages - 1))
+        combos = [(s, page_buckets[-1]) for s in decode_buckets]
+        combos += [(decode_buckets[-1], p) for p in page_buckets[:-1]]
+
+        page = self.config.cache.page_size
+        for nseq, npages in combos:
             items = []
-            for i in range(min(nseq, self.num_pages - 1)):
-                seq = Sequence(i, [1, 2], SamplingParams(max_tokens=4))
-                seq.page_table = [1 + (i % max(1, self.num_pages - 1))]
-                seq.num_computed_tokens = 1
-                items.append(ScheduledSeq(seq, 1, 1))
+            for i in range(nseq):
+                ctx = npages * page - 1   # context filling npages pages
+                seq = Sequence(i, [1] * (ctx + 1),
+                               SamplingParams(max_tokens=4))
+                # All warmup rows may share pages: decode only READS pages
+                # and writes one fresh slot; sharing keeps warmup within any
+                # pool size.
+                seq.page_table = [1 + (j % max(1, self.num_pages - 1))
+                                  for j in range(npages)]
+                seq.num_computed_tokens = ctx
+                items.append(ScheduledSeq(seq, 1, ctx))
             if items:
                 self.step(ScheduledBatch(items))
-        logger.info("warmed %d decode buckets", len(decode_buckets))
+        logger.info("warmed %d decode shape buckets", len(combos))

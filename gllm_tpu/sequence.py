@@ -49,7 +49,10 @@ class Sequence:
         self.finish_reason: Optional[str] = None
         # Incremental detokenization state (reference sequence.py
         # detokenize_inc): window start / first-unemitted-token offsets.
-        self.detok_prefix_offset = len(prompt_token_ids)
+        # The window starts a few tokens INSIDE the prompt so sentencepiece
+        # word-boundary markers render as the leading space of the first
+        # output token (the reference re-adds this space explicitly).
+        self.detok_prefix_offset = max(0, len(prompt_token_ids) - 6)
         self.detok_read_offset = len(prompt_token_ids)
         self.output_text = ""
 
