@@ -55,15 +55,32 @@ def paged_attention(
         return _xla_paged_attention(q, k_cache, v_cache, metadata,
                                     scale=scale, max_q_len=max_q_len)
     if impl == "pallas":
-        try:
-            from gllm_tpu.ops.pallas.ragged_paged_attention import (
-                ragged_paged_attention)
-        except ImportError as e:  # kernel not built yet / wrong platform
-            raise NotImplementedError(
-                "pallas ragged paged attention kernel unavailable; "
-                "use impl='xla'") from e
-        return ragged_paged_attention(q, k_cache, v_cache, metadata,
-                                      scale=scale, max_q_len=max_q_len)
+        if max_q_len == 1:
+            # Pure-decode batch: T == S, one query row per sequence (the
+            # layout prepare.py emits for max_q_len == 1).
+            if q.shape[0] != metadata.kv_lens.shape[0]:
+                raise ValueError(
+                    f"pallas decode path requires T == S, got T={q.shape[0]} "
+                    f"S={metadata.kv_lens.shape[0]}")
+            backend = jax.default_backend()
+            if backend == "cpu":
+                interpret = True
+            elif backend in ("tpu", "axon"):
+                interpret = False
+            else:
+                raise NotImplementedError(
+                    f"pallas attention unsupported on backend {backend!r}; "
+                    "use impl='xla'")
+            from gllm_tpu.ops.pallas.decode_attention import (
+                paged_decode_attention)
+            return paged_decode_attention(
+                q, k_cache, v_cache, metadata.kv_lens, metadata.page_table,
+                scale=scale, interpret=interpret)
+        # Mixed/prefill batches: XLA path until the unified ragged kernel
+        # lands (prefill is matmul-bound; decode is where the paged gather
+        # hurts).
+        return _xla_paged_attention(q, k_cache, v_cache, metadata,
+                                    scale=scale, max_q_len=max_q_len)
     raise ValueError(f"unknown attention impl {impl!r}")
 
 

@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the hot data-plane ops.
+
+These replace the prebuilt CUDA kernels the reference consumes
+(sgl_kernel flash_attn_with_kvcache etc., SURVEY.md §2.6). Each kernel has an
+XLA reference implementation in gllm_tpu/ops/ used as its correctness oracle
+(interpret-mode tests run on CPU).
+"""

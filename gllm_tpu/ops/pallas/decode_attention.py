@@ -1,0 +1,168 @@
+"""Pallas TPU paged decode attention.
+
+The decode half of the reference's core attention kernel
+(sgl_kernel ``flash_attn_with_kvcache`` — /root/reference/gllm/layers/
+attention.py:92-140; Triton split-K analogue in layers/ops/
+triton_decode_attention.py). One query row per sequence attends over that
+sequence's paged KV context.
+
+Design (TPU-first, not a Triton translation):
+- grid = (S,): one program per sequence; each program streams its own page
+  list — HBM traffic is the sequence's *actual* context, independent of the
+  padded page-table bucket (the XLA gather fallback pays the padded extent).
+- KV pages stay in HBM (`pl.ANY`); the kernel double-buffers page blocks
+  into VMEM with async DMA, overlapping fetch with the flash-attention
+  accumulation (online softmax in f32 carried through the kv-block loop).
+- GQA is computed as a kv-head-batched dot: q reshaped to [Hkv, G, D] so
+  every kv head's group hits the MXU together.
+- The kv-block loop bound is dynamic (ceil(kv_len / block)): padded
+  sequences (kv_len 0) skip the loop entirely.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_KV_BLOCK = 256
+
+
+def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
+            q_ref, k_hbm, v_hbm,            # inputs
+            o_ref,                          # output
+            k_buf, v_buf, sems,             # scratch
+            *, page_size: int, pages_per_block: int, scale: float,
+            num_kv_heads: int, group: int, head_dim: int):
+    s = pl.program_id(0)
+    kv_len = kv_lens_ref[s]
+    bk = pages_per_block * page_size
+    n_blocks = pl.cdiv(kv_len, bk)
+
+    def start_fetch(slot, blk):
+        for j in range(pages_per_block):
+            page_idx = pt_ref[s, blk * pages_per_block + j]
+            pltpu.make_async_copy(k_hbm.at[page_idx], k_buf.at[slot, j],
+                                  sems.at[slot, j, 0]).start()
+            pltpu.make_async_copy(v_hbm.at[page_idx], v_buf.at[slot, j],
+                                  sems.at[slot, j, 1]).start()
+
+    def wait_fetch(slot, blk):
+        for j in range(pages_per_block):
+            page_idx = pt_ref[s, blk * pages_per_block + j]
+            pltpu.make_async_copy(k_hbm.at[page_idx], k_buf.at[slot, j],
+                                  sems.at[slot, j, 0]).wait()
+            pltpu.make_async_copy(v_hbm.at[page_idx], v_buf.at[slot, j],
+                                  sems.at[slot, j, 1]).wait()
+
+    @pl.when(n_blocks > 0)
+    def _():
+        start_fetch(0, 0)
+
+    q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
+    qh = q.reshape(num_kv_heads, group, head_dim)     # [Hkv, G, D]
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < n_blocks)
+        def _():
+            start_fetch(1 - slot, i + 1)
+
+        wait_fetch(slot, i)
+        k = k_buf[slot].reshape(bk, num_kv_heads, head_dim)
+        v = v_buf[slot].reshape(bk, num_kv_heads, head_dim)
+        kt = k.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, D]
+        vt = v.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, D]
+
+        # [Hkv, G, BK] = batch-dot over kv heads (MXU)
+        scores = jax.lax.dot_general(
+            qh, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        kv_pos = i * bk + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 2)
+        scores = jnp.where(kv_pos < kv_len, scores, -jnp.inf)
+
+        m_blk = jnp.max(scores, axis=2, keepdims=True)   # [Hkv, G, 1]
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new)                      # [Hkv, G, BK]
+        l_new = l * alpha + jnp.sum(p, axis=2, keepdims=True)
+        # [Hkv, G, D] accumulation
+        pv = jax.lax.dot_general(
+            p, vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_new = acc * alpha + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((num_kv_heads, group, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((num_kv_heads, group, 1), jnp.float32)
+    acc0 = jnp.zeros((num_kv_heads, group, head_dim), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)                   # padded seqs → 0
+    o_ref[0] = out.reshape(num_kv_heads * group,
+                           head_dim).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("scale", "kv_block", "interpret"))
+def paged_decode_attention(
+    q: jnp.ndarray,            # [S, Hq, D]
+    k_cache: jnp.ndarray,      # [num_pages, page_size, Hkv, D]
+    v_cache: jnp.ndarray,
+    kv_lens: jnp.ndarray,      # [S] int32 (0 for padded rows)
+    page_table: jnp.ndarray,   # [S, max_pages] int32 (padding → dummy page 0)
+    *,
+    scale: float,
+    kv_block: int = DEFAULT_KV_BLOCK,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    S, num_q_heads, head_dim = q.shape
+    num_pages, page_size, num_kv_heads, _ = k_cache.shape
+    max_pages = page_table.shape[1]
+    group = num_q_heads // num_kv_heads
+
+    pages_per_block = max(1, min(kv_block // page_size, max_pages))
+    # page_table must cover whole blocks; pad with dummy page 0.
+    rem = max_pages % pages_per_block
+    if rem:
+        page_table = jnp.pad(page_table,
+                             ((0, 0), (0, pages_per_block - rem)))
+        max_pages += pages_per_block - rem
+
+    kernel = functools.partial(
+        _kernel, page_size=page_size, pages_per_block=pages_per_block,
+        scale=scale, num_kv_heads=num_kv_heads, group=group,
+        head_dim=head_dim)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, num_q_heads, head_dim), lambda s, *_: (s, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, num_q_heads, head_dim),
+                               lambda s, *_: (s, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, pages_per_block, page_size, num_kv_heads,
+                        head_dim), k_cache.dtype),
+            pltpu.VMEM((2, pages_per_block, page_size, num_kv_heads,
+                        head_dim), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, num_q_heads, head_dim), q.dtype),
+        interpret=interpret,
+    )(kv_lens, page_table, q, k_cache, v_cache)

@@ -96,9 +96,14 @@ class ModelRunner:
         impl = self.config.attention_impl
         if impl != "auto":
             return impl
+        if self.mesh is not None and self.config.parallel.tp > 1:
+            # TODO: shard_map wrapper so the decode kernel runs per-TP-shard
+            # (q and KV are both head-sharded, so the kernel partitions
+            # cleanly); until then sharded runs use the XLA path.
+            return "xla"
         if jax.default_backend() in ("tpu", "axon"):
             try:
-                from gllm_tpu.ops.pallas import ragged_paged_attention  # noqa
+                from gllm_tpu.ops.pallas import decode_attention  # noqa
                 return "pallas"
             except ImportError:
                 return "xla"
