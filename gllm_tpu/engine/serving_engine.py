@@ -1,0 +1,165 @@
+"""Threaded serving core: continuous-batching loop + per-request streams.
+
+The reference splits this across PipeAsyncLLM (asyncio streams,
+/root/reference/gllm/async_llm_engine.py:11-139) and the worker processes it
+talks to over zmq. Our single-controller design needs neither asyncio nor
+IPC: one engine thread owns the scheduler + runner and runs the continuous
+batching loop; HTTP handler threads submit requests through a thread-safe
+queue and block on per-sequence output queues (SSE streams one queue item
+per token). Client disconnects abort the sequence mid-flight, matching the
+reference's disconnect→abort propagation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import List, Optional
+
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.sampling_params import SamplingParams
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class StreamChunk:
+    token_id: Optional[int]
+    text: str
+    finish_reason: Optional[str]
+    # cumulative counts for usage reporting
+    num_prompt_tokens: int = 0
+    num_output_tokens: int = 0
+
+
+class RequestHandle:
+    def __init__(self, seq_id: int, prompt_len: int):
+        self.seq_id = seq_id
+        self.prompt_len = prompt_len
+        self.chunks: "queue.Queue[StreamChunk]" = queue.Queue()
+
+    def __iter__(self):
+        while True:
+            chunk = self.chunks.get()
+            yield chunk
+            if chunk.finish_reason is not None:
+                return
+
+
+class ServingEngine:
+    """Owns the LLM on a dedicated thread; thread-safe submit/abort."""
+
+    def __init__(self, llm: LLM):
+        self.llm = llm
+        self._intake: "queue.Queue" = queue.Queue()
+        self._handles: dict[int, RequestHandle] = {}
+        self._seqs: dict[int, object] = {}
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="gllm-engine")
+        self._thread.start()
+
+    # ---- client-facing (any thread) ---------------------------------------
+
+    def submit(self, token_ids: List[int],
+               sampling_params: SamplingParams) -> RequestHandle:
+        sampling_params.validate()
+        with self._lock:
+            seq = self.llm._allocate_seq(token_ids, sampling_params)
+            handle = RequestHandle(seq.seq_id, len(token_ids))
+            self._handles[seq.seq_id] = handle
+            self._seqs[seq.seq_id] = seq
+        self._intake.put(seq)
+        self._wake.set()
+        return handle
+
+    def abort(self, seq_id: int) -> None:
+        self.llm.scheduler.abort_seq(seq_id)
+        self._wake.set()
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout=5)
+
+    # ---- engine thread ----------------------------------------------------
+
+    def _run(self) -> None:
+        llm = self.llm
+        while not self._stop:
+            drained = False
+            while True:
+                try:
+                    seq = self._intake.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    llm.scheduler.add_seq(seq)
+                except ValueError as e:
+                    self._deliver_error(seq.seq_id, str(e))
+                drained = True
+            if not llm.scheduler.has_unfinished:
+                if not drained:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                continue
+            try:
+                outputs = llm.step()
+            except Exception:
+                logger.exception("engine step failed")
+                self._fail_all()
+                continue
+            for out in outputs:
+                handle = self._handles.get(out.seq.seq_id)
+                if handle is None:
+                    continue
+                text = ""
+                if llm.tokenizer is not None:
+                    if out.new_token_id is not None:
+                        text = llm._stream_detokenize(out.seq)
+                    if out.finish_reason is not None:
+                        # flush text held back by the partial-char check
+                        before = len(out.seq.output_text)
+                        final = llm._finalize(out.seq)
+                        text += final.text[before:]
+                if out.new_token_id is not None or out.finish_reason:
+                    handle.chunks.put(StreamChunk(
+                        token_id=out.new_token_id,
+                        text=text,
+                        finish_reason=out.finish_reason,
+                        num_prompt_tokens=out.seq.prompt_len,
+                        num_output_tokens=out.seq.num_output_tokens))
+                if out.finish_reason is not None:
+                    with self._lock:
+                        self._handles.pop(out.seq.seq_id, None)
+                        self._seqs.pop(out.seq.seq_id, None)
+            # aborted sequences never produce a SeqOutput → close their
+            # streams here
+            self._reap_aborted()
+
+    def _reap_aborted(self):
+        with self._lock:
+            dead = [sid for sid, seq in self._seqs.items()
+                    if seq.is_finished and sid in self._handles]
+            for sid in dead:
+                self._seqs.pop(sid, None)
+        for sid in dead:
+            self._deliver_error(sid, "abort")
+
+    def _deliver_error(self, seq_id: int, reason: str) -> None:
+        with self._lock:
+            handle = self._handles.pop(seq_id, None)
+        if handle is not None:
+            handle.chunks.put(StreamChunk(None, "", reason or "error"))
+
+    def _fail_all(self) -> None:
+        with self._lock:
+            handles = list(self._handles.values())
+            self._handles.clear()
+        for h in handles:
+            h.chunks.put(StreamChunk(None, "", "error"))

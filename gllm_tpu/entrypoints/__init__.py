@@ -1,0 +1,1 @@
+"""Serving entrypoints: OpenAI-compatible HTTP server + CLI."""

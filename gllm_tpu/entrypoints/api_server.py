@@ -1,0 +1,340 @@
+"""OpenAI-compatible HTTP server (stdlib, dependency-free).
+
+Serves the same route surface as the reference FastAPI server
+(/root/reference/gllm/entrypoints/api_server.py:41-207):
+``/v1/chat/completions``, ``/v1/completions``, ``/v1/models``, ``/health``,
+``/version``, ``/server_info``, ``/start_profile``, ``/stop_profile`` —
+with SSE streaming, client-disconnect abort, and the reference's CLI flag
+surface (:267-508) mapped onto EngineConfig.
+
+Implementation note: this image ships neither fastapi nor uvicorn, so the
+server is a ThreadingHTTPServer — one OS thread per in-flight request,
+blocking on the ServingEngine's per-sequence queues. The engine itself is
+single-threaded continuous batching; HTTP concurrency is intake concurrency.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import gllm_tpu
+from gllm_tpu.config import (CacheConfig, EngineConfig, ParallelConfig,
+                             SchedulerConfig)
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.engine.serving_engine import ServingEngine
+from gllm_tpu.entrypoints import protocol as proto
+
+logger = logging.getLogger(__name__)
+
+
+class ServerState:
+    def __init__(self, llm: LLM, served_model: str):
+        self.llm = llm
+        self.engine = ServingEngine(llm)
+        self.served_model = served_model
+        self.start_time = time.time()
+        self._profiling = False
+
+    # ---- request handling -------------------------------------------------
+
+    def encode_chat(self, req: proto.ChatCompletionRequest):
+        tok = self.llm.tokenizer
+        if tok is None:
+            raise proto.ProtocolError("server has no tokenizer loaded")
+        return tok.apply_chat_template(req.messages,
+                                       add_generation_prompt=True,
+                                       **req.chat_template_kwargs)
+
+    def encode_completion(self, req: proto.CompletionRequest):
+        if isinstance(req.prompt, list):
+            return list(req.prompt)
+        if self.llm.tokenizer is None:
+            raise proto.ProtocolError(
+                "server has no tokenizer; send token-array prompts")
+        return self.llm.tokenizer.encode(req.prompt)
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    state: ServerState = None  # injected
+
+    # quiet default logging; route through logging module
+    def log_message(self, fmt, *args):
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    # ---- helpers ----------------------------------------------------------
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            d = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise proto.ProtocolError(f"invalid JSON body: {e}") from e
+        if not isinstance(d, dict):
+            raise proto.ProtocolError("request body must be a JSON object")
+        return d
+
+    def _sse_start(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+
+    def _sse(self, obj) -> None:
+        self.wfile.write(b"data: " + json.dumps(obj).encode() + b"\n\n")
+        self.wfile.flush()
+
+    # ---- routes -----------------------------------------------------------
+
+    def do_GET(self):
+        st = self.state
+        if self.path == "/health":
+            self._json({"status": "ok"})
+        elif self.path == "/version":
+            self._json({"version": gllm_tpu.__version__})
+        elif self.path == "/v1/models":
+            self._json({"object": "list", "data": [{
+                "id": st.served_model, "object": "model",
+                "created": int(st.start_time), "owned_by": "gllm-tpu"}]})
+        elif self.path == "/server_info":
+            cfg = st.llm.config
+            self._json({
+                "model": cfg.model,
+                "uptime_s": round(time.time() - st.start_time, 1),
+                "max_model_len": cfg.max_model_len,
+                "schedule_method": cfg.scheduler.schedule_method,
+                "page_size": cfg.cache.page_size,
+                "num_pages": st.llm.runner.num_pages,
+                "prefix_caching": cfg.cache.enable_prefix_caching,
+                "parallel": {"tp": cfg.parallel.tp, "dp": cfg.parallel.dp,
+                             "pp": cfg.parallel.pp},
+                "attention_impl": st.llm.runner.attn_impl,
+                "waiting": len(st.llm.scheduler.waiting),
+                "running": len(st.llm.scheduler.running),
+            })
+        else:
+            self._json(proto.error_response("not found", 404), code=404)
+
+    def do_POST(self):
+        try:
+            if self.path == "/v1/chat/completions":
+                self._chat()
+            elif self.path == "/v1/completions":
+                self._completion()
+            elif self.path == "/start_profile":
+                self._profile(True)
+            elif self.path == "/stop_profile":
+                self._profile(False)
+            else:
+                self._json(proto.error_response("not found", 404), code=404)
+        except proto.ProtocolError as e:
+            self._json(proto.error_response(str(e)), code=400)
+        except BrokenPipeError:
+            pass  # client went away mid-write; abort handled in stream loop
+        except Exception as e:  # pragma: no cover
+            logger.exception("request failed")
+            try:
+                self._json(proto.error_response(f"internal error: {e}", 500),
+                           code=500)
+            except Exception:
+                pass
+
+    # ---- chat / completions ----------------------------------------------
+
+    def _chat(self):
+        st = self.state
+        req = proto.ChatCompletionRequest.from_dict(
+            self._read_json(), default_max_tokens=256)
+        ids = st.encode_chat(req)
+        handle = st.engine.submit(list(ids), req.sampling)
+        if req.stream:
+            rid = proto.new_request_id(chat=True)
+            self._sse_start()
+            self._sse(proto.chat_completion_chunk(rid, req.model, None, None,
+                                                  role=True))
+            self._stream(handle, lambda text, fin: proto.
+                         chat_completion_chunk(rid, req.model, text, fin))
+        else:
+            text, fin, usage = self._collect(handle)
+            self._json(proto.chat_completion_response(req.model, text, fin,
+                                                      usage))
+
+    def _completion(self):
+        st = self.state
+        req = proto.CompletionRequest.from_dict(
+            self._read_json(), default_max_tokens=256)
+        ids = st.encode_completion(req)
+        handle = st.engine.submit(ids, req.sampling)
+        if req.stream:
+            rid = proto.new_request_id(chat=False)
+            self._sse_start()
+            self._stream(handle, lambda text, fin: proto.completion_chunk(
+                rid, req.model, text or "", fin))
+        else:
+            text, fin, usage = self._collect(handle)
+            if req.echo and isinstance(req.prompt, str):
+                text = req.prompt + text
+            self._json(proto.completion_response(req.model, text, fin,
+                                                 usage))
+
+    def _collect(self, handle):
+        text_parts, finish, usage = [], "stop", proto.usage_dict(0, 0)
+        for chunk in handle:
+            if chunk.text:
+                text_parts.append(chunk.text)
+            if chunk.finish_reason is not None:
+                finish = chunk.finish_reason
+                usage = proto.usage_dict(chunk.num_prompt_tokens,
+                                         chunk.num_output_tokens)
+        return "".join(text_parts), finish, usage
+
+    def _stream(self, handle, make_chunk):
+        try:
+            for chunk in handle:
+                if chunk.text or chunk.finish_reason:
+                    self._sse(make_chunk(chunk.text or None,
+                                         chunk.finish_reason))
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            # client disconnect → abort the sequence
+            # (reference async_llm_engine.py:113-126)
+            self.state.engine.abort(handle.seq_id)
+
+    # ---- profiler (reference profiler_mixin.py:12-117) --------------------
+
+    def _profile(self, start: bool):
+        import jax
+        st = self.state
+        if start and not st._profiling:
+            import os
+            trace_dir = os.environ.get("GLLM_PROFILE_DIR",
+                                       "/tmp/gllm_tpu_profile")
+            jax.profiler.start_trace(trace_dir)
+            st._profiling = True
+            self._json({"status": "profiling started",
+                        "trace_dir": trace_dir})
+        elif not start and st._profiling:
+            jax.profiler.stop_trace()
+            st._profiling = False
+            self._json({"status": "profiling stopped"})
+        else:
+            self._json({"status": "noop"})
+
+
+def build_engine_config(args) -> EngineConfig:
+    return EngineConfig(
+        model=args.model,
+        tokenizer=args.tokenizer,
+        dtype=args.dtype,
+        seed=args.seed,
+        max_model_len=args.max_model_len,
+        max_num_seqs=args.max_num_seqs,
+        load_format=args.load_format,
+        attention_impl=args.attention_impl,
+        scheduler=SchedulerConfig(
+            schedule_method=args.schedule_method,
+            max_decode_seqs=args.maxd,
+            max_prefill_tokens=args.maxp,
+            min_prefill_tokens=args.minp,
+            iter_smooth=args.iterp,
+        ),
+        cache=CacheConfig(
+            page_size=args.page_size,
+            memory_util=args.memory_util,
+            num_pages=args.num_pages,
+            kv_cache_dtype=args.kv_cache_dtype,
+            enable_prefix_caching=args.enable_prefix_caching,
+        ),
+        parallel=ParallelConfig(pp=args.pp, tp=args.tp, dp=args.dp,
+                                enable_ep=args.enable_ep),
+    )
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="gllm-tpu OpenAI-compatible API server")
+    p.add_argument("--model", required=True)
+    p.add_argument("--tokenizer", default=None)
+    p.add_argument("--served-model-name", default=None)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8000)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-model-len", type=int, default=4096)
+    p.add_argument("--max-num-seqs", type=int, default=256)
+    p.add_argument("--load-format", default="auto",
+                   choices=["auto", "dummy"])
+    p.add_argument("--attention-impl", default="auto",
+                   choices=["auto", "pallas", "xla"])
+    # scheduler (reference --schedule-method/--maxd/--maxp/--minp/--iterp)
+    p.add_argument("--schedule-method", default="chunked_prefill",
+                   choices=["chunked_prefill", "token_throttling",
+                            "split_pd"])
+    p.add_argument("--maxd", type=int, default=256)
+    p.add_argument("--maxp", type=int, default=2048)
+    p.add_argument("--minp", type=int, default=128)
+    p.add_argument("--iterp", type=int, default=16)
+    # cache
+    p.add_argument("--page-size", type=int, default=16)
+    p.add_argument("--memory-util", type=float, default=0.9,
+                   help="fraction of device memory for the KV cache")
+    p.add_argument("--num-pages", type=int, default=None)
+    p.add_argument("--kv-cache-dtype", default="auto")
+    p.add_argument("--enable-prefix-caching", action="store_true")
+    p.add_argument("--skip-warmup", action="store_true",
+                   help="don't pre-compile decode buckets before serving "
+                        "(first requests pay compile latency instead)")
+    # parallelism
+    p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--tp", type=int, default=1)
+    p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--enable-ep", action="store_true")
+    return p
+
+
+def serve(llm: LLM, host: str, port: int,
+          served_model: Optional[str] = None) -> ThreadingHTTPServer:
+    """Build the HTTP server (caller decides foreground vs thread)."""
+    state = ServerState(llm, served_model or llm.config.model)
+    handler = type("BoundHandler", (Handler,), {"state": state})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.state = state
+    return httpd
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = make_parser().parse_args(argv)
+    llm = LLM(config=build_engine_config(args))
+    if not args.skip_warmup:
+        llm.runner.warmup()
+    httpd = serve(llm, args.host, args.port,
+                  args.served_model_name or args.model)
+    logger.info("serving %s on %s:%d", args.model, args.host, args.port)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.state.engine.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,190 @@
+"""OpenAI-compatible request/response schema.
+
+Covers the surface of the reference's pydantic protocol
+(/root/reference/gllm/entrypoints/protocol.py, 812 LoC): chat/completions
+requests with sampling knobs, stream & aggregate responses, logprob shapes,
+usage. Re-designed as stdlib dataclasses with explicit validation because
+this image ships no pydantic — the serving stack is dependency-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Union
+
+from gllm_tpu.sampling_params import SamplingParams
+
+
+class ProtocolError(ValueError):
+    """Maps to HTTP 400 with an OpenAI-style error body."""
+
+
+def _get(d: dict, key: str, typ, default=None, required=False):
+    if key not in d or d[key] is None:
+        if required:
+            raise ProtocolError(f"missing required field {key!r}")
+        return default
+    v = d[key]
+    if typ is float and isinstance(v, int):
+        v = float(v)
+    if not isinstance(v, typ):
+        raise ProtocolError(
+            f"field {key!r} must be {getattr(typ, '__name__', typ)}")
+    return v
+
+
+def sampling_from_request(d: dict, default_max_tokens: int) -> SamplingParams:
+    sp = SamplingParams(
+        temperature=_get(d, "temperature", float, 1.0),
+        top_p=_get(d, "top_p", float, 1.0),
+        top_k=_get(d, "top_k", int, -1),
+        repetition_penalty=_get(d, "repetition_penalty", float, 1.0),
+        max_tokens=_get(d, "max_tokens", int,
+                        _get(d, "max_completion_tokens", int,
+                             default_max_tokens)),
+        ignore_eos=_get(d, "ignore_eos", bool, False),
+        stop_token_ids=_get(d, "stop_token_ids", list, []),
+        seed=_get(d, "seed", int, None),
+    )
+    logprobs = d.get("logprobs")
+    if isinstance(logprobs, bool):
+        sp.logprobs = _get(d, "top_logprobs", int, 0) if logprobs else None
+    elif isinstance(logprobs, int):
+        sp.logprobs = logprobs
+    try:
+        sp.validate()
+    except ValueError as e:
+        raise ProtocolError(str(e)) from e
+    return sp
+
+
+@dataclasses.dataclass
+class ChatCompletionRequest:
+    messages: List[Dict[str, Any]]
+    model: str
+    sampling: SamplingParams
+    stream: bool
+    chat_template_kwargs: Dict[str, Any]
+
+    @classmethod
+    def from_dict(cls, d: dict, default_max_tokens: int):
+        messages = _get(d, "messages", list, required=True)
+        if not messages:
+            raise ProtocolError("messages must be non-empty")
+        for m in messages:
+            if not isinstance(m, dict) or "role" not in m:
+                raise ProtocolError("each message needs a 'role'")
+        return cls(
+            messages=messages,
+            model=_get(d, "model", str, ""),
+            sampling=sampling_from_request(d, default_max_tokens),
+            stream=_get(d, "stream", bool, False),
+            chat_template_kwargs=_get(d, "chat_template_kwargs", dict, {}),
+        )
+
+
+@dataclasses.dataclass
+class CompletionRequest:
+    prompt: Union[str, List[int]]
+    model: str
+    sampling: SamplingParams
+    stream: bool
+    echo: bool
+
+    @classmethod
+    def from_dict(cls, d: dict, default_max_tokens: int):
+        prompt = d.get("prompt")
+        if isinstance(prompt, list):
+            if not all(isinstance(t, int) for t in prompt):
+                raise ProtocolError("token-array prompt must be ints")
+        elif not isinstance(prompt, str):
+            raise ProtocolError("prompt must be a string or token array")
+        return cls(
+            prompt=prompt,
+            model=_get(d, "model", str, ""),
+            sampling=sampling_from_request(d, default_max_tokens),
+            stream=_get(d, "stream", bool, False),
+            echo=_get(d, "echo", bool, False),
+        )
+
+
+# ---- response builders ----------------------------------------------------
+
+def _id(prefix: str) -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
+    return {"prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": prompt_tokens + completion_tokens}
+
+
+def chat_completion_response(model: str, text: str, finish_reason: str,
+                             usage: dict) -> dict:
+    return {
+        "id": _id("chatcmpl"),
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{
+            "index": 0,
+            "message": {"role": "assistant", "content": text},
+            "finish_reason": finish_reason,
+        }],
+        "usage": usage,
+    }
+
+
+def chat_completion_chunk(rid: str, model: str, delta: Optional[str],
+                          finish_reason: Optional[str],
+                          role: bool = False) -> dict:
+    d: Dict[str, Any] = {}
+    if role:
+        d["role"] = "assistant"
+    if delta:
+        d["content"] = delta
+    return {
+        "id": rid,
+        "object": "chat.completion.chunk",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "delta": d,
+                     "finish_reason": finish_reason}],
+    }
+
+
+def completion_response(model: str, text: str, finish_reason: str,
+                        usage: dict) -> dict:
+    return {
+        "id": _id("cmpl"),
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "text": text,
+                     "finish_reason": finish_reason, "logprobs": None}],
+        "usage": usage,
+    }
+
+
+def completion_chunk(rid: str, model: str, delta: str,
+                     finish_reason: Optional[str]) -> dict:
+    return {
+        "id": rid,
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [{"index": 0, "text": delta,
+                     "finish_reason": finish_reason, "logprobs": None}],
+    }
+
+
+def error_response(message: str, code: int = 400) -> dict:
+    return {"error": {"message": message, "type": "invalid_request_error",
+                      "code": code}}
+
+
+def new_request_id(chat: bool) -> str:
+    return _id("chatcmpl" if chat else "cmpl")

@@ -9,9 +9,11 @@ prefill attends to all cached tokens plus the causal part of its own chunk).
 
 Two implementations:
 - ``xla``: gather-based reference. Runs on any backend (CPU tests, fallback),
-  numerically the oracle for the Pallas kernel.
-- ``pallas``: the TPU kernel (gllm_tpu/ops/pallas/ragged_paged_attention.py),
-  double-buffered DMA over HBM KV pages.
+  numerically the oracle for the Pallas kernels.
+- ``pallas``: pure-decode batches (max_q_len == 1) run the TPU kernel
+  (gllm_tpu/ops/pallas/decode_attention.py, double-buffered DMA over HBM KV
+  pages); mixed/prefill batches currently take the XLA path until the
+  unified ragged-prefill kernel lands.
 
 Metadata layout (built by the runner, all padded to static bucket shapes):
 - cu_q_lens: [S+1] int32 — cumulative query lengths (padded seqs repeat the

@@ -164,5 +164,10 @@ def paged_decode_attention(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, num_q_heads, head_dim), q.dtype),
+        # Sequences are independent → let Mosaic split the grid across
+        # Megacore TensorCores.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)) if interpret else
+        pltpu.CompilerParams(dimension_semantics=("parallel",)),
         interpret=interpret,
     )(kv_lens, page_table, q, k_cache, v_cache)

@@ -94,20 +94,21 @@ class ModelRunner:
 
     def _pick_attn_impl(self) -> str:
         impl = self.config.attention_impl
+        tp_sharded = self.mesh is not None and self.config.parallel.tp > 1
         if impl != "auto":
+            if impl == "pallas" and tp_sharded:
+                # TODO: shard_map wrapper so the decode kernel runs
+                # per-TP-shard (q and KV are both head-sharded, so it
+                # partitions cleanly); reject rather than silently
+                # all-gathering the KV cache every layer.
+                raise NotImplementedError(
+                    "attention_impl='pallas' with tp>1 is not wired up yet; "
+                    "use attention_impl='xla' (or 'auto')")
             return impl
-        if self.mesh is not None and self.config.parallel.tp > 1:
-            # TODO: shard_map wrapper so the decode kernel runs per-TP-shard
-            # (q and KV are both head-sharded, so the kernel partitions
-            # cleanly); until then sharded runs use the XLA path.
+        if tp_sharded:
             return "xla"
-        if jax.default_backend() in ("tpu", "axon"):
-            try:
-                from gllm_tpu.ops.pallas import decode_attention  # noqa
-                return "pallas"
-            except ImportError:
-                return "xla"
-        return "xla"
+        return ("pallas" if jax.default_backend() in ("tpu", "axon")
+                else "xla")
 
     def _kv_dtype(self):
         kd = self.config.cache.kv_cache_dtype

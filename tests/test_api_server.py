@@ -1,0 +1,164 @@
+"""API server tests: real HTTP requests against a live threaded server."""
+
+import http.client
+import json
+import threading
+
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.entrypoints.api_server import serve
+
+
+class StubTokenizer:
+    """Minimal word-level tokenizer: token id = byte value of 1-char words,
+    good enough to drive encode/decode/chat-template paths."""
+    eos_token_id = 0
+
+    def encode(self, text):
+        return [min(ord(c), 120) for c in text][:64]
+
+    def decode(self, ids, skip_special_tokens=False):
+        return "".join(chr(max(32, i % 127)) for i in ids)
+
+    def apply_chat_template(self, messages, add_generation_prompt=True,
+                            **kw):
+        text = " ".join(str(m.get("content", "")) for m in messages)
+        return self.encode(text or "hi")
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(2)
+    d = tmp_path_factory.mktemp("srv_model")
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        max_position_embeddings=256, eos_token_id=0,
+        attention_bias=False)).save_pretrained(d, safe_serialization=True)
+    cfg = EngineConfig(model=str(d), dtype="float32", max_model_len=128,
+                       cache=CacheConfig(page_size=4, num_pages=128))
+    llm = LLM(config=cfg, tokenizer=StubTokenizer())
+    httpd = serve(llm, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield port
+    httpd.shutdown()
+    httpd.state.engine.shutdown()
+
+
+def request(port, method, path, body=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, data
+
+
+def test_health_version_models(server):
+    status, body = request(server, "GET", "/health")
+    assert status == 200 and json.loads(body)["status"] == "ok"
+    status, body = request(server, "GET", "/version")
+    assert status == 200 and "version" in json.loads(body)
+    status, body = request(server, "GET", "/v1/models")
+    assert json.loads(body)["data"][0]["object"] == "model"
+    status, body = request(server, "GET", "/server_info")
+    info = json.loads(body)
+    assert info["page_size"] == 4 and info["parallel"]["tp"] == 1
+
+
+def test_completion_token_array(server):
+    status, body = request(server, "POST", "/v1/completions", {
+        "prompt": [5, 17, 93], "max_tokens": 6, "temperature": 0,
+        "ignore_eos": True})
+    assert status == 200, body
+    d = json.loads(body)
+    assert d["object"] == "text_completion"
+    assert d["usage"] == {"prompt_tokens": 3, "completion_tokens": 6,
+                          "total_tokens": 9}
+    assert d["choices"][0]["finish_reason"] == "length"
+    assert len(d["choices"][0]["text"]) > 0
+
+
+def test_completion_text_prompt(server):
+    status, body = request(server, "POST", "/v1/completions", {
+        "prompt": "hello", "max_tokens": 4, "temperature": 0})
+    assert status == 200, body
+    assert json.loads(body)["choices"][0]["text"] is not None
+
+
+def test_chat_completion(server):
+    status, body = request(server, "POST", "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hey"}],
+        "max_tokens": 5, "temperature": 0, "ignore_eos": True})
+    assert status == 200, body
+    d = json.loads(body)
+    assert d["object"] == "chat.completion"
+    assert d["choices"][0]["message"]["role"] == "assistant"
+    assert d["usage"]["completion_tokens"] == 5
+
+
+def test_chat_streaming_sse(server):
+    conn = http.client.HTTPConnection("127.0.0.1", server, timeout=60)
+    conn.request("POST", "/v1/chat/completions", body=json.dumps({
+        "messages": [{"role": "user", "content": "stream me"}],
+        "max_tokens": 5, "temperature": 0, "stream": True,
+        "ignore_eos": True}),
+        headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    assert resp.getheader("Content-Type").startswith("text/event-stream")
+    raw = resp.read().decode()
+    conn.close()
+    events = [line[6:] for line in raw.split("\n\n")
+              if line.startswith("data: ")]
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    finals = [c for c in chunks
+              if c["choices"][0]["finish_reason"] is not None]
+    assert finals and finals[-1]["choices"][0]["finish_reason"] == "length"
+    deltas = "".join(c["choices"][0]["delta"].get("content", "")
+                     for c in chunks)
+    assert len(deltas) > 0
+
+
+def test_concurrent_requests(server):
+    results = []
+
+    def one(i):
+        status, body = request(server, "POST", "/v1/completions", {
+            "prompt": [3 + i, 8, 1], "max_tokens": 6, "temperature": 0,
+            "ignore_eos": True})
+        results.append((status, json.loads(body)))
+
+    threads = [threading.Thread(target=one, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 6
+    assert all(s == 200 for s, _ in results)
+    assert all(d["usage"]["completion_tokens"] == 6 for _, d in results)
+
+
+def test_bad_requests(server):
+    status, body = request(server, "POST", "/v1/chat/completions",
+                           {"messages": []})
+    assert status == 400
+    assert "error" in json.loads(body)
+    status, body = request(server, "POST", "/v1/completions",
+                           {"prompt": 42})
+    assert status == 400
+    status, body = request(server, "POST", "/v1/completions",
+                           {"prompt": "x", "temperature": -2})
+    assert status == 400
+    status, _ = request(server, "POST", "/v1/unknown", {})
+    assert status == 404
