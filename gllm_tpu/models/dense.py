@@ -146,12 +146,17 @@ def forward(
     max_q_len: int,
     hidden_in: Optional[jnp.ndarray] = None,
     residual_in: Optional[jnp.ndarray] = None,
+    mlp_fn=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, KVCache]:
     """Run this stage's layers. Returns (hidden, residual, new_kv).
 
     First stage embeds `batch.token_ids`; later PP stages take
-    (hidden_in, residual_in) received from the previous stage.
+    (hidden_in, residual_in) received from the previous stage. ``mlp_fn``
+    swaps the MLP half of each block (MoE models pass their routed-expert
+    MLP); the attention half and scan plumbing are shared.
     """
+    if mlp_fn is None:
+        mlp_fn = _mlp
     if cfg.is_first_stage:
         hidden = params["embed"][batch.token_ids]
         residual = jnp.zeros_like(hidden)
@@ -172,7 +177,7 @@ def forward(
         normed2, res = fused_add_rms_norm(attn_out, res,
                                          lp["post_attn_norm"],
                                          cfg.rms_norm_eps)
-        mlp_out = _mlp(lp, normed2)
+        mlp_out = mlp_fn(lp, normed2)
         return (mlp_out, res, k_all, v_all, li + 1), None
 
     init = (hidden, residual, kv.k, kv.v, jnp.int32(0))

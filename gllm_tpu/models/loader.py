@@ -73,8 +73,9 @@ class LazySafetensors:
 
 
 # A rule maps an HF tensor to (param path, layer index or None, transform).
-# transform: "t" = transpose last two dims, None = as-is.
-Rule = Tuple[Tuple[str, ...], Optional[int], Optional[str]]
+# transform: "t" = transpose last two dims, None = as-is. MoE expert rules
+# extend the index to (layer, expert).
+Rule = Tuple[Tuple[str, ...], Optional[object], Optional[str]]
 
 
 def dense_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
@@ -121,38 +122,101 @@ def dense_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
     return rule
 
 
-def load_dense_params(model_dir: str, cfg: ModelConfig,
-                      dtype=jnp.bfloat16,
-                      progress_cb: Optional[Callable[[int, int], None]] = None,
-                      ) -> dict:
-    """Load a dense-family checkpoint into the stacked param layout."""
-    from gllm_tpu.models import dense
-
-    # Allocate target structure (host-side numpy mirrors, filled per tensor).
-    template = jax.eval_shape(
-        lambda: dense.init_params(cfg, dtype=dtype))
+def _load_params(model_dir: str, template, rules,
+                 progress_cb: Optional[Callable[[int, int], None]] = None,
+                 ) -> dict:
+    """Shared load loop: stream tensors, apply first-match rules, fill the
+    stacked host buffers, ship to device once."""
     host: dict = jax.tree.map(
         lambda s: np.zeros(s.shape, jnp.dtype(s.dtype)), template)
-
     lazy = LazySafetensors(model_dir)
-    rules = dense_rules(cfg)
     names = list(lazy.names())
     total = len(names)
     for n_done, name in enumerate(names):
         r = rules(name)
         if r is None:
             continue
-        path, layer_idx, tf = r
+        path, idx, tf = r
         t = np.asarray(lazy.get(name))
         if tf == "t":
             t = t.T
         dst = host
         for kpath in path[:-1]:
             dst = dst[kpath]
-        if layer_idx is None:
-            dst[path[-1]][...] = t.astype(dst[path[-1]].dtype)
-        else:
-            dst[path[-1]][layer_idx] = t.astype(dst[path[-1]].dtype)
+        leaf = dst[path[-1]]
+        if idx is None:
+            leaf[...] = t.astype(leaf.dtype)
+        else:  # int (layer) or tuple (layer, expert) index
+            leaf[idx] = t.astype(leaf.dtype)
         if progress_cb:
             progress_cb(n_done + 1, total)
     return jax.tree.map(jnp.asarray, host)
+
+
+def load_dense_params(model_dir: str, cfg: ModelConfig,
+                      dtype=jnp.bfloat16,
+                      progress_cb: Optional[Callable[[int, int], None]] = None,
+                      ) -> dict:
+    """Load a dense-family checkpoint into the stacked param layout."""
+    from gllm_tpu.models import dense
+    template = jax.eval_shape(lambda: dense.init_params(cfg, dtype=dtype))
+    return _load_params(model_dir, template, dense_rules(cfg), progress_cb)
+
+
+def moe_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
+    """Rules for Mixtral / Qwen2-MoE / Qwen3-MoE expert layouts
+    (reference weight_loader.py MoE w13/w2 pull-based loaders)."""
+    base = dense_rules(cfg)
+    first, last = cfg.stage_layers
+    # leaf name inside one expert → (our leaf, transform)
+    expert_leaves = {
+        "w1.weight": ("w_gate", "t"), "w3.weight": ("w_up", "t"),
+        "w2.weight": ("w_down", "t"),
+        "gate_proj.weight": ("w_gate", "t"),
+        "up_proj.weight": ("w_up", "t"),
+        "down_proj.weight": ("w_down", "t"),
+    }
+    shared_leaves = {
+        "shared_expert.gate_proj.weight": ("shared_gate_proj", "t"),
+        "shared_expert.up_proj.weight": ("shared_up_proj", "t"),
+        "shared_expert.down_proj.weight": ("shared_down_proj", "t"),
+        "shared_expert_gate.weight": ("shared_expert_gate", "t"),
+    }
+
+    def rule(name: str) -> Optional[Rule]:
+        if name.startswith("model.layers."):
+            rest = name[len("model.layers."):]
+            idx_s, _, leaf = rest.partition(".")
+            i = int(idx_s)
+            if not (first <= i < last):
+                return None
+            li = i - first
+            # router: qwen "mlp.gate.weight", mixtral
+            # "block_sparse_moe.gate.weight"
+            if leaf in ("mlp.gate.weight", "block_sparse_moe.gate.weight"):
+                return (("layers", "router"), li, "t")
+            for prefix in ("mlp.experts.", "block_sparse_moe.experts."):
+                if leaf.startswith(prefix):
+                    rest2 = leaf[len(prefix):]
+                    e_s, _, el = rest2.partition(".")
+                    if el in expert_leaves:
+                        target, tf = expert_leaves[el]
+                        return (("layers", target), (li, int(e_s)), tf)
+            if leaf.startswith("mlp.shared_expert"):
+                key = leaf[len("mlp."):]
+                if key in shared_leaves:
+                    target, tf = shared_leaves[key]
+                    return (("layers", target), li, tf)
+            return base(name)
+        return base(name)
+
+    return rule
+
+
+def load_moe_params(model_dir: str, cfg: ModelConfig,
+                    dtype=jnp.bfloat16,
+                    progress_cb: Optional[Callable[[int, int], None]] = None,
+                    ) -> dict:
+    from gllm_tpu.models import moe
+    template = jax.eval_shape(lambda: moe.init_params(cfg, dtype=dtype))
+    return _load_params(model_dir, template, moe_rules(cfg), progress_cb)

@@ -22,10 +22,12 @@ class ModelDef:
     make_rope_table: Callable
     load_params: Callable          # (model_dir, cfg, dtype) -> params
     init_kv_cache: Callable
+    param_specs: Callable          # (cfg, tp) -> PartitionSpec pytree
 
 
 def _dense_def() -> ModelDef:
     from gllm_tpu.models import dense, loader
+    from gllm_tpu.parallel.shardings import dense_param_specs
     return ModelDef(
         family="dense",
         init_params=dense.init_params,
@@ -34,6 +36,7 @@ def _dense_def() -> ModelDef:
         make_rope_table=dense.make_rope_table,
         load_params=loader.load_dense_params,
         init_kv_cache=dense.init_kv_cache,
+        param_specs=dense_param_specs,
     )
 
 

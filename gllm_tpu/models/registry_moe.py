@@ -1,0 +1,20 @@
+"""MoE model definition for the registry."""
+
+from __future__ import annotations
+
+from gllm_tpu.models.registry import ModelDef
+
+
+def moe_def() -> ModelDef:
+    from gllm_tpu.models import loader, moe
+    from gllm_tpu.parallel.shardings import moe_param_specs
+    return ModelDef(
+        family="moe",
+        init_params=moe.init_params,
+        forward=moe.forward,
+        compute_logits=moe.compute_logits,
+        make_rope_table=moe.make_rope_table,
+        load_params=loader.load_moe_params,
+        init_kv_cache=moe.init_kv_cache,
+        param_specs=moe_param_specs,
+    )

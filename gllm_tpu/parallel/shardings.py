@@ -70,6 +70,30 @@ def dense_param_specs(cfg: ModelConfig, tp: int) -> dict:
     return specs
 
 
+def moe_param_specs(cfg: ModelConfig, tp: int) -> dict:
+    """Dense specs + expert-parallel sharding: the expert axis shards over
+    ``tp`` (the reference's EP group spans the whole stage,
+    dist_utils.py:81-86,209-210). GSPMD inserts the token gathers/psums the
+    reference's dp_gather_hidden/ep_all_reduce perform by hand."""
+    specs = dense_param_specs(cfg, tp)
+    layers = specs["layers"]
+    for name in ("gate_proj", "up_proj", "down_proj"):
+        layers.pop(name, None)
+    ep_ok = cfg.num_experts % tp == 0
+    ep = _tp_if(ep_ok)
+    layers["router"] = P(None, None, None)
+    layers["w_gate"] = P(None, ep, None, None)
+    layers["w_up"] = P(None, ep, None, None)
+    layers["w_down"] = P(None, ep, None, None)
+    if cfg.shared_expert_intermediate_size:
+        si_ok = cfg.shared_expert_intermediate_size % tp == 0
+        layers["shared_gate_proj"] = P(None, None, _tp_if(si_ok))
+        layers["shared_up_proj"] = P(None, None, _tp_if(si_ok))
+        layers["shared_down_proj"] = P(None, _tp_if(si_ok), None)
+        layers["shared_expert_gate"] = P(None, None, None)
+    return specs
+
+
 def kv_cache_specs(cfg: ModelConfig, tp: int):
     from gllm_tpu.models.dense import KVCache
     kv_heads_ok = cfg.num_kv_heads % tp == 0

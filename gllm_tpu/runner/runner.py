@@ -69,9 +69,8 @@ class ModelRunner:
         self.cos_sin = self.model_def.make_rope_table(model_cfg)
 
         if self.mesh is not None:
-            from gllm_tpu.parallel.shardings import (dense_param_specs,
-                                                     shard_params)
-            specs = dense_param_specs(model_cfg, config.parallel.tp)
+            from gllm_tpu.parallel.shardings import shard_params
+            specs = self.model_def.param_specs(model_cfg, config.parallel.tp)
             self.params = shard_params(self.params, specs, self.mesh)
 
         self.num_pages = (config.cache.num_pages
