@@ -54,6 +54,9 @@ class ParallelConfig:
     tp: int = 1
     dp: int = 1
     enable_ep: bool = False
+    # Explicit per-stage layer counts (reference --assigned-layers,
+    # dist_utils.py:494-528); None → even split.
+    assigned_layers: Optional[list] = None
 
     @property
     def world_size(self) -> int:

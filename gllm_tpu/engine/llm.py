@@ -87,8 +87,12 @@ class LLM:
             except Exception:
                 logger.warning("no tokenizer loaded; token-id I/O only")
 
-        from gllm_tpu.runner.runner import ModelRunner
-        self.runner = ModelRunner(config, model_cfg, params=params)
+        if config.parallel.pp > 1:
+            from gllm_tpu.runner.pp_runner import PPModelRunner
+            self.runner = PPModelRunner(config, model_cfg)
+        else:
+            from gllm_tpu.runner.runner import ModelRunner
+            self.runner = ModelRunner(config, model_cfg, params=params)
         self.memory_manager = make_memory_manager(
             self.runner.num_pages, config.cache.page_size,
             config.cache.enable_prefix_caching)
@@ -98,6 +102,8 @@ class LLM:
         if self.eos_token_id is None and self.tokenizer is not None:
             self.eos_token_id = self.tokenizer.eos_token_id
         self._next_seq_id = 0
+        from collections import deque
+        self._in_flight = deque()
 
     # ---- intake -----------------------------------------------------------
 
@@ -117,11 +123,24 @@ class LLM:
     # ---- main loops -------------------------------------------------------
 
     def step(self) -> List[SeqOutput]:
-        """One engine iteration: schedule → device step → process output."""
-        batch = self.scheduler.schedule_once()
-        if batch is None:
+        """One engine iteration.
+
+        Keeps up to ``pp`` microbatches in flight (the pipeline depth —
+        reference scheduler.py:358-364 keeps pp_size batches running), then
+        collects the oldest and advances scheduler state. With pp=1 this is
+        launch-one/collect-one, with jax async dispatch hiding host work
+        behind the device step.
+        """
+        depth = max(1, self.config.parallel.pp)
+        while len(self._in_flight) < depth:
+            batch = self.scheduler.schedule_once()
+            if batch is None:
+                break
+            self._in_flight.append((batch, self.runner.step_async(batch)))
+        if not self._in_flight:
             return []
-        tokens = self.runner.step(batch)
+        batch, handle = self._in_flight.popleft()
+        tokens = self.runner.collect(handle)
         return self.scheduler.process_output(batch, tokens.tolist(),
                                              self.eos_token_id)
 

@@ -169,8 +169,10 @@ class ModelRunner:
 
     # ---- execution --------------------------------------------------------
 
-    def step(self, sched_batch: ScheduledBatch) -> np.ndarray:
-        """Run one step; returns sampled token per batch item (host numpy)."""
+    def step_async(self, sched_batch: ScheduledBatch):
+        """Launch one step; returns an opaque handle whose tokens are an
+        uncommitted device future (jax async dispatch — the host does not
+        block until ``collect``)."""
         self._step_count += 1
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
         batch, max_q, presence_mask = self.builder.build(sched_batch,
@@ -180,7 +182,15 @@ class ModelRunner:
             tokens, self.kv = self._step_fn(self.params, self.kv, batch,
                                             self.cos_sin, presence_mask,
                                             max_q_len=max_q)
-        return np.asarray(tokens)[:sched_batch.num_seqs]
+        return tokens, sched_batch.num_seqs
+
+    def collect(self, handle) -> np.ndarray:
+        tokens, n = handle
+        return np.asarray(tokens)[:n]
+
+    def step(self, sched_batch: ScheduledBatch) -> np.ndarray:
+        """Run one step; returns sampled token per batch item (host numpy)."""
+        return self.collect(self.step_async(sched_batch))
 
     def warmup(self, decode_buckets: Optional[Tuple[int, ...]] = None,
                page_buckets: Optional[Tuple[int, ...]] = None):

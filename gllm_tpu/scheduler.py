@@ -173,8 +173,12 @@ class Scheduler:
     # ---- preemption -------------------------------------------------------
 
     def _preempt_one(self, protect: set[int]) -> bool:
-        """Free memory by preempting the largest unprotected running seq."""
-        victims = [s for s in self.running if s.seq_id not in protect]
+        """Free memory by preempting the largest unprotected running seq.
+
+        In-flight seqs are immune: their pipeline step is still writing KV
+        into the pages we would free."""
+        victims = [s for s in self.running
+                   if s.seq_id not in protect and not s.in_flight]
         if not victims:
             return False
         victim = max(victims, key=lambda s: s.num_tokens)
@@ -205,8 +209,10 @@ class Scheduler:
         self._process_aborts()
         self._decay_ratio()
 
-        decode_ready = [s for s in self.running if s.num_remaining_tokens == 1]
-        prefill_mid = [s for s in self.running if s.num_remaining_tokens > 1]
+        decode_ready = [s for s in self.running
+                        if s.num_remaining_tokens == 1 and not s.in_flight]
+        prefill_mid = [s for s in self.running
+                       if s.num_remaining_tokens > 1 and not s.in_flight]
         has_prefill_work = bool(prefill_mid or self.waiting)
 
         items: List[ScheduledSeq] = []
@@ -221,7 +227,11 @@ class Scheduler:
             self._schedule_prefill(items, self._prefill_token_budget())
 
         self._maybe_log_stats()
-        return ScheduledBatch(items) if items else None
+        if not items:
+            return None
+        for it in items:
+            it.seq.in_flight = True
+        return ScheduledBatch(items)
 
     def _schedule_decode(self, items: List[ScheduledSeq],
                          decode_ready: List[Sequence]) -> None:
@@ -256,7 +266,8 @@ class Scheduler:
         max_seqs = self.config.max_num_seqs
 
         # 1) continue partially prefilled running seqs (already admitted).
-        for seq in [s for s in self.running if s.num_remaining_tokens > 1]:
+        for seq in [s for s in self.running
+                    if s.num_remaining_tokens > 1 and not s.in_flight]:
             if token_budget <= 0 or len(items) >= max_seqs:
                 break
             n = min(seq.num_remaining_tokens, token_budget)
@@ -309,6 +320,7 @@ class Scheduler:
         outputs: List[SeqOutput] = []
         for it, tok in zip(batch.items, sampled_tokens):
             seq = it.seq
+            seq.in_flight = False
             if seq.seq_id in self._aborted_ids:
                 continue  # handled in _process_aborts
             if seq.status is not SequenceStatus.RUNNING:
@@ -345,8 +357,11 @@ class Scheduler:
     def _process_aborts(self) -> None:
         if not self._aborted_ids:
             return
+        # In-flight seqs keep their pages until the step lands; they are
+        # reaped on a later schedule_once after process_output cleared the
+        # flag.
         for seq in [s for s in self.running
-                    if s.seq_id in self._aborted_ids]:
+                    if s.seq_id in self._aborted_ids and not s.in_flight]:
             self.running.remove(seq)
             self._finish_abort(seq)
         for seq in [s for s in self.waiting

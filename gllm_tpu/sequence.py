@@ -43,6 +43,11 @@ class Sequence:
 
         self.status = SequenceStatus.WAITING
         self.num_computed_tokens = 0
+        # True while a scheduled chunk for this seq is in flight in the
+        # pipeline (reference keeps <= pp_size batches in flight,
+        # scheduler.py:358-364; an in-flight seq must not be rescheduled or
+        # preempted until its step lands).
+        self.in_flight = False
         self.page_table: List[int] = []
         # Pages whose contents came from the prefix cache (KV already valid).
         self.num_cached_tokens = 0
