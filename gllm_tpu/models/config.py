@@ -42,6 +42,26 @@ class ModelConfig:
     mlp_only_layers: Tuple[int, ...] = ()
     shared_expert_intermediate_size: int = 0
 
+    # MLA (DeepSeek V2/V3 — reference models/deepseek_v2.py)
+    q_lora_rank: int = 0              # 0 → direct q projection (V2-Lite)
+    kv_lora_rank: int = 0             # > 0 enables MLA latent cache
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
+    # DeepSeek MoE routing
+    first_k_dense_replace: int = 0
+    n_shared_experts: int = 0
+    routed_scaling_factor: float = 1.0
+    n_group: int = 0
+    topk_group: int = 0
+    scoring_func: str = "softmax"     # softmax (V2) | sigmoid (V3)
+    topk_method: str = "greedy"       # greedy | group_limited_greedy |
+                                      # noaux_tc (V3 bias-corrected)
+
+    @property
+    def use_mla(self) -> bool:
+        return self.kv_lora_rank > 0
+
     # Pipeline-parallel stage slice (rank-aware model construction like the
     # reference's per-stage layer builds, qwen2.py:186-270). Full model by
     # default.
@@ -103,7 +123,8 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
         bos_token_id=_first_eos(hf.get("bos_token_id")),
         hidden_act=hf.get("hidden_act", "silu"),
         num_experts=hf.get("num_experts",
-                           hf.get("num_local_experts", 0) or 0),
+                           hf.get("num_local_experts",
+                                  hf.get("n_routed_experts", 0)) or 0),
         num_experts_per_tok=hf.get("num_experts_per_tok", 0) or 0,
         moe_intermediate_size=hf.get("moe_intermediate_size", 0) or 0,
         norm_topk_prob=hf.get("norm_topk_prob", True),
@@ -111,4 +132,16 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
         mlp_only_layers=tuple(hf.get("mlp_only_layers", []) or []),
         shared_expert_intermediate_size=hf.get(
             "shared_expert_intermediate_size", 0) or 0,
+        q_lora_rank=hf.get("q_lora_rank", 0) or 0,
+        kv_lora_rank=hf.get("kv_lora_rank", 0) or 0,
+        qk_nope_head_dim=hf.get("qk_nope_head_dim", 0) or 0,
+        qk_rope_head_dim=hf.get("qk_rope_head_dim", 0) or 0,
+        v_head_dim=hf.get("v_head_dim", 0) or 0,
+        first_k_dense_replace=hf.get("first_k_dense_replace", 0) or 0,
+        n_shared_experts=hf.get("n_shared_experts", 0) or 0,
+        routed_scaling_factor=hf.get("routed_scaling_factor", 1.0) or 1.0,
+        n_group=hf.get("n_group", 0) or 0,
+        topk_group=hf.get("topk_group", 0) or 0,
+        scoring_func=hf.get("scoring_func", "softmax") or "softmax",
+        topk_method=hf.get("topk_method", "greedy") or "greedy",
     )

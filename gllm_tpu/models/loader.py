@@ -138,11 +138,21 @@ def _load_params(model_dir: str, template, rules,
             continue
         path, idx, tf = r
         t = np.asarray(lazy.get(name))
-        if tf == "t":
-            t = t.T
         dst = host
         for kpath in path[:-1]:
             dst = dst[kpath]
+        if callable(tf):
+            # transform expands one HF tensor into several leaves (e.g.
+            # DeepSeek kv_b_proj → absorbed w_uk + w_uv)
+            for leaf_name, arr in tf(t).items():
+                leaf = dst[leaf_name]
+                if idx is None:
+                    leaf[...] = arr.astype(leaf.dtype)
+                else:
+                    leaf[idx] = arr.astype(leaf.dtype)
+            continue
+        if tf == "t":
+            t = t.T
         leaf = dst[path[-1]]
         if idx is None:
             leaf[...] = t.astype(leaf.dtype)
@@ -220,3 +230,88 @@ def load_moe_params(model_dir: str, cfg: ModelConfig,
     from gllm_tpu.models import moe
     template = jax.eval_shape(lambda: moe.init_params(cfg, dtype=dtype))
     return _load_params(model_dir, template, moe_rules(cfg), progress_cb)
+
+
+def deepseek_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
+    """DeepSeek V2/V3: MLA projections (kv_b_proj split into absorbed
+    W_UK/W_UV at load — reference does this at runtime,
+    layers/attention.py:272-293), dense-then-MoE layer groups."""
+    first, last = cfg.stage_layers
+    k_dense = cfg.first_k_dense_replace
+    nope, v, lora = cfg.qk_nope_head_dim, cfg.v_head_dim, cfg.kv_lora_rank
+    Hq = cfg.num_heads
+
+    def split_kv_b(t: np.ndarray) -> dict:
+        # t: [Hq*(nope+v), lora] → w_uk [Hq, nope, lora], w_uv [Hq, lora, v]
+        m = t.reshape(Hq, nope + v, lora)
+        return {"w_uk": m[:, :nope, :],
+                "w_uv": m[:, nope:, :].transpose(0, 2, 1)}
+
+    attn_map = {
+        "self_attn.q_proj.weight": ("q_proj", "t"),
+        "self_attn.q_a_proj.weight": ("q_a_proj", "t"),
+        "self_attn.q_a_layernorm.weight": ("q_a_norm", None),
+        "self_attn.q_b_proj.weight": ("q_b_proj", "t"),
+        "self_attn.kv_a_proj_with_mqa.weight": ("kv_a_proj", "t"),
+        "self_attn.kv_a_layernorm.weight": ("kv_a_norm", None),
+        "self_attn.o_proj.weight": ("o_proj", "t"),
+        "input_layernorm.weight": ("input_norm", None),
+        "post_attention_layernorm.weight": ("post_attn_norm", None),
+        "mlp.gate_proj.weight": ("gate_proj", "t"),
+        "mlp.up_proj.weight": ("up_proj", "t"),
+        "mlp.down_proj.weight": ("down_proj", "t"),
+        "mlp.shared_experts.gate_proj.weight": ("shared_gate_proj", "t"),
+        "mlp.shared_experts.up_proj.weight": ("shared_up_proj", "t"),
+        "mlp.shared_experts.down_proj.weight": ("shared_down_proj", "t"),
+    }
+    expert_leaves = {
+        "gate_proj.weight": ("w_gate", "t"),
+        "up_proj.weight": ("w_up", "t"),
+        "down_proj.weight": ("w_down", "t"),
+    }
+
+    def rule(name: str) -> Optional[Rule]:
+        if name == "model.embed_tokens.weight":
+            return (("embed",), None, None) if cfg.is_first_stage else None
+        if name == "model.norm.weight":
+            return (("final_norm",), None, None) if cfg.is_last_stage else None
+        if name == "lm_head.weight":
+            if cfg.is_last_stage and not cfg.tie_word_embeddings:
+                return (("lm_head",), None, "t")
+            return None
+        if not name.startswith("model.layers."):
+            return None
+        rest = name[len("model.layers."):]
+        idx_s, _, leaf = rest.partition(".")
+        i = int(idx_s)
+        if not (first <= i < last):
+            return None
+        group = "dense_layers" if i < k_dense else "moe_layers"
+        li = (i - first) if i < k_dense else (i - max(first, k_dense))
+        if leaf == "self_attn.kv_b_proj.weight":
+            return ((group, "__multi__"), li, split_kv_b)
+        if leaf in attn_map:
+            target, tf = attn_map[leaf]
+            return ((group, target), li, tf)
+        if leaf == "mlp.gate.weight":
+            return ((group, "router"), li, "t")
+        if leaf == "mlp.gate.e_score_correction_bias":
+            return ((group, "e_bias"), li, None)
+        if leaf.startswith("mlp.experts."):
+            rest2 = leaf[len("mlp.experts."):]
+            e_s, _, el = rest2.partition(".")
+            if el in expert_leaves:
+                target, tf = expert_leaves[el]
+                return ((group, target), (li, int(e_s)), tf)
+        return None
+
+    return rule
+
+
+def load_deepseek_params(model_dir: str, cfg: ModelConfig,
+                         dtype=jnp.bfloat16,
+                         progress_cb=None) -> dict:
+    from gllm_tpu.models import deepseek
+    template = jax.eval_shape(lambda: deepseek.init_params(cfg, dtype=dtype))
+    return _load_params(model_dir, template, deepseek_rules(cfg),
+                        progress_cb)

@@ -23,11 +23,13 @@ class ModelDef:
     load_params: Callable          # (model_dir, cfg, dtype) -> params
     init_kv_cache: Callable
     param_specs: Callable          # (cfg, tp) -> PartitionSpec pytree
+    kv_specs: Callable             # (cfg, tp) -> cache PartitionSpec pytree
 
 
 def _dense_def() -> ModelDef:
     from gllm_tpu.models import dense, loader
-    from gllm_tpu.parallel.shardings import dense_param_specs
+    from gllm_tpu.parallel.shardings import (dense_param_specs,
+                                             kv_cache_specs)
     return ModelDef(
         family="dense",
         init_params=dense.init_params,
@@ -37,6 +39,7 @@ def _dense_def() -> ModelDef:
         load_params=loader.load_dense_params,
         init_kv_cache=dense.init_kv_cache,
         param_specs=dense_param_specs,
+        kv_specs=kv_cache_specs,
     )
 
 
@@ -54,9 +57,12 @@ def get_model_def(cfg: ModelConfig) -> ModelDef:
     if cfg.architecture in _MOE_ARCHS:
         from gllm_tpu.models.registry_moe import moe_def
         return moe_def()
+    if cfg.architecture in _MLA_ARCHS:
+        from gllm_tpu.models.registry_moe import deepseek_def
+        return deepseek_def()
     raise NotImplementedError(
         f"architecture {cfg.architecture!r} not supported yet; "
-        f"dense: {_DENSE_ARCHS}, moe: {_MOE_ARCHS}")
+        f"dense: {_DENSE_ARCHS}, moe: {_MOE_ARCHS}, mla: {_MLA_ARCHS}")
 
 
 _MOE_ARCHS = (
@@ -65,8 +71,14 @@ _MOE_ARCHS = (
     "Qwen3MoeForCausalLM",
 )
 
+_MLA_ARCHS = (
+    "DeepseekV2ForCausalLM",
+    "DeepseekV3ForCausalLM",
+)
+
 
 def supported_architectures() -> Dict[str, str]:
     out = {a: "dense" for a in _DENSE_ARCHS}
     out.update({a: "moe" for a in _MOE_ARCHS})
+    out.update({a: "mla-moe" for a in _MLA_ARCHS})
     return out

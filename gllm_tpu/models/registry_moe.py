@@ -5,9 +5,27 @@ from __future__ import annotations
 from gllm_tpu.models.registry import ModelDef
 
 
+def deepseek_def() -> ModelDef:
+    from gllm_tpu.models import deepseek, loader
+    from gllm_tpu.parallel.shardings import (deepseek_param_specs,
+                                             latent_kv_specs)
+    return ModelDef(
+        family="deepseek",
+        init_params=deepseek.init_params,
+        forward=deepseek.forward,
+        compute_logits=deepseek.compute_logits,
+        make_rope_table=deepseek.make_rope_table,
+        load_params=loader.load_deepseek_params,
+        init_kv_cache=deepseek.init_kv_cache,
+        param_specs=deepseek_param_specs,
+        kv_specs=latent_kv_specs,
+    )
+
+
 def moe_def() -> ModelDef:
     from gllm_tpu.models import loader, moe
-    from gllm_tpu.parallel.shardings import moe_param_specs
+    from gllm_tpu.parallel.shardings import (kv_cache_specs,
+                                             moe_param_specs)
     return ModelDef(
         family="moe",
         init_params=moe.init_params,
@@ -17,4 +35,5 @@ def moe_def() -> ModelDef:
         load_params=loader.load_moe_params,
         init_kv_cache=moe.init_kv_cache,
         param_specs=moe_param_specs,
+        kv_specs=kv_cache_specs,
     )

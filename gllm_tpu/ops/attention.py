@@ -90,6 +90,8 @@ def _xla_paged_attention(q, k_cache, v_cache, md: AttentionMetadata, *,
                          scale: float, max_q_len: int):
     T, num_q_heads, head_dim = q.shape
     num_pages, page_size, num_kv_heads, _ = k_cache.shape
+    v_dim = v_cache.shape[-1]     # may differ from head_dim (MLA: values
+                                  # are the latent prefix of the keys)
     S, max_pages = md.page_table.shape
     group = num_q_heads // num_kv_heads
     max_kv = max_pages * page_size
@@ -103,7 +105,7 @@ def _xla_paged_attention(q, k_cache, v_cache, md: AttentionMetadata, *,
 
     # Gather per-seq KV pages → [S, max_kv, Hkv, D]
     kg = k_cache[md.page_table].reshape(S, max_kv, num_kv_heads, head_dim)
-    vg = v_cache[md.page_table].reshape(S, max_kv, num_kv_heads, head_dim)
+    vg = v_cache[md.page_table].reshape(S, max_kv, num_kv_heads, v_dim)
 
     # Causal+context mask: query at local index t has absolute position
     # kv_len - q_len + t; key j is visible iff j <= that position.
@@ -121,11 +123,11 @@ def _xla_paged_attention(q, k_cache, v_cache, md: AttentionMetadata, *,
     # Rows with no visible keys (padding) produce NaN-free zeros:
     probs = jnp.where(visible[:, None, None, :, :], probs, 0.0)
     out = jnp.einsum("shgqk,skhd->sqhgd", probs, vg.astype(jnp.float32))
-    out = out.reshape(S, max_q_len, num_q_heads, head_dim).astype(q.dtype)
+    out = out.reshape(S, max_q_len, num_q_heads, v_dim).astype(q.dtype)
 
     # Scatter back to the ragged token layout. Padded/invalid rows carry
     # zeros and clipped duplicate indices — scatter-add keeps it exact.
     out = jnp.where(q_valid[:, :, None, None], out, 0)
-    flat = jnp.zeros_like(q)
+    flat = jnp.zeros((T, num_q_heads, v_dim), q.dtype)
     return flat.at[q_idx.reshape(-1)].add(
-        out.reshape(S * max_q_len, num_q_heads, head_dim))
+        out.reshape(S * max_q_len, num_q_heads, v_dim))

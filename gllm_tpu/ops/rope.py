@@ -13,7 +13,7 @@ float32) and gathered by token position inside the jit'd step — a cheap
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax.numpy as jnp
 
@@ -44,6 +44,58 @@ def _llama3_scale_inv_freq(inv_freq: jnp.ndarray,
     return scaled
 
 
+def _yarn_get_mscale(scale: float, mscale: float) -> float:
+    if scale <= 1.0:
+        return 1.0
+    return 0.1 * mscale * math.log(scale) + 1.0
+
+
+def _yarn_inv_freq(rot_dim: int, theta: float,
+                   s: Dict[str, Any]) -> Tuple[jnp.ndarray, float]:
+    """YaRN NTK-by-parts frequency blend (reference rotary_embedding.py YaRN
+    variant; used by DeepSeek V2/V3). Returns (inv_freq, cos_sin_mscale)."""
+    factor = s.get("factor", 1.0)
+    orig_max = s.get("original_max_position_embeddings", 4096)
+    beta_fast = s.get("beta_fast", 32)
+    beta_slow = s.get("beta_slow", 1)
+    mscale = s.get("mscale", 1.0)
+    mscale_all_dim = s.get("mscale_all_dim", 0.0)
+
+    def correction_dim(num_rot):
+        return (rot_dim * math.log(orig_max / (num_rot * 2 * math.pi))
+                / (2 * math.log(theta)))
+
+    low = math.floor(correction_dim(beta_fast))
+    high = math.ceil(correction_dim(beta_slow))
+    low, high = max(low, 0), min(high, rot_dim - 1)
+
+    pos_freq = theta ** (jnp.arange(0, rot_dim, 2, dtype=jnp.float32)
+                         / rot_dim)
+    inv_extra = 1.0 / pos_freq
+    inv_interp = 1.0 / (factor * pos_freq)
+    # linear ramp over dims: 0 below low (extrapolate), 1 above high
+    idx = jnp.arange(rot_dim // 2, dtype=jnp.float32)
+    ramp = jnp.clip((idx - low) / max(high - low, 0.001), 0, 1)
+    inv_freq_mask = 1.0 - ramp
+    inv_freq = inv_interp * (1 - inv_freq_mask) + inv_extra * inv_freq_mask
+    cs_mscale = float(_yarn_get_mscale(factor, mscale)
+                      / _yarn_get_mscale(factor, mscale_all_dim))
+    return inv_freq, cs_mscale
+
+
+def yarn_softmax_scale_mult(rope_scaling: Optional[Dict[str, Any]]) -> float:
+    """Extra attention-scale factor under YaRN with mscale_all_dim
+    (HF DeepSeek: softmax_scale *= mscale**2)."""
+    if not rope_scaling:
+        return 1.0
+    rtype = rope_scaling.get("rope_type", rope_scaling.get("type"))
+    if rtype != "yarn":
+        return 1.0
+    m = _yarn_get_mscale(rope_scaling.get("factor", 1.0),
+                         rope_scaling.get("mscale_all_dim", 0.0))
+    return m * m
+
+
 def compute_rope_cos_sin(
     rot_dim: int,
     max_position: int,
@@ -53,6 +105,7 @@ def compute_rope_cos_sin(
     """Returns [max_position, rot_dim] table: concat(cos, sin) halves."""
     inv_freq = _base_inv_freq(rot_dim, theta)
     positions = jnp.arange(max_position, dtype=jnp.float32)
+    mscale = 1.0
     if rope_scaling:
         rtype = rope_scaling.get("rope_type",
                                  rope_scaling.get("type", "default"))
@@ -60,12 +113,15 @@ def compute_rope_cos_sin(
             positions = positions / rope_scaling.get("factor", 1.0)
         elif rtype in ("llama3",):
             inv_freq = _llama3_scale_inv_freq(inv_freq, rope_scaling)
+        elif rtype in ("yarn",):
+            inv_freq, mscale = _yarn_inv_freq(rot_dim, theta, rope_scaling)
         elif rtype in ("default", "mrope", None):
             pass
         else:
             raise NotImplementedError(f"rope scaling type {rtype!r}")
     freqs = jnp.outer(positions, inv_freq)          # [max_pos, rot_dim/2]
-    return jnp.concatenate([jnp.cos(freqs), jnp.sin(freqs)], axis=-1)
+    return jnp.concatenate([jnp.cos(freqs) * mscale,
+                            jnp.sin(freqs) * mscale], axis=-1)
 
 
 def apply_rope(q: jnp.ndarray, k: jnp.ndarray, positions: jnp.ndarray,
@@ -95,3 +151,16 @@ def apply_rope(q: jnp.ndarray, k: jnp.ndarray, positions: jnp.ndarray,
         return out
 
     return rotate(q), rotate(k)
+
+
+def apply_rope_interleaved(q: jnp.ndarray, k: jnp.ndarray,
+                           positions: jnp.ndarray, cos_sin: jnp.ndarray):
+    """DeepSeek-layout rotary: channels are (pair-interleaved) —
+    HF's modeling reorders ``d//2 pairs`` into half layout before the
+    standard rotate-half (apply_rotary_pos_emb in HF deepseek models).
+    """
+    def deinterleave(x):
+        *lead, d = x.shape
+        return x.reshape(*lead, d // 2, 2).swapaxes(-1, -2).reshape(*lead, d)
+
+    return apply_rope(deinterleave(q), deinterleave(k), positions, cos_sin)

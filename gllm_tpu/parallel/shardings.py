@@ -101,6 +101,12 @@ def kv_cache_specs(cfg: ModelConfig, tp: int):
     return KVCache(spec, spec)
 
 
+def latent_kv_specs(cfg: ModelConfig, tp: int):
+    """MLA latent cache is MQA-shaped (no head axis) → replicated over tp."""
+    from gllm_tpu.models.deepseek import LatentKVCache
+    return LatentKVCache(P(None, None, None, None))
+
+
 def shard_params(params, specs, mesh: Optional[Mesh]):
     """Place a param pytree onto the mesh with the given specs."""
     if mesh is None:
@@ -108,3 +114,64 @@ def shard_params(params, specs, mesh: Optional[Mesh]):
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, specs)
+
+
+def deepseek_param_specs(cfg: ModelConfig, tp: int) -> dict:
+    """DeepSeek MLA + MoE shardings: query heads / absorbed W_UK/W_UV /
+    o_proj shard over heads; latent projections replicate (rank dims are
+    small); experts shard over tp (EP)."""
+    heads_ok = cfg.num_heads % tp == 0
+    h = _tp_if(heads_ok)
+    ep = _tp_if(cfg.num_experts % tp == 0 if cfg.num_experts else False)
+    inter_ok = cfg.intermediate_size % tp == 0
+    vocab_ok = cfg.vocab_size % tp == 0
+
+    def mla_block(has_mlp_dense: bool, L_key: str) -> dict:
+        d = {
+            "input_norm": P(None, None),
+            "post_attn_norm": P(None, None),
+            "kv_a_proj": P(None, None, None),
+            "kv_a_norm": P(None, None),
+            "w_uk": P(None, h, None, None),
+            "w_uv": P(None, h, None, None),
+            "o_proj": P(None, h, None),
+        }
+        if cfg.q_lora_rank:
+            d["q_a_proj"] = P(None, None, None)
+            d["q_a_norm"] = P(None, None)
+            d["q_b_proj"] = P(None, None, h)
+        else:
+            d["q_proj"] = P(None, None, h)
+        return d
+
+    specs: dict = {}
+    first, last = cfg.stage_layers
+    n_dense = max(0, min(cfg.first_k_dense_replace, last) - first)
+    n_moe = (last - first) - n_dense
+    if n_dense:
+        d = mla_block(True, "dense_layers")
+        d["gate_proj"] = P(None, None, _tp_if(inter_ok))
+        d["up_proj"] = P(None, None, _tp_if(inter_ok))
+        d["down_proj"] = P(None, _tp_if(inter_ok), None)
+        specs["dense_layers"] = d
+    if n_moe:
+        m = mla_block(False, "moe_layers")
+        m["router"] = P(None, None, None)
+        if cfg.topk_method == "noaux_tc":
+            m["e_bias"] = P(None, None)
+        m["w_gate"] = P(None, ep, None, None)
+        m["w_up"] = P(None, ep, None, None)
+        m["w_down"] = P(None, ep, None, None)
+        si_ok = (cfg.n_shared_experts
+                 * cfg.moe_intermediate_size) % tp == 0
+        m["shared_gate_proj"] = P(None, None, _tp_if(si_ok))
+        m["shared_up_proj"] = P(None, None, _tp_if(si_ok))
+        m["shared_down_proj"] = P(None, _tp_if(si_ok), None)
+        specs["moe_layers"] = m
+    if cfg.is_first_stage:
+        specs["embed"] = P(_tp_if(vocab_ok), None)
+    if cfg.is_last_stage:
+        specs["final_norm"] = P(None)
+        if not cfg.tie_word_embeddings:
+            specs["lm_head"] = P(None, _tp_if(vocab_ok))
+    return specs

@@ -139,11 +139,10 @@ class PPModelRunner(ModelRunner):
                 else _DTYPES[config.cache.kv_cache_dtype])
             if smesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
-                from gllm_tpu.parallel.shardings import (kv_cache_specs,
-                                                         shard_params)
+                from gllm_tpu.parallel.shardings import shard_params
                 sparams = shard_params(
                     sparams, self.model_def.param_specs(scfg, tp), smesh)
-                kspecs = kv_cache_specs(scfg, tp)
+                kspecs = self.model_def.kv_specs(scfg, tp)
                 skv = jax.tree.map(
                     lambda x, s: jax.device_put(x, NamedSharding(smesh, s)),
                     skv, kspecs)

@@ -80,8 +80,7 @@ class ModelRunner:
             self._kv_dtype())
         if self.mesh is not None:
             from jax.sharding import NamedSharding
-            from gllm_tpu.parallel.shardings import kv_cache_specs
-            kspecs = kv_cache_specs(model_cfg, config.parallel.tp)
+            kspecs = self.model_def.kv_specs(model_cfg, config.parallel.tp)
             self.kv = jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
                 self.kv, kspecs)
@@ -118,6 +117,11 @@ class ModelRunner:
         divisible, so each chip holds 1/tp of every page)."""
         cfg, page = self.model_cfg, self.config.cache.page_size
         itemsize = jnp.dtype(self._kv_dtype()).itemsize
+        if cfg.use_mla:
+            # MLA latent cache: one [lora+rope] row per token, replicated
+            # over tp (MQA-shaped).
+            width = cfg.kv_lora_rank + cfg.qk_rope_head_dim
+            return cfg.num_stage_layers * page * width * itemsize
         tp = self.config.parallel.tp
         shards = tp if (self.mesh is not None
                         and cfg.num_kv_heads % tp == 0) else 1

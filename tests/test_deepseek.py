@@ -1,0 +1,98 @@
+"""DeepSeek V2/V3 (MLA + DeepSeekMoE): HF greedy-equivalence oracles."""
+
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.sampling_params import SamplingParams
+
+BASE = dict(
+    vocab_size=128, hidden_size=64, num_hidden_layers=3,
+    num_attention_heads=4, num_key_value_heads=4, intermediate_size=96,
+    max_position_embeddings=256, rms_norm_eps=1e-6, rope_theta=10000.0,
+    tie_word_embeddings=False, eos_token_id=0,
+    # MLA geometry
+    kv_lora_rank=32, qk_nope_head_dim=16, qk_rope_head_dim=8,
+    v_head_dim=16,
+    # MoE: 1 dense layer then MoE layers
+    n_routed_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+    first_k_dense_replace=1, n_shared_experts=1, moe_layer_freq=1,
+    routed_scaling_factor=1.5,
+)
+
+
+def make_ckpt(arch, tmpdir, **over):
+    torch.manual_seed(31)
+    cfg_kw = {**BASE, **over}
+    if arch == "DeepseekV2ForCausalLM":
+        from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+        cfg = DeepseekV2Config(**cfg_kw)
+        model = DeepseekV2ForCausalLM(cfg)
+    else:
+        from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+        cfg = DeepseekV3Config(**cfg_kw)
+        model = DeepseekV3ForCausalLM(cfg)
+    model.eval()
+    model.save_pretrained(tmpdir, safe_serialization=True)
+    return model
+
+
+def hf_greedy(model, prompt_ids, n):
+    ids = list(prompt_ids)
+    with torch.no_grad():
+        for _ in range(n):
+            logits = model(torch.tensor([ids])).logits[0, -1]
+            ids.append(int(logits.argmax()))
+    return ids[len(prompt_ids):]
+
+
+def ours(model_dir, prompts, n):
+    cfg = EngineConfig(model=model_dir, dtype="float32", max_model_len=128,
+                       cache=CacheConfig(page_size=4, num_pages=128))
+    llm = LLM(config=cfg)
+    return [o.output_token_ids for o in llm.generate(
+        prompt_token_ids=prompts,
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=n,
+                                       ignore_eos=True))]
+
+
+@pytest.mark.parametrize("q_lora", [None, 48])
+def test_deepseek_v2_greedy_equivalence(tmp_path, q_lora):
+    hf = make_ckpt("DeepseekV2ForCausalLM", tmp_path, q_lora_rank=q_lora,
+                   topk_method="greedy", n_group=None, topk_group=None,
+                   scoring_func="softmax", norm_topk_prob=False)
+    prompts = [[7, 3, 56, 21], [99, 14, 2]]
+    got = ours(str(tmp_path), prompts, 8)
+    for p, g in zip(prompts, got):
+        assert g == hf_greedy(hf, p, 8), (p, g)
+
+
+def test_deepseek_v3_greedy_equivalence(tmp_path):
+    hf = make_ckpt("DeepseekV3ForCausalLM", tmp_path, q_lora_rank=48,
+                   n_group=4, topk_group=2, topk_method="noaux_tc",
+                   scoring_func="sigmoid", norm_topk_prob=True)
+    # give the correction bias real values so the noaux_tc path is exercised
+    with torch.no_grad():
+        for layer in hf.model.layers[1:]:
+            layer.mlp.gate.e_score_correction_bias.add_(
+                torch.randn_like(layer.mlp.gate.e_score_correction_bias)
+                * 0.1)
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+    prompts = [[5, 9, 23, 41, 77], [100, 90]]
+    got = ours(str(tmp_path), prompts, 8)
+    for p, g in zip(prompts, got):
+        assert g == hf_greedy(hf, p, 8), (p, g)
+
+
+def test_deepseek_v2_yarn_rope(tmp_path):
+    scaling = {"rope_type": "yarn", "factor": 2.0, "beta_fast": 32,
+               "beta_slow": 1, "mscale": 0.707, "mscale_all_dim": 0.707,
+               "original_max_position_embeddings": 64}
+    hf = make_ckpt("DeepseekV2ForCausalLM", tmp_path, q_lora_rank=None,
+                   topk_method="greedy", n_group=None, topk_group=None,
+                   scoring_func="softmax", norm_topk_prob=False,
+                   rope_scaling=scaling)
+    prompts = [[9, 8, 7, 6, 5, 4, 3, 2]]
+    got = ours(str(tmp_path), prompts, 6)
+    assert got[0] == hf_greedy(hf, prompts[0], 6)
