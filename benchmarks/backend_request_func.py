@@ -1,0 +1,116 @@
+"""Per-request serving measurements: TTFT / ITL / E2E latency.
+
+stdlib re-design of the reference's vLLM-style async request functions
+(/root/reference/benchmarks/backend_request_func.py:38-46): each request
+streams from the OpenAI endpoint and records time-to-first-token,
+inter-token latencies, and end-to-end latency. Thread-per-request instead of
+aiohttp (this image has no aiohttp).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import json
+import time
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class RequestResult:
+    success: bool = False
+    ttft_s: float = 0.0
+    itl_s: List[float] = dataclasses.field(default_factory=list)
+    e2e_s: float = 0.0
+    output_tokens: int = 0
+    error: str = ""
+
+    @property
+    def tpot_s(self) -> float:
+        return (sum(self.itl_s) / len(self.itl_s)) if self.itl_s else 0.0
+
+
+def stream_completion(host: str, port: int, payload: dict,
+                      path: str = "/v1/completions",
+                      timeout: float = 600.0) -> RequestResult:
+    """Fire one streaming request; measure token arrival times."""
+    res = RequestResult()
+    payload = dict(payload, stream=True)
+    t0 = time.perf_counter()
+    last = t0
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        conn.request("POST", path, body=json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            res.error = f"HTTP {resp.status}: {resp.read()[:200]!r}"
+            return res
+        buf = b""
+        while True:
+            chunk = resp.read(4096)
+            if not chunk:
+                break
+            buf += chunk
+            while b"\n\n" in buf:
+                event, buf = buf.split(b"\n\n", 1)
+                if not event.startswith(b"data: "):
+                    continue
+                payload_b = event[6:]
+                if payload_b == b"[DONE]":
+                    continue
+                d = json.loads(payload_b)
+                choice = d["choices"][0]
+                delta = choice.get("delta")
+                # one event == one token: completion chunks carry "text",
+                # chat chunks a delta with "content" (possibly empty when
+                # detokenization held bytes back); skip the role preamble
+                is_token = ("text" in choice if delta is None
+                            else "content" in (delta or {}))
+                if delta is not None and "role" in delta and "content" \
+                        not in delta:
+                    is_token = False
+                now = time.perf_counter()
+                if is_token:
+                    if res.output_tokens == 0:
+                        res.ttft_s = now - t0
+                    else:
+                        res.itl_s.append(now - last)
+                    res.output_tokens += 1
+                    last = now
+        res.e2e_s = time.perf_counter() - t0
+        res.success = res.output_tokens > 0
+        conn.close()
+    except Exception as e:  # noqa: BLE001
+        res.error = str(e)
+    return res
+
+
+def percentile(vals: List[float], p: float) -> float:
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    i = min(len(vals) - 1, int(p / 100.0 * len(vals)))
+    return vals[i]
+
+
+def summarize(results: List[RequestResult], wall_s: float) -> dict:
+    ok = [r for r in results if r.success]
+    out_toks = sum(r.output_tokens for r in ok)
+    ttfts = [r.ttft_s for r in ok]
+    tpots = [r.tpot_s for r in ok if r.itl_s]
+    return {
+        "completed": len(ok),
+        "failed": len(results) - len(ok),
+        "wall_s": round(wall_s, 2),
+        "request_throughput_rps": round(len(ok) / wall_s, 3),
+        "output_tok_s": round(out_toks / wall_s, 1),
+        "ttft_ms": {"mean": round(1e3 * sum(ttfts) / len(ttfts), 1)
+                    if ttfts else 0,
+                    "p50": round(1e3 * percentile(ttfts, 50), 1),
+                    "p99": round(1e3 * percentile(ttfts, 99), 1)},
+        "tpot_ms": {"mean": round(1e3 * sum(tpots) / len(tpots), 1)
+                    if tpots else 0,
+                    "p50": round(1e3 * percentile(tpots, 50), 1),
+                    "p99": round(1e3 * percentile(tpots, 99), 1)},
+    }

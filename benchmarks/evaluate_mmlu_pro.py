@@ -1,0 +1,70 @@
+"""MMLU-Pro-style multiple-choice accuracy eval against a running server
+(reference benchmarks/evaluate_mmlu_pro.py).
+
+Zero-egress environment: the dataset must be a LOCAL file
+(``--data-path`` jsonl with fields: question, options (list), answer
+(letter or index)). The prompting/extraction protocol mirrors the
+reference: few-shot-free direct answering, "Answer:" extraction of the
+first choice letter.
+"""
+
+import argparse
+import http.client
+import json
+import re
+import sys
+
+LETTERS = "ABCDEFGHIJ"
+
+
+def format_prompt(q):
+    opts = "\n".join(f"{LETTERS[i]}. {o}"
+                     for i, o in enumerate(q["options"]))
+    return (f"Question: {q['question']}\nOptions:\n{opts}\n"
+            "Answer with the option letter only.\nAnswer:")
+
+
+def extract_choice(text):
+    m = re.search(r"\b([A-J])\b", text.strip().upper())
+    return m.group(1) if m else None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-path", required=True,
+                    help="local jsonl: question/options/answer per line")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--limit", type=int, default=None)
+    args = ap.parse_args()
+
+    with open(args.data_path) as f:
+        questions = [json.loads(line) for line in f if line.strip()]
+    if args.limit:
+        questions = questions[:args.limit]
+
+    correct = total = 0
+    for q in questions:
+        body = {"messages": [{"role": "user",
+                              "content": format_prompt(q)}],
+                "max_tokens": 8, "temperature": 0.0}
+        conn = http.client.HTTPConnection(args.host, args.port, timeout=600)
+        conn.request("POST", "/v1/chat/completions", body=json.dumps(body),
+                     headers={"Content-Type": "application/json"})
+        d = json.loads(conn.getresponse().read())
+        conn.close()
+        got = extract_choice(d["choices"][0]["message"]["content"] or "")
+        want = q["answer"]
+        if isinstance(want, int):
+            want = LETTERS[want]
+        total += 1
+        correct += int(got == str(want).strip().upper())
+        if total % 50 == 0:
+            print(f"{total}: acc={correct / total:.3f}", file=sys.stderr)
+    print(json.dumps({"metric": "mmlu_pro_accuracy",
+                      "value": correct / max(1, total),
+                      "n": total}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,99 @@
+"""Long-context retrieval eval (RULER-style needle-in-a-haystack).
+
+Counterpart of the reference's evaluate_ruler.py long-context eval — but
+fully offline: the haystack/needle data is synthesized locally (this
+environment has zero egress), so it doubles as an e2e long-context
+correctness check of chunked prefill + paged KV.
+
+Drives the OpenAI endpoint of a running server OR an in-process LLM
+(--model), reports exact-match retrieval accuracy per context length.
+"""
+
+import argparse
+import json
+import random
+import sys
+
+
+def build_case(rng, tokenizer, context_tokens):
+    key = rng.randrange(10000, 99999)
+    val = rng.randrange(10000, 99999)
+    needle = f" The secret code for {key} is {val}. "
+    filler_unit = ("The sky is blue and the grass grows slowly in spring. ")
+    n_units = max(1, context_tokens // max(
+        1, len(tokenizer.encode(filler_unit))))
+    pos = rng.randrange(max(1, n_units))
+    text = (filler_unit * pos) + needle + (filler_unit * (n_units - pos))
+    question = (f"\nQuestion: What is the secret code for {key}? "
+                f"Answer with the number only.\nAnswer:")
+    return text + question, str(val)
+
+
+def run_inprocess(args, cases):
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.sampling_params import SamplingParams
+    llm = LLM(args.model, max_model_len=args.max_model_len)
+    prompts = [llm.encode(p)[-(args.max_model_len - 32):]
+               for p, _ in cases]
+    outs = llm.generate(
+        prompt_token_ids=prompts,
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=16))
+    return [o.text for o in outs]
+
+
+def run_server(args, cases):
+    import http.client
+    answers = []
+    for prompt, _ in cases:
+        conn = http.client.HTTPConnection(args.host, args.port, timeout=600)
+        conn.request("POST", "/v1/completions", body=json.dumps({
+            "prompt": prompt, "max_tokens": 16, "temperature": 0.0}),
+            headers={"Content-Type": "application/json"})
+        d = json.loads(conn.getresponse().read())
+        answers.append(d["choices"][0]["text"])
+        conn.close()
+    return answers
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None, help="in-process mode")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=None, help="server mode")
+    ap.add_argument("--context-lens", default="1024,2048,4096")
+    ap.add_argument("--num-cases", type=int, default=10)
+    ap.add_argument("--max-model-len", type=int, default=8192)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    rng = random.Random(args.seed)
+    if args.model:
+        from transformers import AutoTokenizer
+        tokenizer = AutoTokenizer.from_pretrained(args.model,
+                                                  local_files_only=True)
+    else:
+        class Approx:  # server mode: approximate token counting
+            def encode(self, s):
+                return s.split()
+        tokenizer = Approx()
+
+    report = {}
+    for ctx in [int(c) for c in args.context_lens.split(",")]:
+        cases = [build_case(rng, tokenizer, ctx)
+                 for _ in range(args.num_cases)]
+        if args.model:
+            answers = run_inprocess(args, cases)
+        elif args.port:
+            answers = run_server(args, cases)
+        else:
+            raise SystemExit("pass --model (in-process) or --port (server)")
+        correct = sum(1 for (_, want), got in zip(cases, answers)
+                      if want in got)
+        report[ctx] = correct / len(cases)
+        print(f"context {ctx}: {correct}/{len(cases)} "
+              f"({report[ctx]:.0%})", file=sys.stderr)
+    print(json.dumps({"metric": "ruler_niah_accuracy", "by_context": report}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Online serving benchmark against a live server: TTFT / TPOT / throughput.
+
+Counterpart of the reference's serving benchmark flow (backend_request_func
+driven over a request list with bounded concurrency). stdlib threads.
+
+Usage:
+  python benchmarks/serve_bench.py --port 8000 --num-prompts 64 \
+      --concurrency 16 --prompt-len 256 --output-len 128
+"""
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+from benchmarks.backend_request_func import (RequestResult,  # noqa: E402
+                                             stream_completion, summarize)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--num-prompts", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--prompt-len", type=int, default=256)
+    ap.add_argument("--output-len", type=int, default=128)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(args.seed)
+    payloads = []
+    for _ in range(args.num_prompts):
+        p_len = max(4, int(rng.normal(args.prompt_len,
+                                      args.prompt_len / 4)))
+        payloads.append({
+            "prompt": rng.integers(1, 30000, size=p_len).tolist(),
+            "max_tokens": args.output_len,
+            "temperature": 0.0,
+            "ignore_eos": True,
+        })
+
+    results: list[RequestResult] = [None] * len(payloads)
+    sem = threading.Semaphore(args.concurrency)
+
+    def worker(i):
+        with sem:
+            results[i] = stream_completion(args.host, args.port, payloads[i])
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(payloads))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+
+    summary = summarize(results, wall)
+    errors = {r.error for r in results if r and not r.success and r.error}
+    if errors:
+        summary["errors"] = sorted(errors)[:3]
+    print(json.dumps(summary, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Offline throughput benchmark (reference examples/batch_inference.py).
+
+Drives ``LLM.generate`` over a ShareGPT-style JSON dataset (or a synthetic
+workload when no dataset is given — this environment has no egress) and
+prints reqs/s + input/output tok/s like the reference (:56-74).
+
+Usage:
+  python examples/batch_inference.py --model <dir> [--dataset sharegpt.json]
+  python examples/batch_inference.py --model-size tiny --dummy   # smoke
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def load_sharegpt(path, tokenizer, n, max_len):
+    with open(path) as f:
+        data = json.load(f)
+    out = []
+    for conv in data:
+        turns = conv.get("conversations", [])
+        if len(turns) < 2:
+            continue
+        prompt = tokenizer.encode(turns[0]["value"])[:max_len // 2]
+        completion = tokenizer.encode(turns[1]["value"])
+        if len(prompt) < 4:
+            continue
+        out.append((prompt, max(1, len(completion))))
+        if len(out) >= n:
+            break
+    return out
+
+
+def synthetic(rng, n, max_len):
+    out = []
+    for _ in range(n):
+        p = int(min(max(rng.lognormal(5.0, 0.8), 16), max_len // 2))
+        o = int(min(max(rng.lognormal(4.5, 0.7), 16), max_len // 2))
+        out.append((rng.integers(1, 30000, size=p).tolist(), o))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="")
+    ap.add_argument("--dataset", default=None, help="ShareGPT json")
+    ap.add_argument("--num-prompts", type=int, default=64)
+    ap.add_argument("--max-model-len", type=int, default=2048)
+    ap.add_argument("--maxp", type=int, default=1024)
+    ap.add_argument("--maxd", type=int, default=128)
+    ap.add_argument("--dummy", action="store_true",
+                    help="random weights (no checkpoint)")
+    ap.add_argument("--enable-prefix-caching", action="store_true")
+    args = ap.parse_args()
+
+    from gllm_tpu.config import (CacheConfig, EngineConfig, SchedulerConfig)
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.sampling_params import SamplingParams
+
+    cfg = EngineConfig(
+        model=args.model, max_model_len=args.max_model_len,
+        load_format="dummy" if args.dummy else "auto",
+        scheduler=SchedulerConfig(max_prefill_tokens=args.maxp,
+                                  max_decode_seqs=args.maxd),
+        cache=CacheConfig(enable_prefix_caching=args.enable_prefix_caching))
+    model_cfg = None
+    if args.dummy and not args.model:
+        from gllm_tpu.models.config import ModelConfig
+        model_cfg = ModelConfig(
+            architecture="LlamaForCausalLM", vocab_size=32000,
+            hidden_size=512, num_layers=4, num_heads=8, num_kv_heads=4,
+            head_dim=64, intermediate_size=1024,
+            max_position=args.max_model_len)
+    llm = LLM(config=cfg, model_cfg=model_cfg)
+
+    rng = np.random.default_rng(0)
+    if args.dataset:
+        workload = load_sharegpt(args.dataset, llm.tokenizer,
+                                 args.num_prompts, args.max_model_len)
+    else:
+        workload = synthetic(rng, args.num_prompts, args.max_model_len)
+    prompts = [p for p, _ in workload]
+    params = [SamplingParams(temperature=0.0, max_tokens=o, ignore_eos=True)
+              for _, o in workload]
+
+    t0 = time.monotonic()
+    outs = llm.generate(prompt_token_ids=prompts, sampling_params=params)
+    dt = time.monotonic() - t0
+
+    n_in = sum(len(p) for p in prompts)
+    n_out = sum(o.num_output_tokens for o in outs)
+    print(f"requests:      {len(prompts)} in {dt:.2f}s "
+          f"({len(prompts) / dt:.2f} req/s)")
+    print(f"input tokens:  {n_in} ({n_in / dt:.1f} tok/s)")
+    print(f"output tokens: {n_out} ({n_out / dt:.1f} tok/s)")
+    print(f"total:         {(n_in + n_out) / dt:.1f} tok/s")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,48 @@
+"""Interactive offline chat REPL (reference examples/chat.py).
+
+Usage: python examples/chat.py --model <dir> [--temperature 0.7]
+"""
+
+import argparse
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--temperature", type=float, default=0.7)
+    ap.add_argument("--max-tokens", type=int, default=512)
+    ap.add_argument("--system", default=None)
+    args = ap.parse_args()
+
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.sampling_params import SamplingParams
+
+    llm = LLM(args.model)
+    if llm.tokenizer is None:
+        raise SystemExit("chat REPL needs a tokenizer in the model dir")
+    sp = SamplingParams(temperature=args.temperature,
+                        max_tokens=args.max_tokens)
+    messages = []
+    if args.system:
+        messages.append({"role": "system", "content": args.system})
+    print("(/exit to quit, /reset to clear history)")
+    while True:
+        try:
+            user = input("you> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if user == "/exit":
+            break
+        if user == "/reset":
+            messages = messages[:1] if args.system else []
+            continue
+        if not user:
+            continue
+        messages.append({"role": "user", "content": user})
+        out = llm.chat(messages, sampling_params=sp)
+        print(f"bot> {out.text}")
+        messages.append({"role": "assistant", "content": out.text})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""Minimal OpenAI-API client against a running gllm-tpu server
+(reference examples/client.py + chat_client.py). stdlib-only.
+
+Usage:
+  python examples/client.py --port 8000 --prompt "hello"
+  python examples/client.py --port 8000 --chat "hi there" --stream
+"""
+
+import argparse
+import http.client
+import json
+
+
+def request(host, port, path, body, stream=False):
+    conn = http.client.HTTPConnection(host, port, timeout=600)
+    conn.request("POST", path, body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if not stream:
+        print(json.dumps(json.loads(resp.read()), indent=2))
+        conn.close()
+        return
+    buf = b""
+    while True:
+        chunk = resp.read(1)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n\n" in buf:
+            event, buf = buf.split(b"\n\n", 1)
+            if not event.startswith(b"data: "):
+                continue
+            payload = event[6:]
+            if payload == b"[DONE]":
+                print()
+                conn.close()
+                return
+            d = json.loads(payload)
+            choice = d["choices"][0]
+            delta = (choice.get("delta", {}).get("content")
+                     or choice.get("text") or "")
+            print(delta, end="", flush=True)
+    conn.close()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--prompt", default=None)
+    ap.add_argument("--chat", default=None)
+    ap.add_argument("--stream", action="store_true")
+    ap.add_argument("--max-tokens", type=int, default=128)
+    ap.add_argument("--temperature", type=float, default=0.7)
+    args = ap.parse_args()
+
+    if args.chat is not None:
+        body = {"messages": [{"role": "user", "content": args.chat}],
+                "max_tokens": args.max_tokens,
+                "temperature": args.temperature, "stream": args.stream}
+        request(args.host, args.port, "/v1/chat/completions", body,
+                args.stream)
+    else:
+        body = {"prompt": args.prompt or "Hello",
+                "max_tokens": args.max_tokens,
+                "temperature": args.temperature, "stream": args.stream}
+        request(args.host, args.port, "/v1/completions", body, args.stream)
+
+
+if __name__ == "__main__":
+    main()

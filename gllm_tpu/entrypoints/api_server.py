@@ -34,12 +34,16 @@ logger = logging.getLogger(__name__)
 
 
 class ServerState:
-    def __init__(self, llm: LLM, served_model: str):
+    def __init__(self, llm: LLM, served_model: str,
+                 tool_parser: Optional[str] = None):
+        from gllm_tpu.entrypoints.tool_parsers import get_tool_parser
         self.llm = llm
         self.engine = ServingEngine(llm)
         self.served_model = served_model
         self.start_time = time.time()
         self._profiling = False
+        self.tool_parser = get_tool_parser(tool_parser,
+                                           llm.config.model or served_model)
 
     # ---- request handling -------------------------------------------------
 
@@ -47,9 +51,12 @@ class ServerState:
         tok = self.llm.tokenizer
         if tok is None:
             raise proto.ProtocolError("server has no tokenizer loaded")
+        kwargs = dict(req.chat_template_kwargs)
+        if req.tools:
+            kwargs["tools"] = req.tools
         return tok.apply_chat_template(req.messages,
                                        add_generation_prompt=True,
-                                       **req.chat_template_kwargs)
+                                       **kwargs)
 
     def encode_completion(self, req: proto.CompletionRequest):
         if isinstance(req.prompt, list):
@@ -172,8 +179,15 @@ class Handler(BaseHTTPRequestHandler):
                          chat_completion_chunk(rid, req.model, text, fin))
         else:
             text, fin, usage = self._collect(handle)
+            tool_calls = None
+            if req.tools and req.tool_choice != "none":
+                from gllm_tpu.entrypoints.tool_parsers import (
+                    schemas_from_tools)
+                text, calls = st.tool_parser.parse(
+                    text, schemas_from_tools(req.tools))
+                tool_calls = [c.to_openai() for c in calls] or None
             self._json(proto.chat_completion_response(req.model, text, fin,
-                                                      usage))
+                                                      usage, tool_calls))
 
     def _completion(self):
         st = self.state
@@ -207,9 +221,11 @@ class Handler(BaseHTTPRequestHandler):
     def _stream(self, handle, make_chunk):
         try:
             for chunk in handle:
-                if chunk.text or chunk.finish_reason:
-                    self._sse(make_chunk(chunk.text or None,
-                                         chunk.finish_reason))
+                # one SSE event per generated token (even when incremental
+                # detokenization held text back) — clients measure ITL from
+                # event arrivals
+                self._sse(make_chunk(chunk.text or "",
+                                     chunk.finish_reason))
             self.wfile.write(b"data: [DONE]\n\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
@@ -298,6 +314,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-pages", type=int, default=None)
     p.add_argument("--kv-cache-dtype", default="auto")
     p.add_argument("--enable-prefix-caching", action="store_true")
+    p.add_argument("--tool-call-parser", default=None,
+                   choices=["qwen", "hermes", "deepseek", "none"],
+                   help="tool-call markup parser (default: auto-detect "
+                        "from model name)")
     p.add_argument("--skip-warmup", action="store_true",
                    help="don't pre-compile decode buckets before serving "
                         "(first requests pay compile latency instead)")
@@ -310,9 +330,10 @@ def make_parser() -> argparse.ArgumentParser:
 
 
 def serve(llm: LLM, host: str, port: int,
-          served_model: Optional[str] = None) -> ThreadingHTTPServer:
+          served_model: Optional[str] = None,
+          tool_parser: Optional[str] = None) -> ThreadingHTTPServer:
     """Build the HTTP server (caller decides foreground vs thread)."""
-    state = ServerState(llm, served_model or llm.config.model)
+    state = ServerState(llm, served_model or llm.config.model, tool_parser)
     handler = type("BoundHandler", (Handler,), {"state": state})
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.state = state
@@ -326,7 +347,8 @@ def main(argv=None):
     if not args.skip_warmup:
         llm.runner.warmup()
     httpd = serve(llm, args.host, args.port,
-                  args.served_model_name or args.model)
+                  args.served_model_name or args.model,
+                  tool_parser=args.tool_call_parser)
     logger.info("serving %s on %s:%d", args.model, args.host, args.port)
     try:
         httpd.serve_forever()

@@ -67,6 +67,8 @@ class ChatCompletionRequest:
     sampling: SamplingParams
     stream: bool
     chat_template_kwargs: Dict[str, Any]
+    tools: List[Dict[str, Any]]
+    tool_choice: Any
 
     @classmethod
     def from_dict(cls, d: dict, default_max_tokens: int):
@@ -82,6 +84,8 @@ class ChatCompletionRequest:
             sampling=sampling_from_request(d, default_max_tokens),
             stream=_get(d, "stream", bool, False),
             chat_template_kwargs=_get(d, "chat_template_kwargs", dict, {}),
+            tools=_get(d, "tools", list, []),
+            tool_choice=d.get("tool_choice", "auto"),
         )
 
 
@@ -123,7 +127,13 @@ def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
 
 
 def chat_completion_response(model: str, text: str, finish_reason: str,
-                             usage: dict) -> dict:
+                             usage: dict,
+                             tool_calls: Optional[list] = None) -> dict:
+    message: Dict[str, Any] = {"role": "assistant", "content": text}
+    if tool_calls:
+        message["tool_calls"] = tool_calls
+        message["content"] = text or None
+        finish_reason = "tool_calls"
     return {
         "id": _id("chatcmpl"),
         "object": "chat.completion",
@@ -131,7 +141,7 @@ def chat_completion_response(model: str, text: str, finish_reason: str,
         "model": model,
         "choices": [{
             "index": 0,
-            "message": {"role": "assistant", "content": text},
+            "message": message,
             "finish_reason": finish_reason,
         }],
         "usage": usage,

@@ -1,0 +1,162 @@
+"""Model-native tool-call markup → OpenAI ``tool_calls``.
+
+Covers the reference's tool-parser suite
+(/root/reference/gllm/tokenizers/tool_parsers.py, 673 LoC): per-model-family
+parsers that extract tool invocations from generated text, with
+schema-driven argument type coercion, plus auto-detection from the model
+name (reference api_server.py:543-575).
+
+Formats:
+- ``qwen`` (hermes-style, Qwen/Qwen2.5/Qwen3):
+  ``<tool_call>\\n{"name": ..., "arguments": {...}}\\n</tool_call>``
+- ``deepseek`` (DeepSeek V3-family unicode-fenced sections):
+  ``<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>NAME<｜tool▁sep｜>JSON
+  <｜tool▁call▁end｜>...<｜tool▁calls▁end｜>``
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class ToolCall:
+    name: str
+    arguments: str              # JSON-encoded string (OpenAI wire format)
+    id: str = ""
+
+    def to_openai(self) -> dict:
+        return {
+            "id": self.id or f"call_{uuid.uuid4().hex[:24]}",
+            "type": "function",
+            "function": {"name": self.name, "arguments": self.arguments},
+        }
+
+
+def coerce_arguments(args: Dict[str, Any],
+                     schema: Optional[dict]) -> Dict[str, Any]:
+    """Schema-driven argument type coercion (reference tool_parsers.py):
+    models emit numbers/bools as strings; fix them up against the declared
+    parameter types."""
+    if not schema:
+        return args
+    props = schema.get("properties", {})
+    out = {}
+    for k, v in args.items():
+        typ = props.get(k, {}).get("type")
+        try:
+            if typ == "integer" and isinstance(v, str):
+                v = int(v)
+            elif typ == "number" and isinstance(v, str):
+                v = float(v)
+            elif typ == "boolean" and isinstance(v, str):
+                v = v.strip().lower() in ("true", "1", "yes")
+            elif typ in ("object", "array") and isinstance(v, str):
+                v = json.loads(v)
+        except (ValueError, json.JSONDecodeError):
+            pass
+        out[k] = v
+    return out
+
+
+class ToolParser:
+    """Base: no tool support — everything is content."""
+
+    def parse(self, text: str,
+              schemas: Optional[Dict[str, dict]] = None
+              ) -> Tuple[str, List[ToolCall]]:
+        return text, []
+
+
+class QwenToolParser(ToolParser):
+    _RE = re.compile(r"<tool_call>\s*(\{.*?\})\s*</tool_call>", re.DOTALL)
+
+    def parse(self, text, schemas=None):
+        calls: List[ToolCall] = []
+
+        def repl(match):
+            try:
+                obj = json.loads(match.group(1))
+            except json.JSONDecodeError:
+                return match.group(0)  # leave malformed markup as content
+            name = obj.get("name", "")
+            args = obj.get("arguments", {})
+            if isinstance(args, dict) and schemas:
+                args = coerce_arguments(args, schemas.get(name))
+            calls.append(ToolCall(name=name, arguments=json.dumps(
+                args, ensure_ascii=False)))
+            return ""
+
+        content = self._RE.sub(repl, text).strip()
+        return content, calls
+
+
+class DeepSeekToolParser(ToolParser):
+    _BLOCK = re.compile(
+        r"<｜tool▁calls▁begin｜>(.*?)<｜tool▁calls▁end｜>", re.DOTALL)
+    _CALL = re.compile(
+        r"<｜tool▁call▁begin｜>(.*?)<｜tool▁sep｜>(.*?)<｜tool▁call▁end｜>",
+        re.DOTALL)
+
+    def parse(self, text, schemas=None):
+        calls: List[ToolCall] = []
+
+        def repl(match):
+            for name, payload in self._CALL.findall(match.group(1)):
+                name = name.strip().split("<｜tool▁sep｜>")[-1].strip()
+                # some checkpoints emit "function<sep>name"; keep last token
+                name = name.split("\n")[-1].strip()
+                payload = payload.strip()
+                if payload.startswith("```json"):
+                    payload = payload[7:].rstrip("`").strip()
+                try:
+                    args = json.loads(payload)
+                except json.JSONDecodeError:
+                    args = {}
+                if schemas:
+                    args = coerce_arguments(args, schemas.get(name))
+                calls.append(ToolCall(name=name, arguments=json.dumps(
+                    args, ensure_ascii=False)))
+            return ""
+
+        content = self._BLOCK.sub(repl, text).strip()
+        return content, calls
+
+
+_PARSERS = {
+    "qwen": QwenToolParser,
+    "hermes": QwenToolParser,
+    "deepseek": DeepSeekToolParser,
+    "none": ToolParser,
+}
+
+
+def get_tool_parser(name: Optional[str] = None,
+                    model_name: str = "") -> ToolParser:
+    """Explicit name, or auto-detect from the model id
+    (reference api_server.py:543-575)."""
+    if name:
+        if name not in _PARSERS:
+            raise ValueError(f"unknown tool parser {name!r}; "
+                             f"choices: {sorted(_PARSERS)}")
+        return _PARSERS[name]()
+    m = model_name.lower()
+    if "qwen" in m:
+        return QwenToolParser()
+    if "deepseek" in m or "kimi" in m:
+        return DeepSeekToolParser()
+    return ToolParser()
+
+
+def schemas_from_tools(tools: Optional[List[dict]]) -> Dict[str, dict]:
+    """OpenAI `tools` request field → {name: parameters-schema}."""
+    out: Dict[str, dict] = {}
+    for t in tools or []:
+        fn = t.get("function", {})
+        if fn.get("name"):
+            out[fn["name"]] = fn.get("parameters", {})
+    return out

@@ -1,0 +1,84 @@
+"""Tool-call parser tests (reference tokenizers/tool_parsers.py surface)."""
+
+import json
+
+from gllm_tpu.entrypoints.tool_parsers import (DeepSeekToolParser,
+                                               QwenToolParser,
+                                               coerce_arguments,
+                                               get_tool_parser,
+                                               schemas_from_tools)
+
+
+def test_qwen_single_call_with_content():
+    text = ('Let me check the weather.\n<tool_call>\n'
+            '{"name": "get_weather", "arguments": {"city": "Paris"}}\n'
+            '</tool_call>')
+    content, calls = QwenToolParser().parse(text)
+    assert content == "Let me check the weather."
+    assert len(calls) == 1
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris"}
+
+
+def test_qwen_multiple_calls():
+    text = ('<tool_call>\n{"name": "a", "arguments": {}}\n</tool_call>\n'
+            '<tool_call>\n{"name": "b", "arguments": {"x": 1}}\n</tool_call>')
+    content, calls = QwenToolParser().parse(text)
+    assert content == ""
+    assert [c.name for c in calls] == ["a", "b"]
+
+
+def test_qwen_malformed_json_left_as_content():
+    text = "<tool_call>\n{not json}\n</tool_call>"
+    content, calls = QwenToolParser().parse(text)
+    assert calls == []
+    assert "not json" in content
+
+
+def test_schema_coercion():
+    schema = {"properties": {"n": {"type": "integer"},
+                             "f": {"type": "number"},
+                             "b": {"type": "boolean"},
+                             "o": {"type": "object"}}}
+    args = coerce_arguments(
+        {"n": "42", "f": "3.5", "b": "true", "o": '{"k": 1}', "s": "x"},
+        schema)
+    assert args == {"n": 42, "f": 3.5, "b": True, "o": {"k": 1}, "s": "x"}
+
+
+def test_qwen_coercion_via_schemas():
+    tools = [{"type": "function", "function": {
+        "name": "add", "parameters": {
+            "properties": {"x": {"type": "integer"}}}}}]
+    text = ('<tool_call>\n{"name": "add", "arguments": {"x": "7"}}\n'
+            '</tool_call>')
+    _, calls = QwenToolParser().parse(text, schemas_from_tools(tools))
+    assert json.loads(calls[0].arguments) == {"x": 7}
+
+
+def test_deepseek_format():
+    text = ("thinking...<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>"
+            "get_time<｜tool▁sep｜>{\"tz\": \"UTC\"}"
+            "<｜tool▁call▁end｜><｜tool▁calls▁end｜>")
+    content, calls = DeepSeekToolParser().parse(text)
+    assert content == "thinking..."
+    assert calls[0].name == "get_time"
+    assert json.loads(calls[0].arguments) == {"tz": "UTC"}
+
+
+def test_autodetect():
+    assert isinstance(get_tool_parser(None, "Qwen/Qwen3-8B"),
+                      QwenToolParser)
+    assert isinstance(get_tool_parser(None, "deepseek-ai/DeepSeek-V3"),
+                      DeepSeekToolParser)
+    assert get_tool_parser(None, "meta-llama/Llama-3").parse(
+        "plain") == ("plain", [])
+    assert isinstance(get_tool_parser("hermes", ""), QwenToolParser)
+
+
+def test_openai_wire_format():
+    _, calls = QwenToolParser().parse(
+        '<tool_call>{"name": "f", "arguments": {}}</tool_call>')
+    d = calls[0].to_openai()
+    assert d["type"] == "function" and d["id"].startswith("call_")
+    assert d["function"] == {"name": "f", "arguments": "{}"}
