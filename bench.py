@@ -101,7 +101,7 @@ def main():
             rope_theta=500000.0, tie_word_embeddings=True)
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="bfloat16", max_model_len=2048,
-            max_num_seqs=256,
+            max_num_seqs=256, overlap_scheduling=True,
             scheduler=SchedulerConfig(max_prefill_tokens=1024,
                                       max_decode_seqs=128),
             cache=CacheConfig(page_size=16, memory_util=0.85))

@@ -73,6 +73,10 @@ class EngineConfig:
     max_num_seqs: int = 256
     load_format: str = "auto"             # auto | dummy (weight-less bring-up,
                                           # reference api_server.py:293-299)
+    # Overlap scheduling (reference --overlap-scheduling + OverlapWorker):
+    # chain decode steps on-device so the host round trip between decode
+    # iterations disappears.
+    overlap_scheduling: bool = False
     enforce_eager: bool = False           # disable donation/async tricks (debug)
     attention_impl: str = "auto"          # auto | pallas | xla
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)

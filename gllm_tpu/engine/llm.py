@@ -132,7 +132,21 @@ class LLM:
         behind the device step.
         """
         depth = max(1, self.config.parallel.pp)
+        overlap = (self.config.overlap_scheduling
+                   and self.config.parallel.pp == 1)
+        if overlap:
+            depth = 2
         while len(self._in_flight) < depth:
+            if overlap and self._in_flight and not self.scheduler.waiting:
+                # chain the next decode step off the in-flight batch's
+                # on-device tokens (overlap scheduling)
+                prev_batch, prev_handle = self._in_flight[-1]
+                chained = self.scheduler.schedule_chained(prev_batch)
+                if chained is None:
+                    break
+                handle = self.runner.step_async_chained(chained, prev_handle)
+                self._in_flight.append((chained, handle))
+                continue
             batch = self.scheduler.schedule_once()
             if batch is None:
                 break

@@ -264,6 +264,7 @@ def build_engine_config(args) -> EngineConfig:
         max_num_seqs=args.max_num_seqs,
         load_format=args.load_format,
         attention_impl=args.attention_impl,
+        overlap_scheduling=args.overlap_scheduling,
         scheduler=SchedulerConfig(
             schedule_method=args.schedule_method,
             max_decode_seqs=args.maxd,
@@ -314,6 +315,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-pages", type=int, default=None)
     p.add_argument("--kv-cache-dtype", default="auto")
     p.add_argument("--enable-prefix-caching", action="store_true")
+    p.add_argument("--overlap-scheduling", action="store_true",
+                   help="chain decode steps on-device (no host round trip "
+                        "between decode iterations)")
     p.add_argument("--tool-call-parser", default=None,
                    choices=["qwen", "hermes", "deepseek", "none"],
                    help="tool-call markup parser (default: auto-detect "

@@ -81,7 +81,10 @@ class BatchBuilder:
         off = 0
         for i, it in enumerate(batch.items):
             seq, n, before = it.seq, it.num_new_tokens, it.computed_before
-            tokens[off:off + n] = seq.token_ids[before:before + n]
+            vals = seq.token_ids[before:before + n]
+            # chained overlap-decode rows have no host-side token value yet
+            # (it lives on device; the runner splices it in) — leave 0s.
+            tokens[off:off + len(vals)] = vals
             positions[off:off + n] = np.arange(before, before + n)
             pt_row = np.asarray(seq.page_table, np.int32)
             pos = np.arange(before, before + n)

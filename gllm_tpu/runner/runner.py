@@ -188,6 +188,29 @@ class ModelRunner:
                                             max_q_len=max_q)
         return tokens, sched_batch.num_seqs
 
+    def step_async_chained(self, sched_batch: ScheduledBatch, prev_handle):
+        """Launch a chained decode step whose input tokens are the PREVIOUS
+        step's on-device sampled tokens (overlap scheduling: the reference's
+        FutureMap placeholder resolution, async_utils.py:56-61, without the
+        negative-id dance — the sampled-token array is simply spliced in as
+        the next step's token_ids)."""
+        prev_tokens, prev_n = prev_handle
+        assert prev_n == sched_batch.num_seqs
+        self._step_count += 1
+        step_key = jax.random.fold_in(self.rng_key, self._step_count)
+        batch, max_q, presence_mask = self.builder.build(sched_batch,
+                                                         step_key)
+        assert max_q == 1 and presence_mask is None
+        assert prev_tokens.shape[0] == batch.token_ids.shape[0], \
+            (prev_tokens.shape, batch.token_ids.shape)
+        batch = batch._replace(token_ids=prev_tokens)
+        from gllm_tpu.parallel.mesh import mesh_context
+        with mesh_context(self.mesh):
+            tokens, self.kv = self._step_fn(self.params, self.kv, batch,
+                                            self.cos_sin, presence_mask,
+                                            max_q_len=1)
+        return tokens, sched_batch.num_seqs
+
     def collect(self, handle) -> np.ndarray:
         tokens, n = handle
         return np.asarray(tokens)[:n]

@@ -93,6 +93,7 @@ class Scheduler:
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
         self._aborted_ids: set[int] = set()
+        self._deferred_free: set = set()
 
         self.new_token_ratio = self.sched_cfg.init_new_token_ratio
         self._ratio_decay = (
@@ -178,7 +179,7 @@ class Scheduler:
         In-flight seqs are immune: their pipeline step is still writing KV
         into the pages we would free."""
         victims = [s for s in self.running
-                   if s.seq_id not in protect and not s.in_flight]
+                   if s.seq_id not in protect and not s.num_in_flight]
         if not victims:
             return False
         victim = max(victims, key=lambda s: s.num_tokens)
@@ -210,9 +211,9 @@ class Scheduler:
         self._decay_ratio()
 
         decode_ready = [s for s in self.running
-                        if s.num_remaining_tokens == 1 and not s.in_flight]
+                        if s.num_remaining_tokens == 1 and not s.num_in_flight]
         prefill_mid = [s for s in self.running
-                       if s.num_remaining_tokens > 1 and not s.in_flight]
+                       if s.num_remaining_tokens > 1 and not s.num_in_flight]
         has_prefill_work = bool(prefill_mid or self.waiting)
 
         items: List[ScheduledSeq] = []
@@ -230,7 +231,7 @@ class Scheduler:
         if not items:
             return None
         for it in items:
-            it.seq.in_flight = True
+            it.seq.num_in_flight += 1
         return ScheduledBatch(items)
 
     def _schedule_decode(self, items: List[ScheduledSeq],
@@ -267,7 +268,7 @@ class Scheduler:
 
         # 1) continue partially prefilled running seqs (already admitted).
         for seq in [s for s in self.running
-                    if s.num_remaining_tokens > 1 and not s.in_flight]:
+                    if s.num_remaining_tokens > 1 and not s.num_in_flight]:
             if token_budget <= 0 or len(items) >= max_seqs:
                 break
             n = min(seq.num_remaining_tokens, token_budget)
@@ -310,6 +311,53 @@ class Scheduler:
             items.append(ScheduledSeq(seq, n, seq.num_computed_tokens))
             token_budget -= n
 
+    def schedule_chained(self, prev: ScheduledBatch) -> \
+            Optional[ScheduledBatch]:
+        """Schedule the NEXT decode step for ``prev``'s sequences before
+        ``prev``'s sampled tokens have reached the host.
+
+        This is the overlap-scheduling trick (reference OverlapScheduler's
+        deferred placeholder finalize, scheduler.py:702-783 + FutureMap):
+        the next step's input token values live only on the device, but page
+        allocation, positions, and slots depend solely on token *counts*,
+        which the host already knows. The runner feeds the previous step's
+        on-device sampled tokens straight into the chained step — no
+        host↔device round trip between decode iterations.
+
+        Returns None (caller falls back to the synchronous path) unless
+        every prev item samples and is guaranteed not to finish by length
+        at prev's step, and pages are available without preemption.
+        """
+        items: List[ScheduledSeq] = []
+        for it in prev.items:
+            seq = it.seq
+            if not it.samples or seq.seq_id in self._aborted_ids:
+                return None
+            if seq.sampling_params.repetition_penalty != 1.0:
+                return None  # needs a host-built presence mask
+            computed_next = it.computed_before + it.num_new_tokens
+            # Output length after prev's token is appended; chaining a seq
+            # that will finish by max_tokens would waste a step AND change
+            # the batch composition — skip chaining entirely.
+            out_after = computed_next + 1 - seq.prompt_len
+            if out_after >= seq.sampling_params.max_tokens:
+                return None
+            if computed_next + 1 > self.config.max_model_len:
+                return None
+            need = cdiv(computed_next + 1, self.mm.page_size) \
+                - len(seq.page_table)
+            if need and not self.mm.can_allocate(need):
+                return None
+            items.append(ScheduledSeq(seq, 1, computed_next))
+        for it in items:
+            seq = it.seq
+            # cover tokens [0, computed_before+1) — num_computed_tokens
+            # hasn't advanced yet (prev is still in flight)
+            cover = it.computed_before + 1 - seq.num_computed_tokens
+            self.mm.allocate_seq_pages(seq, cover)
+            seq.num_in_flight += 1
+        return ScheduledBatch(items)
+
     # ---- output path ------------------------------------------------------
 
     def process_output(self, batch: ScheduledBatch,
@@ -320,11 +368,18 @@ class Scheduler:
         outputs: List[SeqOutput] = []
         for it, tok in zip(batch.items, sampled_tokens):
             seq = it.seq
-            seq.in_flight = False
+            seq.num_in_flight -= 1
             if seq.seq_id in self._aborted_ids:
                 continue  # handled in _process_aborts
             if seq.status is not SequenceStatus.RUNNING:
-                continue  # preempted after scheduling (shouldn't happen)
+                # finished at an earlier (chained) step while this one was
+                # in flight: release its deferred pages once the last
+                # in-flight step lands.
+                if (seq in self._deferred_free
+                        and seq.num_in_flight == 0):
+                    self._deferred_free.discard(seq)
+                    self.mm.free_seq(seq)
+                continue
             seq.num_computed_tokens = it.computed_before + it.num_new_tokens
             new_token: Optional[int] = None
             finish: Optional[str] = None
@@ -342,7 +397,12 @@ class Scheduler:
                 seq.status = SequenceStatus.FINISHED
                 seq.finish_reason = finish
                 self.running.remove(seq)
-                self.mm.free_seq(seq)
+                if seq.num_in_flight > 0:
+                    # a chained step for this seq is still writing KV into
+                    # its pages — free when it lands
+                    self._deferred_free.add(seq)
+                else:
+                    self.mm.free_seq(seq)
             outputs.append(SeqOutput(seq, new_token, finish))
         return outputs
 
@@ -361,7 +421,8 @@ class Scheduler:
         # reaped on a later schedule_once after process_output cleared the
         # flag.
         for seq in [s for s in self.running
-                    if s.seq_id in self._aborted_ids and not s.in_flight]:
+                    if s.seq_id in self._aborted_ids
+                    and not s.num_in_flight]:
             self.running.remove(seq)
             self._finish_abort(seq)
         for seq in [s for s in self.waiting

@@ -43,11 +43,13 @@ class Sequence:
 
         self.status = SequenceStatus.WAITING
         self.num_computed_tokens = 0
-        # True while a scheduled chunk for this seq is in flight in the
-        # pipeline (reference keeps <= pp_size batches in flight,
-        # scheduler.py:358-364; an in-flight seq must not be rescheduled or
-        # preempted until its step lands).
-        self.in_flight = False
+        # Number of scheduled chunks for this seq currently in flight
+        # (pipeline microbatches + chained overlap decode; reference keeps
+        # <= pp_size batches running, scheduler.py:358-364, and overlaps
+        # decode with placeholder tokens, scheduler.py:702-783). An
+        # in-flight seq must not be rescheduled (except by chaining),
+        # preempted, or have its pages freed until its steps land.
+        self.num_in_flight = 0
         self.page_table: List[int] = []
         # Pages whose contents came from the prefix cache (KV already valid).
         self.num_cached_tokens = 0

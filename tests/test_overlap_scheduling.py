@@ -1,0 +1,69 @@
+"""Overlap (chained on-device decode) must be byte-identical to sync."""
+
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(41)
+    d = tmp_path_factory.mktemp("ov_llama")
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        max_position_embeddings=256, eos_token_id=0,
+        attention_bias=False)).save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def run(model_dir, overlap, prompts, sp):
+    cfg = EngineConfig(
+        model=model_dir, dtype="float32", max_model_len=128,
+        overlap_scheduling=overlap,
+        scheduler=SchedulerConfig(max_prefill_tokens=64, max_decode_seqs=8),
+        cache=CacheConfig(page_size=4, num_pages=128))
+    llm = LLM(config=cfg)
+    outs = llm.generate(prompt_token_ids=prompts, sampling_params=sp)
+    assert llm.memory_manager.num_free_pages == \
+        llm.memory_manager.allocator.num_total  # no page leaks
+    return [(o.output_token_ids, o.finish_reason) for o in outs]
+
+
+def test_overlap_matches_sync_long_decode(ckpt):
+    sp = SamplingParams(temperature=0.0, max_tokens=20, ignore_eos=True)
+    prompts = [[3, 14, 15], [9, 2, 6, 5, 3], [58, 9]]
+    assert run(ckpt, True, prompts, sp) == run(ckpt, False, prompts, sp)
+
+
+def test_overlap_matches_sync_with_eos(ckpt):
+    # natural EOS can land mid-chain → the chained step's work is discarded
+    # and pages are released late but exactly once
+    sp = SamplingParams(temperature=0.0, max_tokens=30)
+    prompts = [[i, i + 1, i + 2] for i in range(1, 12, 2)]
+    assert run(ckpt, True, prompts, sp) == run(ckpt, False, prompts, sp)
+
+
+def test_overlap_matches_sync_max_tokens_boundary(ckpt):
+    sp = SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True)
+    prompts = [[5, 6], [7, 8, 9]]
+    assert run(ckpt, True, prompts, sp) == run(ckpt, False, prompts, sp)
+
+
+def test_overlap_page_boundary_growth(ckpt):
+    # page_size 4: decode repeatedly crosses page boundaries inside chains
+    sp = SamplingParams(temperature=0.0, max_tokens=13, ignore_eos=True)
+    prompts = [[3] * 7]
+    assert run(ckpt, True, prompts, sp) == run(ckpt, False, prompts, sp)
+
+
+def test_overlap_sampled_reproducible(ckpt):
+    sp = SamplingParams(temperature=0.8, top_k=30, max_tokens=12,
+                        ignore_eos=True)
+    a = run(ckpt, True, [[4, 8], [15, 16]], sp)
+    b = run(ckpt, True, [[4, 8], [15, 16]], sp)
+    assert a == b
