@@ -88,6 +88,9 @@ class LLM:
                 logger.warning("no tokenizer loaded; token-id I/O only")
 
         if config.parallel.pp > 1:
+            if params is not None:
+                raise ValueError(
+                    "explicit params are not supported with pp > 1")
             from gllm_tpu.runner.pp_runner import PPModelRunner
             self.runner = PPModelRunner(config, model_cfg)
         else:
@@ -190,7 +193,7 @@ class LLM:
         for s in seqs:
             self.scheduler.add_seq(s)
 
-        while self.scheduler.has_unfinished:
+        while self.scheduler.has_unfinished or self._in_flight:
             for out in self.step():
                 if out.new_token_id is not None and self.tokenizer is not None:
                     self._stream_detokenize(out.seq)

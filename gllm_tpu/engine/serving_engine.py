@@ -103,7 +103,7 @@ class ServingEngine:
                 except ValueError as e:
                     self._deliver_error(seq.seq_id, str(e))
                 drained = True
-            if not llm.scheduler.has_unfinished:
+            if not llm.scheduler.has_unfinished and not llm._in_flight:
                 if not drained:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()

@@ -170,7 +170,31 @@ class Handler(BaseHTTPRequestHandler):
             self._read_json(), default_max_tokens=256)
         ids = st.encode_chat(req)
         handle = st.engine.submit(list(ids), req.sampling)
-        if req.stream:
+        parse_tools = bool(req.tools) and req.tool_choice != "none"
+        if req.stream and parse_tools:
+            # Tool markup can't be parsed incrementally with certainty —
+            # buffer, parse, then emit one delta carrying content and/or
+            # tool_calls (reference streams tool deltas; buffered round 1).
+            rid = proto.new_request_id(chat=True)
+            self._sse_start()
+            self._sse(proto.chat_completion_chunk(rid, req.model, None, None,
+                                                  role=True))
+            text, fin, usage = self._collect(handle)
+            from gllm_tpu.entrypoints.tool_parsers import schemas_from_tools
+            text, calls = st.tool_parser.parse(
+                text, schemas_from_tools(req.tools))
+            chunk = proto.chat_completion_chunk(rid, req.model, text or None,
+                                                None)
+            if calls:
+                chunk["choices"][0]["delta"]["tool_calls"] = [
+                    dict(c.to_openai(), index=i)
+                    for i, c in enumerate(calls)]
+                fin = "tool_calls"
+            self._sse(chunk)
+            self._sse(proto.chat_completion_chunk(rid, req.model, None, fin))
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        elif req.stream:
             rid = proto.new_request_id(chat=True)
             self._sse_start()
             self._sse(proto.chat_completion_chunk(rid, req.model, None, None,

@@ -102,19 +102,35 @@ class DeepSeekToolParser(ToolParser):
         r"<｜tool▁call▁begin｜>(.*?)<｜tool▁sep｜>(.*?)<｜tool▁call▁end｜>",
         re.DOTALL)
 
+    @staticmethod
+    def _strip_fence(payload: str) -> str:
+        payload = payload.strip()
+        if payload.startswith("```json"):
+            payload = payload[7:]
+        elif payload.startswith("```"):
+            payload = payload[3:]
+        return payload.strip().rstrip("`").strip()
+
     def parse(self, text, schemas=None):
         calls: List[ToolCall] = []
 
         def repl(match):
-            for name, payload in self._CALL.findall(match.group(1)):
-                name = name.strip().split("<｜tool▁sep｜>")[-1].strip()
-                # some checkpoints emit "function<sep>name"; keep last token
-                name = name.split("\n")[-1].strip()
-                payload = payload.strip()
-                if payload.startswith("```json"):
-                    payload = payload[7:].rstrip("`").strip()
+            for head, body in self._CALL.findall(match.group(1)):
+                head = head.strip()
+                body = body.strip()
+                # Two layouts in the wild:
+                #   stock V3/R1 template: head == "function",
+                #     body == "NAME\n```json\nARGS\n```"
+                #   simplified:           head == NAME, body == ARGS-json
+                if head == "function" or "```" in body:
+                    name, _, fenced = body.partition("\n")
+                    name = name.strip()
+                    payload = self._strip_fence(fenced)
+                else:
+                    name = head
+                    payload = self._strip_fence(body)
                 try:
-                    args = json.loads(payload)
+                    args = json.loads(payload) if payload else {}
                 except json.JSONDecodeError:
                     args = {}
                 if schemas:
@@ -147,8 +163,10 @@ def get_tool_parser(name: Optional[str] = None,
     m = model_name.lower()
     if "qwen" in m:
         return QwenToolParser()
-    if "deepseek" in m or "kimi" in m:
+    if "deepseek" in m:
         return DeepSeekToolParser()
+    # Kimi K2 uses its own <|tool_call_begin|> markup — parser TBD; fall
+    # through to the no-op parser rather than mis-parse.
     return ToolParser()
 
 

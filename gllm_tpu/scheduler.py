@@ -369,17 +369,18 @@ class Scheduler:
         for it, tok in zip(batch.items, sampled_tokens):
             seq = it.seq
             seq.num_in_flight -= 1
-            if seq.seq_id in self._aborted_ids:
-                continue  # handled in _process_aborts
             if seq.status is not SequenceStatus.RUNNING:
                 # finished at an earlier (chained) step while this one was
                 # in flight: release its deferred pages once the last
-                # in-flight step lands.
+                # in-flight step lands (even if the client also aborted it
+                # meanwhile).
                 if (seq in self._deferred_free
                         and seq.num_in_flight == 0):
                     self._deferred_free.discard(seq)
                     self.mm.free_seq(seq)
                 continue
+            if seq.seq_id in self._aborted_ids:
+                continue  # handled in _process_aborts
             seq.num_computed_tokens = it.computed_before + it.num_new_tokens
             new_token: Optional[int] = None
             finish: Optional[str] = None

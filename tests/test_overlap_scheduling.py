@@ -67,3 +67,27 @@ def test_overlap_sampled_reproducible(ckpt):
     a = run(ckpt, True, [[4, 8], [15, 16]], sp)
     b = run(ckpt, True, [[4, 8], [15, 16]], sp)
     assert a == b
+
+
+def test_overlap_single_seq_eos_midchain_no_leak(ckpt):
+    # single seq finishing by EOS while its chained step is in flight: the
+    # engine must drain the chain and release every page (review repro)
+    cfg = EngineConfig(
+        model=ckpt, dtype="float32", max_model_len=128,
+        overlap_scheduling=True,
+        cache=CacheConfig(page_size=4, num_pages=128))
+    llm = LLM(config=cfg)
+    # find the eos organically: run greedy, use the 3rd generated token as eos
+    probe = llm.generate(prompt_token_ids=[[5, 6, 7]],
+                         sampling_params=SamplingParams(
+                             temperature=0.0, max_tokens=8, ignore_eos=True))
+    eos = probe[0].output_token_ids[2]
+    llm2 = LLM(config=cfg)
+    llm2.eos_token_id = eos
+    out = llm2.generate(prompt_token_ids=[[5, 6, 7]],
+                        sampling_params=SamplingParams(temperature=0.0,
+                                                       max_tokens=30))[0]
+    assert out.finish_reason == "stop"
+    assert not llm2._in_flight
+    assert llm2.memory_manager.num_free_pages == \
+        llm2.memory_manager.allocator.num_total

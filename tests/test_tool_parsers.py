@@ -82,3 +82,15 @@ def test_openai_wire_format():
     d = calls[0].to_openai()
     assert d["type"] == "function" and d["id"].startswith("call_")
     assert d["function"] == {"name": "f", "arguments": "{}"}
+
+
+def test_deepseek_v3_stock_template_format():
+    # the actual V3/R1 chat-template layout:
+    # function<sep>NAME\n```json\nARGS\n```
+    text = ("<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>function"
+            "<｜tool▁sep｜>get_weather\n```json\n{\"city\": \"Paris\"}\n```"
+            "<｜tool▁call▁end｜><｜tool▁calls▁end｜>")
+    content, calls = DeepSeekToolParser().parse(text)
+    assert content == ""
+    assert calls[0].name == "get_weather"
+    assert json.loads(calls[0].arguments) == {"city": "Paris"}
