@@ -77,6 +77,9 @@ class EngineConfig:
     # chain decode steps on-device so the host round trip between decode
     # iterations disappears.
     overlap_scheduling: bool = False
+    # Weight-only quantization: None | "int8" | "fp8" (per-output-channel,
+    # XLA-fused dequant — reference quantization stack SURVEY §2.6)
+    quantization: Optional[str] = None
     enforce_eager: bool = False           # disable donation/async tricks (debug)
     attention_impl: str = "auto"          # auto | pallas | xla
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)

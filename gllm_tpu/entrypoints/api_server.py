@@ -289,6 +289,7 @@ def build_engine_config(args) -> EngineConfig:
         load_format=args.load_format,
         attention_impl=args.attention_impl,
         overlap_scheduling=args.overlap_scheduling,
+        quantization=args.quantization,
         scheduler=SchedulerConfig(
             schedule_method=args.schedule_method,
             max_decode_seqs=args.maxd,
@@ -338,6 +339,9 @@ def make_parser() -> argparse.ArgumentParser:
                    help="fraction of device memory for the KV cache")
     p.add_argument("--num-pages", type=int, default=None)
     p.add_argument("--kv-cache-dtype", default="auto")
+    p.add_argument("--quantization", default=None,
+                   choices=["int8", "fp8"],
+                   help="weight-only quantization")
     p.add_argument("--enable-prefix-caching", action="store_true")
     p.add_argument("--overlap-scheduling", action="store_true",
                    help="chain decode steps on-device (no host round trip "

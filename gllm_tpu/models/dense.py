@@ -31,6 +31,7 @@ from gllm_tpu.models.config import ModelConfig
 from gllm_tpu.ops import (apply_rope, compute_rope_cos_sin,
                           fused_add_rms_norm, paged_attention, rms_norm,
                           silu_and_mul, write_kv)
+from gllm_tpu.ops.quant import qmm
 from gllm_tpu.parallel.mesh import shard_hint
 
 Params = Dict[str, Any]
@@ -105,9 +106,9 @@ def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
     T = x.shape[0]
     Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
 
-    q = x @ lp["q_proj"]
-    k = x @ lp["k_proj"]
-    v = x @ lp["v_proj"]
+    q = qmm(x, lp["q_proj"])
+    k = qmm(x, lp["k_proj"])
+    v = qmm(x, lp["v_proj"])
     if "q_bias" in lp:
         q = q + lp["q_bias"]
         k = k + lp["k_bias"]
@@ -124,15 +125,15 @@ def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
     attn = paged_attention(q, k_cache, v_cache, batch.attn,
                            scale=D ** -0.5, max_q_len=max_q_len,
                            impl=attn_impl)
-    out = attn.reshape(T, Hq * D) @ lp["o_proj"]
+    out = qmm(attn.reshape(T, Hq * D), lp["o_proj"])
     return out, k_cache, v_cache
 
 
 def _mlp(lp, x):
-    gate = shard_hint(x @ lp["gate_proj"], None, "tp")
-    up = shard_hint(x @ lp["up_proj"], None, "tp")
+    gate = shard_hint(qmm(x, lp["gate_proj"]), None, "tp")
+    up = shard_hint(qmm(x, lp["up_proj"]), None, "tp")
     fused = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
-    return fused @ lp["down_proj"]
+    return qmm(fused, lp["down_proj"])
 
 
 def forward(

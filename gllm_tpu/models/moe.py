@@ -31,6 +31,7 @@ from gllm_tpu.models import dense
 from gllm_tpu.models.config import ModelConfig
 from gllm_tpu.models.dense import KVCache
 from gllm_tpu.ops import silu_and_mul
+from gllm_tpu.ops.quant import qmm
 
 Params = dict
 
@@ -73,10 +74,10 @@ def moe_mlp(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     combined = jnp.zeros((T, H), out.dtype).at[token_of].add(out * w_sorted)
 
     if cfg.shared_expert_intermediate_size:
-        sg = x @ lp["shared_gate_proj"]
-        su = x @ lp["shared_up_proj"]
-        shared = silu_and_mul(jnp.concatenate([sg, su], axis=-1)) \
-            @ lp["shared_down_proj"]
+        sg = qmm(x, lp["shared_gate_proj"])
+        su = qmm(x, lp["shared_up_proj"])
+        shared = qmm(silu_and_mul(jnp.concatenate([sg, su], axis=-1)),
+                     lp["shared_down_proj"])
         gate_logit = x @ lp["shared_expert_gate"]       # [T, 1]
         shared = shared * jax.nn.sigmoid(
             gate_logit.astype(jnp.float32)).astype(shared.dtype)

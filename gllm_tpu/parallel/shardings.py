@@ -108,12 +108,27 @@ def latent_kv_specs(cfg: ModelConfig, tp: int):
 
 
 def shard_params(params, specs, mesh: Optional[Mesh]):
-    """Place a param pytree onto the mesh with the given specs."""
+    """Place a param pytree onto the mesh with the given specs.
+
+    Quantized leaves (ops/quant.py) place their int8 payload with the
+    weight's spec and their [.., 1, out] scale with the same spec minus any
+    axis on size-1 dims (a sharded singleton is impossible)."""
     if mesh is None:
         return params
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params, specs)
+    from gllm_tpu.ops.quant import Quantized
+
+    def place(x, s):
+        if isinstance(x, Quantized):
+            dims = list(s) + [None] * (x.q.ndim - len(s))
+            scale_spec = P(*[None if x.scale.shape[i] == 1 else dims[i]
+                             for i in range(x.scale.ndim)])
+            return Quantized(
+                jax.device_put(x.q, NamedSharding(mesh, s)),
+                jax.device_put(x.scale, NamedSharding(mesh, scale_spec)))
+        return jax.device_put(x, NamedSharding(mesh, s))
+
+    return jax.tree.map(place, params, specs,
+                        is_leaf=lambda n: isinstance(n, Quantized))
 
 
 def deepseek_param_specs(cfg: ModelConfig, tp: int) -> dict:

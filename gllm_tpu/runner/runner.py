@@ -68,6 +68,16 @@ class ModelRunner:
                 config.model, model_cfg, dtype=self.dtype)
         self.cos_sin = self.model_def.make_rope_table(model_cfg)
 
+        if config.quantization:
+            from gllm_tpu.ops.quant import param_bytes, quantize_params
+            before = param_bytes(self.params)
+            qdtype = {"int8": jnp.int8,
+                      "fp8": jnp.float8_e4m3fn}[config.quantization]
+            self.params = quantize_params(self.params, qdtype)
+            logger.info("quantized weights (%s): %.2f GB -> %.2f GB",
+                        config.quantization, before / 1e9,
+                        param_bytes(self.params) / 1e9)
+
         if self.mesh is not None:
             from gllm_tpu.parallel.shardings import shard_params
             specs = self.model_def.param_specs(model_cfg, config.parallel.tp)
