@@ -29,6 +29,9 @@ class ModelConfig:
     tie_word_embeddings: bool = False
     attention_bias: bool = False      # qwen2-style qkv bias
     qk_norm: bool = False             # qwen3-style per-head q/k RMSNorm
+    partial_rotary_factor: float = 1.0  # GLM: rotate only this prefix of D
+    rope_interleaved: bool = False    # GLM/DeepSeek pair-interleaved layout
+    sandwich_norms: bool = False      # GLM4 post_self_attn/post_mlp norms
     eos_token_id: Optional[int] = None
     bos_token_id: Optional[int] = None
     hidden_act: str = "silu"
@@ -100,6 +103,7 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
     hidden = hf["hidden_size"]
     head_dim = hf.get("head_dim") or hidden // num_heads
     qk_norm = arch in ("Qwen3ForCausalLM", "Qwen3MoeForCausalLM")
+    is_glm4 = arch in ("Glm4ForCausalLM",)
     attention_bias = hf.get("attention_bias",
                             arch in ("Qwen2ForCausalLM",
                                      "Qwen2MoeForCausalLM"))
@@ -119,6 +123,9 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
         tie_word_embeddings=hf.get("tie_word_embeddings", False),
         attention_bias=attention_bias,
         qk_norm=qk_norm,
+        partial_rotary_factor=hf.get("partial_rotary_factor", 1.0) or 1.0,
+        rope_interleaved=is_glm4,
+        sandwich_norms=is_glm4,
         eos_token_id=_first_eos(hf.get("eos_token_id")),
         bos_token_id=_first_eos(hf.get("bos_token_id")),
         hidden_act=hf.get("hidden_act", "silu"),

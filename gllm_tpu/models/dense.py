@@ -31,6 +31,7 @@ from gllm_tpu.models.config import ModelConfig
 from gllm_tpu.ops import (apply_rope, compute_rope_cos_sin,
                           fused_add_rms_norm, paged_attention, rms_norm,
                           silu_and_mul, write_kv)
+from gllm_tpu.ops.rope import apply_rope_interleaved
 from gllm_tpu.ops.quant import qmm
 from gllm_tpu.parallel.mesh import shard_hint
 
@@ -87,6 +88,10 @@ def init_params(cfg: ModelConfig, seed: int = 0,
     if cfg.qk_norm:
         layers["q_norm"] = jnp.ones((L, D), dtype)
         layers["k_norm"] = jnp.ones((L, D), dtype)
+    if cfg.sandwich_norms:
+        # GLM4 normalizes each sublayer OUTPUT before the residual add
+        layers["post_self_attn_norm"] = jnp.ones((L, H), dtype)
+        layers["post_mlp_norm"] = jnp.ones((L, H), dtype)
     params["layers"] = layers
     if cfg.is_first_stage:
         params["embed"] = w(next(ks), (cfg.vocab_size, H), 1.0)
@@ -120,7 +125,9 @@ def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
         # per-head RMSNorm over D (reference qwen3.py adds q/k norms)
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
-    q, k = apply_rope(q, k, batch.positions, cos_sin)
+    rope_fn = (apply_rope_interleaved if cfg.rope_interleaved
+               else apply_rope)
+    q, k = rope_fn(q, k, batch.positions, cos_sin)
     k_cache, v_cache = write_kv(k_cache, v_cache, k, v, batch.slot_mapping)
     attn = paged_attention(q, k_cache, v_cache, batch.attn,
                            scale=D ** -0.5, max_q_len=max_q_len,
@@ -175,10 +182,16 @@ def forward(
             attn_impl=attn_impl, max_q_len=max_q_len)
         k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_c, li, 0)
         v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_c, li, 0)
+        if cfg.sandwich_norms:
+            attn_out = rms_norm(attn_out, lp["post_self_attn_norm"],
+                                cfg.rms_norm_eps)
         normed2, res = fused_add_rms_norm(attn_out, res,
                                          lp["post_attn_norm"],
                                          cfg.rms_norm_eps)
         mlp_out = mlp_fn(lp, normed2)
+        if cfg.sandwich_norms:
+            mlp_out = rms_norm(mlp_out, lp["post_mlp_norm"],
+                               cfg.rms_norm_eps)
         return (mlp_out, res, k_all, v_all, li + 1), None
 
     init = (hidden, residual, kv.k, kv.v, jnp.int32(0))
@@ -208,5 +221,6 @@ def compute_logits(params: Params, hidden: jnp.ndarray,
 
 
 def make_rope_table(cfg: ModelConfig) -> jnp.ndarray:
-    return compute_rope_cos_sin(cfg.head_dim, cfg.max_position,
+    rot_dim = int(cfg.head_dim * cfg.partial_rotary_factor)
+    return compute_rope_cos_sin(rot_dim, cfg.max_position,
                                 cfg.rope_theta, cfg.rope_scaling)

@@ -97,7 +97,14 @@ def dense_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
         "mlp.down_proj.weight": ("down_proj", "t"),
         "input_layernorm.weight": ("input_norm", None),
         "post_attention_layernorm.weight": ("post_attn_norm", None),
+        "post_self_attn_layernorm.weight": ("post_self_attn_norm", None),
+        "post_mlp_layernorm.weight": ("post_mlp_norm", None),
     }
+
+    def split_gate_up(t: np.ndarray) -> dict:
+        # GLM4 fused [2I, H] gate_up → our separate [H, I] gate/up
+        gate, up = np.split(t, 2, axis=0)
+        return {"gate_proj": gate.T, "up_proj": up.T}
 
     def rule(name: str) -> Optional[Rule]:
         if name == "model.embed_tokens.weight":
@@ -114,6 +121,8 @@ def dense_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
             i = int(idx_s)
             if not (first <= i < last):
                 return None  # other PP stage's layer — skip (EP/PP pruning)
+            if leaf == "mlp.gate_up_proj.weight":
+                return (("layers", "__multi__"), i - first, split_gate_up)
             if leaf in proj_map:
                 target, tf = proj_map[leaf]
                 return (("layers", target), i - first, tf)

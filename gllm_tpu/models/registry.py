@@ -44,6 +44,7 @@ def _dense_def() -> ModelDef:
 
 
 _DENSE_ARCHS = (
+    "Glm4ForCausalLM",
     "LlamaForCausalLM",
     "MistralForCausalLM",
     "Qwen2ForCausalLM",

@@ -155,12 +155,22 @@ def apply_rope(q: jnp.ndarray, k: jnp.ndarray, positions: jnp.ndarray,
 
 def apply_rope_interleaved(q: jnp.ndarray, k: jnp.ndarray,
                            positions: jnp.ndarray, cos_sin: jnp.ndarray):
-    """DeepSeek-layout rotary: channels are (pair-interleaved) —
-    HF's modeling reorders ``d//2 pairs`` into half layout before the
-    standard rotate-half (apply_rotary_pos_emb in HF deepseek models).
+    """Pair-interleaved rotary (DeepSeek, GLM): channel pairs (2i, 2i+1)
+    rotate with frequency i. Implemented by de-interleaving the rotated
+    prefix into half layout and applying the standard rotation — a fixed
+    permutation applied identically to q and k, so attention scores are
+    unchanged vs the interleaved-output formulation (HF's rotate_half on
+    strided halves). Supports partial rotary: only the first
+    ``cos_sin.shape[-1]`` channels rotate; the tail passes through.
     """
+    rot_dim = cos_sin.shape[-1]
+
     def deinterleave(x):
-        *lead, d = x.shape
-        return x.reshape(*lead, d // 2, 2).swapaxes(-1, -2).reshape(*lead, d)
+        head, tail = x[..., :rot_dim], x[..., rot_dim:]
+        *lead, d = head.shape
+        head = head.reshape(*lead, d // 2, 2).swapaxes(-1, -2).reshape(
+            *lead, d)
+        return (jnp.concatenate([head, tail], axis=-1)
+                if tail.shape[-1] else head)
 
     return apply_rope(deinterleave(q), deinterleave(k), positions, cos_sin)

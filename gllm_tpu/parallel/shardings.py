@@ -60,6 +60,9 @@ def dense_param_specs(cfg: ModelConfig, tp: int) -> dict:
     if cfg.qk_norm:
         layers["q_norm"] = P(None, None)
         layers["k_norm"] = P(None, None)
+    if cfg.sandwich_norms:
+        layers["post_self_attn_norm"] = P(None, None)
+        layers["post_mlp_norm"] = P(None, None)
     specs = {"layers": layers}
     if cfg.is_first_stage:
         specs["embed"] = P(_tp_if(vocab_ok), None)

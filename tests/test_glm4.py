@@ -1,0 +1,39 @@
+"""GLM4: sandwich norms + partial interleaved rotary, HF oracle."""
+
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.sampling_params import SamplingParams
+
+
+def test_glm4_greedy_equivalence(tmp_path):
+    from transformers import Glm4Config, Glm4ForCausalLM
+    torch.manual_seed(17)
+    hf = Glm4ForCausalLM(Glm4Config(
+        vocab_size=128, hidden_size=64, num_hidden_layers=3,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        head_dim=16, partial_rotary_factor=0.5, attention_bias=True,
+        max_position_embeddings=256, eos_token_id=0, pad_token_id=0,
+        tie_word_embeddings=False))
+    hf.eval()
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = EngineConfig(model=str(tmp_path), dtype="float32",
+                       max_model_len=128,
+                       cache=CacheConfig(page_size=4, num_pages=64))
+    llm = LLM(config=cfg)
+    prompts = [[7, 3, 56, 21], [99, 14, 2, 8, 30]]
+    outs = llm.generate(
+        prompt_token_ids=prompts,
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
+                                       ignore_eos=True))
+    for p, out in zip(prompts, outs):
+        ids = list(p)
+        with torch.no_grad():
+            for _ in range(8):
+                ids.append(int(hf(torch.tensor([ids])).logits[0, -1]
+                               .argmax()))
+        assert out.output_token_ids == ids[len(p):], (p,
+                                                      out.output_token_ids,
+                                                      ids[len(p):])
