@@ -31,4 +31,7 @@ def __getattr__(name):
     if name == "LLM":
         from gllm_tpu.engine.llm import LLM
         return LLM
+    if name == "RequestOutput":
+        from gllm_tpu.engine.llm import RequestOutput
+        return RequestOutput
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

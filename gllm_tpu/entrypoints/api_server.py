@@ -353,7 +353,12 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--skip-warmup", action="store_true",
                    help="don't pre-compile decode buckets before serving "
                         "(first requests pay compile latency instead)")
-    # parallelism
+    # parallelism / multi-host (reference --launch-mode master|slave →
+    # jax.distributed coordinator/worker)
+    p.add_argument("--coordinator-address", default=None,
+                   help="host:port of host 0 for multi-host serving")
+    p.add_argument("--num-hosts", type=int, default=1)
+    p.add_argument("--host-id", type=int, default=None)
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--dp", type=int, default=1)
@@ -375,6 +380,10 @@ def serve(llm: LLM, host: str, port: int,
 def main(argv=None):
     logging.basicConfig(level=logging.INFO)
     args = make_parser().parse_args(argv)
+    if args.num_hosts > 1 or args.coordinator_address:
+        from gllm_tpu.parallel.multihost import init_multihost
+        init_multihost(args.coordinator_address, args.num_hosts,
+                       args.host_id)
     llm = LLM(config=build_engine_config(args))
     if not args.skip_warmup:
         llm.runner.warmup()

@@ -1,0 +1,43 @@
+"""Multi-host initialization.
+
+The reference's multi-node story (README launch modes ``master``/``slave``,
+NCCL TCP rendezvous + zmq port handshakes — /root/reference/gllm/
+llm_engine.py:198-211, comm.py:191-319) maps on TPU to one process per host
+joined through ``jax.distributed.initialize``: the coordinator replaces the
+NCCL rendezvous, and ICI/DCN collectives replace NCCL. After init,
+``jax.devices()`` spans the pod and the same mesh/sharding code paths apply;
+a pp×tp mesh whose stages align to hosts keeps hidden-state transfers on
+ICI within stages and DCN only between them.
+
+Single-host runs skip all of this (``num_hosts == 1``).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+def init_multihost(coordinator_address: Optional[str],
+                   num_hosts: int = 1,
+                   host_id: Optional[int] = None) -> None:
+    """Join this process to a multi-host pod.
+
+    coordinator_address: "host:port" of host 0 (the reference's master addr).
+    On Cloud TPU pods with metadata available, all three arguments may be
+    omitted and jax auto-detects them.
+    """
+    if num_hosts <= 1 and coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_hosts if num_hosts > 1 else None,
+        process_id=host_id,
+    )
+    logger.info("multihost up: process %d/%d, %d global devices "
+                "(%d local)", jax.process_index(), jax.process_count(),
+                len(jax.devices()), len(jax.local_devices()))
