@@ -100,3 +100,7 @@ class EngineConfig:
         ):
             raise ValueError(
                 f"unknown schedule_method {self.scheduler.schedule_method!r}")
+        if self.quantization not in (None, "int8", "fp8"):
+            raise ValueError(
+                f"unknown quantization {self.quantization!r} "
+                "(choices: int8, fp8)")

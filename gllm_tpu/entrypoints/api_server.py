@@ -384,6 +384,16 @@ def main(argv=None):
         from gllm_tpu.parallel.multihost import init_multihost
         init_multihost(args.coordinator_address, args.num_hosts,
                        args.host_id)
+        import jax
+        if jax.process_count() > 1:
+            # Serving over a multi-controller pod needs a host-0 frontend
+            # with request broadcast so every process issues identical jit
+            # programs (the role the reference's zmq master/slave plane
+            # plays). That layer lands next; refuse to half-work.
+            raise SystemExit(
+                "multi-host serving is not wired up yet: "
+                "jax.distributed initialized with "
+                f"{jax.process_count()} processes")
     llm = LLM(config=build_engine_config(args))
     if not args.skip_warmup:
         llm.runner.warmup()

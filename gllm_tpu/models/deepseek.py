@@ -35,6 +35,7 @@ from gllm_tpu.models.moe import select_experts
 from gllm_tpu.ops import (fused_add_rms_norm, paged_attention, rms_norm,
                           silu_and_mul)
 from gllm_tpu.ops.attention import AttentionMetadata
+from gllm_tpu.ops.quant import qmm
 from gllm_tpu.ops.rope import (apply_rope_interleaved, compute_rope_cos_sin,
                                yarn_softmax_scale_mult)
 
@@ -117,10 +118,10 @@ def _moe_block(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     combined = jnp.zeros((T, H), out.dtype).at[token_of].add(out * w_sorted)
 
     if cfg.n_shared_experts:
-        sg = x @ lp["shared_gate_proj"]
-        su = x @ lp["shared_up_proj"]
-        shared = silu_and_mul(jnp.concatenate([sg, su], axis=-1)) \
-            @ lp["shared_down_proj"]
+        sg = qmm(x, lp["shared_gate_proj"])
+        su = qmm(x, lp["shared_up_proj"])
+        shared = qmm(silu_and_mul(jnp.concatenate([sg, su], axis=-1)),
+                     lp["shared_down_proj"])
         combined = combined + shared
     return combined.astype(x.dtype)
 
@@ -138,9 +139,9 @@ def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
 
     if cfg.q_lora_rank:
         qa = rms_norm(x @ lp["q_a_proj"], lp["q_a_norm"], cfg.rms_norm_eps)
-        q = qa @ lp["q_b_proj"]
+        q = qmm(qa, lp["q_b_proj"])
     else:
-        q = x @ lp["q_proj"]
+        q = qmm(x, lp["q_proj"])
     q = q.reshape(T, Hq, nope + rope)
     q_nope, q_pe = q[..., :nope], q[..., nope:]
 
@@ -167,7 +168,8 @@ def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
                               max_q_len=max_q_len, impl="xla")  # [T,Hq,lora]
     out = jnp.einsum("thl,hlv->thv", out_lat.astype(jnp.float32),
                      lp["w_uv"].astype(jnp.float32)).astype(x.dtype)
-    return out.reshape(T, Hq * cfg.v_head_dim) @ lp["o_proj"], latent_cache
+    return (qmm(out.reshape(T, Hq * cfg.v_head_dim), lp["o_proj"]),
+            latent_cache)
 
 
 # ---------------------------------------------------------------------------

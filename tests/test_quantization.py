@@ -78,3 +78,34 @@ def test_engine_int8_outputs_close_to_full_precision(tmp_path, quant):
     # model for at least the first tokens
     assert quantized.output_token_ids[:2] == full.output_token_ids[:2]
     assert len(quantized.output_token_ids) == 8
+
+
+def test_deepseek_int8_quantized_runs(tmp_path):
+    """DeepSeek leaves are in QUANT_LEAVES — the model must route them
+    through qmm (regression for the trace-time crash)."""
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+    torch.manual_seed(5)
+    DeepseekV2ForCausalLM(DeepseekV2Config(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, intermediate_size=96,
+        max_position_embeddings=128, eos_token_id=0,
+        kv_lora_rank=32, q_lora_rank=48, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16,
+        n_routed_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        first_k_dense_replace=1, n_shared_experts=1,
+        topk_method="greedy", n_group=None, topk_group=None,
+        norm_topk_prob=False)).save_pretrained(tmp_path,
+                                               safe_serialization=True)
+    cfg = EngineConfig(model=str(tmp_path), dtype="float32",
+                       max_model_len=64, quantization="int8",
+                       cache=CacheConfig(page_size=4, num_pages=64))
+    out = LLM(config=cfg).generate(
+        prompt_token_ids=[[5, 9, 23]],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=4,
+                                       ignore_eos=True))[0]
+    assert len(out.output_token_ids) == 4
+
+
+def test_bad_quantization_value_rejected():
+    with pytest.raises(ValueError, match="quantization"):
+        EngineConfig(quantization="int4").validate()
