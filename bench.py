@@ -15,6 +15,12 @@ vs_baseline denominator: BASELINE.json's flagship target (2000 output tok/s
 for Llama-3-70B PP=8 on v5e-8 — i.e. ~250 tok/s/chip × 8; a 1B model on one
 chip should beat it by a wide margin; it is the round-over-round yardstick).
 
+Robustness: the default invocation is a supervisor that runs the actual
+benchmark in a child process under a hard deadline, retries once on
+backend-init failure/hang (round 1 died with "Unable to initialize backend
+'axon'" and produced no number), and on unrecoverable failure still prints
+one parseable JSON line with an "error" field.
+
 Usage: python bench.py            # real chip (axon/tpu)
        python bench.py --tiny     # CPU smoke (small model, small workload)
 """
@@ -25,12 +31,66 @@ import argparse
 import json
 import logging
 import os
+import subprocess
 import sys
 import time
+
+METRIC = "sharegpt_output_tok_s_per_chip"
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
+
+
+def supervise(args, argv):
+    """Run the real benchmark in a child process; retry once; always print
+    one JSON line."""
+    attempts = 2
+    # First attempt gets the full budget (TPU backend init via the tunnel
+    # can take minutes); the retry gets the remainder.
+    deadline = time.monotonic() + (900 if not args.tiny else 420)
+    last_tail = ""
+    for attempt in range(1, attempts + 1):
+        budget = max(60, deadline - time.monotonic())
+        log(f"[bench supervisor] attempt {attempt}/{attempts}, "
+            f"budget {budget:.0f}s")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--inner"]
+                + argv,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, timeout=budget)
+            tail = proc.stdout[-8000:]
+            sys.stderr.write(tail)
+            sys.stderr.flush()
+            if proc.returncode == 0:
+                # The inner run prints the JSON line last.
+                for line in reversed(proc.stdout.strip().splitlines()):
+                    line = line.strip()
+                    if line.startswith("{"):
+                        try:
+                            parsed = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        if parsed.get("metric") == METRIC:
+                            print(line)
+                            return 0
+            last_tail = tail[-1500:]
+        except subprocess.TimeoutExpired as e:
+            out = (e.stdout or b"")
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            last_tail = (out[-1500:] + f"\n[timeout after {budget:.0f}s]")
+            log(f"[bench supervisor] attempt {attempt} timed out")
+        if time.monotonic() >= deadline - 60:
+            break
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": "tok/s",
+        "vs_baseline": 0.0,
+        "error": f"benchmark failed after {attempts} attempts: "
+                 + last_tail[-900:],
+    }))
+    return 0
 
 
 def build_workload(rng, n_requests, max_model_len, tiny=False):
@@ -57,7 +117,15 @@ def main():
                     help="CPU smoke test (small model/workload)")
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--inner", action="store_true",
+                    help="(internal) run the measurement directly; without"
+                         " this flag a supervisor child-process wrapper"
+                         " with deadline+retry is used")
     args = ap.parse_args()
+
+    if not args.inner:
+        argv = [a for a in sys.argv[1:] if a != "--inner"]
+        sys.exit(supervise(args, argv))
 
     if args.tiny:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -138,7 +206,7 @@ def main():
     log(f"measured pass: {dt:.2f}s → {value:.1f} output tok/s "
         f"({n_requests / dt:.2f} req/s)")
     print(json.dumps({
-        "metric": "sharegpt_output_tok_s_per_chip",
+        "metric": METRIC,
         "value": round(value, 2),
         "unit": "tok/s",
         "vs_baseline": round(value / 2000.0, 4),
