@@ -101,9 +101,10 @@ class LLM:
             config.cache.enable_prefix_caching)
         self.scheduler = Scheduler(config, self.memory_manager,
                                    pp_size=config.parallel.pp)
-        self.eos_token_id = model_cfg.eos_token_id
-        if self.eos_token_id is None and self.tokenizer is not None:
-            self.eos_token_id = self.tokenizer.eos_token_id
+        self.eos_token_ids = frozenset(model_cfg.eos_token_ids)
+        if not self.eos_token_ids and self.tokenizer is not None \
+                and self.tokenizer.eos_token_id is not None:
+            self.eos_token_ids = frozenset([self.tokenizer.eos_token_id])
         self._next_seq_id = 0
         from collections import deque
         self._in_flight = deque()
@@ -159,7 +160,7 @@ class LLM:
         batch, handle = self._in_flight.popleft()
         tokens = self.runner.collect(handle)
         return self.scheduler.process_output(batch, tokens.tolist(),
-                                             self.eos_token_id)
+                                             self.eos_token_ids)
 
     def generate(
         self,

@@ -32,8 +32,20 @@ class ModelConfig:
     partial_rotary_factor: float = 1.0  # GLM: rotate only this prefix of D
     rope_interleaved: bool = False    # GLM/DeepSeek pair-interleaved layout
     sandwich_norms: bool = False      # GLM4 post_self_attn/post_mlp norms
-    eos_token_id: Optional[int] = None
+    # int, tuple of ints, or None. Checkpoints like GLM4 / Llama-3 declare
+    # several terminators (reference llm_engine.py finish_tokens treats
+    # eos_token_id as a list); use ``eos_token_ids`` for finish checks.
+    eos_token_id: Any = None
     bos_token_id: Optional[int] = None
+
+    @property
+    def eos_token_ids(self) -> Tuple[int, ...]:
+        v = self.eos_token_id
+        if v is None:
+            return ()
+        if isinstance(v, (list, tuple)):
+            return tuple(v)
+        return (v,)
     hidden_act: str = "silu"
     # MoE fields (0 experts → dense). See gllm_tpu/models/moe.py.
     num_experts: int = 0
@@ -96,6 +108,14 @@ def _first_eos(v) -> Optional[int]:
     return v
 
 
+def _eos_tuple(v) -> Optional[Tuple[int, ...]]:
+    if v is None:
+        return None
+    if isinstance(v, (list, tuple)):
+        return tuple(v) or None
+    return (v,)
+
+
 def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
     """Parse an HF config.json dict into a ModelConfig."""
     arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
@@ -126,7 +146,7 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
         partial_rotary_factor=hf.get("partial_rotary_factor", 1.0) or 1.0,
         rope_interleaved=is_glm4,
         sandwich_norms=is_glm4,
-        eos_token_id=_first_eos(hf.get("eos_token_id")),
+        eos_token_id=_eos_tuple(hf.get("eos_token_id")),
         bos_token_id=_first_eos(hf.get("bos_token_id")),
         hidden_act=hf.get("hidden_act", "silu"),
         num_experts=hf.get("num_experts",

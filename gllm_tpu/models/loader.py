@@ -28,7 +28,25 @@ from gllm_tpu.models.config import ModelConfig, from_hf_config
 
 def load_hf_config(model_dir: str) -> dict:
     with open(os.path.join(model_dir, "config.json")) as f:
-        return json.load(f)
+        hf = json.load(f)
+    # Checkpoints often declare extra terminators only in
+    # generation_config.json (the reference reads it the same way; GLM4 /
+    # Llama-3 list several eos ids there). Merge them into the config dict.
+    gen_path = os.path.join(model_dir, "generation_config.json")
+    if os.path.exists(gen_path):
+        try:
+            with open(gen_path) as f:
+                gen = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            gen = {}
+        ids = []
+        for v in (hf.get("eos_token_id"), gen.get("eos_token_id")):
+            if v is None:
+                continue
+            ids.extend(v if isinstance(v, list) else [v])
+        if ids:
+            hf["eos_token_id"] = list(dict.fromkeys(ids))
+    return hf
 
 
 class LazySafetensors:

@@ -27,6 +27,11 @@ class SamplingMetadata(NamedTuple):
     # penalty > 1 scales positive logits down / negative up for seen tokens.
     repetition_penalty: jnp.ndarray   # [S] f32
     step_key: jnp.ndarray          # PRNG key for this step
+    # Per-seq seeded determinism (reference honors SamplingParams.seed):
+    # seed >= 0 → that row's key is a pure function of (seed, out_step),
+    # independent of batch composition; seed < 0 → engine step_key.
+    seed: Optional[jnp.ndarray] = None       # [S] i32
+    out_step: Optional[jnp.ndarray] = None   # [S] i32 output-token index
 
 
 def apply_repetition_penalty(logits: jnp.ndarray,
@@ -76,7 +81,24 @@ def sample(logits: jnp.ndarray, md: SamplingMetadata,
     temp = jnp.maximum(md.temperature, 1e-6)[:, None]
     scaled = _topk_topp_mask(logits / temp, md.top_k, md.top_p)
     # Gumbel-max == categorical sampling, stays fused on device.
-    gumbel = jax.random.gumbel(md.step_key, scaled.shape, dtype=jnp.float32)
+    if md.seed is None:
+        gumbel = jax.random.gumbel(md.step_key, scaled.shape,
+                                   dtype=jnp.float32)
+    else:
+        S, V = scaled.shape
+        rows = jnp.arange(S, dtype=jnp.uint32)
+        unseeded = jax.vmap(jax.random.fold_in,
+                            in_axes=(None, 0))(md.step_key, rows)
+        seeded = jax.vmap(
+            lambda s, t: jax.random.fold_in(
+                jax.random.key(s.astype(jnp.uint32)), t))(
+            md.seed, md.out_step.astype(jnp.uint32))
+        key_data = jnp.where((md.seed >= 0)[:, None],
+                             jax.random.key_data(seeded),
+                             jax.random.key_data(unseeded))
+        keys = jax.random.wrap_key_data(key_data)
+        gumbel = jax.vmap(
+            lambda k: jax.random.gumbel(k, (V,), dtype=jnp.float32))(keys)
     sampled = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
 
     return jnp.where(md.temperature == 0.0, greedy_tokens, sampled)

@@ -77,6 +77,9 @@ class BatchBuilder:
         top_p = np.ones(s_pad, np.float32)
         top_k = np.full(s_pad, -1, np.int32)
         rep_penalty = np.ones(s_pad, np.float32)
+        seeds = np.full(s_pad, -1, np.int32)
+        out_steps = np.zeros(s_pad, np.int32)
+        any_seeded = False
 
         off = 0
         for i, it in enumerate(batch.items):
@@ -98,6 +101,11 @@ class BatchBuilder:
             top_p[i] = sp.top_p
             top_k[i] = sp.top_k
             rep_penalty[i] = sp.repetition_penalty
+            if sp.seed is not None:
+                any_seeded = True
+                seeds[i] = sp.seed
+                # index of the output token this step will sample
+                out_steps[i] = before + n - seq.prompt_len
             off += n
         cu[len(batch.items) + 1:] = off
 
@@ -131,6 +139,11 @@ class BatchBuilder:
                 top_p=jnp.asarray(top_p),
                 top_k=jnp.asarray(top_k),
                 repetition_penalty=jnp.asarray(rep_penalty),
-                step_key=step_key),
+                step_key=step_key,
+                # None keeps the fused single-draw gumbel path (the common
+                # all-unseeded case); per-row keys only when a request
+                # actually asked for a seed (one extra jit variant).
+                seed=jnp.asarray(seeds) if any_seeded else None,
+                out_step=jnp.asarray(out_steps) if any_seeded else None),
         )
         return step_batch, max_q, presence_mask

@@ -41,3 +41,10 @@ class SamplingParams:
             raise ValueError("max_tokens must be >= 1")
         if self.repetition_penalty <= 0:
             raise ValueError("repetition_penalty must be > 0")
+        if self.seed is not None:
+            if self.seed < 0:
+                raise ValueError("seed must be >= 0")
+            # 64-bit client seeds (vLLM-style) are folded into the 31-bit
+            # device key space up front so the request is deterministic and
+            # the int32 batch arrays can't overflow mid-step.
+            self.seed &= 0x7FFFFFFF

@@ -245,6 +245,11 @@ class Scheduler:
         self._decode_offset += budget
         protect = {it.seq.seq_id for it in items}
         for seq in orderd[:budget]:
+            if seq.status is not SequenceStatus.RUNNING:
+                # Preempted as a victim by an earlier seq in this same pass
+                # (already reset and pushed to waiting) — scheduling it now
+                # would double-schedule it against _schedule_prefill.
+                continue
             protect.add(seq.seq_id)
             if not self._allocate_with_preemption(seq, 1, protect):
                 protect.discard(seq.seq_id)
@@ -286,6 +291,8 @@ class Scheduler:
                and len(items) < max_seqs):
             seq = self.waiting[0]
             if seq.seq_id in self._aborted_ids:
+                if seq.num_in_flight:
+                    break  # let the in-flight step land before freeing
                 self.waiting.popleft()
                 self._finish_abort(seq)
                 continue
@@ -329,6 +336,7 @@ class Scheduler:
         at prev's step, and pages are available without preemption.
         """
         items: List[ScheduledSeq] = []
+        total_need = 0
         for it in prev.items:
             seq = it.seq
             if not it.samples or seq.seq_id in self._aborted_ids:
@@ -346,9 +354,14 @@ class Scheduler:
                 return None
             need = cdiv(computed_next + 1, self.mm.page_size) \
                 - len(seq.page_table)
-            if need and not self.mm.can_allocate(need):
-                return None
+            total_need += max(0, need)
             items.append(ScheduledSeq(seq, 1, computed_next))
+        # Validate the page need of the WHOLE chained batch before touching
+        # the allocator: per-item checks would each pass near a full pool
+        # yet exhaust it mid-allocation below, crashing the step with
+        # earlier items' num_in_flight already incremented.
+        if total_need and not self.mm.can_allocate(total_need):
+            return None
         for it in items:
             seq = it.seq
             # cover tokens [0, computed_before+1) — num_computed_tokens
@@ -362,9 +375,10 @@ class Scheduler:
 
     def process_output(self, batch: ScheduledBatch,
                        sampled_tokens: List[int],
-                       eos_token_id: Optional[int]) -> List[SeqOutput]:
+                       eos_token_ids) -> List[SeqOutput]:
         """Advance state after a step. ``sampled_tokens[i]`` is the sampled
-        token for batch item i (ignored for items that don't sample)."""
+        token for batch item i (ignored for items that don't sample).
+        ``eos_token_ids`` is a collection of terminator ids (or None)."""
         outputs: List[SeqOutput] = []
         for it, tok in zip(batch.items, sampled_tokens):
             seq = it.seq
@@ -387,7 +401,7 @@ class Scheduler:
             if it.samples:
                 seq.append_token(int(tok))
                 new_token = int(tok)
-                finish = seq.check_finish(eos_token_id)
+                finish = seq.check_finish(eos_token_ids)
                 # Hard cap: the KV layout (page_table width, rope table) is
                 # sized for max_model_len; never decode past it.
                 if (finish is None
@@ -427,7 +441,8 @@ class Scheduler:
             self.running.remove(seq)
             self._finish_abort(seq)
         for seq in [s for s in self.waiting
-                    if s.seq_id in self._aborted_ids]:
+                    if s.seq_id in self._aborted_ids
+                    and not s.num_in_flight]:
             self.waiting.remove(seq)
             self._finish_abort(seq)
 

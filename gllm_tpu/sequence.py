@@ -99,12 +99,19 @@ class Sequence:
         self.num_cached_tokens = 0
         self.page_table = []
 
-    def check_finish(self, eos_token_id: Optional[int]) -> Optional[str]:
-        """EOS / stop-token / length check after a token was appended."""
+    def check_finish(self, eos_token_ids) -> Optional[str]:
+        """EOS / stop-token / length check after a token was appended.
+
+        ``eos_token_ids`` is a collection — checkpoints declare several
+        terminators (reference llm_engine.py finish_tokens membership
+        check; GLM4 has three eos ids, Llama-3 two).
+        """
         sp = self.sampling_params
         last = self.token_ids[-1]
+        if isinstance(eos_token_ids, int):
+            eos_token_ids = (eos_token_ids,)
         if self.num_output_tokens >= sp.min_tokens:
-            if not sp.ignore_eos and eos_token_id is not None and last == eos_token_id:
+            if not sp.ignore_eos and eos_token_ids and last in eos_token_ids:
                 return "stop"
             if last in sp.stop_token_ids:
                 return "stop"

@@ -83,7 +83,7 @@ def test_overlap_single_seq_eos_midchain_no_leak(ckpt):
                              temperature=0.0, max_tokens=8, ignore_eos=True))
     eos = probe[0].output_token_ids[2]
     llm2 = LLM(config=cfg)
-    llm2.eos_token_id = eos
+    llm2.eos_token_ids = frozenset([eos])
     out = llm2.generate(prompt_token_ids=[[5, 6, 7]],
                         sampling_params=SamplingParams(temperature=0.0,
                                                        max_tokens=30))[0]
