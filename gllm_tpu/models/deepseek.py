@@ -131,7 +131,8 @@ def _moe_block(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
-                   cos_sin, *, max_q_len: int, scale: float):
+                   cos_sin, *, max_q_len: int, scale: float,
+                   attn_impl: str = "xla"):
     T = x.shape[0]
     Hq = cfg.num_heads
     nope, rope, lora = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
@@ -162,10 +163,13 @@ def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
                        lp["w_uk"].astype(jnp.float32)).astype(x.dtype)
     q_full = jnp.concatenate([q_lat, q_pe], axis=-1)  # [T, Hq, lora+rope]
 
+    # MQA over the latent cache; values are the latent prefix of the keys
+    # (v_cache=None → the Pallas kernels read v from the k block in VMEM,
+    # one DMA stream; the xla path slices lazily inside its gather).
     kc = latent_cache[:, :, None, :]                  # [P, page, 1, width]
-    vc = kc[..., :lora]
-    out_lat = paged_attention(q_full, kc, vc, batch.attn, scale=scale,
-                              max_q_len=max_q_len, impl="xla")  # [T,Hq,lora]
+    out_lat = paged_attention(q_full, kc, None, batch.attn, scale=scale,
+                              max_q_len=max_q_len, impl=attn_impl,
+                              v_dim=lora)             # [T, Hq, lora]
     out = jnp.einsum("thl,hlv->thv", out_lat.astype(jnp.float32),
                      lp["w_uv"].astype(jnp.float32)).astype(x.dtype)
     return (qmm(out.reshape(T, Hq * cfg.v_head_dim), lp["o_proj"]),
@@ -254,7 +258,6 @@ def init_params(cfg: ModelConfig, seed: int = 0,
 def forward(params, kv: LatentKVCache, batch: StepBatch, cfg: ModelConfig,
             *, cos_sin, attn_impl: str = "xla", max_q_len: int,
             hidden_in=None, residual_in=None):
-    del attn_impl  # MLA always uses the xla path for now
     head_dim = cfg.qk_nope_head_dim + cfg.qk_rope_head_dim
     scale = head_dim ** -0.5 * yarn_softmax_scale_mult(cfg.rope_scaling)
 
@@ -276,7 +279,7 @@ def forward(params, kv: LatentKVCache, batch: StepBatch, cfg: ModelConfig,
             lc = jax.lax.dynamic_index_in_dim(cache, li, 0, keepdims=False)
             attn_out, lc = _mla_attention(lp, normed, batch, lc, cfg,
                                           cos_sin, max_q_len=max_q_len,
-                                          scale=scale)
+                                          scale=scale, attn_impl=attn_impl)
             cache = jax.lax.dynamic_update_index_in_dim(cache, lc, li, 0)
             normed2, res = fused_add_rms_norm(attn_out, res,
                                               lp["post_attn_norm"],

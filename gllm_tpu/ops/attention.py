@@ -42,47 +42,59 @@ class AttentionMetadata(NamedTuple):
 NEG_INF = float("-inf")
 
 
-@functools.partial(jax.jit, static_argnames=("max_q_len", "scale", "impl"))
+@functools.partial(jax.jit, static_argnames=("max_q_len", "scale", "impl",
+                                             "v_dim"))
 def paged_attention(
     q: jnp.ndarray,            # [T, Hq, D]
     k_cache: jnp.ndarray,      # [num_pages, page_size, Hkv, D]
-    v_cache: jnp.ndarray,
+    v_cache,                   # [P, page, Hkv, Dv] or None → v = k[:, :Dv]
+                               # (MLA absorbed: values are the latent
+                               # prefix of the keys — one cache, one DMA
+                               # stream)
     metadata: AttentionMetadata,
     *,
     scale: float,
     max_q_len: int,
     impl: str = "xla",
+    v_dim: Optional[int] = None,
 ) -> jnp.ndarray:
+    if v_cache is None and v_dim is None:
+        raise ValueError("v_dim required when v_cache is None")
     if impl == "xla":
+        if v_cache is None:
+            v_cache = k_cache[..., :v_dim]
         return _xla_paged_attention(q, k_cache, v_cache, metadata,
                                     scale=scale, max_q_len=max_q_len)
     if impl == "pallas":
+        backend = jax.default_backend()
+        if backend == "cpu":
+            interpret = True
+        elif backend in ("tpu", "axon"):
+            interpret = False
+        else:
+            raise NotImplementedError(
+                f"pallas attention unsupported on backend {backend!r}; "
+                "use impl='xla'")
         if max_q_len == 1:
             # Pure-decode batch: T == S, one query row per sequence (the
-            # layout prepare.py emits for max_q_len == 1).
+            # layout prepare.py emits for max_q_len == 1). The per-seq
+            # decode kernel wins here: its [Hkv, G, BK] dot shape avoids
+            # the ragged kernel's masked-row waste for 1-token rows.
             if q.shape[0] != metadata.kv_lens.shape[0]:
                 raise ValueError(
                     f"pallas decode path requires T == S, got T={q.shape[0]} "
                     f"S={metadata.kv_lens.shape[0]}")
-            backend = jax.default_backend()
-            if backend == "cpu":
-                interpret = True
-            elif backend in ("tpu", "axon"):
-                interpret = False
-            else:
-                raise NotImplementedError(
-                    f"pallas attention unsupported on backend {backend!r}; "
-                    "use impl='xla'")
             from gllm_tpu.ops.pallas.decode_attention import (
                 paged_decode_attention)
             return paged_decode_attention(
                 q, k_cache, v_cache, metadata.kv_lens, metadata.page_table,
-                scale=scale, interpret=interpret)
-        # Mixed/prefill batches: XLA path until the unified ragged kernel
-        # lands (prefill is matmul-bound; decode is where the paged gather
-        # hurts).
-        return _xla_paged_attention(q, k_cache, v_cache, metadata,
-                                    scale=scale, max_q_len=max_q_len)
+                scale=scale, interpret=interpret, v_dim=v_dim)
+        from gllm_tpu.ops.pallas.ragged_attention import (
+            ragged_paged_attention)
+        return ragged_paged_attention(
+            q, k_cache, v_cache, metadata.cu_q_lens, metadata.kv_lens,
+            metadata.page_table, scale=scale, interpret=interpret,
+            v_dim=v_dim)
     raise ValueError(f"unknown attention impl {impl!r}")
 
 

@@ -17,50 +17,50 @@ Design (TPU-first, not a Triton translation):
   every kv head's group hits the MXU together.
 - The kv-block loop bound is dynamic (ceil(kv_len / block)): padded
   sequences (kv_len 0) skip the loop entirely.
+- MLA absorbed mode: ``v_cache=None`` + ``v_dim`` reads values as the
+  leading ``v_dim`` lanes of each key block (the latent prefix) — one DMA
+  stream instead of two (reference MLA shares the latent cache the same
+  way, layers/attention.py:272-293).
 """
 
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from gllm_tpu.ops.pallas.paged_kv import (block_kv, kv_stream_specs,
+                                          make_fetch_fns)
+
 DEFAULT_KV_BLOCK = 256
 
 
 def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
-            q_ref, k_hbm, v_hbm,            # inputs
-            o_ref,                          # output
-            k_buf, v_buf, sems,             # scratch
-            *, page_size: int, pages_per_block: int, scale: float,
-            num_kv_heads: int, group: int, head_dim: int):
+            *refs,
+            page_size: int, pages_per_block: int, scale: float,
+            num_kv_heads: int, group: int, head_dim: int, v_dim: int,
+            shared_kv: bool):
+    if shared_kv:
+        q_ref, k_hbm, o_ref, k_buf, sems = refs
+        v_hbm = v_buf = None
+    else:
+        q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems = refs
     s = pl.program_id(0)
     kv_len = kv_lens_ref[s]
     bk = pages_per_block * page_size
     n_blocks = pl.cdiv(kv_len, bk)
 
-    def start_fetch(slot, blk):
-        for j in range(pages_per_block):
-            page_idx = pt_ref[s, blk * pages_per_block + j]
-            pltpu.make_async_copy(k_hbm.at[page_idx], k_buf.at[slot, j],
-                                  sems.at[slot, j, 0]).start()
-            pltpu.make_async_copy(v_hbm.at[page_idx], v_buf.at[slot, j],
-                                  sems.at[slot, j, 1]).start()
-
-    def wait_fetch(slot, blk):
-        for j in range(pages_per_block):
-            page_idx = pt_ref[s, blk * pages_per_block + j]
-            pltpu.make_async_copy(k_hbm.at[page_idx], k_buf.at[slot, j],
-                                  sems.at[slot, j, 0]).wait()
-            pltpu.make_async_copy(v_hbm.at[page_idx], v_buf.at[slot, j],
-                                  sems.at[slot, j, 1]).wait()
+    start_fetch, wait_fetch = make_fetch_fns(
+        pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems, pages_per_block,
+        shared_kv)
 
     @pl.when(n_blocks > 0)
     def _():
-        start_fetch(0, 0)
+        start_fetch(0, s, 0)
 
     q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
     qh = q.reshape(num_kv_heads, group, head_dim)     # [Hkv, G, D]
@@ -71,13 +71,13 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
 
         @pl.when(i + 1 < n_blocks)
         def _():
-            start_fetch(1 - slot, i + 1)
+            start_fetch(1 - slot, s, i + 1)
 
-        wait_fetch(slot, i)
-        k = k_buf[slot].reshape(bk, num_kv_heads, head_dim)
-        v = v_buf[slot].reshape(bk, num_kv_heads, head_dim)
+        wait_fetch(slot, s, i)
+        k, v = block_kv(k_buf, v_buf, slot, bk, num_kv_heads, head_dim,
+                        v_dim, shared_kv)
         kt = k.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, D]
-        vt = v.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, D]
+        vt = v.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, Dv]
 
         # [Hkv, G, BK] = batch-dot over kv heads (MXU)
         scores = jax.lax.dot_general(
@@ -92,7 +92,7 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
         alpha = jnp.exp(m - m_new)
         p = jnp.exp(scores - m_new)                      # [Hkv, G, BK]
         l_new = l * alpha + jnp.sum(p, axis=2, keepdims=True)
-        # [Hkv, G, D] accumulation
+        # [Hkv, G, Dv] accumulation
         pv = jax.lax.dot_general(
             p, vt, (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
@@ -101,31 +101,39 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
 
     m0 = jnp.full((num_kv_heads, group, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((num_kv_heads, group, 1), jnp.float32)
-    acc0 = jnp.zeros((num_kv_heads, group, head_dim), jnp.float32)
+    acc0 = jnp.zeros((num_kv_heads, group, v_dim), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-30)                   # padded seqs → 0
     o_ref[0] = out.reshape(num_kv_heads * group,
-                           head_dim).astype(o_ref.dtype)
+                           v_dim).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("scale", "kv_block", "interpret"))
+                   static_argnames=("scale", "kv_block", "interpret",
+                                    "v_dim"))
 def paged_decode_attention(
     q: jnp.ndarray,            # [S, Hq, D]
     k_cache: jnp.ndarray,      # [num_pages, page_size, Hkv, D]
-    v_cache: jnp.ndarray,
+    v_cache: Optional[jnp.ndarray],  # None → v = k[..., :v_dim] (MLA)
     kv_lens: jnp.ndarray,      # [S] int32 (0 for padded rows)
     page_table: jnp.ndarray,   # [S, max_pages] int32 (padding → dummy page 0)
     *,
     scale: float,
     kv_block: int = DEFAULT_KV_BLOCK,
     interpret: bool = False,
+    v_dim: Optional[int] = None,
 ) -> jnp.ndarray:
     S, num_q_heads, head_dim = q.shape
     num_pages, page_size, num_kv_heads, _ = k_cache.shape
     max_pages = page_table.shape[1]
     group = num_q_heads // num_kv_heads
+    shared_kv = v_cache is None
+    if shared_kv:
+        if v_dim is None:
+            raise ValueError("v_dim required when v_cache is None")
+    else:
+        v_dim = v_cache.shape[-1]
 
     pages_per_block = max(1, min(kv_block // page_size, max_pages))
     # page_table must cover whole blocks; pad with dummy page 0.
@@ -138,36 +146,34 @@ def paged_decode_attention(
     kernel = functools.partial(
         _kernel, page_size=page_size, pages_per_block=pages_per_block,
         scale=scale, num_kv_heads=num_kv_heads, group=group,
-        head_dim=head_dim)
+        head_dim=head_dim, v_dim=v_dim, shared_kv=shared_kv)
+
+    kv_specs, scratch_shapes, kv_inputs = kv_stream_specs(
+        k_cache, v_cache, pages_per_block, page_size, num_kv_heads,
+        head_dim, v_dim)
+    in_specs = [
+        pl.BlockSpec((1, num_q_heads, head_dim), lambda s, *_: (s, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ] + kv_specs
+    inputs = [kv_lens, page_table, q] + kv_inputs
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S,),
-        in_specs=[
-            pl.BlockSpec((1, num_q_heads, head_dim), lambda s, *_: (s, 0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec((1, num_q_heads, head_dim),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, num_q_heads, v_dim),
                                lambda s, *_: (s, 0, 0),
                                memory_space=pltpu.VMEM),
-        scratch_shapes=[
-            pltpu.VMEM((2, pages_per_block, page_size, num_kv_heads,
-                        head_dim), k_cache.dtype),
-            pltpu.VMEM((2, pages_per_block, page_size, num_kv_heads,
-                        head_dim), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, pages_per_block, 2)),
-        ],
+        scratch_shapes=scratch_shapes,
     )
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, num_q_heads, head_dim), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((S, num_q_heads, v_dim), q.dtype),
         # Sequences are independent → let Mosaic split the grid across
         # Megacore TensorCores.
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)) if interpret else
         pltpu.CompilerParams(dimension_semantics=("parallel",)),
         interpret=interpret,
-    )(kv_lens, page_table, q, k_cache, v_cache)
+    )(*inputs)

@@ -1,0 +1,76 @@
+"""Shared paged-KV streaming machinery for the Pallas attention kernels.
+
+Both the decode kernel (grid over sequences) and the ragged prefill kernel
+(grid over q blocks) stream KV pages HBM→VMEM with double-buffered async
+DMA, optionally with values read as the leading ``v_dim`` lanes of each key
+block (MLA absorbed layout — one DMA stream). This module is the single
+copy of that discipline.
+"""
+
+from __future__ import annotations
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def make_fetch_fns(pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems,
+                   pages_per_block: int, shared_kv: bool):
+    """(start_fetch, wait_fetch), each taking (slot, seq, kv_block_idx).
+
+    Copies ``pages_per_block`` whole pages per block; semaphore layout is
+    [slot, page_in_block, k_or_v]. Start/wait pairs must match 1:1 — the
+    callers' double-buffer loops guarantee it.
+    """
+
+    def start_fetch(slot, s, blk):
+        for j in range(pages_per_block):
+            page_idx = pt_ref[s, blk * pages_per_block + j]
+            pltpu.make_async_copy(k_hbm.at[page_idx], k_buf.at[slot, j],
+                                  sems.at[slot, j, 0]).start()
+            if not shared_kv:
+                pltpu.make_async_copy(v_hbm.at[page_idx], v_buf.at[slot, j],
+                                      sems.at[slot, j, 1]).start()
+
+    def wait_fetch(slot, s, blk):
+        for j in range(pages_per_block):
+            page_idx = pt_ref[s, blk * pages_per_block + j]
+            pltpu.make_async_copy(k_hbm.at[page_idx], k_buf.at[slot, j],
+                                  sems.at[slot, j, 0]).wait()
+            if not shared_kv:
+                pltpu.make_async_copy(v_hbm.at[page_idx], v_buf.at[slot, j],
+                                      sems.at[slot, j, 1]).wait()
+
+    return start_fetch, wait_fetch
+
+
+def block_kv(k_buf, v_buf, slot, bk: int, num_kv_heads: int,
+             head_dim: int, v_dim: int, shared_kv: bool):
+    """The current VMEM block as ([BK, Hkv, D] keys, [BK, Hkv, Dv] values);
+    shared-kv mode slices values from the key block (latent prefix)."""
+    k = k_buf[slot].reshape(bk, num_kv_heads, head_dim)
+    if shared_kv:
+        v = k[..., :v_dim]
+    else:
+        v = v_buf[slot].reshape(bk, num_kv_heads, v_dim)
+    return k, v
+
+
+def kv_stream_specs(k_cache, v_cache, pages_per_block: int, page_size: int,
+                    num_kv_heads: int, head_dim: int, v_dim: int):
+    """(in_specs_tail, scratch_shapes, inputs_tail) for the KV streams.
+
+    Appends the v stream only when a distinct v cache exists; the DMA
+    semaphore array always comes last in scratch.
+    """
+    shared_kv = v_cache is None
+    in_specs = [pl.BlockSpec(memory_space=pl.ANY)]
+    scratch = [pltpu.VMEM((2, pages_per_block, page_size, num_kv_heads,
+                           head_dim), k_cache.dtype)]
+    inputs = [k_cache]
+    if not shared_kv:
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        scratch.append(pltpu.VMEM((2, pages_per_block, page_size,
+                                   num_kv_heads, v_dim), v_cache.dtype))
+        inputs.append(v_cache)
+    scratch.append(pltpu.SemaphoreType.DMA((2, pages_per_block, 2)))
+    return in_specs, scratch, inputs

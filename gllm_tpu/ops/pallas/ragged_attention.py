@@ -1,0 +1,240 @@
+"""Pallas TPU ragged paged attention (prefill + mixed batches).
+
+The prefill half of the reference's core attention kernel — one varlen call
+serving a mixed batch of prefill chunks and decode rows against the paged KV
+cache (sgl_kernel ``flash_attn_varlen_func`` semantics,
+/root/reference/gllm/layers/attention.py:92-140). Replaces the dense-gather
+XLA fallback whose HBM traffic scaled with the *padded* page-table extent
+(round-1 verdict: gigabytes per layer at 4K context).
+
+Design (TPU-first):
+- grid = (num_q_blocks,) over the FLAT packed token axis. Because blocks are
+  aligned with the ragged layout, q and the output use plain VMEM BlockSpecs
+  — no gather/scatter at either end. A q block may span several sequences
+  (decode rows are 1 token each); each program loops over exactly the
+  sequences overlapping its block (host-precomputed [first,last] range via
+  searchsorted, passed as scalar prefetch).
+- per sequence, KV pages stream HBM→VMEM with double-buffered async DMA
+  (same discipline as decode_attention.py); the kv-block loop bound is the
+  causal limit of this q block within that sequence, so HBM traffic is the
+  actual context, not the padded page-table width.
+- GQA layout: the q block is reshaped to [Hkv, BQ*G, D] so scores are one
+  kv-head-batched MXU dot per kv block; rows outside the current sequence
+  are masked with -inf and contribute nothing to their online softmax state
+  (m/l/acc carried across the sequence loop).
+- Values may have a different head dim than keys (Dv != D) to serve the MLA
+  absorbed path, where v is the latent prefix of k.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from gllm_tpu.ops.pallas.paged_kv import (block_kv, kv_stream_specs,
+                                          make_fetch_fns)
+
+DEFAULT_KV_BLOCK = 256
+DEFAULT_Q_BLOCK = 128
+NEG_INF = float("-inf")
+
+
+def _kernel(cu_ref, kv_lens_ref, pt_ref, first_ref, last_ref,  # prefetch
+            *refs,
+            page_size: int, pages_per_block: int, scale: float,
+            num_kv_heads: int, group: int, head_dim: int, v_dim: int,
+            q_blk: int, shared_kv: bool):
+    if shared_kv:
+        q_ref, k_hbm, o_ref, k_buf, sems = refs
+        v_hbm = v_buf = None
+    else:
+        q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems = refs
+    b = pl.program_id(0)
+    t_start = b * q_blk
+    s0 = first_ref[b]
+    s1 = last_ref[b]
+    bk = pages_per_block * page_size
+    rows = q_blk * group
+
+    q = q_ref[...].astype(jnp.float32) * scale            # [BQ, Hq, D]
+    # [BQ, Hkv, G, D] → [Hkv, BQ, G, D] → [Hkv, BQ*G, D]
+    qh = q.reshape(q_blk, num_kv_heads, group, head_dim) \
+          .transpose(1, 0, 2, 3).reshape(num_kv_heads, rows, head_dim)
+    # token index of each score row: row r → t_start + r // G
+    row_tok = t_start + jax.lax.broadcasted_iota(
+        jnp.int32, (num_kv_heads, rows, 1), 1) // group
+
+    start_fetch, wait_fetch = make_fetch_fns(
+        pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems, pages_per_block,
+        shared_kv)
+
+    def seq_body(s, carry):
+        m, l, acc = carry
+        q_start = cu_ref[s]
+        q_end = cu_ref[s + 1]                 # exclusive
+        q_len = q_end - q_start
+        kv_len = kv_lens_ref[s]
+        # overlap of [q_start, q_end) with this q block's token range
+        lo = jnp.maximum(q_start, t_start)
+        hi = jnp.minimum(q_end, t_start + q_blk)   # exclusive
+        # causal kv limit for the LAST overlapping row of this block:
+        # absolute position of token t is kv_len - q_len + (t - q_start).
+        kv_limit = kv_len - q_len + (hi - 1 - q_start) + 1
+        kv_limit = jnp.where(hi > lo, jnp.minimum(kv_limit, kv_len), 0)
+        n_blocks = pl.cdiv(kv_limit, bk)
+
+        @pl.when(n_blocks > 0)
+        def _():
+            start_fetch(0, s, 0)
+
+        def blk_body(i, carry2):
+            m, l, acc = carry2
+            slot = jax.lax.rem(i, 2)
+
+            @pl.when(i + 1 < n_blocks)
+            def _():
+                start_fetch(1 - slot, s, i + 1)
+
+            wait_fetch(slot, s, i)
+            k, v = block_kv(k_buf, v_buf, slot, bk, num_kv_heads,
+                            head_dim, v_dim, shared_kv)
+            kt = k.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, D]
+            vt = v.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, Dv]
+
+            # [Hkv, BQ*G, BK]
+            scores = jax.lax.dot_general(
+                qh, kt, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            kv_pos = i * bk + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 2)
+            in_seq = (row_tok >= q_start) & (row_tok < q_end)
+            q_pos = kv_len - q_len + (row_tok - q_start)    # [Hkv, R, 1]
+            visible = in_seq & (kv_pos <= q_pos) & (kv_pos < kv_len)
+            scores = jnp.where(visible, scores, NEG_INF)
+
+            m_blk = jnp.max(scores, axis=2, keepdims=True)
+            m_new = jnp.maximum(m, m_blk)
+            # rows with nothing visible yet keep m == -inf; exp against a
+            # zero stand-in keeps alpha/p at exactly 0 (no nan from
+            # -inf - -inf).
+            safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
+            alpha = jnp.exp(m - safe_m)
+            p = jnp.exp(scores - safe_m)
+            l_new = l * alpha + jnp.sum(p, axis=2, keepdims=True)
+            pv = jax.lax.dot_general(
+                p, vt, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc * alpha + pv
+
+        return jax.lax.fori_loop(0, n_blocks, blk_body, (m, l, acc))
+
+    m0 = jnp.full((num_kv_heads, rows, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((num_kv_heads, rows, 1), jnp.float32)
+    acc0 = jnp.zeros((num_kv_heads, rows, v_dim), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(s0, s1 + 1, seq_body, (m0, l0, acc0))
+
+    out = acc / jnp.maximum(l, 1e-30)                   # empty rows → 0
+    # [Hkv, BQ*G, Dv] → [BQ, Hkv, G, Dv] → [BQ, Hq, Dv]
+    out = out.reshape(num_kv_heads, q_blk, group, v_dim) \
+             .transpose(1, 0, 2, 3) \
+             .reshape(q_blk, num_kv_heads * group, v_dim)
+    o_ref[...] = out.astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "q_block", "kv_block", "interpret", "v_dim"))
+def ragged_paged_attention(
+    q: jnp.ndarray,            # [T, Hq, D] packed ragged tokens
+    k_cache: jnp.ndarray,      # [num_pages, page_size, Hkv, D]
+    v_cache,                   # [P, page, Hkv, Dv] or None → v = k[:, :Dv]
+    cu_q_lens: jnp.ndarray,    # [S+1] int32 (padded seqs repeat last value)
+    kv_lens: jnp.ndarray,      # [S] int32 (0 for padded rows)
+    page_table: jnp.ndarray,   # [S, max_pages] int32 (padding → dummy page)
+    *,
+    scale: float,
+    q_block: int = DEFAULT_Q_BLOCK,
+    kv_block: int = DEFAULT_KV_BLOCK,
+    interpret: bool = False,
+    v_dim=None,
+) -> jnp.ndarray:
+    T, num_q_heads, head_dim = q.shape
+    _, page_size, num_kv_heads, _ = k_cache.shape
+    shared_kv = v_cache is None
+    if shared_kv:
+        if v_dim is None:
+            raise ValueError("v_dim required when v_cache is None")
+    else:
+        v_dim = v_cache.shape[-1]
+    S, max_pages = page_table.shape
+    group = num_q_heads // num_kv_heads
+
+    # Honor the requested q block (tests use small ones to force blocks
+    # that span sequences), but scale it down when the f32 score tile
+    # would crowd VMEM next to the double-buffered KV blocks.
+    bq = min(q_block, T)
+    while (num_q_heads * bq * kv_block * 4 > 6 * 1024 * 1024
+           and bq > 16):
+        bq //= 2
+    t_pad = -(-T // bq) * bq
+    if t_pad != T:
+        q = jnp.pad(q, ((0, t_pad - T), (0, 0), (0, 0)))
+    nb = t_pad // bq
+
+    pages_per_block = max(1, min(kv_block // page_size, max_pages))
+    rem = max_pages % pages_per_block
+    if rem:
+        page_table = jnp.pad(page_table,
+                             ((0, 0), (0, pages_per_block - rem)))
+
+    # Per-block overlapping sequence range: seq s covers tokens
+    # [cu[s], cu[s+1]); searchsorted over the upper bounds finds the first
+    # seq whose range extends past a given token.
+    t_starts = jnp.arange(nb, dtype=jnp.int32) * bq
+    upper = cu_q_lens[1:]
+    first = jnp.clip(jnp.searchsorted(upper, t_starts, side="right"),
+                     0, S - 1).astype(jnp.int32)
+    last = jnp.clip(jnp.searchsorted(upper, t_starts + bq - 1,
+                                     side="right"),
+                    0, S - 1).astype(jnp.int32)
+
+    kernel = functools.partial(
+        _kernel, page_size=page_size, pages_per_block=pages_per_block,
+        scale=scale, num_kv_heads=num_kv_heads, group=group,
+        head_dim=head_dim, v_dim=v_dim, q_blk=bq, shared_kv=shared_kv)
+
+    kv_specs, scratch_shapes, kv_inputs = kv_stream_specs(
+        k_cache, v_cache, pages_per_block, page_size, num_kv_heads,
+        head_dim, v_dim)
+    in_specs = [
+        pl.BlockSpec((bq, num_q_heads, head_dim),
+                     lambda b, *_: (b, 0, 0),
+                     memory_space=pltpu.VMEM),
+    ] + kv_specs
+    inputs = [cu_q_lens, kv_lens, page_table, first, last, q] + kv_inputs
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bq, num_q_heads, v_dim),
+                               lambda b, *_: (b, 0, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=scratch_shapes,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((t_pad, num_q_heads, v_dim),
+                                       q.dtype),
+        # q blocks are independent → Megacore may split the grid.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)) if interpret else
+        pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(*inputs)
+    return out[:T]

@@ -96,3 +96,23 @@ def test_deepseek_v2_yarn_rope(tmp_path):
     prompts = [[9, 8, 7, 6, 5, 4, 3, 2]]
     got = ours(str(tmp_path), prompts, 6)
     assert got[0] == hf_greedy(hf, prompts[0], 6)
+
+
+def test_mla_pallas_matches_xla(tmp_path):
+    """MLA routed through the Pallas kernels (shared latent KV, v_dim <
+    head_dim) must reproduce the xla-impl greedy output end-to-end."""
+    make_ckpt("DeepseekV2ForCausalLM", tmp_path, q_lora_rank=None,
+              topk_method="greedy", n_group=None, topk_group=None,
+              scoring_func="softmax", norm_topk_prob=False)
+    prompts = [[7, 3, 56, 21, 8, 4, 90], [99, 14, 2]]
+
+    def run(impl):
+        cfg = EngineConfig(model=str(tmp_path), dtype="float32",
+                           max_model_len=128, attention_impl=impl,
+                           cache=CacheConfig(page_size=4, num_pages=128))
+        return [o.output_token_ids for o in LLM(config=cfg).generate(
+            prompt_token_ids=prompts,
+            sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
+                                           ignore_eos=True))]
+
+    assert run("pallas") == run("xla")
