@@ -13,7 +13,7 @@ defines the structure the jit'd step function consumes.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax.numpy as jnp
 
@@ -29,3 +29,8 @@ class StepBatch(NamedTuple):
                                  # the token buffer (padded rows repeat 0)
     attn: AttentionMetadata
     sampling: SamplingMetadata
+    # Multimodal extras (VL models only; None keeps text-only programs
+    # unchanged — reference model_runner.py:663-1406 MM pipeline):
+    mrope_positions: Optional[jnp.ndarray] = None  # [3, T] int32
+    mm_embeds: Optional[jnp.ndarray] = None        # [T, H] visual rows
+    mm_mask: Optional[jnp.ndarray] = None          # [T] bool (row is visual)

@@ -169,6 +169,7 @@ class LLM:
                                         Seq[SamplingParams]]] = None,
         prompt_token_ids: Optional[Seq[List[int]]] = None,
         stream_cb: Optional[Callable[[SeqOutput], None]] = None,
+        mm_inputs: Optional[Seq[Optional[dict]]] = None,
     ) -> List[RequestOutput]:
         if prompts is not None and prompt_token_ids is not None:
             raise ValueError(
@@ -191,6 +192,18 @@ class LLM:
 
         seqs = [self._allocate_seq(ids, sp)
                 for ids, sp in zip(prompt_token_ids, sampling_params)]
+        if mm_inputs is not None:
+            # HF-processor outputs per request (pixel_values,
+            # image_grid_thw, ...) → per-seq MMState (hashing, mrope
+            # positions, visual-row index; gllm_tpu/engine/mm.py).
+            if len(mm_inputs) != n:
+                raise ValueError(f"{len(mm_inputs)} mm_inputs for {n} "
+                                 "prompts")
+            from gllm_tpu.engine.mm import build_mm_state
+            for seq, mi in zip(seqs, mm_inputs):
+                if mi:
+                    seq.mm = build_mm_state(seq.token_ids, self.model_cfg,
+                                            **mi)
         for s in seqs:
             self.scheduler.add_seq(s)
 
@@ -206,14 +219,36 @@ class LLM:
     def chat(self, messages: List[dict],
              sampling_params: Optional[SamplingParams] = None,
              **kwargs) -> RequestOutput:
-        """Apply the tokenizer chat template and generate
-        (reference llm_engine.py:647)."""
+        """Apply the tokenizer/processor chat template and generate
+        (reference llm_engine.py:647; multimodal content routes through
+        the HF processor like the reference's MM pipeline)."""
+        if self.model_cfg.use_mm:
+            ids, mm_input = self.process_mm_messages(messages, **kwargs)
+            return self.generate(prompt_token_ids=[ids],
+                                 sampling_params=sampling_params,
+                                 mm_inputs=[mm_input])[0]
         if self.tokenizer is None:
             raise ValueError("chat() requires a tokenizer")
         ids = self.tokenizer.apply_chat_template(
             messages, add_generation_prompt=True, **kwargs)
         return self.generate(prompt_token_ids=[ids],
                              sampling_params=sampling_params)[0]
+
+    @property
+    def processor(self):
+        """Lazy HF processor for multimodal chat templates + pixels."""
+        if getattr(self, "_processor", None) is None:
+            from transformers import AutoProcessor
+            self._processor = AutoProcessor.from_pretrained(
+                self.config.model, local_files_only=True)
+        return self._processor
+
+    def process_mm_messages(self, messages: List[dict], **kwargs):
+        """messages (OpenAI-style, with image content parts) → (token_ids,
+        mm_input dict for build_mm_state). AutoProcessor when loadable,
+        else the skeleton-tokenization fallback (engine/mm_processing.py)."""
+        from gllm_tpu.engine.mm_processing import encode_mm_messages
+        return encode_mm_messages(self, messages, **kwargs)
 
     # ---- output -----------------------------------------------------------
 

@@ -1,0 +1,147 @@
+"""Multimodal request state: hashing, mrope positions, visual-row indexing.
+
+Host-side half of the reference's MM pipeline
+(/root/reference/gllm/model_runner.py:100-158,663-1406): per-item sha256
+content hashes, synthetic pad ids spliced into the prefix-cache token
+stream (so two prompts sharing a text+image prefix hit the same pages, and
+two different images never do), full-prompt 3-D mrope positions with the
+decode-extrapolation delta, and the token→visual-row index used to splice
+ViT output rows into the step batch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gllm_tpu.models.config import ModelConfig
+from gllm_tpu.ops.rope import get_mrope_input_positions
+
+# Synthetic prefix-cache ids for visual spans: flag bit 1<<30 sits above
+# every real vocab (reference model_runner.py:100-112); low 30 bits carry
+# the item content hash.
+_MM_PAD_ID_BASE = 1 << 30
+_MM_PAD_ID_MASK = _MM_PAD_ID_BASE - 1
+
+
+def mm_pad_id(content_hash: bytes) -> int:
+    return _MM_PAD_ID_BASE | (int.from_bytes(content_hash[:4], "big")
+                              & _MM_PAD_ID_MASK)
+
+
+def content_hash(pixels: np.ndarray, grid_thw) -> bytes:
+    """Per-item digest over pixel bytes + dtype/shape/grid (reference
+    _hash_tensor_bytes / _build_item_content_hash)."""
+    h = hashlib.sha256()
+    arr = np.ascontiguousarray(pixels)
+    h.update(str(arr.dtype).encode())
+    h.update(repr(tuple(arr.shape)).encode())
+    h.update(arr.tobytes())
+    h.update(repr(tuple(int(v) for v in grid_thw)).encode())
+    return h.digest()
+
+
+@dataclasses.dataclass
+class MMItem:
+    modality: str                 # "image" | "video"
+    pixels: np.ndarray            # [n_patches, C*tps*ps*ps]
+    grid_thw: Tuple[int, int, int]
+    hash: bytes
+
+
+@dataclasses.dataclass
+class MMState:
+    """Per-sequence multimodal state, attached as ``Sequence.mm``."""
+    items: List[MMItem]
+    mrope_positions: np.ndarray          # [3, prompt_len] int32
+    mrope_delta: int
+    vis_index: np.ndarray                # [prompt_len] int32; -1 = text row
+    num_vis_tokens: int
+    hash_token_ids: List[int]            # prompt ids with pad-id splices
+    # filled by the runner at first prefill (ViT output, prompt order):
+    vis_embeds: Optional[np.ndarray] = None   # [num_vis_tokens, H]
+
+
+def build_mm_state(token_ids: Sequence[int], cfg: ModelConfig,
+                   pixel_values=None, image_grid_thw=None,
+                   video_pixel_values=None, video_grid_thw=None,
+                   second_per_grid_ts=None) -> MMState:
+    """Build MMState from HF-processor outputs.
+
+    ``pixel_values`` is the processor's concatenation over image items;
+    per-item slices are recovered from grid_thw (t*h*w rows each).
+    """
+    items: List[MMItem] = []
+
+    def split_items(pixels, grids, modality):
+        if pixels is None or grids is None:
+            return
+        pixels = np.asarray(pixels)
+        off = 0
+        for g in np.asarray(grids):
+            n = int(g[0] * g[1] * g[2])
+            chunk = pixels[off:off + n]
+            off += n
+            items.append(MMItem(modality, chunk,
+                                (int(g[0]), int(g[1]), int(g[2])),
+                                content_hash(chunk, g)))
+
+    split_items(pixel_values, image_grid_thw, "image")
+    split_items(video_pixel_values, video_grid_thw, "video")
+
+    positions, delta = get_mrope_input_positions(
+        token_ids,
+        [it.grid_thw for it in items if it.modality == "image"],
+        [it.grid_thw for it in items if it.modality == "video"],
+        image_token_id=cfg.image_token_id,
+        video_token_id=cfg.video_token_id,
+        spatial_merge_size=(cfg.vision_config or {}).get(
+            "spatial_merge_size", 2),
+        tokens_per_second=(cfg.vision_config or {}).get(
+            "tokens_per_second", 1.0),
+        second_per_grid_ts=second_per_grid_ts,
+    )
+
+    ids = np.asarray(token_ids, np.int64)
+    is_img = ids == cfg.image_token_id
+    is_vid = ids == cfg.video_token_id
+    is_vis = is_img | is_vid
+    num_vis = int(is_vis.sum())
+    # vis_embeds rows are concatenated in ITEMS order (images then videos,
+    # matching embed order); the per-token index routes image placeholder
+    # tokens into the image block and video tokens past it — prompt order
+    # of modalities may interleave arbitrarily.
+    n_img_tokens = int(is_img.sum())
+    vis_index = np.full(len(ids), -1, np.int32)
+    vis_index[is_img] = np.arange(int(is_img.sum()))
+    vis_index[is_vid] = n_img_tokens + np.arange(int(is_vid.sum()))
+
+    # Splice per-item pad ids over each item's placeholder run, pairing
+    # each run (in prompt order) with the next unused item of the run's
+    # modality — runs never merge across items because the chat template
+    # separates them with vision_start/end tokens.
+    hash_ids = list(int(t) for t in token_ids)
+    run_starts = []
+    prev = False
+    for i, v in enumerate(is_vis):
+        if v and not prev:
+            run_starts.append(i)
+        prev = bool(v)
+    assert len(run_starts) == len(items), (len(run_starts), len(items))
+    by_modality = {"image": [it for it in items if it.modality == "image"],
+                   "video": [it for it in items if it.modality == "video"]}
+    for start in run_starts:
+        modality = "image" if is_img[start] else "video"
+        item = by_modality[modality].pop(0)
+        pad = mm_pad_id(item.hash)
+        i = start
+        while i < len(hash_ids) and is_vis[i]:
+            hash_ids[i] = pad
+            i += 1
+
+    return MMState(items=items, mrope_positions=positions,
+                   mrope_delta=delta, vis_index=vis_index,
+                   num_vis_tokens=num_vis, hash_token_ids=hash_ids)

@@ -1,0 +1,124 @@
+"""Chat-message → (token_ids, pixels) encoding for VL models.
+
+Primary path: the checkpoint's HF AutoProcessor. Fallback (used when the
+processor can't load — e.g. its video processor needs torchvision, absent
+on TPU serving hosts): the reference's skeleton-tokenization design
+(/root/reference/gllm/mm_common.py + model_runner.py encode_skeleton) —
+apply the *tokenizer* chat template with one ``<|image_pad|>`` sentinel per
+item, run the standalone image processor for pixels + grids, then expand
+the i-th sentinel to that item's visual token count.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def extract_mm_items(messages: List[dict]) -> List[Tuple[str, object]]:
+    """Ordered [(modality, content), ...] from normalized messages
+    (reference extract_mm_items_ordered)."""
+    items = []
+    for message in messages:
+        contents = message.get("content")
+        if not isinstance(contents, list):
+            continue
+        for content in contents:
+            if content.get("type") == "image":
+                items.append(("image", content["image"]))
+            elif content.get("type") == "video":
+                items.append(("video", content["video"]))
+    return items
+
+
+def load_image_processor(model_dir: str, vision_config: Dict):
+    """The checkpoint's image processor, or a config-derived default."""
+    from transformers.models.qwen2_vl.image_processing_qwen2_vl import (
+        Qwen2VLImageProcessor)
+    try:
+        return Qwen2VLImageProcessor.from_pretrained(
+            model_dir, local_files_only=True)
+    except Exception:
+        return Qwen2VLImageProcessor(
+            patch_size=vision_config.get("patch_size", 14),
+            temporal_patch_size=vision_config.get("temporal_patch_size", 2),
+            merge_size=vision_config.get("spatial_merge_size", 2))
+
+
+def encode_mm_fallback(tokenizer, image_processor, messages: List[dict],
+                       cfg, **template_kwargs):
+    """(token_ids, mm_input) without a working AutoProcessor.
+
+    The tokenizer chat template must emit exactly one image/video
+    placeholder token per item (the standard Qwen-VL templates do).
+    """
+    items = extract_mm_items(messages)
+    ids = tokenizer.apply_chat_template(messages,
+                                        add_generation_prompt=True,
+                                        **template_kwargs)
+    if not items:
+        return list(ids), None
+
+    images = [c for m, c in items if m == "image"]
+    if any(m == "video" for m, _ in items):
+        raise NotImplementedError(
+            "video input requires the checkpoint's AutoProcessor")
+    out = image_processor(images=images, return_tensors="np")
+    pixel_values = out["pixel_values"]
+    grid_thw = np.asarray(out["image_grid_thw"])
+    merge = image_processor.merge_size ** 2
+    counts = [int(t * h * w) // merge for t, h, w in grid_thw]
+
+    expanded: List[int] = []
+    item_i = 0
+    for tok in ids:
+        if tok == cfg.image_token_id:
+            if item_i >= len(counts):
+                raise ValueError("more image placeholders than images")
+            expanded.extend([tok] * counts[item_i])
+            item_i += 1
+        else:
+            expanded.append(int(tok))
+    if item_i != len(counts):
+        raise ValueError(f"{len(counts)} images but {item_i} placeholders "
+                         "in the chat template output")
+    return expanded, {"pixel_values": pixel_values,
+                      "image_grid_thw": grid_thw}
+
+
+def encode_mm_messages(llm, messages: List[dict], **kwargs):
+    """Dispatch: AutoProcessor when available, fallback otherwise."""
+    processor = None
+    try:
+        processor = llm.processor
+    except Exception as e:
+        logger.info("AutoProcessor unavailable (%s); using fallback "
+                    "skeleton tokenization", e)
+    if processor is not None:
+        out = processor.apply_chat_template(
+            messages, add_generation_prompt=True, tokenize=True,
+            return_dict=True, return_tensors="np", **kwargs)
+        ids = [int(t) for t in out["input_ids"][0]]
+        mm_input = {}
+        if out.get("pixel_values") is not None:
+            mm_input["pixel_values"] = out["pixel_values"]
+            mm_input["image_grid_thw"] = out.get("image_grid_thw")
+        if out.get("pixel_values_videos") is not None:
+            mm_input["video_pixel_values"] = out["pixel_values_videos"]
+            mm_input["video_grid_thw"] = out.get("video_grid_thw")
+            if out.get("second_per_grid_ts") is not None:
+                mm_input["second_per_grid_ts"] = [
+                    float(v) for v in out["second_per_grid_ts"]]
+        return ids, (mm_input or None)
+
+    if llm.tokenizer is None:
+        raise ValueError("multimodal chat requires a tokenizer")
+    if getattr(llm, "_mm_image_processor", None) is None:
+        llm._mm_image_processor = load_image_processor(
+            llm.config.model, llm.model_cfg.vision_config or {})
+    return encode_mm_fallback(llm.tokenizer, llm._mm_image_processor,
+                              messages, llm.model_cfg, **kwargs)

@@ -67,10 +67,20 @@ class ServingEngine:
     # ---- client-facing (any thread) ---------------------------------------
 
     def submit(self, token_ids: List[int],
-               sampling_params: SamplingParams) -> RequestHandle:
+               sampling_params: SamplingParams,
+               mm_input: Optional[dict] = None) -> RequestHandle:
         sampling_params.validate()
+        mm_state = None
+        if mm_input:
+            # Hashing + position building over full pixel arrays is
+            # hundreds of ms for big images — do it before taking the
+            # engine-wide lock.
+            from gllm_tpu.engine.mm import build_mm_state
+            mm_state = build_mm_state(token_ids, self.llm.model_cfg,
+                                      **mm_input)
         with self._lock:
             seq = self.llm._allocate_seq(token_ids, sampling_params)
+            seq.mm = mm_state
             handle = RequestHandle(seq.seq_id, len(token_ids))
             self._handles[seq.seq_id] = handle
             self._seqs[seq.seq_id] = seq

@@ -48,15 +48,24 @@ class ServerState:
     # ---- request handling -------------------------------------------------
 
     def encode_chat(self, req: proto.ChatCompletionRequest):
-        tok = self.llm.tokenizer
-        if tok is None:
-            raise proto.ProtocolError("server has no tokenizer loaded")
+        """Returns (token_ids, mm_input_or_None)."""
         kwargs = dict(req.chat_template_kwargs)
         if req.tools:
             kwargs["tools"] = req.tools
+        if self.llm.model_cfg.use_mm:
+            messages = _normalize_mm_messages(req.messages)
+            try:
+                return self.llm.process_mm_messages(messages, **kwargs)
+            except proto.ProtocolError:
+                raise
+            except Exception as e:
+                raise proto.ProtocolError(f"multimodal encode failed: {e}")
+        tok = self.llm.tokenizer
+        if tok is None:
+            raise proto.ProtocolError("server has no tokenizer loaded")
         return tok.apply_chat_template(req.messages,
                                        add_generation_prompt=True,
-                                       **kwargs)
+                                       **kwargs), None
 
     def encode_completion(self, req: proto.CompletionRequest):
         if isinstance(req.prompt, list):
@@ -65,6 +74,43 @@ class ServerState:
             raise proto.ProtocolError(
                 "server has no tokenizer; send token-array prompts")
         return self.llm.tokenizer.encode(req.prompt)
+
+
+def _normalize_mm_messages(messages):
+    """OpenAI image content → HF-processor image entries.
+
+    ``image_url`` parts (data: URLs decoded to PIL — the serving host is
+    zero-egress, remote URLs are left for the processor to resolve) become
+    ``{"type": "image", "image": ...}`` like the reference's
+    extract_modify_mm (model_runner.py:663-690)."""
+    import base64
+    import copy
+    import io
+
+    out = copy.deepcopy(messages)
+    for message in out:
+        contents = message.get("content")
+        if not isinstance(contents, list):
+            continue
+        for content in contents:
+            if content.get("type") not in ("image_url", "video_url"):
+                continue
+            kind = content["type"][:-4]                  # image | video
+            data = content.pop(content["type"])
+            if isinstance(data, dict):
+                data = data.get("url")
+            content["type"] = kind
+            if isinstance(data, str) and data.startswith("data:"):
+                header, _, b64 = data.partition(",")
+                raw = base64.b64decode(b64)
+                if kind == "image":
+                    from PIL import Image
+                    data = Image.open(io.BytesIO(raw))
+                    data.load()
+                else:
+                    data = raw
+            content[kind] = data
+    return out
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -168,8 +214,9 @@ class Handler(BaseHTTPRequestHandler):
         st = self.state
         req = proto.ChatCompletionRequest.from_dict(
             self._read_json(), default_max_tokens=256)
-        ids = st.encode_chat(req)
-        handle = st.engine.submit(list(ids), req.sampling)
+        ids, mm_input = st.encode_chat(req)
+        handle = st.engine.submit(list(ids), req.sampling,
+                                  mm_input=mm_input)
         parse_tools = bool(req.tools) and req.tool_choice != "none"
         if req.stream and parse_tools:
             # Tool markup can't be parsed incrementally with certainty —

@@ -138,7 +138,9 @@ class PrefixMemoryManager(MemoryManager):
 
     def _page_tokens(self, seq: Sequence, page_idx: int) -> List[int]:
         s = page_idx * self.page_size
-        return seq.token_ids[s:s + self.page_size]
+        # cache_token_ids splices multimodal content-hash pad ids over
+        # visual spans (Sequence.cache_token_ids).
+        return seq.cache_token_ids[s:s + self.page_size]
 
     def match_prefix(self, seq: Sequence, extra_key: bytes = b"") -> int:
         """Claim cached pages covering the longest matching prompt prefix.

@@ -77,6 +77,19 @@ class ModelConfig:
     def use_mla(self) -> bool:
         return self.kv_lora_rank > 0
 
+    # Multimodal (Qwen-VL family — reference models/qwen2_5_vl.py,
+    # rotary_embedding.py:607-706). mrope_section sums to rot_dim/2;
+    # vision_config is the raw HF vision sub-config dict, parsed by
+    # gllm_tpu/models/vision.py.
+    mrope_section: Tuple[int, ...] = ()
+    image_token_id: int = -1
+    video_token_id: int = -1
+    vision_config: Optional[Dict[str, Any]] = None
+
+    @property
+    def use_mm(self) -> bool:
+        return self.vision_config is not None
+
     # Pipeline-parallel stage slice (rank-aware model construction like the
     # reference's per-stage layer builds, qwen2.py:186-270). Full model by
     # default.
@@ -118,7 +131,33 @@ def _eos_tuple(v) -> Optional[Tuple[int, ...]]:
 
 def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
     """Parse an HF config.json dict into a ModelConfig."""
-    arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+    arch = (hf.get("architectures")
+            or (hf.get("text_config") or {}).get("architectures")
+            or ["LlamaForCausalLM"])[0]
+    extra: Dict[str, Any] = {}
+    if arch in ("Qwen2_5_VLForConditionalGeneration",
+                "Qwen2VLForConditionalGeneration"):
+        # VL configs nest the LM under text_config (newer transformers) or
+        # keep it flat (older checkpoints); vision is always a sub-dict.
+        vision = hf.get("vision_config") or {}
+        text = dict(hf.get("text_config") or hf)
+        rope_scaling = text.get("rope_scaling") or {}
+        extra = dict(
+            mrope_section=tuple(rope_scaling.get("mrope_section", ())),
+            image_token_id=hf.get("image_token_id",
+                                  text.get("image_token_id", -1)),
+            video_token_id=hf.get("video_token_id",
+                                  text.get("video_token_id", -1)),
+            vision_config=vision,
+        )
+        # mrope tables are plain rope tables; drop the marker type so the
+        # table builder doesn't choke, keep the section split in extra.
+        if rope_scaling.get("type") == "mrope" \
+                or rope_scaling.get("rope_type") == "mrope":
+            text["rope_scaling"] = None
+        hf = {**text, "architectures": [arch],
+              "eos_token_id": hf.get("eos_token_id",
+                                     text.get("eos_token_id"))}
     num_heads = hf["num_attention_heads"]
     hidden = hf["hidden_size"]
     head_dim = hf.get("head_dim") or hidden // num_heads
@@ -126,7 +165,9 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
     is_glm4 = arch in ("Glm4ForCausalLM",)
     attention_bias = hf.get("attention_bias",
                             arch in ("Qwen2ForCausalLM",
-                                     "Qwen2MoeForCausalLM"))
+                                     "Qwen2MoeForCausalLM",
+                                     "Qwen2_5_VLForConditionalGeneration",
+                                     "Qwen2VLForConditionalGeneration"))
     return ModelConfig(
         architecture=arch,
         vocab_size=hf["vocab_size"],
@@ -171,4 +212,5 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
         topk_group=hf.get("topk_group", 0) or 0,
         scoring_func=hf.get("scoring_func", "softmax") or "softmax",
         topk_method=hf.get("topk_method", "greedy") or "greedy",
+        **extra,
     )

@@ -31,7 +31,7 @@ from gllm_tpu.models.config import ModelConfig
 from gllm_tpu.ops import (apply_rope, compute_rope_cos_sin,
                           fused_add_rms_norm, paged_attention, rms_norm,
                           silu_and_mul, write_kv)
-from gllm_tpu.ops.rope import apply_rope_interleaved
+from gllm_tpu.ops.rope import apply_mrope, apply_rope_interleaved
 from gllm_tpu.ops.quant import qmm
 from gllm_tpu.parallel.mesh import shard_hint
 
@@ -125,9 +125,13 @@ def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
         # per-head RMSNorm over D (reference qwen3.py adds q/k norms)
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
-    rope_fn = (apply_rope_interleaved if cfg.rope_interleaved
-               else apply_rope)
-    q, k = rope_fn(q, k, batch.positions, cos_sin)
+    if cfg.mrope_section and batch.mrope_positions is not None:
+        q, k = apply_mrope(q, k, batch.mrope_positions, cos_sin,
+                           cfg.mrope_section)
+    else:
+        rope_fn = (apply_rope_interleaved if cfg.rope_interleaved
+                   else apply_rope)
+        q, k = rope_fn(q, k, batch.positions, cos_sin)
     k_cache, v_cache = write_kv(k_cache, v_cache, k, v, batch.slot_mapping)
     attn = paged_attention(q, k_cache, v_cache, batch.attn,
                            scale=D ** -0.5, max_q_len=max_q_len,
@@ -167,6 +171,13 @@ def forward(
         mlp_fn = _mlp
     if cfg.is_first_stage:
         hidden = params["embed"][batch.token_ids]
+        if batch.mm_embeds is not None:
+            # Visual rows come pre-embedded by the vision tower; splice
+            # them over the placeholder-token embeddings (reference
+            # embed_input_ids merge, qwen2_5_vl.py:972-996).
+            hidden = jnp.where(batch.mm_mask[:, None],
+                               batch.mm_embeds.astype(hidden.dtype),
+                               hidden)
         residual = jnp.zeros_like(hidden)
     else:
         hidden, residual = hidden_in, residual_in

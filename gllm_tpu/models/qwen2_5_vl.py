@@ -1,0 +1,112 @@
+"""Qwen2.5-VL: vision tower + dense GQA LM with mrope.
+
+Reference: /root/reference/gllm/models/qwen2_5_vl.py (1045 LoC). The LM half
+IS the Qwen2 dense decoder (reference derives it the same way) — we reuse
+gllm_tpu/models/dense.py wholesale; mrope and visual-row splicing ride in
+via StepBatch.mrope_positions / mm_embeds (see dense.forward). This module
+adds the vision tower (gllm_tpu/models/vision.py), the combined param
+pytree, and the checkpoint rules for both halves.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gllm_tpu.models import dense, vision
+from gllm_tpu.models.config import ModelConfig
+
+init_kv_cache = dense.init_kv_cache
+compute_logits = dense.compute_logits
+forward = dense.forward
+
+
+def vision_cfg(cfg: ModelConfig) -> vision.VisionConfig:
+    assert cfg.vision_config is not None
+    return vision.from_hf_vision_config(cfg.vision_config)
+
+
+def make_rope_table(cfg: ModelConfig) -> jnp.ndarray:
+    # mrope indices can exceed the token count (video temporal axis); the
+    # reference sizes its cache at 4x max_position (rotary_embedding.py:640).
+    rot_dim = int(cfg.head_dim * cfg.partial_rotary_factor)
+    from gllm_tpu.ops import compute_rope_cos_sin
+    return compute_rope_cos_sin(rot_dim, cfg.max_position * 4,
+                                cfg.rope_theta, cfg.rope_scaling)
+
+
+def init_params(cfg: ModelConfig, seed: int = 0,
+                dtype=jnp.bfloat16) -> dict:
+    params = dense.init_params(cfg, seed=seed, dtype=dtype)
+    params["visual"] = vision.init_vision_params(vision_cfg(cfg),
+                                                 seed=seed, dtype=dtype)
+    return params
+
+
+def _vl_rules(cfg: ModelConfig):
+    from gllm_tpu.models.loader import dense_rules
+    base = dense_rules(cfg)
+    vcfg = vision_cfg(cfg)
+
+    vis_leaves = {
+        "norm1.weight": ("norm1", None),
+        "norm2.weight": ("norm2", None),
+        "attn.qkv.weight": ("qkv_w", "t"),
+        "attn.qkv.bias": ("qkv_b", None),
+        "attn.proj.weight": ("proj_w", "t"),
+        "attn.proj.bias": ("proj_b", None),
+        "mlp.gate_proj.weight": ("gate_w", "t"),
+        "mlp.gate_proj.bias": ("gate_b", None),
+        "mlp.up_proj.weight": ("up_w", "t"),
+        "mlp.up_proj.bias": ("up_b", None),
+        "mlp.down_proj.weight": ("down_w", "t"),
+        "mlp.down_proj.bias": ("down_b", None),
+    }
+    merger_leaves = {
+        "ln_q.weight": ("ln_q", None),
+        "mlp.0.weight": ("fc1_w", "t"),
+        "mlp.0.bias": ("fc1_b", None),
+        "mlp.2.weight": ("fc2_w", "t"),
+        "mlp.2.bias": ("fc2_b", None),
+    }
+
+    def patch_embed_tf(t: np.ndarray) -> dict:
+        # HF Conv3d weight [H, C, tps, ps, ps] → [C*tps*ps*ps, H] matmul
+        return {"patch_embed": t.reshape(vcfg.hidden_size, -1).T}
+
+    def rule(name: str):
+        # transformers >= 4.52 nests the LM under model.language_model.*
+        if name.startswith("model.language_model."):
+            name = "model." + name[len("model.language_model."):]
+        elif name.startswith("model.visual."):
+            name = name[len("model."):]
+        if name.startswith("visual."):
+            rest = name[len("visual."):]
+            if rest == "patch_embed.proj.weight":
+                return (("visual", "__multi__"), None, patch_embed_tf)
+            if rest.startswith("blocks."):
+                idx_s, _, leaf = rest[len("blocks."):].partition(".")
+                if leaf in vis_leaves:
+                    target, tf = vis_leaves[leaf]
+                    return (("visual", "blocks", target), int(idx_s), tf)
+                return None
+            if rest.startswith("merger."):
+                leaf = rest[len("merger."):]
+                if leaf in merger_leaves:
+                    target, tf = merger_leaves[leaf]
+                    return (("visual", "merger", target), None, tf)
+                return None
+            return None
+        return base(name)
+
+    return rule
+
+
+def load_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16,
+                progress_cb=None) -> dict:
+    from gllm_tpu.models.loader import _load_params
+    template = jax.eval_shape(lambda: init_params(cfg, dtype=dtype))
+    return _load_params(model_dir, template, _vl_rules(cfg), progress_cb)

@@ -52,6 +52,22 @@ _DENSE_ARCHS = (
 )
 
 
+def _vl_def() -> ModelDef:
+    from gllm_tpu.models import qwen2_5_vl
+    from gllm_tpu.parallel.shardings import kv_cache_specs, vl_param_specs
+    return ModelDef(
+        family="vl",
+        init_params=qwen2_5_vl.init_params,
+        forward=qwen2_5_vl.forward,
+        compute_logits=qwen2_5_vl.compute_logits,
+        make_rope_table=qwen2_5_vl.make_rope_table,
+        load_params=qwen2_5_vl.load_params,
+        init_kv_cache=qwen2_5_vl.init_kv_cache,
+        param_specs=vl_param_specs,
+        kv_specs=kv_cache_specs,
+    )
+
+
 def get_model_def(cfg: ModelConfig) -> ModelDef:
     if cfg.architecture in _DENSE_ARCHS:
         return _dense_def()
@@ -61,9 +77,12 @@ def get_model_def(cfg: ModelConfig) -> ModelDef:
     if cfg.architecture in _MLA_ARCHS:
         from gllm_tpu.models.registry_moe import deepseek_def
         return deepseek_def()
+    if cfg.architecture in _VL_ARCHS:
+        return _vl_def()
     raise NotImplementedError(
         f"architecture {cfg.architecture!r} not supported yet; "
-        f"dense: {_DENSE_ARCHS}, moe: {_MOE_ARCHS}, mla: {_MLA_ARCHS}")
+        f"dense: {_DENSE_ARCHS}, moe: {_MOE_ARCHS}, mla: {_MLA_ARCHS}, "
+        f"vl: {_VL_ARCHS}")
 
 
 _MOE_ARCHS = (
@@ -77,9 +96,14 @@ _MLA_ARCHS = (
     "DeepseekV3ForCausalLM",
 )
 
+_VL_ARCHS = (
+    "Qwen2_5_VLForConditionalGeneration",
+)
+
 
 def supported_architectures() -> Dict[str, str]:
     out = {a: "dense" for a in _DENSE_ARCHS}
     out.update({a: "moe" for a in _MOE_ARCHS})
     out.update({a: "mla-moe" for a in _MLA_ARCHS})
+    out.update({a: "vl" for a in _VL_ARCHS})
     return out

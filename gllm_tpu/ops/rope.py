@@ -153,6 +153,134 @@ def apply_rope(q: jnp.ndarray, k: jnp.ndarray, positions: jnp.ndarray,
     return rotate(q), rotate(k)
 
 
+def apply_mrope(q: jnp.ndarray, k: jnp.ndarray, positions3: jnp.ndarray,
+                cos_sin: jnp.ndarray, mrope_section: Tuple[int, ...]):
+    """Multimodal rotary (Qwen-VL family).
+
+    The half-rotary-dim axis is split into [T|H|W] sections; section ``i``
+    rotates with the position of axis ``i`` (reference
+    rotary_embedding.py:607-706 MRotaryEmbedding, non-interleaved layout).
+
+    positions3: [3, T] int32 (temporal/height/width); text tokens carry the
+    same value on all three axes, so this degenerates to standard rope.
+    """
+    rot_dim = cos_sin.shape[-1]
+    half = rot_dim // 2
+    assert sum(mrope_section) == half, (mrope_section, half)
+    cs = cos_sin[positions3]                         # [3, T, rot_dim]
+    # which axis each half-dim reads from: [sec0 zeros | sec1 ones | ...]
+    axis_of_dim = jnp.concatenate([
+        jnp.full((n,), i, jnp.int32) for i, n in enumerate(mrope_section)])
+    cs_sel = jnp.take_along_axis(
+        cs.transpose(1, 2, 0),                       # [T, rot_dim, 3]
+        jnp.concatenate([axis_of_dim, axis_of_dim])[None, :, None],
+        axis=2)[..., 0]                              # [T, rot_dim]
+    cos = cs_sel[:, :half][:, None, :]
+    sin = cs_sel[:, half:][:, None, :]
+
+    def rotate(x):
+        x_rot, x_pass = x[..., :rot_dim], x[..., rot_dim:]
+        x1, x2 = x_rot[..., :half], x_rot[..., half:]
+        x1f = x1.astype(jnp.float32)
+        x2f = x2.astype(jnp.float32)
+        o1 = x1f * cos - x2f * sin
+        o2 = x2f * cos + x1f * sin
+        out = jnp.concatenate([o1, o2], axis=-1).astype(x.dtype)
+        if x_pass.shape[-1]:
+            out = jnp.concatenate([out, x_pass], axis=-1)
+        return out
+
+    return rotate(q), rotate(k)
+
+
+def get_mrope_input_positions(
+    token_ids,
+    image_grid_thw,
+    video_grid_thw,
+    *,
+    image_token_id: int,
+    video_token_id: int,
+    spatial_merge_size: int,
+    tokens_per_second: float = 1.0,
+    second_per_grid_ts=None,
+):
+    """Host-side 3-D position builder (numpy).
+
+    Port of the reference's semantics
+    (rotary_embedding.py:740-855 _vl_get_input_positions_tensor): text runs
+    advance all three axes together; each vision span gets (t, h, w) grid
+    positions offset past the preceding text; the next text run resumes
+    after the max position so far. Returns ([3, L] int32, mrope_delta) where
+    delta extrapolates decode positions: pos = delta + token_index.
+    """
+    import numpy as np
+
+    token_ids = list(token_ids)
+    image_grid_thw = [tuple(int(v) for v in g)
+                      for g in (image_grid_thw or [])]
+    video_grid_thw = [tuple(int(v) for v in g)
+                      for g in (video_grid_thw or [])]
+    second_per_grid_ts = list(second_per_grid_ts or [])
+
+    chunks = []
+    st = 0
+    img_i = vid_i = 0
+    remain_img, remain_vid = len(image_grid_thw), len(video_grid_thw)
+    max_pos = -1
+
+    def text_chunk(n):
+        nonlocal max_pos
+        start = max_pos + 1
+        pos = np.arange(start, start + n, dtype=np.int64)
+        max_pos = start + n - 1 if n else max_pos
+        return np.stack([pos, pos, pos])
+
+    for _ in range(remain_img + remain_vid):
+        ed_image = (token_ids.index(image_token_id, st)
+                    if remain_img and image_token_id in token_ids[st:]
+                    else len(token_ids) + 1)
+        ed_video = (token_ids.index(video_token_id, st)
+                    if remain_vid and video_token_id in token_ids[st:]
+                    else len(token_ids) + 1)
+        if ed_image < ed_video:
+            t, h, w = image_grid_thw[img_i]
+            img_i += 1
+            remain_img -= 1
+            ed = ed_image
+            sec_per_t = 0.0
+        else:
+            t, h, w = video_grid_thw[vid_i]
+            sec_per_t = (second_per_grid_ts[vid_i]
+                         if vid_i < len(second_per_grid_ts) else 1.0)
+            vid_i += 1
+            remain_vid -= 1
+            ed = ed_video
+        lh, lw = h // spatial_merge_size, w // spatial_merge_size
+        chunks.append(text_chunk(ed - st))
+        base = max_pos + 1
+        t_idx = (np.repeat(np.arange(t), lh * lw)
+                 * sec_per_t * tokens_per_second).astype(np.int64)
+        h_idx = np.tile(np.repeat(np.arange(lh), lw), t)
+        w_idx = np.tile(np.arange(lw), t * lh)
+        grid = np.stack([t_idx, h_idx, w_idx]) + base
+        max_pos = int(grid.max())
+        chunks.append(grid)
+        st = ed + t * lh * lw
+
+    if st < len(token_ids):
+        chunks.append(text_chunk(len(token_ids) - st))
+
+    if not chunks:
+        positions = np.zeros((3, 0), np.int64)
+    else:
+        positions = np.concatenate(chunks, axis=1)
+    assert positions.shape[1] == len(token_ids), \
+        (positions.shape, len(token_ids))
+    delta = int(positions.max() + 1 - len(token_ids)) if len(token_ids) \
+        else 0
+    return positions.astype(np.int32), delta
+
+
 def apply_rope_interleaved(q: jnp.ndarray, k: jnp.ndarray,
                            positions: jnp.ndarray, cos_sin: jnp.ndarray):
     """Pair-interleaved rotary (DeepSeek, GLM): channel pairs (2i, 2i+1)

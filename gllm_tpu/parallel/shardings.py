@@ -193,3 +193,17 @@ def deepseek_param_specs(cfg: ModelConfig, tp: int) -> dict:
         if not cfg.tie_word_embeddings:
             specs["lm_head"] = P(None, _tp_if(vocab_ok))
     return specs
+
+
+def vl_param_specs(cfg: ModelConfig, tp: int) -> dict:
+    """VL = dense text specs + replicated vision tower (the ViT is small
+    relative to the LM; per-item batches don't shard usefully over tp)."""
+    import jax
+
+    from gllm_tpu.models import qwen2_5_vl, vision
+    specs = dense_param_specs(cfg, tp)
+    vtemplate = jax.eval_shape(
+        lambda: vision.init_vision_params(qwen2_5_vl.vision_cfg(cfg)))
+    specs["visual"] = jax.tree.map(lambda s: P(*([None] * len(s.shape))),
+                                   vtemplate)
+    return specs

@@ -93,6 +93,11 @@ class PPModelRunner(ModelRunner):
         if config.parallel.dp > 1:
             raise NotImplementedError("dp with pp pending multi-replica "
                                       "engine")
+        if model_cfg.use_mm:
+            # Reject honestly rather than silently dropping images (the
+            # per-stage builder has no vision tower / mrope plumbing yet).
+            raise NotImplementedError(
+                "multimodal models with pp > 1 are not wired up yet")
         devices = jax.devices()
         if len(devices) < pp * tp:
             raise ValueError(f"pp={pp} tp={tp} needs {pp * tp} devices, "

@@ -29,10 +29,13 @@ from gllm_tpu.utils import bucket_size, cdiv
 
 class BatchBuilder:
     def __init__(self, config: EngineConfig, page_size: int,
-                 vocab_size: int = 0):
+                 vocab_size: int = 0, hidden_size: int = 0,
+                 use_mm: bool = False):
         self.config = config
         self.page_size = page_size
         self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.use_mm = use_mm
         sc = config.scheduler
         # Upper bounds for the shape buckets.
         self.max_tokens = sc.max_prefill_tokens + sc.max_decode_seqs
@@ -80,6 +83,14 @@ class BatchBuilder:
         seeds = np.full(s_pad, -1, np.int32)
         out_steps = np.zeros(s_pad, np.int32)
         any_seeded = False
+        if self.use_mm:
+            # VL batches always carry mrope; the dense [T, H] visual-row
+            # buffer is allocated lazily on first visual row so text-only /
+            # decode steps (the common case) skip the host→device transfer
+            # entirely (one extra jit variant).
+            mrope = np.zeros((3, t_pad), np.int32)
+            mm_mask = np.zeros(t_pad, bool)
+            mm_embeds = None
 
         off = 0
         for i, it in enumerate(batch.items):
@@ -106,6 +117,28 @@ class BatchBuilder:
                 seeds[i] = sp.seed
                 # index of the output token this step will sample
                 out_steps[i] = before + n - seq.prompt_len
+            if self.use_mm:
+                mm = seq.mm
+                if mm is None:
+                    mrope[:, off:off + n] = pos[None, :]
+                elif before + n <= seq.prompt_len:
+                    # prefill chunk: precomputed 3-D prompt positions +
+                    # visual-row splicing
+                    mrope[:, off:off + n] = \
+                        mm.mrope_positions[:, before:before + n]
+                    vis = mm.vis_index[before:before + n]
+                    sel = vis >= 0
+                    if sel.any():
+                        if mm_embeds is None:
+                            mm_embeds = np.zeros(
+                                (t_pad, self.hidden_size), np.float32)
+                        mm_mask[off:off + n] = sel
+                        mm_embeds[off:off + n][sel] = \
+                            mm.vis_embeds[vis[sel]]
+                else:
+                    # decode: extrapolate all three axes with the prompt's
+                    # mrope delta (reference get_next_input_positions)
+                    mrope[:, off:off + n] = (pos + mm.mrope_delta)[None, :]
             off += n
         cu[len(batch.items) + 1:] = off
 
@@ -145,5 +178,10 @@ class BatchBuilder:
                 # actually asked for a seed (one extra jit variant).
                 seed=jnp.asarray(seeds) if any_seeded else None,
                 out_step=jnp.asarray(out_steps) if any_seeded else None),
+            mrope_positions=jnp.asarray(mrope) if self.use_mm else None,
+            mm_embeds=(jnp.asarray(mm_embeds)
+                       if mm_embeds is not None else None),
+            mm_mask=(jnp.asarray(mm_mask)
+                     if self.use_mm and mm_embeds is not None else None),
         )
         return step_batch, max_q, presence_mask

@@ -53,7 +53,12 @@ class ModelRunner:
         self.model_def = get_model_def(model_cfg)
         self.attn_impl = self._pick_attn_impl()
         self.builder = BatchBuilder(config, config.cache.page_size,
-                                    vocab_size=model_cfg.vocab_size)
+                                    vocab_size=model_cfg.vocab_size,
+                                    hidden_size=model_cfg.hidden_size,
+                                    use_mm=model_cfg.use_mm)
+        if model_cfg.use_mm:
+            from gllm_tpu.utils import LRUBytesCache
+            self._mm_cache = LRUBytesCache()
         self.rng_key = jax.random.key(config.seed)
         self._step_count = 0
 
@@ -183,10 +188,40 @@ class ModelRunner:
 
     # ---- execution --------------------------------------------------------
 
+    def _prepare_mm(self, sched_batch: ScheduledBatch) -> None:
+        """Run the vision tower for sequences entering prefill with pending
+        visual items; ViT outputs are LRU-cached by content hash (reference
+        MultiModalEmbeddingCache) and attached to the sequence as host rows
+        for the batch builder to splice."""
+        from gllm_tpu.models import qwen2_5_vl, vision
+        vcfg = qwen2_5_vl.vision_cfg(self.model_cfg)
+        for it in sched_batch.items:
+            mm = it.seq.mm
+            if mm is None or mm.vis_embeds is not None:
+                continue
+            chunks = []
+            for item in mm.items:
+                cached = self._mm_cache.get(item.hash)
+                if cached is None:
+                    out = vision.embed_single(
+                        self.params["visual"], vcfg,
+                        jnp.asarray(item.pixels).astype(self.dtype),
+                        item.grid_thw)
+                    cached = np.asarray(out, np.float32)
+                    self._mm_cache.put(item.hash, cached)
+                chunks.append(cached)
+            mm.vis_embeds = (np.concatenate(chunks) if chunks
+                             else np.zeros((0, self.model_cfg.hidden_size),
+                                           np.float32))
+            assert mm.vis_embeds.shape[0] == mm.num_vis_tokens, \
+                (mm.vis_embeds.shape, mm.num_vis_tokens)
+
     def step_async(self, sched_batch: ScheduledBatch):
         """Launch one step; returns an opaque handle whose tokens are an
         uncommitted device future (jax async dispatch — the host does not
         block until ``collect``)."""
+        if self.model_cfg.use_mm:
+            self._prepare_mm(sched_batch)
         self._step_count += 1
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
         batch, max_q, presence_mask = self.builder.build(sched_batch,

@@ -62,6 +62,17 @@ class Sequence:
         self.detok_prefix_offset = max(0, len(prompt_token_ids) - 6)
         self.detok_read_offset = len(prompt_token_ids)
         self.output_text = ""
+        # Multimodal state (gllm_tpu/engine/mm.py MMState) or None for
+        # text-only requests.
+        self.mm = None
+
+    @property
+    def cache_token_ids(self) -> List[int]:
+        """Token ids used for prefix-cache page hashing: visual placeholder
+        spans carry content-hash pad ids so two different images never
+        share pages (reference model_runner.py:100-158)."""
+        return self.mm.hash_token_ids if self.mm is not None \
+            else self.token_ids
 
     # ---- token accounting -------------------------------------------------
 
@@ -88,6 +99,8 @@ class Sequence:
 
     def append_token(self, token_id: int) -> None:
         self.token_ids.append(token_id)
+        if self.mm is not None:
+            self.mm.hash_token_ids.append(token_id)
 
     # ---- lifecycle --------------------------------------------------------
 

@@ -33,3 +33,46 @@ def bucket_size(x: int, minimum: int, maximum: int) -> int:
     if x > maximum:
         raise ValueError(f"size {x} exceeds maximum bucket {maximum}")
     return min(next_pow2(x, minimum), maximum)
+
+
+class LRUBytesCache:
+    """Byte-budgeted LRU (reference MultiModalEmbeddingCache,
+    model_runner.py:161-221): caps both entry count and total bytes so one
+    huge entry can't squat on the pool."""
+
+    def __init__(self, max_entries: int = 64, max_mb: float = 256.0):
+        from collections import OrderedDict
+        self._cache = OrderedDict()
+        self.max_entries = max_entries
+        self.max_bytes = int(max_mb * 1024 * 1024)
+        self._cur_bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    @staticmethod
+    def _size_of(value) -> int:
+        nbytes = getattr(value, "nbytes", None)
+        return int(nbytes) if nbytes is not None else 0
+
+    def get(self, key):
+        v = self._cache.get(key)
+        if v is None:
+            self.misses += 1
+            return None
+        self.hits += 1
+        self._cache.move_to_end(key)
+        return v
+
+    def put(self, key, value) -> None:
+        sz = self._size_of(value)
+        if sz > self.max_bytes:
+            return
+        if key in self._cache:
+            self._cur_bytes -= self._size_of(self._cache[key])
+            self._cache.move_to_end(key)
+        self._cache[key] = value
+        self._cur_bytes += sz
+        while (len(self._cache) > self.max_entries
+               or self._cur_bytes > self.max_bytes):
+            _, evicted = self._cache.popitem(last=False)
+            self._cur_bytes -= self._size_of(evicted)

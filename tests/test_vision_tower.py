@@ -1,0 +1,102 @@
+"""Vision tower vs the HF Qwen2.5-VL ViT (unit-level oracle).
+
+Covers window/full attention block alternation, multi-frame grids,
+non-square grids, edge windows (grid not divisible by the window side),
+and the q-chunked full-attention path used for large images.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from gllm_tpu.models import vision
+
+VD = dict(depth=4, hidden_size=32, intermediate_size=48, num_heads=4,
+          patch_size=2, temporal_patch_size=2, in_channels=3,
+          spatial_merge_size=2, out_hidden_size=24, window_size=8,
+          fullatt_block_indexes=[1, 3], hidden_act="silu")
+
+
+@pytest.fixture(scope="module")
+def hf_and_params():
+    from transformers import Qwen2_5_VLConfig
+    from transformers.models.qwen2_5_vl.modeling_qwen2_5_vl import (
+        Qwen2_5_VisionTransformerPretrainedModel)
+    torch.manual_seed(0)
+    hf = Qwen2_5_VisionTransformerPretrainedModel._from_config(
+        Qwen2_5_VLConfig(vision_config=VD).vision_config)
+    hf.eval().float()
+    vcfg = vision.from_hf_vision_config(VD)
+    sd = hf.state_dict()
+    L, H = vcfg.depth, vcfg.hidden_size
+
+    def stack(fmt, trans=True):
+        ws = np.stack([sd[fmt.format(i)].numpy() for i in range(L)])
+        return jnp.asarray(ws.transpose(0, 2, 1) if trans else ws)
+
+    params = {
+        "patch_embed": jnp.asarray(
+            sd["patch_embed.proj.weight"].reshape(H, -1).numpy().T),
+        "blocks": {
+            "norm1": stack("blocks.{}.norm1.weight", False),
+            "norm2": stack("blocks.{}.norm2.weight", False),
+            "qkv_w": stack("blocks.{}.attn.qkv.weight"),
+            "qkv_b": stack("blocks.{}.attn.qkv.bias", False),
+            "proj_w": stack("blocks.{}.attn.proj.weight"),
+            "proj_b": stack("blocks.{}.attn.proj.bias", False),
+            "gate_w": stack("blocks.{}.mlp.gate_proj.weight"),
+            "gate_b": stack("blocks.{}.mlp.gate_proj.bias", False),
+            "up_w": stack("blocks.{}.mlp.up_proj.weight"),
+            "up_b": stack("blocks.{}.mlp.up_proj.bias", False),
+            "down_w": stack("blocks.{}.mlp.down_proj.weight"),
+            "down_b": stack("blocks.{}.mlp.down_proj.bias", False),
+        },
+        "merger": {
+            "ln_q": jnp.asarray(sd["merger.ln_q.weight"].numpy()),
+            "fc1_w": jnp.asarray(sd["merger.mlp.0.weight"].numpy().T),
+            "fc1_b": jnp.asarray(sd["merger.mlp.0.bias"].numpy()),
+            "fc2_w": jnp.asarray(sd["merger.mlp.2.weight"].numpy().T),
+            "fc2_b": jnp.asarray(sd["merger.mlp.2.bias"].numpy()),
+        },
+    }
+    return hf, vcfg, params
+
+
+@pytest.mark.parametrize("grid", [
+    (1, 4, 8),      # multi-window
+    (1, 8, 8),
+    (2, 4, 4),      # multi-frame (full attention is per-frame)
+    (1, 6, 10),     # edge windows (not divisible by window side)
+])
+def test_vit_matches_hf(hf_and_params, grid):
+    hf, vcfg, params = hf_and_params
+    t, h, w = grid
+    rng = np.random.default_rng(1)
+    pixels = rng.standard_normal(
+        (t * h * w, vcfg.patch_input_dim)).astype(np.float32)
+    with torch.no_grad():
+        want = hf(torch.tensor(pixels),
+                  grid_thw=torch.tensor([list(grid)])).numpy()
+    got = np.asarray(vision.embed_single(params, vcfg, pixels, grid))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_vit_chunked_full_attention(hf_and_params, monkeypatch):
+    """Force the q-chunked full-attention path (used for large images) and
+    check it is exact vs HF."""
+    hf, vcfg, params = hf_and_params
+    monkeypatch.setattr(vision, "_FULL_DENSE_MAX", 8)
+    monkeypatch.setattr(vision, "_FULL_CHUNK", 16)
+    grid = (1, 6, 10)
+    rng = np.random.default_rng(4)
+    pixels = rng.standard_normal(
+        (60, vcfg.patch_input_dim)).astype(np.float32)
+    with torch.no_grad():
+        want = hf(torch.tensor(pixels),
+                  grid_thw=torch.tensor([list(grid)])).numpy()
+    vision._vit_jit.clear_cache()
+    got = np.asarray(vision.embed_single(params, vcfg, pixels, grid))
+    vision._vit_jit.clear_cache()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
