@@ -83,14 +83,14 @@ class BatchBuilder:
         seeds = np.full(s_pad, -1, np.int32)
         out_steps = np.zeros(s_pad, np.int32)
         any_seeded = False
+        # VL batches always carry mrope; the dense [T, H] visual-row
+        # buffer is allocated lazily on first visual row so text-only /
+        # decode steps (the common case) skip the host→device transfer
+        # entirely (one extra jit variant).
+        mm_embeds = None
         if self.use_mm:
-            # VL batches always carry mrope; the dense [T, H] visual-row
-            # buffer is allocated lazily on first visual row so text-only /
-            # decode steps (the common case) skip the host→device transfer
-            # entirely (one extra jit variant).
             mrope = np.zeros((3, t_pad), np.int32)
             mm_mask = np.zeros(t_pad, bool)
-            mm_embeds = None
 
         off = 0
         for i, it in enumerate(batch.items):
