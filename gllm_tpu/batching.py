@@ -34,3 +34,7 @@ class StepBatch(NamedTuple):
     mrope_positions: Optional[jnp.ndarray] = None  # [3, T] int32
     mm_embeds: Optional[jnp.ndarray] = None        # [T, H] visual rows
     mm_mask: Optional[jnp.ndarray] = None          # [T] bool (row is visual)
+    # Hybrid (GDN) extras: per-seq state slot in the SSM pools (reference
+    # sequence.ssm_state_slot → InputData._cal_ssm_metadata); padded rows
+    # point at the dummy slot 0.
+    ssm_slots: Optional[jnp.ndarray] = None        # [S] int32

@@ -42,6 +42,9 @@ class CacheConfig:
     num_pages: Optional[int] = None       # explicit override (tests/benchmarks)
     kv_cache_dtype: str = "auto"          # auto | bfloat16 | float32
     enable_prefix_caching: bool = False
+    # Hybrid (GDN) models: cached-prefix SSM state slots (reference
+    # --max-snapshot-ssm-slots; 0 disables the SSM half of prefix caching)
+    ssm_snapshot_slots: int = 64
 
 
 @dataclasses.dataclass

@@ -98,7 +98,11 @@ class LLM:
             self.runner = ModelRunner(config, model_cfg, params=params)
         self.memory_manager = make_memory_manager(
             self.runner.num_pages, config.cache.page_size,
-            config.cache.enable_prefix_caching)
+            config.cache.enable_prefix_caching,
+            ssm_working_slots=getattr(self.runner, "ssm_working_slots", 0),
+            ssm_snapshot_slots=getattr(self.runner, "ssm_snapshot_slots",
+                                       0))
+        self.runner.memory_manager = self.memory_manager
         self.scheduler = Scheduler(config, self.memory_manager,
                                    pp_size=config.parallel.pp)
         self.eos_token_ids = frozenset(model_cfg.eos_token_ids)

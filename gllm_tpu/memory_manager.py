@@ -46,9 +46,19 @@ def _chain_hash(prev: bytes, token_ids: List[int], extra_key: bytes = b"") -> by
 
 
 class MemoryManager:
-    """Plain paged allocator (no prefix reuse)."""
+    """Plain paged allocator (no prefix reuse).
 
-    def __init__(self, num_pages: int, page_size: int):
+    For hybrid (GDN) models it additionally owns the SSM slot allocators
+    (reference SSMSegment, memory_manager.py:87-255): one *working* slot
+    per live request plus an optional *snapshot* range for cached-prefix
+    state. The device arrays live with the runner; this class only hands
+    out slot ids and records copy/zero intents the runner applies before
+    its next step (single-controller, so FIFO intent order is exact).
+    Slot 0 is the padding dummy in both ranges.
+    """
+
+    def __init__(self, num_pages: int, page_size: int,
+                 ssm_working_slots: int = 0, ssm_snapshot_slots: int = 0):
         if num_pages < 2:
             raise ValueError("need at least 2 pages (one is the dummy page)")
         self.page_size = page_size
@@ -57,6 +67,55 @@ class MemoryManager:
         # Page 0 reserved for padding writes.
         self.allocator = IDAllocator(num_pages - 1, start=1)
         self.ref_count: Dict[int, int] = {}
+
+        self.ssm_working_slots = ssm_working_slots
+        self.ssm_snapshot_slots = ssm_snapshot_slots
+        if ssm_working_slots:
+            self.ssm_alloc: Optional[IDAllocator] = IDAllocator(
+                ssm_working_slots, start=1)
+            self.ssm_snap_alloc: Optional[IDAllocator] = (
+                IDAllocator(ssm_snapshot_slots,
+                            start=1 + ssm_working_slots)
+                if ssm_snapshot_slots else None)
+        else:
+            self.ssm_alloc = None
+            self.ssm_snap_alloc = None
+        # ("snapshot", work, snap) | ("zero", slot, 0) | ("restore", snap,
+        # work) — drained by the runner, applied snapshot→zero→restore.
+        self.ssm_intents: List[Tuple[str, int, int]] = []
+
+    # ---- SSM slots (hybrid models) ----------------------------------------
+
+    @property
+    def use_ssm(self) -> bool:
+        return self.ssm_alloc is not None
+
+    def can_admit_seq(self) -> bool:
+        return self.ssm_alloc is None or self.ssm_alloc.num_free > 0
+
+    def prepare_seq(self, seq: Sequence) -> None:
+        """Allocate per-seq auxiliary state at admission (waiting→running):
+        a fresh (zeroed-on-free) SSM working slot, plus the prefix-cache
+        state restore recorded by match_prefix."""
+        if self.ssm_alloc is None:
+            return
+        if getattr(seq, "ssm_slot", None) is None:
+            seq.ssm_slot = self.ssm_alloc.allocate()
+        snap = getattr(seq, "_ssm_restore_snap", None)
+        if snap is not None:
+            self.ssm_intents.append(("restore", snap, seq.ssm_slot))
+            seq._ssm_restore_snap = None
+
+    def _free_ssm(self, seq: Sequence) -> None:
+        slot = getattr(seq, "ssm_slot", None)
+        if slot is not None:
+            self.ssm_intents.append(("zero", slot, 0))
+            self.ssm_alloc.free(slot)
+            seq.ssm_slot = None
+
+    def drain_ssm_intents(self) -> List[Tuple[str, int, int]]:
+        out, self.ssm_intents = self.ssm_intents, []
+        return out
 
     # ---- stats ------------------------------------------------------------
 
@@ -101,6 +160,7 @@ class MemoryManager:
         for page in seq.page_table:
             self._release_page(page)
         seq.page_table = []
+        self._free_ssm(seq)
 
     def _release_page(self, page: int) -> None:
         self.ref_count[page] -= 1
@@ -112,8 +172,8 @@ class MemoryManager:
 class PrefixMemoryManager(MemoryManager):
     """Paged allocator with page-granular hash-keyed KV reuse."""
 
-    def __init__(self, num_pages: int, page_size: int):
-        super().__init__(num_pages, page_size)
+    def __init__(self, num_pages: int, page_size: int, **ssm_kwargs):
+        super().__init__(num_pages, page_size, **ssm_kwargs)
         # hash digest -> page id (only fully computed pages).
         self.hash_to_page: Dict[bytes, int] = {}
         # page id -> (hash digest, canary token ids)
@@ -122,6 +182,11 @@ class PrefixMemoryManager(MemoryManager):
         # extension (reference memory_manager.py:898-917 caches the chain on
         # the sequence; we key it by seq id here).
         self._seq_chain: Dict[int, Tuple[int, bytes]] = {}  # seq_id -> (num_pages_hashed, digest)
+        # hybrid: page id → SSM snapshot slot holding the state at that
+        # page's boundary (reference page2ssm_snapshot; entries here are
+        # always valid — slots are allocated at capture time, not
+        # pre-reserved).
+        self.page2snap: Dict[int, int] = {}
         self.hit_tokens = 0
         self.query_tokens = 0
 
@@ -134,7 +199,15 @@ class PrefixMemoryManager(MemoryManager):
             digest = meta[0]
             if self.hash_to_page.get(digest) == page:
                 del self.hash_to_page[digest]
+        self._release_snapshot_for(page)
         return page
+
+    def _release_snapshot_for(self, page: int) -> None:
+        """Drop the SSM snapshot of a page's previous tenant (reference
+        memory_manager.py _release_snapshot_for)."""
+        snap = self.page2snap.pop(page, None)
+        if snap is not None:
+            self.ssm_snap_alloc.free(snap)
 
     def _page_tokens(self, seq: Sequence, page_idx: int) -> List[int]:
         s = page_idx * self.page_size
@@ -155,6 +228,7 @@ class PrefixMemoryManager(MemoryManager):
         max_pages = (seq.prompt_len - 1) // self.page_size
         matched_digest = b"root"
         matched = 0
+        digests: List[bytes] = []
         for i in range(max_pages):
             tokens = self._page_tokens(seq, i)
             digest = _chain_hash(matched_digest, tokens, extra_key)
@@ -170,6 +244,24 @@ class PrefixMemoryManager(MemoryManager):
             seq.page_table.append(page)
             matched += 1
             matched_digest = digest
+            digests.append(digest)
+        if self.use_ssm and matched:
+            # Hybrid: a KV hit is only usable up to the last page whose SSM
+            # snapshot exists — roll the claim back to that boundary
+            # (reference _rollback_to_last_ssm_hit). Without any snapshot,
+            # the whole hit is dropped: replaying from token 0 with a
+            # claimed-but-stateless prefix would corrupt the recurrence.
+            keep = matched
+            while keep > 0 and seq.page_table[keep - 1] not in self.page2snap:
+                keep -= 1
+            for page in seq.page_table[keep:]:
+                self._release_page(page)
+            del seq.page_table[keep:]
+            if keep:
+                matched_digest = digests[keep - 1]
+                seq._ssm_restore_snap = self.page2snap[
+                    seq.page_table[keep - 1]]
+            matched = keep
         seq.num_computed_tokens = matched * self.page_size
         seq.num_cached_tokens = seq.num_computed_tokens
         if matched:
@@ -182,6 +274,12 @@ class PrefixMemoryManager(MemoryManager):
 
         Called by the scheduler *after* outputs for a step landed, so only real
         (non-placeholder) tokens are ever hashed (reference :1055-1079).
+
+        Hybrid: when the just-computed range ends exactly at a page
+        boundary (and the seq has no chained step in flight that would have
+        advanced the device state past it), the working SSM state IS the
+        state at that boundary — capture it into a snapshot slot tied to
+        the page (reference _maybe_snapshot_state, qwen3_5.py:307-360).
         """
         full_pages = seq.num_computed_tokens // self.page_size
         n_hashed, digest = self._seq_chain.get(seq.seq_id, (0, b"root"))
@@ -193,6 +291,17 @@ class PrefixMemoryManager(MemoryManager):
             if existing is None:
                 self.hash_to_page[digest] = page
                 self.page_meta[page] = (digest, tuple(tokens[:_CANARY_TOKENS]))
+                if (self.ssm_snap_alloc is not None
+                        and (i + 1) * self.page_size
+                        == seq.num_computed_tokens
+                        and not seq.num_in_flight
+                        and getattr(seq, "ssm_slot", None) is not None
+                        and page not in self.page2snap
+                        and self.ssm_snap_alloc.num_free > 0):
+                    snap = self.ssm_snap_alloc.allocate()
+                    self.page2snap[page] = snap
+                    self.ssm_intents.append(("snapshot", seq.ssm_slot,
+                                             snap))
             n_hashed = i + 1
         self._seq_chain[seq.seq_id] = (n_hashed, digest)
 
@@ -206,6 +315,9 @@ class PrefixMemoryManager(MemoryManager):
 
 
 def make_memory_manager(num_pages: int, page_size: int,
-                        enable_prefix_caching: bool) -> MemoryManager:
+                        enable_prefix_caching: bool,
+                        ssm_working_slots: int = 0,
+                        ssm_snapshot_slots: int = 0) -> MemoryManager:
     cls = PrefixMemoryManager if enable_prefix_caching else MemoryManager
-    return cls(num_pages, page_size)
+    return cls(num_pages, page_size, ssm_working_slots=ssm_working_slots,
+               ssm_snapshot_slots=ssm_snapshot_slots)

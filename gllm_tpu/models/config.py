@@ -90,6 +90,35 @@ class ModelConfig:
     def use_mm(self) -> bool:
         return self.vision_config is not None
 
+    # Hybrid linear-attention (Qwen3-Next / Qwen3.5 — reference
+    # models/qwen3_5.py). layer_types marks each layer "linear_attention"
+    # or "full_attention".
+    layer_types: Tuple[str, ...] = ()
+    linear_num_value_heads: int = 0
+    linear_num_key_heads: int = 0
+    linear_key_head_dim: int = 0
+    linear_value_head_dim: int = 0
+    linear_conv_kernel_dim: int = 4
+
+    @property
+    def use_hybrid(self) -> bool:
+        return "linear_attention" in self.layer_types
+
+    @property
+    def num_attn_layers(self) -> int:
+        if not self.layer_types:
+            return self.num_layers
+        return sum(1 for t in self.layer_types if t == "full_attention")
+
+    @property
+    def num_linear_layers(self) -> int:
+        return sum(1 for t in self.layer_types if t == "linear_attention")
+
+    @property
+    def gdn_conv_dim(self) -> int:
+        return (2 * self.linear_num_key_heads * self.linear_key_head_dim
+                + self.linear_num_value_heads * self.linear_value_head_dim)
+
     # Pipeline-parallel stage slice (rank-aware model construction like the
     # reference's per-stage layer builds, qwen2.py:186-270). Full model by
     # default.
@@ -158,10 +187,22 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
         hf = {**text, "architectures": [arch],
               "eos_token_id": hf.get("eos_token_id",
                                      text.get("eos_token_id"))}
+    if arch in ("Qwen3NextForCausalLM", "Qwen3_5ForCausalLM",
+                "Qwen3_5MoeForCausalLM"):
+        extra = dict(
+            layer_types=tuple(hf.get("layer_types", ())),
+            linear_num_value_heads=hf.get("linear_num_value_heads", 0),
+            linear_num_key_heads=hf.get("linear_num_key_heads", 0),
+            linear_key_head_dim=hf.get("linear_key_head_dim", 0),
+            linear_value_head_dim=hf.get("linear_value_head_dim", 0),
+            linear_conv_kernel_dim=hf.get("linear_conv_kernel_dim", 4),
+        )
     num_heads = hf["num_attention_heads"]
     hidden = hf["hidden_size"]
     head_dim = hf.get("head_dim") or hidden // num_heads
-    qk_norm = arch in ("Qwen3ForCausalLM", "Qwen3MoeForCausalLM")
+    qk_norm = arch in ("Qwen3ForCausalLM", "Qwen3MoeForCausalLM",
+                       "Qwen3NextForCausalLM", "Qwen3_5ForCausalLM",
+                       "Qwen3_5MoeForCausalLM")
     is_glm4 = arch in ("Glm4ForCausalLM",)
     attention_bias = hf.get("attention_bias",
                             arch in ("Qwen2ForCausalLM",

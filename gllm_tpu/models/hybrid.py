@@ -1,0 +1,465 @@
+"""Hybrid linear-attention decoder (Qwen3-Next / Qwen3.5 family).
+
+Reference: /root/reference/gllm/models/qwen3_5.py (1153 LoC) — a 3:1
+interleave of Gated-DeltaNet linear-attention layers and gated
+full-attention layers, MoE or dense MLP, partial rotary, per-head q/k norm.
+
+TPU-first structure:
+- layer_types must tile periodically (Qwen3-Next: [lin, lin, lin, full]);
+  the decoder runs as ONE ``lax.scan`` over periods with the period's
+  static pattern unrolled inside — compile time is O(period), not O(depth).
+- The GDN state (conv + recurrent) lives in slot pools beside the paged KV
+  (HybridKV), indexed per sequence via ``batch.ssm_slots`` — the TPU
+  analogue of the reference's SSMSegment working pool
+  (memory_manager.py:87-255). Chunked prefill carries the state between
+  chunks; decode takes the closed-form recurrent step (ops/gdn.py).
+- Ragged batches: GDN math runs in a per-seq [S, Qmax] layout gathered
+  from the flat token axis; padded positions fold to the identity via
+  g = 0, beta = 0.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gllm_tpu.batching import StepBatch
+from gllm_tpu.models import dense, moe
+from gllm_tpu.models.config import ModelConfig
+from gllm_tpu.ops import (compute_rope_cos_sin, fused_add_rms_norm,
+                          paged_attention, rms_norm, silu_and_mul, write_kv)
+from gllm_tpu.ops.gdn import (causal_conv1d, chunk_gated_delta_rule,
+                              recurrent_gated_delta_step, rms_norm_gated)
+from gllm_tpu.ops.rope import apply_rope
+from gllm_tpu.ops.quant import qmm
+
+Params = Dict[str, Any]
+
+
+class HybridKV(NamedTuple):
+    """Paged KV for the full-attention layers + GDN slot pools."""
+    k: jnp.ndarray      # [La, num_pages, page_size, Hkv, D]
+    v: jnp.ndarray
+    conv: jnp.ndarray   # [Lg, num_slots, conv_dim, K-1] f32
+    rec: jnp.ndarray    # [Lg, num_slots, Nv, Dk, Dv] f32
+
+
+def period_pattern(cfg: ModelConfig) -> Tuple[str, ...]:
+    """Smallest repeating layer-type pattern; raises if non-periodic."""
+    lt = cfg.layer_types
+    assert lt, "hybrid model needs layer_types"
+    L = len(lt)
+    for p in range(1, L + 1):
+        if L % p == 0 and lt == lt[:p] * (L // p):
+            return lt[:p]
+    raise AssertionError("unreachable")
+
+
+def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
+                  dtype=jnp.bfloat16, num_slots: int = 2) -> HybridKV:
+    La, Lg = cfg.num_attn_layers, cfg.num_linear_layers
+    kv_shape = (La, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    K = cfg.linear_conv_kernel_dim
+    return HybridKV(
+        k=jnp.zeros(kv_shape, dtype),
+        v=jnp.zeros(kv_shape, dtype),
+        conv=jnp.zeros((Lg, num_slots, cfg.gdn_conv_dim, K - 1),
+                       jnp.float32),
+        rec=jnp.zeros((Lg, num_slots, cfg.linear_num_value_heads,
+                       cfg.linear_key_head_dim, cfg.linear_value_head_dim),
+                      jnp.float32),
+    )
+
+
+def make_rope_table(cfg: ModelConfig) -> jnp.ndarray:
+    rot_dim = int(cfg.head_dim * cfg.partial_rotary_factor)
+    return compute_rope_cos_sin(rot_dim, cfg.max_position, cfg.rope_theta,
+                                cfg.rope_scaling)
+
+
+# ---------------------------------------------------------------------------
+# Params
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: ModelConfig, seed: int = 0,
+                dtype=jnp.bfloat16) -> Params:
+    H, D = cfg.hidden_size, cfg.head_dim
+    Hq, Hkv = cfg.num_heads, cfg.num_kv_heads
+    La, Lg, L = cfg.num_attn_layers, cfg.num_linear_layers, cfg.num_layers
+    Nk, Nv = cfg.linear_num_key_heads, cfg.linear_num_value_heads
+    Dk, Dv = cfg.linear_key_head_dim, cfg.linear_value_head_dim
+    K = cfg.linear_conv_kernel_dim
+    key_dim, value_dim = Nk * Dk, Nv * Dv
+    key = jax.random.key(seed)
+    ks = iter(jax.random.split(key, 48))
+
+    def w(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * scale).astype(dtype)
+
+    s = H ** -0.5
+    params: Params = {
+        "attn_layers": {
+            # q_proj emits query+gate interleaved per head (2x width)
+            "q_proj": w(next(ks), (La, H, Hq * D * 2), s),
+            "k_proj": w(next(ks), (La, H, Hkv * D), s),
+            "v_proj": w(next(ks), (La, H, Hkv * D), s),
+            "o_proj": w(next(ks), (La, Hq * D, H), (Hq * D) ** -0.5),
+            "q_norm": jnp.ones((La, D), dtype),
+            "k_norm": jnp.ones((La, D), dtype),
+        },
+        "gdn_layers": {
+            "in_qkvz": w(next(ks), (Lg, H, 2 * key_dim + 2 * value_dim), s),
+            "in_ba": w(next(ks), (Lg, H, 2 * Nv), s),
+            "conv_w": w(next(ks), (Lg, cfg.gdn_conv_dim, K),
+                        K ** -0.5),
+            "dt_bias": jnp.ones((Lg, Nv), jnp.float32),
+            "a_log": jnp.zeros((Lg, Nv), jnp.float32),
+            "gdn_norm": jnp.ones((Lg, Dv), dtype),
+            "out_proj": w(next(ks), (Lg, value_dim, H),
+                          value_dim ** -0.5),
+        },
+    }
+    mlp: Params = {
+        "input_norm": jnp.ones((L, H), dtype),
+        "post_attn_norm": jnp.ones((L, H), dtype),
+    }
+    if cfg.num_experts:
+        E, I = cfg.num_experts, cfg.moe_intermediate_size
+        mlp["router"] = w(next(ks), (L, H, E), s)
+        mlp["w_gate"] = w(next(ks), (L, E, H, I), s)
+        mlp["w_up"] = w(next(ks), (L, E, H, I), s)
+        mlp["w_down"] = w(next(ks), (L, E, I, H), I ** -0.5)
+        SI = cfg.shared_expert_intermediate_size
+        if SI:
+            mlp["shared_gate_proj"] = w(next(ks), (L, H, SI), s)
+            mlp["shared_up_proj"] = w(next(ks), (L, H, SI), s)
+            mlp["shared_down_proj"] = w(next(ks), (L, SI, H), SI ** -0.5)
+            mlp["shared_expert_gate"] = w(next(ks), (L, H, 1), s)
+    else:
+        I = cfg.intermediate_size
+        mlp["gate_proj"] = w(next(ks), (L, H, I), s)
+        mlp["up_proj"] = w(next(ks), (L, H, I), s)
+        mlp["down_proj"] = w(next(ks), (L, I, H), I ** -0.5)
+    params["mlp_layers"] = mlp
+    if cfg.is_first_stage:
+        params["embed"] = w(next(ks), (cfg.vocab_size, H), 1.0)
+    if cfg.is_last_stage:
+        params["final_norm"] = jnp.ones((H,), dtype)
+        if not cfg.tie_word_embeddings:
+            params["lm_head"] = w(next(ks), (H, cfg.vocab_size), s)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Attention half (gated full attention)
+# ---------------------------------------------------------------------------
+
+def _gated_attention(lp, x, batch: StepBatch, k_cache, v_cache,
+                     cfg: ModelConfig, cos_sin, *, attn_impl, max_q_len):
+    T = x.shape[0]
+    Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    qg = qmm(x, lp["q_proj"]).reshape(T, Hq, 2 * D)
+    q, gate = qg[..., :D], qg[..., D:]
+    k = qmm(x, lp["k_proj"]).reshape(T, Hkv, D)
+    v = qmm(x, lp["v_proj"]).reshape(T, Hkv, D)
+    q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+    k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q, k = apply_rope(q, k, batch.positions, cos_sin)
+    k_cache, v_cache = write_kv(k_cache, v_cache, k, v, batch.slot_mapping)
+    attn = paged_attention(q, k_cache, v_cache, batch.attn,
+                           scale=D ** -0.5, max_q_len=max_q_len,
+                           impl=attn_impl)
+    attn = attn.reshape(T, Hq * D) * jax.nn.sigmoid(
+        gate.astype(jnp.float32).reshape(T, Hq * D)).astype(x.dtype)
+    return qmm(attn, lp["o_proj"]), k_cache, v_cache
+
+
+# ---------------------------------------------------------------------------
+# GDN half
+# ---------------------------------------------------------------------------
+
+def _gdn_layer(lp, x, batch: StepBatch, conv_state, rec_state,
+               cfg: ModelConfig, *, max_q_len: int):
+    """One Gated-DeltaNet layer over the flat ragged batch.
+
+    conv_state/rec_state: full slot pools for this layer
+    ([num_slots, conv_dim, K-1] / [num_slots, Nv, Dk, Dv]); reads/writes go
+    through batch.ssm_slots (HF Qwen3NextGatedDeltaNet math).
+    """
+    T = x.shape[0]
+    Nk, Nv = cfg.linear_num_key_heads, cfg.linear_num_value_heads
+    Dk, Dv = cfg.linear_key_head_dim, cfg.linear_value_head_dim
+    r = Nv // Nk
+    key_dim, value_dim = Nk * Dk, Nv * Dv
+    slots = batch.ssm_slots
+    S = slots.shape[0]
+
+    qkvz = qmm(x, lp["in_qkvz"]).reshape(T, Nk, 2 * Dk + 2 * r * Dv)
+    ba = qmm(x, lp["in_ba"]).reshape(T, Nk, 2 * r)
+    q = qkvz[..., :Dk]
+    k = qkvz[..., Dk:2 * Dk]
+    v = qkvz[..., 2 * Dk:2 * Dk + r * Dv].reshape(T, Nv, Dv)
+    z = qkvz[..., 2 * Dk + r * Dv:].reshape(T, Nv, Dv)
+    b = ba[..., :r].reshape(T, Nv)
+    a = ba[..., r:].reshape(T, Nv)
+
+    mixed = jnp.concatenate([q.reshape(T, key_dim), k.reshape(T, key_dim),
+                             v.reshape(T, value_dim)], axis=-1)
+    beta = jax.nn.sigmoid(b.astype(jnp.float32))
+    g = (-jnp.exp(lp["a_log"].astype(jnp.float32))
+         * jax.nn.softplus(a.astype(jnp.float32)
+                           + lp["dt_bias"].astype(jnp.float32)))
+
+    conv_w = lp["conv_w"]
+
+    def unpack(mx):
+        # conv output → heads, with GQA repeat to Nv
+        qh = mx[..., :key_dim].reshape(*mx.shape[:-1], Nk, Dk)
+        kh = mx[..., key_dim:2 * key_dim].reshape(*mx.shape[:-1], Nk, Dk)
+        vh = mx[..., 2 * key_dim:].reshape(*mx.shape[:-1], Nv, Dv)
+        if r > 1:
+            qh = jnp.repeat(qh, r, axis=-2)
+            kh = jnp.repeat(kh, r, axis=-2)
+        return qh, kh, vh
+
+    if max_q_len == 1:
+        # pure decode: flat rows are already one-per-seq ([T == S])
+        cstate = conv_state[slots]                       # [S, C, K-1]
+        buf = jnp.concatenate(
+            [cstate, mixed.astype(jnp.float32)[:, :, None]], axis=-1)
+        out_c = jax.nn.silu(
+            jnp.einsum("sck,ck->sc", buf, conv_w.astype(jnp.float32)))
+        new_cstate = buf[..., 1:]
+        qh, kh, vh = unpack(out_c)
+        rstate = rec_state[slots]
+        core, new_rstate = recurrent_gated_delta_step(
+            qh, kh, vh, g, beta, rstate)
+        conv_state = conv_state.at[slots].set(new_cstate)
+        rec_state = rec_state.at[slots].set(new_rstate)
+        core_flat = core                                  # [T, Nv, Dv]
+    else:
+        # ragged prefill/mixed: gather per-seq rows [S, Qmax, ...]
+        cu = batch.attn.cu_q_lens
+        q_lens = cu[1:] - cu[:-1]
+        local = jnp.arange(max_q_len, dtype=jnp.int32)
+        q_idx = jnp.clip(cu[:-1, None] + local[None, :], 0, T - 1)
+        valid = local[None, :] < q_lens[:, None]          # [S, Qmax]
+
+        mixed_s = mixed[q_idx]                            # [S, Q, C]
+        g_s = jnp.where(valid[..., None], g[q_idx], 0.0)
+        beta_s = jnp.where(valid[..., None], beta[q_idx], 0.0)
+
+        cstate = conv_state[slots]
+        out_c, new_cstate = causal_conv1d(mixed_s, cstate, conv_w, q_lens)
+        qh, kh, vh = unpack(out_c)
+        rstate = rec_state[slots]
+        core, new_rstate = chunk_gated_delta_rule(
+            qh, kh, vh, g_s, beta_s, initial_state=rstate)
+        conv_state = conv_state.at[slots].set(new_cstate)
+        rec_state = rec_state.at[slots].set(new_rstate)
+        # scatter valid rows back to the flat layout
+        core = jnp.where(valid[..., None, None], core, 0.0)
+        flat = jnp.zeros((T, Nv, Dv), jnp.float32)
+        core_flat = flat.at[q_idx.reshape(-1)].add(
+            core.reshape(S * max_q_len, Nv, Dv))
+
+    out = rms_norm_gated(core_flat.astype(x.dtype), z, lp["gdn_norm"],
+                         cfg.rms_norm_eps)
+    return (qmm(out.reshape(T, value_dim), lp["out_proj"]),
+            conv_state, rec_state)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _mlp(lp, x, cfg: ModelConfig):
+    if cfg.num_experts:
+        # moe_mlp covers the shared expert + sigmoid gate too (Qwen3Next's
+        # sparse block is qwen2-moe-shaped).
+        return moe.moe_mlp(lp, x, cfg)
+    gate = qmm(x, lp["gate_proj"])
+    up = qmm(x, lp["up_proj"])
+    return qmm(silu_and_mul(jnp.concatenate([gate, up], axis=-1)),
+               lp["down_proj"])
+
+
+def forward(params: Params, kv: HybridKV, batch: StepBatch,
+            cfg: ModelConfig, *, cos_sin, attn_impl: str = "xla",
+            max_q_len: int, hidden_in=None, residual_in=None):
+    pattern = period_pattern(cfg)
+    p = len(pattern)
+    n_lin = sum(1 for t in pattern if t == "linear_attention")
+    n_att = p - n_lin
+    n_periods = cfg.num_layers // p
+
+    if cfg.is_first_stage:
+        hidden = params["embed"][batch.token_ids]
+        residual = jnp.zeros_like(hidden)
+    else:
+        hidden, residual = hidden_in, residual_in
+
+    def reshape_stack(tree, groups):
+        return jax.tree.map(
+            lambda a: a.reshape(n_periods, groups, *a.shape[1:]), tree)
+
+    mlp_xs = reshape_stack(params["mlp_layers"], p)
+    attn_xs = reshape_stack(params["attn_layers"], n_att) if n_att else None
+    gdn_xs = reshape_stack(params["gdn_layers"], n_lin) if n_lin else None
+
+    def period_step(carry, xs):
+        h, res, k_all, v_all, conv_all, rec_all, ai, gi = carry
+        mlp_p, attn_p, gdn_p = xs
+        a_j = g_j = 0
+        for j, ltype in enumerate(pattern):
+            lp_mlp = jax.tree.map(lambda a: a[j], mlp_p)
+            normed, res = fused_add_rms_norm(h, res, lp_mlp["input_norm"],
+                                             cfg.rms_norm_eps)
+            if ltype == "full_attention":
+                lp = jax.tree.map(lambda a: a[a_j], attn_p)
+                kc = jax.lax.dynamic_index_in_dim(k_all, ai + a_j, 0,
+                                                  keepdims=False)
+                vc = jax.lax.dynamic_index_in_dim(v_all, ai + a_j, 0,
+                                                  keepdims=False)
+                mix_out, kc, vc = _gated_attention(
+                    lp, normed, batch, kc, vc, cfg, cos_sin,
+                    attn_impl=attn_impl, max_q_len=max_q_len)
+                k_all = jax.lax.dynamic_update_index_in_dim(
+                    k_all, kc, ai + a_j, 0)
+                v_all = jax.lax.dynamic_update_index_in_dim(
+                    v_all, vc, ai + a_j, 0)
+                a_j += 1
+            else:
+                lp = jax.tree.map(lambda a: a[g_j], gdn_p)
+                conv_l = jax.lax.dynamic_index_in_dim(conv_all, gi + g_j, 0,
+                                                      keepdims=False)
+                rec_l = jax.lax.dynamic_index_in_dim(rec_all, gi + g_j, 0,
+                                                     keepdims=False)
+                mix_out, conv_l, rec_l = _gdn_layer(
+                    lp, normed, batch, conv_l, rec_l, cfg,
+                    max_q_len=max_q_len)
+                conv_all = jax.lax.dynamic_update_index_in_dim(
+                    conv_all, conv_l, gi + g_j, 0)
+                rec_all = jax.lax.dynamic_update_index_in_dim(
+                    rec_all, rec_l, gi + g_j, 0)
+                g_j += 1
+            normed2, res = fused_add_rms_norm(
+                mix_out, res, lp_mlp["post_attn_norm"], cfg.rms_norm_eps)
+            h = _mlp(lp_mlp, normed2, cfg)
+        return (h, res, k_all, v_all, conv_all, rec_all,
+                ai + n_att, gi + n_lin), None
+
+    init = (hidden, residual, kv.k, kv.v, kv.conv, kv.rec,
+            jnp.int32(0), jnp.int32(0))
+    (hidden, residual, k_all, v_all, conv_all, rec_all, _, _), _ = \
+        jax.lax.scan(period_step, init, (mlp_xs, attn_xs, gdn_xs))
+    return hidden, residual, HybridKV(k_all, v_all, conv_all, rec_all)
+
+
+compute_logits = dense.compute_logits
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint loading
+# ---------------------------------------------------------------------------
+
+def hybrid_rules(cfg: ModelConfig):
+    """Qwen3-Next checkpoint → our stacked layout. Layer index i maps to
+    a per-kind index (i-th attention layer / i-th linear layer)."""
+    attn_index = {}
+    lin_index = {}
+    for i, t in enumerate(cfg.layer_types):
+        if t == "full_attention":
+            attn_index[i] = len(attn_index)
+        else:
+            lin_index[i] = len(lin_index)
+
+    def plus1(leaf_name):
+        # Qwen3Next RMSNorm is zero-centered: forward scales by
+        # (1 + weight); fold the offset into the stored weight so our
+        # standard rms_norm applies unchanged.
+        return lambda t: {leaf_name: t + 1.0}
+
+    attn_leaves = {
+        "self_attn.q_proj.weight": ("q_proj", "t"),
+        "self_attn.k_proj.weight": ("k_proj", "t"),
+        "self_attn.v_proj.weight": ("v_proj", "t"),
+        "self_attn.o_proj.weight": ("o_proj", "t"),
+        "self_attn.q_norm.weight": ("__multi__", plus1("q_norm")),
+        "self_attn.k_norm.weight": ("__multi__", plus1("k_norm")),
+    }
+    gdn_leaves = {
+        "linear_attn.in_proj_qkvz.weight": ("in_qkvz", "t"),
+        "linear_attn.in_proj_ba.weight": ("in_ba", "t"),
+        "linear_attn.dt_bias": ("dt_bias", None),
+        "linear_attn.A_log": ("a_log", None),
+        "linear_attn.norm.weight": ("gdn_norm", None),
+        "linear_attn.out_proj.weight": ("out_proj", "t"),
+    }
+    mlp_leaves = {
+        "input_layernorm.weight": ("__multi__", plus1("input_norm")),
+        "post_attention_layernorm.weight": ("__multi__",
+                                            plus1("post_attn_norm")),
+        "mlp.gate_proj.weight": ("gate_proj", "t"),
+        "mlp.up_proj.weight": ("up_proj", "t"),
+        "mlp.down_proj.weight": ("down_proj", "t"),
+        "mlp.gate.weight": ("router", "t"),
+        "mlp.shared_expert.gate_proj.weight": ("shared_gate_proj", "t"),
+        "mlp.shared_expert.up_proj.weight": ("shared_up_proj", "t"),
+        "mlp.shared_expert.down_proj.weight": ("shared_down_proj", "t"),
+        "mlp.shared_expert_gate.weight": ("shared_expert_gate", "t"),
+    }
+    expert_leaves = {
+        "gate_proj.weight": ("w_gate", "t"),
+        "up_proj.weight": ("w_up", "t"),
+        "down_proj.weight": ("w_down", "t"),
+    }
+
+    def conv_tf(t):
+        # HF Conv1d weight [C, 1, K] → [C, K]
+        return {"conv_w": t.reshape(t.shape[0], t.shape[-1])}
+
+    def rule(name: str):
+        if name == "model.embed_tokens.weight":
+            return (("embed",), None, None)
+        if name == "model.norm.weight":
+            return (("__multi__",), None, plus1("final_norm"))
+        if name == "lm_head.weight":
+            if not cfg.tie_word_embeddings:
+                return (("lm_head",), None, "t")
+            return None
+        if not name.startswith("model.layers."):
+            return None
+        rest = name[len("model.layers."):]
+        idx_s, _, leaf = rest.partition(".")
+        i = int(idx_s)
+        if leaf == "linear_attn.conv1d.weight":
+            return (("gdn_layers", "__multi__"), lin_index[i], conv_tf)
+        if leaf in attn_leaves:
+            target, tf = attn_leaves[leaf]
+            return (("attn_layers", target), attn_index[i], tf)
+        if leaf in gdn_leaves:
+            target, tf = gdn_leaves[leaf]
+            return (("gdn_layers", target), lin_index[i], tf)
+        if leaf in mlp_leaves:
+            target, tf = mlp_leaves[leaf]
+            return (("mlp_layers", target), i, tf)
+        if leaf.startswith("mlp.experts."):
+            rest2 = leaf[len("mlp.experts."):]
+            e_s, _, el = rest2.partition(".")
+            if el in expert_leaves:
+                target, tf = expert_leaves[el]
+                return (("mlp_layers", target), (i, int(e_s)), tf)
+        return None
+
+    return rule
+
+
+def load_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16,
+                progress_cb=None) -> Params:
+    from gllm_tpu.models.loader import _load_params
+    template = jax.eval_shape(lambda: init_params(cfg, dtype=dtype))
+    return _load_params(model_dir, template, hybrid_rules(cfg), progress_cb)

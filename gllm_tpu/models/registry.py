@@ -79,6 +79,21 @@ def get_model_def(cfg: ModelConfig) -> ModelDef:
         return deepseek_def()
     if cfg.architecture in _VL_ARCHS:
         return _vl_def()
+    if cfg.architecture in _HYBRID_ARCHS:
+        from gllm_tpu.models import hybrid
+        from gllm_tpu.parallel.shardings import (hybrid_kv_specs,
+                                                 hybrid_param_specs)
+        return ModelDef(
+            family="hybrid",
+            init_params=hybrid.init_params,
+            forward=hybrid.forward,
+            compute_logits=hybrid.compute_logits,
+            make_rope_table=hybrid.make_rope_table,
+            load_params=hybrid.load_params,
+            init_kv_cache=hybrid.init_kv_cache,
+            param_specs=hybrid_param_specs,
+            kv_specs=hybrid_kv_specs,
+        )
     raise NotImplementedError(
         f"architecture {cfg.architecture!r} not supported yet; "
         f"dense: {_DENSE_ARCHS}, moe: {_MOE_ARCHS}, mla: {_MLA_ARCHS}, "
@@ -100,10 +115,17 @@ _VL_ARCHS = (
     "Qwen2_5_VLForConditionalGeneration",
 )
 
+_HYBRID_ARCHS = (
+    "Qwen3NextForCausalLM",
+    "Qwen3_5ForCausalLM",
+    "Qwen3_5MoeForCausalLM",
+)
+
 
 def supported_architectures() -> Dict[str, str]:
     out = {a: "dense" for a in _DENSE_ARCHS}
     out.update({a: "moe" for a in _MOE_ARCHS})
     out.update({a: "mla-moe" for a in _MLA_ARCHS})
     out.update({a: "vl" for a in _VL_ARCHS})
+    out.update({a: "hybrid" for a in _HYBRID_ARCHS})
     return out

@@ -207,3 +207,49 @@ def vl_param_specs(cfg: ModelConfig, tp: int) -> dict:
     specs["visual"] = jax.tree.map(lambda s: P(*([None] * len(s.shape))),
                                    vtemplate)
     return specs
+
+
+def hybrid_param_specs(cfg: ModelConfig, tp: int) -> dict:
+    """Qwen3-Next hybrid shardings: attention halves shard like dense
+    (head axis), GDN projections shard on their output/head axes, MoE
+    experts on the expert axis; small per-head vectors replicate."""
+    import jax
+
+    from gllm_tpu.models import hybrid
+    template = jax.eval_shape(lambda: hybrid.init_params(cfg))
+
+    def spec_for(path, leaf):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        nd = len(leaf.shape)
+        tp_ok = lambda dim: dim % tp == 0  # noqa: E731
+        if name in ("q_proj", "k_proj", "v_proj", "in_qkvz", "in_ba",
+                    "gate_proj", "up_proj", "shared_gate_proj",
+                    "shared_up_proj"):
+            return P(*([None] * (nd - 1)),
+                     _tp_if(tp_ok(leaf.shape[-1])))
+        if name in ("o_proj", "down_proj", "out_proj",
+                    "shared_down_proj"):
+            return P(None, _tp_if(tp_ok(leaf.shape[1])), None)
+        if name in ("w_gate", "w_up", "w_down"):
+            return P(None, _tp_if(tp_ok(leaf.shape[1])), None, None)
+        if name == "embed":
+            return P(_tp_if(tp_ok(leaf.shape[0])), None)
+        if name == "lm_head":
+            return P(None, _tp_if(tp_ok(leaf.shape[-1])))
+        return P(*([None] * nd))
+
+    import jax.tree_util as jtu
+    return jtu.tree_map_with_path(spec_for, template)
+
+
+def hybrid_kv_specs(cfg: ModelConfig, tp: int):
+    from gllm_tpu.models.hybrid import HybridKV
+    kv_heads_ok = cfg.num_kv_heads % tp == 0
+    kv_spec = P(None, None, None, _tp_if(kv_heads_ok), None)
+    # GDN states shard over the value-head axis when divisible.
+    vh_ok = cfg.linear_num_value_heads % tp == 0
+    return HybridKV(
+        k=kv_spec, v=kv_spec,
+        conv=P(None, None, None, None),
+        rec=P(None, None, _tp_if(vh_ok), None, None),
+    )

@@ -98,6 +98,9 @@ class PPModelRunner(ModelRunner):
             # per-stage builder has no vision tower / mrope plumbing yet).
             raise NotImplementedError(
                 "multimodal models with pp > 1 are not wired up yet")
+        if model_cfg.use_hybrid:
+            raise NotImplementedError(
+                "hybrid (GDN) models with pp > 1 are not wired up yet")
         devices = jax.devices()
         if len(devices) < pp * tp:
             raise ValueError(f"pp={pp} tp={tp} needs {pp * tp} devices, "

@@ -30,12 +30,13 @@ from gllm_tpu.utils import bucket_size, cdiv
 class BatchBuilder:
     def __init__(self, config: EngineConfig, page_size: int,
                  vocab_size: int = 0, hidden_size: int = 0,
-                 use_mm: bool = False):
+                 use_mm: bool = False, use_ssm: bool = False):
         self.config = config
         self.page_size = page_size
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.use_mm = use_mm
+        self.use_ssm = use_ssm
         sc = config.scheduler
         # Upper bounds for the shape buckets.
         self.max_tokens = sc.max_prefill_tokens + sc.max_decode_seqs
@@ -91,6 +92,8 @@ class BatchBuilder:
         if self.use_mm:
             mrope = np.zeros((3, t_pad), np.int32)
             mm_mask = np.zeros(t_pad, bool)
+        if self.use_ssm:
+            ssm_slots = np.zeros(s_pad, np.int32)   # padding → dummy slot 0
 
         off = 0
         for i, it in enumerate(batch.items):
@@ -117,6 +120,8 @@ class BatchBuilder:
                 seeds[i] = sp.seed
                 # index of the output token this step will sample
                 out_steps[i] = before + n - seq.prompt_len
+            if self.use_ssm:
+                ssm_slots[i] = getattr(seq, "ssm_slot", None) or 0
             if self.use_mm:
                 mm = seq.mm
                 if mm is None:
@@ -183,5 +188,6 @@ class BatchBuilder:
                        if mm_embeds is not None else None),
             mm_mask=(jnp.asarray(mm_mask)
                      if self.use_mm and mm_embeds is not None else None),
+            ssm_slots=jnp.asarray(ssm_slots) if self.use_ssm else None,
         )
         return step_batch, max_q, presence_mask

@@ -32,12 +32,26 @@ from gllm_tpu.models import ModelConfig, get_model_def
 from gllm_tpu.ops.sampling import sample
 from gllm_tpu.runner.prepare import BatchBuilder
 from gllm_tpu.scheduler import ScheduledBatch
-from gllm_tpu.utils import bucket_size, cdiv
+from gllm_tpu.utils import bucket_size, cdiv, next_pow2
 
 logger = logging.getLogger(__name__)
 
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "float16": jnp.float16}
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _ssm_apply(conv, rec, snap_src, snap_dst, zero_slots, rest_src,
+               rest_dst):
+    """Batched SSM slot maintenance. Padding entries are (0, 0) / slot 0 —
+    the dummy slot, where self-copies and zeroing are harmless."""
+    conv = conv.at[:, snap_dst].set(conv[:, snap_src])
+    rec = rec.at[:, snap_dst].set(rec[:, snap_src])
+    conv = conv.at[:, zero_slots].set(0.0)
+    rec = rec.at[:, zero_slots].set(0.0)
+    conv = conv.at[:, rest_dst].set(conv[:, rest_src])
+    rec = rec.at[:, rest_dst].set(rec[:, rest_src])
+    return conv, rec
 
 
 class ModelRunner:
@@ -55,7 +69,8 @@ class ModelRunner:
         self.builder = BatchBuilder(config, config.cache.page_size,
                                     vocab_size=model_cfg.vocab_size,
                                     hidden_size=model_cfg.hidden_size,
-                                    use_mm=model_cfg.use_mm)
+                                    use_mm=model_cfg.use_mm,
+                                    use_ssm=model_cfg.use_hybrid)
         if model_cfg.use_mm:
             from gllm_tpu.utils import LRUBytesCache
             self._mm_cache = LRUBytesCache()
@@ -90,9 +105,23 @@ class ModelRunner:
 
         self.num_pages = (config.cache.num_pages
                           or self.determine_num_pages())
-        self.kv = self.model_def.init_kv_cache(
-            model_cfg, self.num_pages, config.cache.page_size,
-            self._kv_dtype())
+        if model_cfg.use_hybrid:
+            # slot 0 dummy + one working slot per live seq + snapshot range
+            self.ssm_working_slots = config.max_num_seqs
+            self.ssm_snapshot_slots = (
+                config.cache.ssm_snapshot_slots
+                if config.cache.enable_prefix_caching else 0)
+            self.kv = self.model_def.init_kv_cache(
+                model_cfg, self.num_pages, config.cache.page_size,
+                self._kv_dtype(),
+                num_slots=(1 + self.ssm_working_slots
+                           + self.ssm_snapshot_slots))
+        else:
+            self.ssm_working_slots = self.ssm_snapshot_slots = 0
+            self.kv = self.model_def.init_kv_cache(
+                model_cfg, self.num_pages, config.cache.page_size,
+                self._kv_dtype())
+        self.memory_manager = None   # attached by the engine (SSM intents)
         if self.mesh is not None:
             from jax.sharding import NamedSharding
             kspecs = self.model_def.kv_specs(model_cfg, config.parallel.tp)
@@ -140,8 +169,24 @@ class ModelRunner:
         tp = self.config.parallel.tp
         shards = tp if (self.mesh is not None
                         and cfg.num_kv_heads % tp == 0) else 1
-        return (2 * cfg.num_stage_layers * page * cfg.num_kv_heads
+        # Hybrid: only the full-attention layers hold paged KV.
+        n_kv_layers = (cfg.num_attn_layers if cfg.use_hybrid
+                       else cfg.num_stage_layers)
+        return (2 * n_kv_layers * page * cfg.num_kv_heads
                 * cfg.head_dim * itemsize) // shards
+
+    def _ssm_pool_bytes(self) -> int:
+        cfg = self.model_cfg
+        if not cfg.use_hybrid:
+            return 0
+        snapshot = (self.config.cache.ssm_snapshot_slots
+                    if self.config.cache.enable_prefix_caching else 0)
+        slots = 1 + self.config.max_num_seqs + snapshot
+        K = cfg.linear_conv_kernel_dim
+        per_slot = (cfg.gdn_conv_dim * (K - 1)
+                    + cfg.linear_num_value_heads * cfg.linear_key_head_dim
+                    * cfg.linear_value_head_dim) * 4
+        return cfg.num_linear_layers * slots * per_slot
 
     def determine_num_pages(self) -> int:
         """Size the KV pool from live device memory after model load
@@ -157,6 +202,7 @@ class ModelRunner:
         # Headroom for activations at peak batch shape (a full profile-run
         # pass would refine this; 512 MB covers the bucketed step buffers).
         free -= 512 * 1024 * 1024
+        free -= self._ssm_pool_bytes()
         num = int(free // self._kv_bytes_per_page())
         min_pages = cdiv(self.config.max_model_len,
                          self.config.cache.page_size) + 2
@@ -216,12 +262,45 @@ class ModelRunner:
             assert mm.vis_embeds.shape[0] == mm.num_vis_tokens, \
                 (mm.vis_embeds.shape, mm.num_vis_tokens)
 
+    def _apply_ssm_intents(self) -> None:
+        """Apply pending SSM slot ops (snapshot / zero / restore) recorded
+        by the memory manager, in class order: snapshots capture states
+        from completed steps, zeros clear freed slots, restores fill fresh
+        slots from snapshots — all before the next step reads them
+        (reference SSMSegment.copy_state / free_working zeroing)."""
+        mm = self.memory_manager
+        if mm is None or not getattr(mm, "use_ssm", False):
+            return
+        intents = mm.drain_ssm_intents()
+        if not intents:
+            return
+        snap = [(a, b) for k, a, b in intents if k == "snapshot"]
+        zero = [a for k, a, _ in intents if k == "zero"]
+        rest = [(a, b) for k, a, b in intents if k == "restore"]
+
+        def pad_pairs(pairs, n):
+            pairs = pairs + [(0, 0)] * (n - len(pairs))
+            return (jnp.asarray([p[0] for p in pairs], jnp.int32),
+                    jnp.asarray([p[1] for p in pairs], jnp.int32))
+
+        # pow2 padding keeps the jit-shape count logarithmic
+        n_s = next_pow2(len(snap), 1)
+        n_z = next_pow2(len(zero), 1)
+        n_r = next_pow2(len(rest), 1)
+        s_src, s_dst = pad_pairs(snap, n_s)
+        z = jnp.asarray(zero + [0] * (n_z - len(zero)), jnp.int32)
+        r_src, r_dst = pad_pairs(rest, n_r)
+        conv, rec = _ssm_apply(self.kv.conv, self.kv.rec, s_src, s_dst, z,
+                               r_src, r_dst)
+        self.kv = self.kv._replace(conv=conv, rec=rec)
+
     def step_async(self, sched_batch: ScheduledBatch):
         """Launch one step; returns an opaque handle whose tokens are an
         uncommitted device future (jax async dispatch — the host does not
         block until ``collect``)."""
         if self.model_cfg.use_mm:
             self._prepare_mm(sched_batch)
+        self._apply_ssm_intents()
         self._step_count += 1
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
         batch, max_q, presence_mask = self.builder.build(sched_batch,
@@ -241,6 +320,7 @@ class ModelRunner:
         the next step's token_ids)."""
         prev_tokens, prev_n = prev_handle
         assert prev_n == sched_batch.num_seqs
+        self._apply_ssm_intents()
         self._step_count += 1
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
         batch, max_q, presence_mask = self.builder.build(sched_batch,

@@ -266,6 +266,25 @@ class Scheduler:
                 continue
             items.append(ScheduledSeq(seq, 1, seq.num_computed_tokens))
 
+    def _ssm_align_chunk(self, seq: Sequence, n: int) -> int:
+        """Hybrid models: end non-final prefill chunks at page boundaries
+        so the GDN state at chunk end can be snapshotted for that page
+        (prefix caching restores state only at boundaries it has — see
+        PrefixMemoryManager.register_computed_pages)."""
+        if getattr(self.mm, "ssm_snap_alloc", None) is None:
+            return n   # no snapshot pool → aligning would only waste steps
+        page = self.mm.page_size
+        end = seq.num_computed_tokens + n
+        if end >= seq.prompt_len:
+            # final chunk: stop at the last full-page boundary first so its
+            # state gets a snapshot; the (mid-page) remainder follows.
+            aligned = (seq.prompt_len // page) * page
+        else:
+            aligned = (end // page) * page
+        if seq.num_computed_tokens < aligned < end:
+            return aligned - seq.num_computed_tokens
+        return n
+
     def _schedule_prefill(self, items: List[ScheduledSeq],
                           token_budget: int) -> None:
         protect = {it.seq.seq_id for it in items}
@@ -276,7 +295,8 @@ class Scheduler:
                     if s.num_remaining_tokens > 1 and not s.num_in_flight]:
             if token_budget <= 0 or len(items) >= max_seqs:
                 break
-            n = min(seq.num_remaining_tokens, token_budget)
+            n = self._ssm_align_chunk(
+                seq, min(seq.num_remaining_tokens, token_budget))
             protect.add(seq.seq_id)
             if not self._allocate_with_preemption(seq, n, protect):
                 protect.discard(seq.seq_id)
@@ -298,7 +318,8 @@ class Scheduler:
                 continue
             if seq.num_computed_tokens == 0 and not seq.page_table:
                 self.mm.match_prefix(seq)
-            n = min(seq.num_remaining_tokens, token_budget)
+            n = self._ssm_align_chunk(
+                seq, min(seq.num_remaining_tokens, token_budget))
             # Adaptive admission: reserve room for the chunk plus
             # new_token_ratio of the expected decode output. When nothing is
             # running and nothing else got scheduled, drop the reservation —
@@ -311,7 +332,10 @@ class Scheduler:
                 est_extra, self.mm.page_size)
             if not self.mm.can_allocate(need):
                 break
+            if not self.mm.can_admit_seq():
+                break  # hybrid: no free SSM working slot
             self.mm.allocate_seq_pages(seq, n)
+            self.mm.prepare_seq(seq)
             self.waiting.popleft()
             seq.status = SequenceStatus.RUNNING
             self.running.append(seq)

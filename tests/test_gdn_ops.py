@@ -1,0 +1,161 @@
+"""GDN ops vs the HF Qwen3Next torch reference math."""
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from gllm_tpu.ops import gdn
+
+hf = pytest.importorskip(
+    "transformers.models.qwen3_next.modeling_qwen3_next")
+
+
+def rand(rng, *shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+@pytest.mark.parametrize("T,chunk", [(1, 16), (7, 4), (64, 16), (100, 32)])
+def test_chunk_rule_matches_hf(T, chunk):
+    rng = np.random.default_rng(0)
+    S, H, Dk, Dv = 2, 3, 8, 16
+    q, k = rand(rng, S, T, H, Dk), rand(rng, S, T, H, Dk)
+    v = rand(rng, S, T, H, Dv)
+    g = -np.abs(rand(rng, S, T, H))
+    beta = 1 / (1 + np.exp(-rand(rng, S, T, H)))
+    init = rand(rng, S, H, Dk, Dv)
+
+    want, want_state = hf.torch_chunk_gated_delta_rule(
+        torch.tensor(q), torch.tensor(k), torch.tensor(v),
+        torch.tensor(g), torch.tensor(beta), chunk_size=chunk,
+        initial_state=torch.tensor(init), output_final_state=True,
+        use_qk_l2norm_in_kernel=True)
+
+    got, got_state = gdn.chunk_gated_delta_rule(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(g),
+        jnp.asarray(beta), initial_state=jnp.asarray(init),
+        chunk_size=chunk)
+    np.testing.assert_allclose(np.asarray(got), want.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(got_state), want_state.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_recurrent_step_matches_hf():
+    rng = np.random.default_rng(1)
+    S, H, Dk, Dv = 3, 2, 8, 16
+    q, k = rand(rng, S, 1, H, Dk), rand(rng, S, 1, H, Dk)
+    v = rand(rng, S, 1, H, Dv)
+    g = -np.abs(rand(rng, S, 1, H))
+    beta = 1 / (1 + np.exp(-rand(rng, S, 1, H)))
+    init = rand(rng, S, H, Dk, Dv)
+
+    want, want_state = hf.torch_recurrent_gated_delta_rule(
+        torch.tensor(q), torch.tensor(k), torch.tensor(v),
+        torch.tensor(g), torch.tensor(beta),
+        initial_state=torch.tensor(init), output_final_state=True,
+        use_qk_l2norm_in_kernel=True)
+
+    got, got_state = gdn.recurrent_gated_delta_step(
+        jnp.asarray(q[:, 0]), jnp.asarray(k[:, 0]), jnp.asarray(v[:, 0]),
+        jnp.asarray(g[:, 0]), jnp.asarray(beta[:, 0]), jnp.asarray(init))
+    np.testing.assert_allclose(np.asarray(got), want.numpy()[:, 0],
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(got_state), want_state.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_chunk_then_recurrent_continuation():
+    """State handoff: chunked prefill followed by recurrent decode steps
+    equals one chunked pass over the whole sequence."""
+    rng = np.random.default_rng(2)
+    S, T, H, Dk, Dv = 2, 20, 2, 8, 8
+    q, k = rand(rng, S, T, H, Dk), rand(rng, S, T, H, Dk)
+    v = rand(rng, S, T, H, Dv)
+    g = -np.abs(rand(rng, S, T, H))
+    beta = 1 / (1 + np.exp(-rand(rng, S, T, H)))
+
+    full, full_state = gdn.chunk_gated_delta_rule(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(g),
+        jnp.asarray(beta), chunk_size=8)
+
+    split = 15
+    part, state = gdn.chunk_gated_delta_rule(
+        jnp.asarray(q[:, :split]), jnp.asarray(k[:, :split]),
+        jnp.asarray(v[:, :split]), jnp.asarray(g[:, :split]),
+        jnp.asarray(beta[:, :split]), chunk_size=8)
+    outs = [np.asarray(part)]
+    for t in range(split, T):
+        o, state = gdn.recurrent_gated_delta_step(
+            jnp.asarray(q[:, t]), jnp.asarray(k[:, t]),
+            jnp.asarray(v[:, t]), jnp.asarray(g[:, t]),
+            jnp.asarray(beta[:, t]), state)
+        outs.append(np.asarray(o)[:, None])
+    got = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(got, np.asarray(full), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(state), np.asarray(full_state),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_padded_tokens_are_identity():
+    """g = 0, beta = 0 rows leave the state unchanged (ragged batching)."""
+    rng = np.random.default_rng(3)
+    S, T, H, Dk, Dv = 1, 12, 2, 8, 8
+    q, k = rand(rng, S, T, H, Dk), rand(rng, S, T, H, Dk)
+    v = rand(rng, S, T, H, Dv)
+    g = -np.abs(rand(rng, S, T, H))
+    beta = 1 / (1 + np.exp(-rand(rng, S, T, H)))
+    valid = 7
+    g2 = g.copy()
+    beta2 = beta.copy()
+    g2[:, valid:] = 0.0
+    beta2[:, valid:] = 0.0
+
+    _, state_padded = gdn.chunk_gated_delta_rule(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(g2),
+        jnp.asarray(beta2), chunk_size=4)
+    _, state_exact = gdn.chunk_gated_delta_rule(
+        jnp.asarray(q[:, :valid]), jnp.asarray(k[:, :valid]),
+        jnp.asarray(v[:, :valid]), jnp.asarray(g[:, :valid]),
+        jnp.asarray(beta[:, :valid]), chunk_size=4)
+    np.testing.assert_allclose(np.asarray(state_padded),
+                               np.asarray(state_exact),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_causal_conv1d_state_handoff():
+    rng = np.random.default_rng(4)
+    S, T, C, K = 2, 10, 6, 4
+    x = rand(rng, S, T, C)
+    w = rand(rng, C, K)
+    state0 = np.zeros((S, C, K - 1), np.float32)
+    q_lens = np.asarray([T, 7], np.int32)
+
+    out, new_state = gdn.causal_conv1d(jnp.asarray(x), jnp.asarray(state0),
+                                       jnp.asarray(w),
+                                       jnp.asarray(q_lens))
+    # torch oracle per seq (full conv over valid prefix)
+    import torch.nn.functional as F
+    for s, L in enumerate(q_lens):
+        xs = torch.tensor(x[s, :L].T[None])           # [1, C, L]
+        ref = F.conv1d(F.pad(xs, (K - 1, 0)), torch.tensor(w)[:, None, :],
+                       groups=C)
+        ref = F.silu(ref)[0].T.numpy()
+        np.testing.assert_allclose(np.asarray(out)[s, :L], ref,
+                                   rtol=1e-5, atol=1e-5)
+        # state = last K-1 valid inputs
+        want_state = x[s, L - (K - 1):L].T
+        np.testing.assert_allclose(np.asarray(new_state)[s], want_state,
+                                   rtol=1e-6, atol=1e-6)
+
+    # continuation: feed next chunk with carried state == full-seq conv
+    x2 = rand(rng, S, 5, C)
+    out2, _ = gdn.causal_conv1d(jnp.asarray(x2), new_state, jnp.asarray(w),
+                                jnp.asarray([5, 5], np.int32))
+    full = np.concatenate([x[1:2, :7], x2[1:2]], axis=1)
+    ref_full = F.silu(F.conv1d(
+        F.pad(torch.tensor(full.transpose(0, 2, 1)), (K - 1, 0)),
+        torch.tensor(w)[:, None, :], groups=C))[0].T.numpy()
+    np.testing.assert_allclose(np.asarray(out2)[1], ref_full[7:],
+                               rtol=1e-5, atol=1e-5)
