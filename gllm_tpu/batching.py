@@ -38,3 +38,7 @@ class StepBatch(NamedTuple):
     # sequence.ssm_state_slot → InputData._cal_ssm_metadata); padded rows
     # point at the dummy slot 0.
     ssm_slots: Optional[jnp.ndarray] = None        # [S] int32
+    # Prompt-logprob targets: token at position+1 for every prefill row
+    # (0 where unavailable); present only when a seq requested
+    # prompt_logprobs.
+    plp_targets: Optional[jnp.ndarray] = None      # [T] int32

@@ -40,6 +40,11 @@ class RequestOutput:
     finish_reason: Optional[str]
     num_prompt_tokens: int = 0
     num_output_tokens: int = 0
+    # per output token: (chosen_logprob, top_ids, top_logprobs); None when
+    # not requested
+    logprobs: Optional[list] = None
+    # per prompt position (index 0 is None)
+    prompt_logprobs: Optional[list] = None
 
     @property
     def finished(self) -> bool:
@@ -96,15 +101,26 @@ class LLM:
         else:
             from gllm_tpu.runner.runner import ModelRunner
             self.runner = ModelRunner(config, model_cfg, params=params)
-        self.memory_manager = make_memory_manager(
-            self.runner.num_pages, config.cache.page_size,
-            config.cache.enable_prefix_caching,
-            ssm_working_slots=getattr(self.runner, "ssm_working_slots", 0),
-            ssm_snapshot_slots=getattr(self.runner, "ssm_snapshot_slots",
-                                       0))
+        # DP attention: one scheduler + KV pool per replica; the frontend
+        # round-robins requests (reference llm_engine.py:121-133,490-519).
+        self.dp = config.parallel.dp
+        self.memory_managers = [
+            make_memory_manager(
+                self.runner.num_pages, config.cache.page_size,
+                config.cache.enable_prefix_caching,
+                ssm_working_slots=getattr(self.runner,
+                                          "ssm_working_slots", 0),
+                ssm_snapshot_slots=getattr(self.runner,
+                                           "ssm_snapshot_slots", 0))
+            for _ in range(self.dp)]
+        self.memory_manager = self.memory_managers[0]
         self.runner.memory_manager = self.memory_manager
-        self.scheduler = Scheduler(config, self.memory_manager,
-                                   pp_size=config.parallel.pp)
+        self.schedulers = [Scheduler(config, mm,
+                                     pp_size=config.parallel.pp)
+                           for mm in self.memory_managers]
+        self.scheduler = self.schedulers[0]
+        self._rr = 0
+        self._seq_replica: dict = {}
         self.eos_token_ids = frozenset(model_cfg.eos_token_ids)
         if not self.eos_token_ids and self.tokenizer is not None \
                 and self.tokenizer.eos_token_id is not None:
@@ -128,6 +144,24 @@ class LLM:
             raise ValueError("no tokenizer available; pass prompt_token_ids")
         return self.tokenizer.encode(prompt)
 
+    def add_seq(self, seq: Sequence) -> None:
+        """Admit a sequence, round-robining over DP replicas."""
+        sp = seq.sampling_params
+        if (self.dp > 1 or self.config.parallel.pp > 1) \
+                and (sp.logprobs is not None
+                     or sp.prompt_logprobs is not None):
+            raise ValueError(
+                "logprobs are not supported with dp/pp > 1 yet")
+        r = self._rr % self.dp
+        self._rr += 1
+        self._seq_replica[seq.seq_id] = r
+        self.schedulers[r].add_seq(seq)
+
+    @property
+    def has_unfinished(self) -> bool:
+        return any(s.has_unfinished for s in self.schedulers) \
+            or bool(self._in_flight)
+
     # ---- main loops -------------------------------------------------------
 
     def step(self) -> List[SeqOutput]:
@@ -139,6 +173,8 @@ class LLM:
         launch-one/collect-one, with jax async dispatch hiding host work
         behind the device step.
         """
+        if self.dp > 1:
+            return self._step_dp()
         depth = max(1, self.config.parallel.pp)
         overlap = (self.config.overlap_scheduling
                    and self.config.parallel.pp == 1)
@@ -162,9 +198,97 @@ class LLM:
         if not self._in_flight:
             return []
         batch, handle = self._in_flight.popleft()
-        tokens = self.runner.collect(handle)
-        return self.scheduler.process_output(batch, tokens.tolist(),
+        tokens, aux = self.runner.collect(handle)
+        if aux:
+            # before process_output: ScheduledSeq.samples reads the seq's
+            # CURRENT token count, which process_output advances
+            self._record_logprobs(batch, aux)
+        outs = self.scheduler.process_output(batch, tokens.tolist(),
                                              self.eos_token_ids)
+        self._check_stop_strings(outs)
+        return outs
+
+    def _step_dp(self) -> List[SeqOutput]:
+        """One synchronous step over all DP replicas (single jit program;
+        idle replicas run dummy batches inside it)."""
+        batches = [s.schedule_once() for s in self.schedulers]
+        if all(b is None for b in batches):
+            return []
+        handle = self.runner.step_async_dp(batches)
+        rows = self.runner.collect_dp(handle)
+        outs: List[SeqOutput] = []
+        for sched, b, row in zip(self.schedulers, batches, rows):
+            if b is not None:
+                outs.extend(sched.process_output(b, row.tolist(),
+                                                 self.eos_token_ids))
+        self._check_stop_strings(outs)
+        return outs
+
+    def _record_logprobs(self, batch, aux) -> None:
+        """Attach per-token logprobs from the step's aux arrays to their
+        sequences (reference sampler.py:71-91 → llm_engine logprob lists)."""
+        if "lp" in aux:
+            chosen, top_ids, top_lps = aux["lp"]
+            for i, it in enumerate(batch.items):
+                sp = it.seq.sampling_params
+                if not it.samples or sp.logprobs is None:
+                    continue
+                if it.seq.output_logprobs is None:
+                    it.seq.output_logprobs = []
+                k = sp.logprobs
+                it.seq.output_logprobs.append(
+                    (float(chosen[i]), top_ids[i, :k].tolist(),
+                     top_lps[i, :k].tolist()))
+        if "plp" in aux:
+            chosen, top_ids, top_lps = aux["plp"]
+            off = 0
+            for it in batch.items:
+                n, seq = it.num_new_tokens, it.seq
+                sp = seq.sampling_params
+                if (sp.prompt_logprobs is not None
+                        and it.computed_before < seq.prompt_len):
+                    if seq.prompt_logprobs is None:
+                        # position 0 has no conditional logprob
+                        seq.prompt_logprobs = [None] * seq.prompt_len
+                    k = sp.prompt_logprobs
+                    for j in range(n):
+                        pos = it.computed_before + j + 1
+                        if pos >= seq.prompt_len:
+                            break
+                        row = off + j
+                        seq.prompt_logprobs[pos] = (
+                            float(chosen[row]), top_ids[row, :k].tolist(),
+                            top_lps[row, :k].tolist())
+                off += n
+
+    def _check_stop_strings(self, outs) -> None:
+        """Host-side stop-string matching over the incrementally detokenized
+        output; the response text is truncated BEFORE the match (OpenAI
+        semantics, reference frontend stop handling). Only the tail window
+        (new text plus len(stop)-1 overlap chars) is rescanned per step.
+        Finished seq ids also drop out of the DP routing table here."""
+        for out in outs:
+            seq = out.seq
+            sp = seq.sampling_params
+            if out.finish_reason is not None:
+                self._seq_replica.pop(seq.seq_id, None)
+            if (out.new_token_id is None or out.finish_reason is not None
+                    or not sp.stop or self.tokenizer is None):
+                continue
+            self._stream_detokenize(seq)
+            max_stop = max(len(s) for s in sp.stop)
+            start = max(0, getattr(seq, "_stop_scanned", 0) - max_stop + 1)
+            window = seq.output_text[start:]
+            hit = min((start + idx for idx in (window.find(s)
+                                               for s in sp.stop)
+                       if idx >= 0), default=-1)
+            seq._stop_scanned = len(seq.output_text)
+            if hit >= 0:
+                seq.output_text = seq.output_text[:hit]
+                seq.detok_read_offset = seq.num_tokens  # stop re-detok
+                r = self._seq_replica.pop(seq.seq_id, 0)
+                self.schedulers[r].finish_seq(seq, "stop")
+                out.finish_reason = "stop"
 
     def generate(
         self,
@@ -209,9 +333,9 @@ class LLM:
                     seq.mm = build_mm_state(seq.token_ids, self.model_cfg,
                                             **mi)
         for s in seqs:
-            self.scheduler.add_seq(s)
+            self.add_seq(s)
 
-        while self.scheduler.has_unfinished or self._in_flight:
+        while self.has_unfinished:
             for out in self.step():
                 if out.new_token_id is not None and self.tokenizer is not None:
                     self._stream_detokenize(out.seq)
@@ -278,8 +402,12 @@ class LLM:
                 text += full[len(done):]
                 seq.detok_read_offset = seq.num_tokens
                 seq.output_text = text
-            elif not text:
+            elif not text and seq.detok_read_offset <= seq.prompt_len:
+                # never detokenized (offline batch path); a stop-string
+                # truncation to "" leaves read_offset at num_tokens and
+                # must NOT be undone here
                 text = self.tokenizer.decode(seq.output_token_ids)
+                seq.output_text = text
         return RequestOutput(
             seq_id=seq.seq_id,
             prompt_token_ids=seq.token_ids[:seq.prompt_len],
@@ -288,7 +416,12 @@ class LLM:
             finish_reason=seq.finish_reason,
             num_prompt_tokens=seq.prompt_len,
             num_output_tokens=seq.num_output_tokens,
+            logprobs=seq.output_logprobs,
+            prompt_logprobs=seq.prompt_logprobs,
         )
 
     def abort(self, seq_id: int) -> None:
-        self.scheduler.abort_seq(seq_id)
+        # aborted seqs never emit a finishing SeqOutput — drop the routing
+        # entry here
+        r = self._seq_replica.pop(seq_id, 0)
+        self.schedulers[r].abort_seq(seq_id)

@@ -33,6 +33,14 @@ class StreamChunk:
     # cumulative counts for usage reporting
     num_prompt_tokens: int = 0
     num_output_tokens: int = 0
+    # (chosen_logprob, top_ids, top_logprobs) for this token, when the
+    # request asked for logprobs
+    logprob: Optional[tuple] = None
+    # full per-position prompt logprobs, attached on the finishing chunk
+    prompt_logprobs: Optional[list] = None
+    # authoritative full output text on the finishing chunk (stop-string
+    # truncation may shorten it relative to the streamed deltas)
+    final_text: Optional[str] = None
 
 
 class RequestHandle:
@@ -49,6 +57,41 @@ class RequestHandle:
                 return
 
 
+def deliver_output(llm: LLM, out, handle: RequestHandle,
+                   emitted: dict) -> None:
+    """Turn one SeqOutput into a StreamChunk on the request's queue
+    (shared by the single-host and multi-host serving engines)."""
+    text = ""
+    final_text = None
+    if llm.tokenizer is not None:
+        # the engine step may already have detokenized (stop strings) —
+        # emit the delta of seq.output_text beyond what this handle
+        # already streamed
+        if out.new_token_id is not None:
+            llm._stream_detokenize(out.seq)
+        if out.finish_reason is not None:
+            final_text = llm._finalize(out.seq).text
+        full = out.seq.output_text
+        text = full[emitted.get(out.seq.seq_id, 0):]
+        emitted[out.seq.seq_id] = len(full)
+    if out.new_token_id is not None or out.finish_reason:
+        lp = None
+        if out.new_token_id is not None and out.seq.output_logprobs:
+            lp = out.seq.output_logprobs[-1]
+        handle.chunks.put(StreamChunk(
+            token_id=out.new_token_id,
+            text=text,
+            finish_reason=out.finish_reason,
+            num_prompt_tokens=out.seq.prompt_len,
+            num_output_tokens=out.seq.num_output_tokens,
+            logprob=lp,
+            prompt_logprobs=(out.seq.prompt_logprobs
+                             if out.finish_reason else None),
+            final_text=final_text))
+    if out.finish_reason is not None:
+        emitted.pop(out.seq.seq_id, None)
+
+
 class ServingEngine:
     """Owns the LLM on a dedicated thread; thread-safe submit/abort."""
 
@@ -57,6 +100,7 @@ class ServingEngine:
         self._intake: "queue.Queue" = queue.Queue()
         self._handles: dict[int, RequestHandle] = {}
         self._seqs: dict[int, object] = {}
+        self._emitted: dict[int, int] = {}   # seq_id → chars streamed
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -89,7 +133,7 @@ class ServingEngine:
         return handle
 
     def abort(self, seq_id: int) -> None:
-        self.llm.scheduler.abort_seq(seq_id)
+        self.llm.abort(seq_id)
         self._wake.set()
 
     def shutdown(self) -> None:
@@ -109,11 +153,11 @@ class ServingEngine:
                 except queue.Empty:
                     break
                 try:
-                    llm.scheduler.add_seq(seq)
+                    llm.add_seq(seq)
                 except ValueError as e:
                     self._deliver_error(seq.seq_id, str(e))
                 drained = True
-            if not llm.scheduler.has_unfinished and not llm._in_flight:
+            if not llm.has_unfinished:
                 if not drained:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -128,26 +172,12 @@ class ServingEngine:
                 handle = self._handles.get(out.seq.seq_id)
                 if handle is None:
                     continue
-                text = ""
-                if llm.tokenizer is not None:
-                    if out.new_token_id is not None:
-                        text = llm._stream_detokenize(out.seq)
-                    if out.finish_reason is not None:
-                        # flush text held back by the partial-char check
-                        before = len(out.seq.output_text)
-                        final = llm._finalize(out.seq)
-                        text += final.text[before:]
-                if out.new_token_id is not None or out.finish_reason:
-                    handle.chunks.put(StreamChunk(
-                        token_id=out.new_token_id,
-                        text=text,
-                        finish_reason=out.finish_reason,
-                        num_prompt_tokens=out.seq.prompt_len,
-                        num_output_tokens=out.seq.num_output_tokens))
+                deliver_output(llm, out, handle, self._emitted)
                 if out.finish_reason is not None:
                     with self._lock:
                         self._handles.pop(out.seq.seq_id, None)
                         self._seqs.pop(out.seq.seq_id, None)
+                    self._emitted.pop(out.seq.seq_id, None)
             # aborted sequences never produce a SeqOutput → close their
             # streams here
             self._reap_aborted()

@@ -35,10 +35,10 @@ logger = logging.getLogger(__name__)
 
 class ServerState:
     def __init__(self, llm: LLM, served_model: str,
-                 tool_parser: Optional[str] = None):
+                 tool_parser: Optional[str] = None, engine=None):
         from gllm_tpu.entrypoints.tool_parsers import get_tool_parser
         self.llm = llm
-        self.engine = ServingEngine(llm)
+        self.engine = engine if engine is not None else ServingEngine(llm)
         self.served_model = served_model
         self.start_time = time.time()
         self._profiling = False
@@ -210,11 +210,66 @@ class Handler(BaseHTTPRequestHandler):
 
     # ---- chat / completions ----------------------------------------------
 
+    def _run_choices(self, req, ids, mm_input=None):
+        """Submit best_of sequences, collect all, rank by mean logprob when
+        best_of > n, return the top n collected dicts (reference n/best_of
+        semantics, protocol.py:170-203)."""
+        import dataclasses as dc
+        st = self.state
+        rank = req.best_of > req.n
+        handles = []
+        for i in range(req.best_of):
+            sp = dc.replace(req.sampling)
+            if sp.seed is not None:
+                sp.seed = sp.seed + i
+            if rank and sp.logprobs is None:
+                sp.logprobs = 0      # chosen-logprob only, for ranking
+            handles.append(st.engine.submit(list(ids), sp,
+                                            mm_input=mm_input))
+        results = [self._collect(h) for h in handles]
+        if rank:
+            def score(r):
+                lps = [e[1][0] for e in r["lp"] or [] if e[1] is not None]
+                return sum(lps) / len(lps) if lps else float("-inf")
+            results.sort(key=score, reverse=True)
+        results = results[:req.n]
+        prompt_tokens = results[0]["usage"]["prompt_tokens"] if results \
+            else 0
+        completion = sum(r["usage"]["completion_tokens"] for r in results)
+        return results, proto.usage_dict(prompt_tokens, completion)
+
+    def _decode_one(self, token_id: int) -> str:
+        tok = self.state.llm.tokenizer
+        return tok.decode([token_id]) if tok is not None else str(token_id)
+
     def _chat(self):
         st = self.state
         req = proto.ChatCompletionRequest.from_dict(
             self._read_json(), default_max_tokens=256)
         ids, mm_input = st.encode_chat(req)
+        if req.stream and req.n > 1:
+            raise proto.ProtocolError("stream with n > 1 is not supported")
+        if not req.stream:
+            results, usage = self._run_choices(req, ids, mm_input)
+            choices = []
+            for r in results:
+                text, tool_calls = r["text"], None
+                if req.tools and req.tool_choice != "none":
+                    from gllm_tpu.entrypoints.tool_parsers import (
+                        schemas_from_tools)
+                    text, calls = st.tool_parser.parse(
+                        text, schemas_from_tools(req.tools))
+                    tool_calls = [c.to_openai() for c in calls] or None
+                lp = None
+                if req.sampling.logprobs is not None:
+                    lp = proto.chat_logprobs_content(r["lp"],
+                                                     self._decode_one)
+                choices.append({"text": text,
+                                "finish_reason": r["finish"],
+                                "tool_calls": tool_calls, "logprobs": lp})
+            self._json(proto.chat_completion_response(req.model, choices,
+                                                      usage))
+            return
         handle = st.engine.submit(list(ids), req.sampling,
                                   mm_input=mm_input)
         parse_tools = bool(req.tools) and req.tool_choice != "none"
@@ -226,10 +281,11 @@ class Handler(BaseHTTPRequestHandler):
             self._sse_start()
             self._sse(proto.chat_completion_chunk(rid, req.model, None, None,
                                                   role=True))
-            text, fin, usage = self._collect(handle)
+            r = self._collect(handle)
             from gllm_tpu.entrypoints.tool_parsers import schemas_from_tools
             text, calls = st.tool_parser.parse(
-                text, schemas_from_tools(req.tools))
+                r["text"], schemas_from_tools(req.tools))
+            fin = r["finish"]
             chunk = proto.chat_completion_chunk(rid, req.model, text or None,
                                                 None)
             if calls:
@@ -248,46 +304,64 @@ class Handler(BaseHTTPRequestHandler):
                                                   role=True))
             self._stream(handle, lambda text, fin: proto.
                          chat_completion_chunk(rid, req.model, text, fin))
-        else:
-            text, fin, usage = self._collect(handle)
-            tool_calls = None
-            if req.tools and req.tool_choice != "none":
-                from gllm_tpu.entrypoints.tool_parsers import (
-                    schemas_from_tools)
-                text, calls = st.tool_parser.parse(
-                    text, schemas_from_tools(req.tools))
-                tool_calls = [c.to_openai() for c in calls] or None
-            self._json(proto.chat_completion_response(req.model, text, fin,
-                                                      usage, tool_calls))
 
     def _completion(self):
         st = self.state
         req = proto.CompletionRequest.from_dict(
             self._read_json(), default_max_tokens=256)
         ids = st.encode_completion(req)
-        handle = st.engine.submit(ids, req.sampling)
+        if req.stream and req.n > 1:
+            raise proto.ProtocolError("stream with n > 1 is not supported")
         if req.stream:
+            handle = st.engine.submit(ids, req.sampling)
             rid = proto.new_request_id(chat=False)
             self._sse_start()
             self._stream(handle, lambda text, fin: proto.completion_chunk(
                 rid, req.model, text or "", fin))
-        else:
-            text, fin, usage = self._collect(handle)
+            return
+        results, usage = self._run_choices(req, ids)
+        choices = []
+        for r in results:
+            text = r["text"]
+            lp = None
+            if req.sampling.logprobs is not None \
+                    or req.sampling.prompt_logprobs is not None:
+                entries = []
+                offset0 = 0
+                if req.echo and r["plp"] is not None:
+                    entries.extend(
+                        (tid, e) for tid, e in zip(ids, r["plp"]))
+                lp_list = r["lp"] or []
+                entries.extend(lp_list)
+                lp = proto.completion_logprobs(entries, self._decode_one,
+                                               offset0)
             if req.echo and isinstance(req.prompt, str):
                 text = req.prompt + text
-            self._json(proto.completion_response(req.model, text, fin,
-                                                 usage))
+            choices.append({"text": text, "finish_reason": r["finish"],
+                            "logprobs": lp})
+        self._json(proto.completion_response(req.model, choices, usage))
 
     def _collect(self, handle):
-        text_parts, finish, usage = [], "stop", proto.usage_dict(0, 0)
+        """Drain one request's stream → {"text", "finish", "usage", "lp"
+        [(token_id, entry)], "plp"}."""
+        text_parts, finish = [], "stop"
+        usage = proto.usage_dict(0, 0)
+        lp, plp, final_text = [], None, None
         for chunk in handle:
             if chunk.text:
                 text_parts.append(chunk.text)
+            if chunk.token_id is not None and chunk.logprob is not None:
+                lp.append((chunk.token_id, chunk.logprob))
             if chunk.finish_reason is not None:
                 finish = chunk.finish_reason
                 usage = proto.usage_dict(chunk.num_prompt_tokens,
                                          chunk.num_output_tokens)
-        return "".join(text_parts), finish, usage
+                plp = chunk.prompt_logprobs
+                final_text = chunk.final_text
+        text = final_text if final_text is not None \
+            else "".join(text_parts)
+        return {"text": text, "finish": finish,
+                "usage": usage, "lp": lp or None, "plp": plp}
 
     def _stream(self, handle, make_chunk):
         try:
@@ -427,26 +501,40 @@ def serve(llm: LLM, host: str, port: int,
 def main(argv=None):
     logging.basicConfig(level=logging.INFO)
     args = make_parser().parse_args(argv)
+    multihost = False
     if args.num_hosts > 1 or args.coordinator_address:
         from gllm_tpu.parallel.multihost import init_multihost
         init_multihost(args.coordinator_address, args.num_hosts,
                        args.host_id)
         import jax
-        if jax.process_count() > 1:
-            # Serving over a multi-controller pod needs a host-0 frontend
-            # with request broadcast so every process issues identical jit
-            # programs (the role the reference's zmq master/slave plane
-            # plays). That layer lands next; refuse to half-work.
-            raise SystemExit(
-                "multi-host serving is not wired up yet: "
-                "jax.distributed initialized with "
-                f"{jax.process_count()} processes")
+        multihost = jax.process_count() > 1
     llm = LLM(config=build_engine_config(args))
     if not args.skip_warmup:
         llm.runner.warmup()
-    httpd = serve(llm, args.host, args.port,
-                  args.served_model_name or args.model,
-                  tool_parser=args.tool_call_parser)
+    if multihost:
+        # Host 0 runs the HTTP frontend + broadcasts every tick's intake;
+        # followers mirror the deterministic engine loop so all processes
+        # issue identical jit programs (the role of the reference's zmq
+        # master/slave plane, comm.py:191-319).
+        import jax
+
+        from gllm_tpu.parallel.multihost_engine import (
+            MultihostEngine, MultihostServingEngine)
+        if jax.process_index() != 0:
+            logger.info("follower %d joined; mirroring engine loop",
+                        jax.process_index())
+            MultihostEngine(llm).run_follower()
+            return
+        state = ServerState(llm, args.served_model_name or args.model,
+                            tool_parser=args.tool_call_parser,
+                            engine=MultihostServingEngine(llm))
+        handler = type("BoundHandler", (Handler,), {"state": state})
+        httpd = ThreadingHTTPServer((args.host, args.port), handler)
+        httpd.state = state
+    else:
+        httpd = serve(llm, args.host, args.port,
+                      args.served_model_name or args.model,
+                      tool_parser=args.tool_call_parser)
     logger.info("serving %s on %s:%d", args.model, args.host, args.port)
     try:
         httpd.serve_forever()

@@ -36,16 +36,28 @@ def _get(d: dict, key: str, typ, default=None, required=False):
 
 
 def sampling_from_request(d: dict, default_max_tokens: int) -> SamplingParams:
+    stop = d.get("stop")
+    if isinstance(stop, str):
+        stop = [stop]
+    elif stop is None:
+        stop = []
+    elif not (isinstance(stop, list)
+              and all(isinstance(s, str) for s in stop)):
+        raise ProtocolError("stop must be a string or list of strings")
     sp = SamplingParams(
         temperature=_get(d, "temperature", float, 1.0),
         top_p=_get(d, "top_p", float, 1.0),
         top_k=_get(d, "top_k", int, -1),
         repetition_penalty=_get(d, "repetition_penalty", float, 1.0),
+        presence_penalty=_get(d, "presence_penalty", float, 0.0),
+        frequency_penalty=_get(d, "frequency_penalty", float, 0.0),
         max_tokens=_get(d, "max_tokens", int,
                         _get(d, "max_completion_tokens", int,
                              default_max_tokens)),
         ignore_eos=_get(d, "ignore_eos", bool, False),
         stop_token_ids=_get(d, "stop_token_ids", list, []),
+        stop=stop,
+        prompt_logprobs=_get(d, "prompt_logprobs", int, None),
         seed=_get(d, "seed", int, None),
     )
     logprobs = d.get("logprobs")
@@ -60,6 +72,14 @@ def sampling_from_request(d: dict, default_max_tokens: int) -> SamplingParams:
     return sp
 
 
+def n_best_of(d: dict):
+    n = _get(d, "n", int, 1)
+    best_of = _get(d, "best_of", int, n)
+    if n < 1 or best_of < n:
+        raise ProtocolError("need n >= 1 and best_of >= n")
+    return n, best_of
+
+
 @dataclasses.dataclass
 class ChatCompletionRequest:
     messages: List[Dict[str, Any]]
@@ -69,6 +89,8 @@ class ChatCompletionRequest:
     chat_template_kwargs: Dict[str, Any]
     tools: List[Dict[str, Any]]
     tool_choice: Any
+    n: int = 1
+    best_of: int = 1
 
     @classmethod
     def from_dict(cls, d: dict, default_max_tokens: int):
@@ -78,6 +100,7 @@ class ChatCompletionRequest:
         for m in messages:
             if not isinstance(m, dict) or "role" not in m:
                 raise ProtocolError("each message needs a 'role'")
+        n, best_of = n_best_of(d)
         return cls(
             messages=messages,
             model=_get(d, "model", str, ""),
@@ -86,6 +109,7 @@ class ChatCompletionRequest:
             chat_template_kwargs=_get(d, "chat_template_kwargs", dict, {}),
             tools=_get(d, "tools", list, []),
             tool_choice=d.get("tool_choice", "auto"),
+            n=n, best_of=best_of,
         )
 
 
@@ -96,6 +120,8 @@ class CompletionRequest:
     sampling: SamplingParams
     stream: bool
     echo: bool
+    n: int = 1
+    best_of: int = 1
 
     @classmethod
     def from_dict(cls, d: dict, default_max_tokens: int):
@@ -105,12 +131,14 @@ class CompletionRequest:
                 raise ProtocolError("token-array prompt must be ints")
         elif not isinstance(prompt, str):
             raise ProtocolError("prompt must be a string or token array")
+        n, best_of = n_best_of(d)
         return cls(
             prompt=prompt,
             model=_get(d, "model", str, ""),
             sampling=sampling_from_request(d, default_max_tokens),
             stream=_get(d, "stream", bool, False),
             echo=_get(d, "echo", bool, False),
+            n=n, best_of=best_of,
         )
 
 
@@ -126,24 +154,73 @@ def usage_dict(prompt_tokens: int, completion_tokens: int) -> dict:
             "total_tokens": prompt_tokens + completion_tokens}
 
 
-def chat_completion_response(model: str, text: str, finish_reason: str,
-                             usage: dict,
-                             tool_calls: Optional[list] = None) -> dict:
-    message: Dict[str, Any] = {"role": "assistant", "content": text}
-    if tool_calls:
-        message["tool_calls"] = tool_calls
-        message["content"] = text or None
-        finish_reason = "tool_calls"
+def chat_logprobs_content(lp_entries, decode) -> Optional[dict]:
+    """OpenAI chat logprobs shape: {"content": [{token, logprob, bytes,
+    top_logprobs: [...]}, ...]} from our (chosen, top_ids, top_lps)
+    per-token tuples."""
+    if lp_entries is None:
+        return None
+    content = []
+    for tok_id, (chosen, top_ids, top_lps) in lp_entries:
+        tok = decode(tok_id)
+        content.append({
+            "token": tok,
+            "logprob": chosen,
+            "bytes": list(tok.encode()),
+            "top_logprobs": [
+                {"token": decode(i), "logprob": lp,
+                 "bytes": list(decode(i).encode())}
+                for i, lp in zip(top_ids, top_lps)],
+        })
+    return {"content": content}
+
+
+def completion_logprobs(lp_entries, decode, text_offset0: int = 0) \
+        -> Optional[dict]:
+    """OpenAI completions logprobs shape (tokens / token_logprobs /
+    top_logprobs / text_offset)."""
+    if lp_entries is None:
+        return None
+    tokens, token_logprobs, top_logprobs, text_offset = [], [], [], []
+    off = text_offset0
+    for tok_id, entry in lp_entries:
+        tok = decode(tok_id)
+        tokens.append(tok)
+        text_offset.append(off)
+        off += len(tok)
+        if entry is None:            # first prompt position
+            token_logprobs.append(None)
+            top_logprobs.append(None)
+            continue
+        chosen, top_ids, top_lps = entry
+        token_logprobs.append(chosen)
+        top_logprobs.append({decode(i): lp
+                             for i, lp in zip(top_ids, top_lps)})
+    return {"tokens": tokens, "token_logprobs": token_logprobs,
+            "top_logprobs": top_logprobs, "text_offset": text_offset}
+
+
+def chat_completion_response(model: str, choices: list,
+                             usage: dict) -> dict:
+    """choices: [{"text", "finish_reason", "tool_calls"?, "logprobs"?}]"""
+    out = []
+    for i, c in enumerate(choices):
+        message: Dict[str, Any] = {"role": "assistant",
+                                   "content": c["text"]}
+        finish = c["finish_reason"]
+        if c.get("tool_calls"):
+            message["tool_calls"] = c["tool_calls"]
+            message["content"] = c["text"] or None
+            finish = "tool_calls"
+        out.append({"index": i, "message": message,
+                    "finish_reason": finish,
+                    "logprobs": c.get("logprobs")})
     return {
         "id": _id("chatcmpl"),
         "object": "chat.completion",
         "created": int(time.time()),
         "model": model,
-        "choices": [{
-            "index": 0,
-            "message": message,
-            "finish_reason": finish_reason,
-        }],
+        "choices": out,
         "usage": usage,
     }
 
@@ -166,15 +243,17 @@ def chat_completion_chunk(rid: str, model: str, delta: Optional[str],
     }
 
 
-def completion_response(model: str, text: str, finish_reason: str,
-                        usage: dict) -> dict:
+def completion_response(model: str, choices: list, usage: dict) -> dict:
+    """choices: [{"text", "finish_reason", "logprobs"?}]"""
     return {
         "id": _id("cmpl"),
         "object": "text_completion",
         "created": int(time.time()),
         "model": model,
-        "choices": [{"index": 0, "text": text,
-                     "finish_reason": finish_reason, "logprobs": None}],
+        "choices": [{"index": i, "text": c["text"],
+                     "finish_reason": c["finish_reason"],
+                     "logprobs": c.get("logprobs")}
+                    for i, c in enumerate(choices)],
         "usage": usage,
     }
 

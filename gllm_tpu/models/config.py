@@ -53,6 +53,9 @@ class ModelConfig:
     moe_intermediate_size: int = 0
     num_shared_experts: int = 0
     norm_topk_prob: bool = True
+    # Set by the DP runner: route MoE through the dense masked path (the
+    # ragged grouped GEMM doesn't batch under vmap).
+    moe_force_dense: bool = False
     decoder_sparse_step: int = 1      # every Nth layer is MoE (qwen2-moe)
     mlp_only_layers: Tuple[int, ...] = ()
     shared_expert_intermediate_size: int = 0

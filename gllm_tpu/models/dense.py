@@ -211,6 +211,19 @@ def forward(
     return hidden, residual, KVCache(k_all, v_all)
 
 
+def compute_full_logits(params: Params, hidden: jnp.ndarray,
+                        residual: jnp.ndarray,
+                        cfg: ModelConfig) -> jnp.ndarray:
+    """Logits for EVERY token row [T, V] (prompt-logprob path). Single
+    source of truth for the final-norm + head projection; compute_logits
+    is the [S]-row gather specialization of the same math."""
+    final = hidden + residual
+    normed = rms_norm(final, params["final_norm"], cfg.rms_norm_eps)
+    head = (params["embed"].T if cfg.tie_word_embeddings
+            else params["lm_head"])
+    return shard_hint((normed @ head).astype(jnp.float32), None, None)
+
+
 def compute_logits(params: Params, hidden: jnp.ndarray,
                    residual: jnp.ndarray, batch: StepBatch,
                    cfg: ModelConfig) -> jnp.ndarray:

@@ -57,21 +57,38 @@ def moe_mlp(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     router_logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
     weights, ids = select_experts(router_logits, K, cfg.norm_topk_prob)
 
-    # Sort token-replicas by expert id → contiguous per-expert groups.
-    flat_ids = ids.reshape(-1)                          # [T*K]
-    sort_idx = jnp.argsort(flat_ids)                    # [T*K]
-    token_of = sort_idx // K                            # source token rows
-    xs = x[token_of]                                    # [T*K, H]
-    group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
+    if cfg.moe_force_dense:
+        # Under vmap (DP replicas in one program) lax.ragged_dot's batch
+        # rule can't handle the carried-weight layout — fall back to a
+        # masked dense loop over experts. TODO: shard_map over the dp axis
+        # so each replica runs the ragged grouped GEMM natively.
+        combined = jnp.zeros((T, H), jnp.float32)
+        wf = weights.astype(jnp.float32)
+        for e in range(E):
+            ye = qmm(silu_and_mul(jnp.concatenate(
+                [qmm(x, lp["w_gate"][e]), qmm(x, lp["w_up"][e])],
+                axis=-1)), lp["w_down"][e]).astype(jnp.float32)
+            w_e = jnp.sum(jnp.where(ids == e, wf, 0.0), axis=-1)
+            combined = combined + ye * w_e[:, None]
+        combined = combined.astype(x.dtype)
+    else:
+        # Sort token-replicas by expert id → contiguous per-expert groups.
+        flat_ids = ids.reshape(-1)                      # [T*K]
+        sort_idx = jnp.argsort(flat_ids)                # [T*K]
+        token_of = sort_idx // K                        # source token rows
+        xs = x[token_of]                                # [T*K, H]
+        group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
 
-    gate = jax.lax.ragged_dot(xs, lp["w_gate"], group_sizes)
-    up = jax.lax.ragged_dot(xs, lp["w_up"], group_sizes)
-    act = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
-    out = jax.lax.ragged_dot(act, lp["w_down"], group_sizes)  # [T*K, H]
+        gate = jax.lax.ragged_dot(xs, lp["w_gate"], group_sizes)
+        up = jax.lax.ragged_dot(xs, lp["w_up"], group_sizes)
+        act = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
+        out = jax.lax.ragged_dot(act, lp["w_down"],
+                                 group_sizes)           # [T*K, H]
 
-    # Weight by routing prob and scatter-add back to token rows.
-    w_sorted = weights.reshape(-1)[sort_idx][:, None].astype(out.dtype)
-    combined = jnp.zeros((T, H), out.dtype).at[token_of].add(out * w_sorted)
+        # Weight by routing prob and scatter-add back to token rows.
+        w_sorted = weights.reshape(-1)[sort_idx][:, None].astype(out.dtype)
+        combined = jnp.zeros((T, H), out.dtype).at[token_of].add(
+            out * w_sorted)
 
     if cfg.shared_expert_intermediate_size:
         sg = qmm(x, lp["shared_gate_proj"])

@@ -27,6 +27,10 @@ class SamplingMetadata(NamedTuple):
     # penalty > 1 scales positive logits down / negative up for seen tokens.
     repetition_penalty: jnp.ndarray   # [S] f32
     step_key: jnp.ndarray          # PRNG key for this step
+    # OpenAI additive penalties (reference protocol.py): logits -=
+    # presence * (count > 0) + frequency * count.
+    presence_penalty: Optional[jnp.ndarray] = None   # [S] f32
+    frequency_penalty: Optional[jnp.ndarray] = None  # [S] f32
     # Per-seq seeded determinism (reference honors SamplingParams.seed):
     # seed >= 0 → that row's key is a pure function of (seed, out_step),
     # independent of batch composition; seed < 0 → engine step_key.
@@ -34,15 +38,25 @@ class SamplingMetadata(NamedTuple):
     out_step: Optional[jnp.ndarray] = None   # [S] i32 output-token index
 
 
-def apply_repetition_penalty(logits: jnp.ndarray,
-                             presence_mask: Optional[jnp.ndarray],
-                             penalty: jnp.ndarray) -> jnp.ndarray:
-    """presence_mask: [S, V] bool — tokens that appeared in the sequence."""
-    if presence_mask is None:
+def apply_penalties(logits: jnp.ndarray,
+                    token_counts: Optional[jnp.ndarray],
+                    md: "SamplingMetadata") -> jnp.ndarray:
+    """token_counts: [S, V] — occurrence count of each token in the
+    sequence so far. Applies the scaling repetition penalty (reference
+    repetition_penalty.py:40-80) and the OpenAI presence/frequency
+    penalties in one pass."""
+    if token_counts is None:
         return logits
-    p = penalty[:, None]
+    counts = token_counts.astype(jnp.float32)
+    seen = counts > 0
+    p = md.repetition_penalty[:, None]
     penalized = jnp.where(logits > 0, logits / p, logits * p)
-    return jnp.where(presence_mask, penalized, logits)
+    logits = jnp.where(seen, penalized, logits)
+    if md.presence_penalty is not None:
+        logits = logits - md.presence_penalty[:, None] * seen
+    if md.frequency_penalty is not None:
+        logits = logits - md.frequency_penalty[:, None] * counts
+    return logits
 
 
 def _topk_topp_mask(logits: jnp.ndarray, top_k: jnp.ndarray,
@@ -71,11 +85,9 @@ def _topk_topp_mask(logits: jnp.ndarray, top_k: jnp.ndarray,
 
 
 def sample(logits: jnp.ndarray, md: SamplingMetadata,
-           presence_mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+           token_counts: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """logits: [S, V] → sampled token ids [S] int32."""
-    logits = apply_repetition_penalty(logits.astype(jnp.float32),
-                                      presence_mask,
-                                      md.repetition_penalty)
+    logits = apply_penalties(logits.astype(jnp.float32), token_counts, md)
     greedy_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     temp = jnp.maximum(md.temperature, 1e-6)[:, None]

@@ -1,0 +1,254 @@
+"""Multi-host serving: host-0 frontend + deterministic request broadcast.
+
+The reference's master/slave launch keeps one frontend and fans requests to
+worker processes over zmq (/root/reference/gllm/comm.py:191-319,
+llm_engine.py:198-211). Under jax multi-process SPMD the equivalent
+invariant is stronger: EVERY process must issue the SAME sequence of jit
+computations with the same shapes. We get it the single-controller way:
+
+- every host runs an identical engine loop over identical scheduler state;
+- host 0 additionally runs the HTTP frontend; each engine tick it
+  broadcasts the newly-arrived request descriptors (and aborts) to all
+  hosts (two-phase fixed-shape broadcast over the jax collective layer);
+- schedulers are deterministic, so identical intake → identical schedules
+  → identical jit calls on every host. No lockstep barriers beyond the
+  intake broadcast.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import pickle
+import time
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def broadcast_payload(obj) -> object:
+    """Broadcast a picklable object from process 0 to all processes.
+
+    Two-phase (length, then padded payload) so every process presents
+    matching shapes to the collective.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    if jax.process_count() == 1:
+        return obj
+    if jax.process_index() == 0:
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    else:
+        payload = np.zeros(0, np.uint8)
+    n = multihost_utils.broadcast_one_to_all(
+        np.asarray([payload.size], np.int64))
+    size = int(n[0])
+    buf = np.zeros(size, np.uint8)
+    buf[:payload.size] = payload
+    out = multihost_utils.broadcast_one_to_all(buf)
+    return pickle.loads(out.tobytes())
+
+
+@dataclasses.dataclass
+class RequestDesc:
+    """Wire form of one request (frontend → every host)."""
+    seq_id: int
+    token_ids: List[int]
+    sampling: dict                       # dataclasses.asdict(SamplingParams)
+
+
+@dataclasses.dataclass
+class Tick:
+    """One intake broadcast: requests + aborts + shutdown flag."""
+    requests: List[RequestDesc]
+    aborts: List[int]
+    shutdown: bool = False
+
+
+class MultihostEngine:
+    """Runs the engine loop on every host; host 0 feeds it requests.
+
+    Host 0: call ``submit``/``abort`` from frontend threads, run
+    ``run_host0`` on the engine thread. Hosts > 0: call ``run_follower``.
+    Outputs surface only on host 0 (``on_output`` callback).
+    """
+
+    def __init__(self, llm, on_output=None, tick_interval: float = 0.002):
+        import jax
+        self.llm = llm
+        self.on_output = on_output or (lambda out: None)
+        self.tick_interval = tick_interval
+        self.is_host0 = jax.process_index() == 0
+        self._pending: List[RequestDesc] = []
+        self._pending_aborts: List[int] = []
+        self._shutdown = False
+        import threading
+        self._lock = threading.Lock()
+
+    # ---- host-0 frontend side ---------------------------------------------
+
+    def submit(self, token_ids: List[int], sampling_params,
+               on_register=None) -> int:
+        """``on_register(seq_id)`` runs under the intake lock BEFORE the
+        request becomes visible to the engine loop — callers register
+        their output handles there so no chunk can be dropped."""
+        assert self.is_host0
+        with self._lock:
+            seq = self.llm._allocate_seq(list(token_ids), sampling_params)
+            if on_register is not None:
+                on_register(seq.seq_id)
+            self._pending.append(RequestDesc(
+                seq.seq_id, list(token_ids),
+                dataclasses.asdict(sampling_params)))
+            self._seqs = getattr(self, "_seqs", {})
+            self._seqs[seq.seq_id] = seq
+        return seq.seq_id
+
+    def abort(self, seq_id: int) -> None:
+        with self._lock:
+            self._pending_aborts.append(seq_id)
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+
+    # ---- engine loop (every host) -----------------------------------------
+
+    def _apply_tick(self, tick: Tick) -> None:
+        from gllm_tpu.sampling_params import SamplingParams
+        llm = self.llm
+        for rd in tick.requests:
+            if self.is_host0:
+                seq = self._seqs.pop(rd.seq_id, None)
+            else:
+                sp = SamplingParams(**rd.sampling)
+                seq = llm._allocate_seq(rd.token_ids, sp)
+                # keep seq-id allocation identical across hosts
+                seq.seq_id = rd.seq_id
+            try:
+                llm.add_seq(seq)
+            except ValueError as e:
+                # deterministic on every host (same validation) — only
+                # host 0 reports
+                if self.is_host0:
+                    self.on_output(("error", rd.seq_id, str(e)))
+        for sid in tick.aborts:
+            llm.abort(sid)
+
+    def _loop(self) -> None:
+        llm = self.llm
+        while True:
+            if self.is_host0:
+                with self._lock:
+                    tick = Tick(self._pending, self._pending_aborts,
+                                self._shutdown)
+                    self._pending = []
+                    self._pending_aborts = []
+            else:
+                tick = None
+            tick = broadcast_payload(tick)
+            if tick.shutdown:
+                return
+            self._apply_tick(tick)
+            if llm.has_unfinished:
+                try:
+                    outs = llm.step()
+                except Exception:
+                    # deterministic loops fail identically on every host;
+                    # report on host 0 and drain to a clean shutdown tick
+                    logger.exception("engine step failed")
+                    if self.is_host0:
+                        self.on_output(("fail", None))
+                        self._shutdown = True
+                    continue
+                if self.is_host0:
+                    for out in outs:
+                        self.on_output(("out", out))
+            else:
+                time.sleep(self.tick_interval)
+
+    def run_host0(self) -> None:
+        assert self.is_host0
+        self._loop()
+
+    def run_follower(self) -> None:
+        assert not self.is_host0
+        self._loop()
+
+
+class MultihostServingEngine:
+    """ServingEngine-compatible frontend over MultihostEngine (host 0).
+
+    The HTTP handlers use the same submit/abort/shutdown surface and
+    per-request chunk queues as the single-host ServingEngine.
+    """
+
+    def __init__(self, llm):
+        import threading
+
+        from gllm_tpu.engine.serving_engine import (RequestHandle,
+                                                    deliver_output)
+        self.llm = llm
+        self._handles = {}
+        self._emitted: dict = {}
+        self._deliver = deliver_output
+        self._make_handle = RequestHandle
+
+        def on_output(evt):
+            from gllm_tpu.engine.serving_engine import StreamChunk
+            if evt[0] == "error":
+                _, sid, reason = evt
+                h = self._handles.pop(sid, None)
+                if h is not None:
+                    h.chunks.put(StreamChunk(None, "", reason or "error"))
+                return
+            if evt[0] == "fail":
+                for h in list(self._handles.values()):
+                    h.chunks.put(StreamChunk(None, "", "error"))
+                self._handles.clear()
+                self._emitted.clear()
+                return
+            out = evt[1]
+            h = self._handles.get(out.seq.seq_id)
+            if h is None:
+                return
+            self._deliver(self.llm, out, h, self._emitted)
+            if out.finish_reason is not None:
+                self._handles.pop(out.seq.seq_id, None)
+
+        self.engine = MultihostEngine(llm, on_output=on_output)
+        self._thread = threading.Thread(target=self.engine.run_host0,
+                                        daemon=True, name="gllm-mh-engine")
+        self._thread.start()
+
+    def submit(self, token_ids, sampling_params, mm_input=None):
+        if mm_input:
+            raise NotImplementedError(
+                "multimodal requests over multi-host are not wired up yet")
+        sampling_params.validate()
+        box = {}
+
+        def on_register(sid):
+            # under the intake lock, before the engine loop can see the
+            # request — no output chunk can race past the handle
+            box["handle"] = self._make_handle(sid, len(token_ids))
+            self._handles[sid] = box["handle"]
+
+        self.engine.submit(token_ids, sampling_params,
+                           on_register=on_register)
+        return box["handle"]
+
+    def abort(self, seq_id: int) -> None:
+        self.engine.abort(seq_id)
+        # aborted seqs produce no further SeqOutput — close the stream now
+        h = self._handles.pop(seq_id, None)
+        self._emitted.pop(seq_id, None)
+        if h is not None:
+            from gllm_tpu.engine.serving_engine import StreamChunk
+            h.chunks.put(StreamChunk(None, "", "abort"))
+
+    def shutdown(self) -> None:
+        self.engine.shutdown()
+        self._thread.join(timeout=10)

@@ -176,7 +176,7 @@ class PPModelRunner(ModelRunner):
         @functools.partial(jax.jit, static_argnames=("max_q_len",),
                            donate_argnums=(1,))
         def stage(params, kv, batch, cos_sin, hidden, residual,
-                  presence_mask, *, max_q_len: int):
+                  token_counts, *, max_q_len: int):
             hidden, residual, kv = fwd(params, kv, batch, scfg,
                                        cos_sin=cos_sin,
                                        attn_impl=attn_impl,
@@ -185,7 +185,7 @@ class PPModelRunner(ModelRunner):
                                        residual_in=residual)
             if scfg.is_last_stage:
                 logits = logits_fn(params, hidden, residual, batch, scfg)
-                tokens = sample(logits, batch.sampling, presence_mask)
+                tokens = sample(logits, batch.sampling, token_counts)
                 return tokens, kv
             return (hidden, residual), kv
 
@@ -214,11 +214,13 @@ class PPModelRunner(ModelRunner):
                                          pm, max_q_len=max_q)
             if not stage.cfg.is_last_stage:
                 hidden, residual = out
-        return out, sched_batch.num_seqs
+        # aux slot kept empty: per-token logprobs are a single-runner
+        # feature for now (last PP stage could compute them the same way).
+        return out, {}, sched_batch.num_seqs
 
     def collect(self, handle):
-        tokens, n = handle
-        return np.asarray(tokens)[:n]
+        tokens, aux, n = handle
+        return np.asarray(tokens)[:n], aux
 
     def step(self, sched_batch) -> np.ndarray:
-        return self.collect(self.step_async(sched_batch))
+        return self.collect(self.step_async(sched_batch))[0]

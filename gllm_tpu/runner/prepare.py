@@ -65,10 +65,72 @@ class BatchBuilder:
         p = bucket_size(max_pages, 4, self.max_pages_per_seq)
         return t, s, q, p
 
-    def build(self, batch: ScheduledBatch, step_key):
-        """Returns (StepBatch, max_q_len, presence_mask_or_None)."""
-        t_pad, s_pad, max_q, p_pad = self.shape_signature(batch)
+    def empty(self, signature, step_key, force_extras=frozenset()):
+        """An all-padding StepBatch of the given signature (idle DP
+        replicas run these so every replica contributes the same jit
+        signature — the TPU analogue of the reference's idle-replica dummy
+        batches, worker.py:750-829). ``force_extras`` must match the live
+        replicas' optional-field structure."""
+        t_pad, s_pad, _, p_pad = signature
+        return StepBatch(
+            token_ids=jnp.zeros(t_pad, jnp.int32),
+            positions=jnp.zeros(t_pad, jnp.int32),
+            slot_mapping=jnp.zeros(t_pad, jnp.int32),
+            logits_indices=jnp.zeros(s_pad, jnp.int32),
+            attn=AttentionMetadata(
+                cu_q_lens=jnp.zeros(s_pad + 1, jnp.int32),
+                kv_lens=jnp.zeros(s_pad, jnp.int32),
+                page_table=jnp.zeros((s_pad, p_pad), jnp.int32),
+                num_seqs=jnp.asarray(0, jnp.int32)),
+            sampling=SamplingMetadata(
+                temperature=jnp.zeros(s_pad, jnp.float32),
+                top_p=jnp.ones(s_pad, jnp.float32),
+                top_k=jnp.full((s_pad,), -1, jnp.int32),
+                repetition_penalty=jnp.ones(s_pad, jnp.float32),
+                step_key=step_key,
+                presence_penalty=(jnp.zeros(s_pad, jnp.float32)
+                                  if "penalties" in force_extras else None),
+                frequency_penalty=(jnp.zeros(s_pad, jnp.float32)
+                                   if "penalties" in force_extras
+                                   else None),
+                seed=(jnp.full((s_pad,), -1, jnp.int32)
+                      if "seed" in force_extras else None),
+                out_step=(jnp.zeros(s_pad, jnp.int32)
+                          if "seed" in force_extras else None)),
+            plp_targets=(jnp.zeros(t_pad, jnp.int32)
+                         if "plp" in force_extras else None),
+        )
+
+    @staticmethod
+    def batch_extras(batch: ScheduledBatch) -> frozenset:
+        """Which optional StepBatch fields this batch populates — DP
+        replicas must agree on the union so stacked pytrees match."""
+        extras = set()
+        for it in batch.items:
+            sp = it.seq.sampling_params
+            if sp.seed is not None:
+                extras.add("seed")
+            if (sp.repetition_penalty != 1.0 or sp.presence_penalty != 0.0
+                    or sp.frequency_penalty != 0.0):
+                extras.add("penalties")
+            if (sp.prompt_logprobs is not None
+                    and it.computed_before < it.seq.prompt_len):
+                extras.add("plp")
+        return frozenset(extras)
+
+    def build(self, batch: ScheduledBatch, step_key,
+              force_signature=None, force_extras=frozenset()):
+        """Returns (StepBatch, max_q_len, token_counts_or_None).
+
+        ``force_signature`` overrides the computed shape buckets and
+        ``force_extras`` forces optional fields to exist (DP replicas must
+        agree on one signature + structure per step)."""
+        t_pad, s_pad, max_q, p_pad = (force_signature
+                                      or self.shape_signature(batch))
         page = self.page_size
+        force_seeded = "seed" in force_extras
+        force_penalties = "penalties" in force_extras
+        force_plp = "plp" in force_extras
 
         tokens = np.zeros(t_pad, np.int32)
         positions = np.zeros(t_pad, np.int32)
@@ -94,6 +156,12 @@ class BatchBuilder:
             mm_mask = np.zeros(t_pad, bool)
         if self.use_ssm:
             ssm_slots = np.zeros(s_pad, np.int32)   # padding → dummy slot 0
+
+        want_plp = force_plp or any(
+            it.seq.sampling_params.prompt_logprobs is not None
+            and it.computed_before < it.seq.prompt_len
+            for it in batch.items)
+        plp_targets = np.zeros(t_pad, np.int32) if want_plp else None
 
         off = 0
         for i, it in enumerate(batch.items):
@@ -122,6 +190,13 @@ class BatchBuilder:
                 out_steps[i] = before + n - seq.prompt_len
             if self.use_ssm:
                 ssm_slots[i] = getattr(seq, "ssm_slot", None) or 0
+            if want_plp and sp.prompt_logprobs is not None:
+                # row at position p scores prompt token p+1
+                nxt = np.asarray(
+                    seq.token_ids[before + 1:
+                                  min(before + n + 1, seq.prompt_len)],
+                    np.int32)
+                plp_targets[off:off + len(nxt)] = nxt
             if self.use_mm:
                 mm = seq.mm
                 if mm is None:
@@ -147,20 +222,32 @@ class BatchBuilder:
             off += n
         cu[len(batch.items) + 1:] = off
 
-        # Scaling repetition penalty needs a token-presence mask
-        # (reference keeps a persistent GPU mask pool,
-        # memory_manager.py:723-828; we build it host-side only for batches
-        # that actually use the feature — TODO: persistent device mask
-        # updated by scatter once penalties are hot).
-        presence_mask = None
-        if self.vocab_size and any(
-                it.seq.sampling_params.repetition_penalty != 1.0
-                for it in batch.items):
-            pm = np.zeros((s_pad, self.vocab_size), bool)
+        # Repetition/presence/frequency penalties need per-token occurrence
+        # counts (reference keeps a persistent GPU mask pool,
+        # memory_manager.py:723-828; we build counts host-side only for
+        # batches that actually use a penalty).
+        token_counts = None
+        pres = freq = None
+
+        def _uses_penalty(sp):
+            return (sp.repetition_penalty != 1.0
+                    or sp.presence_penalty != 0.0
+                    or sp.frequency_penalty != 0.0)
+
+        if self.vocab_size and (force_penalties or any(
+                _uses_penalty(it.seq.sampling_params)
+                for it in batch.items)):
+            tc = np.zeros((s_pad, self.vocab_size), np.int32)
+            pres = np.zeros(s_pad, np.float32)
+            freq = np.zeros(s_pad, np.float32)
             for i, it in enumerate(batch.items):
-                if it.seq.sampling_params.repetition_penalty != 1.0:
-                    pm[i, np.asarray(it.seq.token_ids, np.int64)] = True
-            presence_mask = jnp.asarray(pm)
+                sp = it.seq.sampling_params
+                if _uses_penalty(sp):
+                    np.add.at(tc[i], np.asarray(it.seq.token_ids,
+                                                np.int64), 1)
+                    pres[i] = sp.presence_penalty
+                    freq[i] = sp.frequency_penalty
+            token_counts = jnp.asarray(tc)
 
         step_batch = StepBatch(
             token_ids=jnp.asarray(tokens),
@@ -178,16 +265,24 @@ class BatchBuilder:
                 top_k=jnp.asarray(top_k),
                 repetition_penalty=jnp.asarray(rep_penalty),
                 step_key=step_key,
+                presence_penalty=(jnp.asarray(pres)
+                                  if pres is not None else None),
+                frequency_penalty=(jnp.asarray(freq)
+                                   if freq is not None else None),
                 # None keeps the fused single-draw gumbel path (the common
                 # all-unseeded case); per-row keys only when a request
                 # actually asked for a seed (one extra jit variant).
-                seed=jnp.asarray(seeds) if any_seeded else None,
-                out_step=jnp.asarray(out_steps) if any_seeded else None),
+                seed=(jnp.asarray(seeds)
+                      if any_seeded or force_seeded else None),
+                out_step=(jnp.asarray(out_steps)
+                          if any_seeded or force_seeded else None)),
             mrope_positions=jnp.asarray(mrope) if self.use_mm else None,
             mm_embeds=(jnp.asarray(mm_embeds)
                        if mm_embeds is not None else None),
             mm_mask=(jnp.asarray(mm_mask)
                      if self.use_mm and mm_embeds is not None else None),
             ssm_slots=jnp.asarray(ssm_slots) if self.use_ssm else None,
+            plp_targets=(jnp.asarray(plp_targets)
+                         if plp_targets is not None else None),
         )
-        return step_batch, max_q, presence_mask
+        return step_batch, max_q, token_counts

@@ -40,6 +40,14 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "float16": jnp.float16}
 
 
+def _to_host(x) -> np.ndarray:
+    """Device→host that also works for multi-host global arrays: sampled
+    tokens / logprobs are replicated, so the local shard IS the value."""
+    if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+        return np.asarray(x.addressable_data(0))
+    return np.asarray(x)
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _ssm_apply(conv, rec, snap_src, snap_dst, zero_slots, rest_src,
                rest_dst):
@@ -103,28 +111,46 @@ class ModelRunner:
             specs = self.model_def.param_specs(model_cfg, config.parallel.tp)
             self.params = shard_params(self.params, specs, self.mesh)
 
-        self.num_pages = (config.cache.num_pages
-                          or self.determine_num_pages())
+        self.dp = config.parallel.dp
+        if self.dp > 1 and (model_cfg.use_hybrid or model_cfg.use_mm):
+            raise NotImplementedError(
+                "dp > 1 with hybrid/multimodal models is not wired up yet")
         if model_cfg.use_hybrid:
             # slot 0 dummy + one working slot per live seq + snapshot range
             self.ssm_working_slots = config.max_num_seqs
             self.ssm_snapshot_slots = (
                 config.cache.ssm_snapshot_slots
                 if config.cache.enable_prefix_caching else 0)
+        else:
+            self.ssm_working_slots = self.ssm_snapshot_slots = 0
+        self.num_pages = (config.cache.num_pages
+                          or self.determine_num_pages())
+        if model_cfg.use_hybrid:
             self.kv = self.model_def.init_kv_cache(
                 model_cfg, self.num_pages, config.cache.page_size,
                 self._kv_dtype(),
                 num_slots=(1 + self.ssm_working_slots
                            + self.ssm_snapshot_slots))
         else:
-            self.ssm_working_slots = self.ssm_snapshot_slots = 0
             self.kv = self.model_def.init_kv_cache(
                 model_cfg, self.num_pages, config.cache.page_size,
                 self._kv_dtype())
+        if self.dp > 1:
+            # One KV pool per DP replica, stacked on a leading axis that
+            # shards over the mesh's dp axis (the reference's per-replica
+            # KV caches, llm_engine.py:121-133 — here one program, one
+            # array, GSPMD placement).
+            self.kv = jax.tree.map(
+                lambda a: jnp.zeros((self.dp,) + a.shape, a.dtype),
+                self.kv)
         self.memory_manager = None   # attached by the engine (SSM intents)
         if self.mesh is not None:
-            from jax.sharding import NamedSharding
+            from jax.sharding import NamedSharding, PartitionSpec
             kspecs = self.model_def.kv_specs(model_cfg, config.parallel.tp)
+            if self.dp > 1:
+                kspecs = jax.tree.map(
+                    lambda s: PartitionSpec("dp", *s), kspecs,
+                    is_leaf=lambda x: isinstance(x, PartitionSpec))
             self.kv = jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
                 self.kv, kspecs)
@@ -136,7 +162,8 @@ class ModelRunner:
 
     def _pick_attn_impl(self) -> str:
         impl = self.config.attention_impl
-        tp_sharded = self.mesh is not None and self.config.parallel.tp > 1
+        tp_sharded = self.mesh is not None and (
+            self.config.parallel.tp > 1 or self.config.parallel.dp > 1)
         if impl != "auto":
             if impl == "pallas" and tp_sharded:
                 # TODO: shard_map wrapper so the decode kernel runs
@@ -179,9 +206,7 @@ class ModelRunner:
         cfg = self.model_cfg
         if not cfg.use_hybrid:
             return 0
-        snapshot = (self.config.cache.ssm_snapshot_slots
-                    if self.config.cache.enable_prefix_caching else 0)
-        slots = 1 + self.config.max_num_seqs + snapshot
+        slots = 1 + self.ssm_working_slots + self.ssm_snapshot_slots
         K = cfg.linear_conv_kernel_dim
         per_slot = (cfg.gdn_conv_dim * (K - 1)
                     + cfg.linear_num_value_heads * cfg.linear_key_head_dim
@@ -218,18 +243,66 @@ class ModelRunner:
         logits_fn = self.model_def.compute_logits
         attn_impl = self.attn_impl
 
-        @functools.partial(jax.jit, static_argnames=("max_q_len",),
+        @functools.partial(jax.jit,
+                           static_argnames=("max_q_len", "logprobs_k",
+                                            "prompt_lp"),
                            donate_argnums=(1,))
-        def step(params, kv, batch: StepBatch, cos_sin, presence_mask,
-                 *, max_q_len: int):
+        def step(params, kv, batch: StepBatch, cos_sin, token_counts,
+                 *, max_q_len: int, logprobs_k: int = -1,
+                 prompt_lp: bool = False):
             hidden, residual, kv = fwd(params, kv, batch, cfg,
                                        cos_sin=cos_sin,
                                        attn_impl=attn_impl,
                                        max_q_len=max_q_len)
             logits = logits_fn(params, hidden, residual, batch, cfg)
-            tokens = sample(logits, batch.sampling, presence_mask)
-            return tokens, kv
+            tokens = sample(logits, batch.sampling, token_counts)
+            aux = {}
+            if logprobs_k >= 0:
+                # Output logprobs of the SAMPLED tokens over the
+                # penalty-adjusted distribution (reference sampler.py:71-91)
+                from gllm_tpu.ops.sampling import (apply_penalties,
+                                                   compute_logprobs)
+                lp_logits = apply_penalties(logits, token_counts,
+                                            batch.sampling)
+                aux["lp"] = compute_logprobs(lp_logits, tokens,
+                                             max(logprobs_k, 1))
+            if prompt_lp:
+                # Prompt logprobs: full-position logits against the known
+                # next tokens (targets built host-side; pad rows target 0).
+                from gllm_tpu.models.dense import compute_full_logits
+                from gllm_tpu.ops.sampling import compute_logprobs
+                full_logits = compute_full_logits(params, hidden,
+                                                  residual, cfg)
+                aux["plp"] = compute_logprobs(full_logits,
+                                              batch.plp_targets,
+                                              max(logprobs_k, 1))
+            return tokens, kv, aux
 
+        if self.dp > 1:
+            import dataclasses as _dc
+            cfg_dp = _dc.replace(cfg, moe_force_dense=True)
+
+            @functools.partial(jax.jit, static_argnames=("max_q_len",),
+                               donate_argnums=(1,))
+            def step_dp(params, kv, batch, cos_sin, token_counts, *,
+                        max_q_len: int):
+                def one(kv_r, batch_r, counts_r):
+                    hidden, residual, kv_r = fwd(params, kv_r, batch_r,
+                                                 cfg_dp, cos_sin=cos_sin,
+                                                 attn_impl=attn_impl,
+                                                 max_q_len=max_q_len)
+                    logits = logits_fn(params, hidden, residual, batch_r,
+                                       cfg_dp)
+                    return sample(logits, batch_r.sampling, counts_r), kv_r
+
+                if token_counts is None:
+                    tokens, kv = jax.vmap(
+                        lambda k, b: one(k, b, None))(kv, batch)
+                else:
+                    tokens, kv = jax.vmap(one)(kv, batch, token_counts)
+                return tokens, kv, {}
+
+            self._step_fn_dp = step_dp
         return step
 
     # ---- execution --------------------------------------------------------
@@ -294,6 +367,92 @@ class ModelRunner:
                                r_src, r_dst)
         self.kv = self.kv._replace(conv=conv, rec=rec)
 
+    @staticmethod
+    def _lp_flags(sched_batch: ScheduledBatch):
+        """(logprobs_k, prompt_lp) static flags for this batch."""
+        k = -1
+        want_plp = False
+        for it in sched_batch.items:
+            sp = it.seq.sampling_params
+            if sp.logprobs is not None:
+                k = max(k, sp.logprobs)
+            if (sp.prompt_logprobs is not None
+                    and it.computed_before < it.seq.prompt_len):
+                # only prefill chunks pay the prompt-logprob k; decode
+                # steps of the same request don't widen top-k
+                k = max(k, sp.prompt_logprobs)
+                want_plp = True
+        return k, want_plp
+
+    def step_async_dp(self, sched_batches):
+        """One step over all DP replicas in ONE program: per-replica
+        batches (None → idle dummy batch) are stacked on a leading axis
+        sharded over the mesh's dp axis; the vmapped step runs each
+        replica's forward/sample on its own devices. No cross-replica
+        lockstep barriers needed — it is a single jit program (reference
+        needs dp_all_gather_meta + idle dummy batches, worker.py:750-829).
+
+        Returns a handle; ``collect_dp`` yields per-replica token rows.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        assert len(sched_batches) == self.dp
+        self._apply_ssm_intents()
+        self._step_count += 1
+        base_key = jax.random.fold_in(self.rng_key, self._step_count)
+
+        live = [b for b in sched_batches if b is not None]
+        assert live, "step_async_dp needs at least one non-empty batch"
+        sigs = [self.builder.shape_signature(b) for b in live]
+        sig = tuple(max(s[i] for s in sigs) for i in range(4))
+        max_q = sig[2]
+        # Replicas must agree on optional-field structure too (a seeded
+        # request on one replica vs an idle/unseeded other would otherwise
+        # stack mismatched pytrees).
+        extras = frozenset().union(
+            *[self.builder.batch_extras(b) for b in live])
+
+        parts = []
+        counts_any = False
+        for r, b in enumerate(sched_batches):
+            key = jax.random.fold_in(base_key, r)
+            if b is None:
+                parts.append((self.builder.empty(sig, key, extras), None))
+            else:
+                batch, _, counts = self.builder.build(
+                    b, key, force_signature=sig, force_extras=extras)
+                counts_any = counts_any or counts is not None
+                parts.append((batch, counts))
+        token_counts = None
+        if counts_any:
+            t_shape = (sig[1], self.model_cfg.vocab_size)
+            token_counts = jnp.stack(
+                [c if c is not None else jnp.zeros(t_shape, jnp.int32)
+                 for _, c in parts])
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
+                               *[p[0] for p in parts])
+        if self.mesh is not None:
+            def put(x):
+                spec = P("dp", *([None] * (x.ndim - 1)))
+                return jax.device_put(x, NamedSharding(self.mesh, spec))
+            stacked = jax.tree.map(put, stacked)
+            if token_counts is not None:
+                token_counts = jax.device_put(
+                    token_counts, NamedSharding(self.mesh, P("dp")))
+
+        from gllm_tpu.parallel.mesh import mesh_context
+        with mesh_context(self.mesh):
+            tokens, self.kv, aux = self._step_fn_dp(
+                self.params, self.kv, stacked, self.cos_sin, token_counts,
+                max_q_len=max_q)
+        return tokens, aux, [b.num_seqs if b is not None else 0
+                             for b in sched_batches]
+
+    def collect_dp(self, handle):
+        """Per-replica sampled-token rows: List[np [n_r]]."""
+        tokens, _aux, ns = handle
+        host = np.asarray(tokens)
+        return [host[r, :n] for r, n in enumerate(ns)]
+
     def step_async(self, sched_batch: ScheduledBatch):
         """Launch one step; returns an opaque handle whose tokens are an
         uncommitted device future (jax async dispatch — the host does not
@@ -303,14 +462,15 @@ class ModelRunner:
         self._apply_ssm_intents()
         self._step_count += 1
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
-        batch, max_q, presence_mask = self.builder.build(sched_batch,
-                                                         step_key)
+        batch, max_q, token_counts = self.builder.build(sched_batch,
+                                                        step_key)
+        lp_k, want_plp = self._lp_flags(sched_batch)
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
-            tokens, self.kv = self._step_fn(self.params, self.kv, batch,
-                                            self.cos_sin, presence_mask,
-                                            max_q_len=max_q)
-        return tokens, sched_batch.num_seqs
+            tokens, self.kv, aux = self._step_fn(
+                self.params, self.kv, batch, self.cos_sin, token_counts,
+                max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp)
+        return tokens, aux, sched_batch.num_seqs
 
     def step_async_chained(self, sched_batch: ScheduledBatch, prev_handle):
         """Launch a chained decode step whose input tokens are the PREVIOUS
@@ -318,31 +478,37 @@ class ModelRunner:
         FutureMap placeholder resolution, async_utils.py:56-61, without the
         negative-id dance — the sampled-token array is simply spliced in as
         the next step's token_ids)."""
-        prev_tokens, prev_n = prev_handle
+        prev_tokens, _, prev_n = prev_handle
         assert prev_n == sched_batch.num_seqs
         self._apply_ssm_intents()
         self._step_count += 1
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
-        batch, max_q, presence_mask = self.builder.build(sched_batch,
-                                                         step_key)
-        assert max_q == 1 and presence_mask is None
+        batch, max_q, token_counts = self.builder.build(sched_batch,
+                                                        step_key)
+        assert max_q == 1 and token_counts is None
         assert prev_tokens.shape[0] == batch.token_ids.shape[0], \
             (prev_tokens.shape, batch.token_ids.shape)
         batch = batch._replace(token_ids=prev_tokens)
+        lp_k, _ = self._lp_flags(sched_batch)
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
-            tokens, self.kv = self._step_fn(self.params, self.kv, batch,
-                                            self.cos_sin, presence_mask,
-                                            max_q_len=1)
-        return tokens, sched_batch.num_seqs
+            tokens, self.kv, aux = self._step_fn(
+                self.params, self.kv, batch, self.cos_sin, token_counts,
+                max_q_len=1, logprobs_k=lp_k)
+        return tokens, aux, sched_batch.num_seqs
 
-    def collect(self, handle) -> np.ndarray:
-        tokens, n = handle
-        return np.asarray(tokens)[:n]
+    def collect(self, handle):
+        """(sampled tokens [n], aux dict of host arrays or {})."""
+        tokens, aux, n = handle
+        out_aux = {}
+        if aux:
+            out_aux = {k: tuple(_to_host(a) for a in v)
+                       for k, v in aux.items()}
+        return _to_host(tokens)[:n], out_aux
 
     def step(self, sched_batch: ScheduledBatch) -> np.ndarray:
         """Run one step; returns sampled token per batch item (host numpy)."""
-        return self.collect(self.step_async(sched_batch))
+        return self.collect(self.step_async(sched_batch))[0]
 
     def warmup(self, decode_buckets: Optional[Tuple[int, ...]] = None,
                page_buckets: Optional[Tuple[int, ...]] = None):

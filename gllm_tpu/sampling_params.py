@@ -18,10 +18,13 @@ class SamplingParams:
     top_p: float = 1.0
     top_k: int = -1                      # -1 = disabled
     repetition_penalty: float = 1.0
+    presence_penalty: float = 0.0        # OpenAI additive penalties
+    frequency_penalty: float = 0.0
     max_tokens: int = 16
     min_tokens: int = 0
     ignore_eos: bool = False
     stop_token_ids: List[int] = dataclasses.field(default_factory=list)
+    stop: List[str] = dataclasses.field(default_factory=list)  # stop strings
     logprobs: Optional[int] = None       # top-N logprobs per output token
     prompt_logprobs: Optional[int] = None
     seed: Optional[int] = None
@@ -41,6 +44,15 @@ class SamplingParams:
             raise ValueError("max_tokens must be >= 1")
         if self.repetition_penalty <= 0:
             raise ValueError("repetition_penalty must be > 0")
+        if not -2.0 <= self.presence_penalty <= 2.0:
+            raise ValueError("presence_penalty must be in [-2, 2]")
+        if not -2.0 <= self.frequency_penalty <= 2.0:
+            raise ValueError("frequency_penalty must be in [-2, 2]")
+        if self.logprobs is not None and not 0 <= self.logprobs <= 20:
+            raise ValueError("logprobs must be in [0, 20]")
+        if self.prompt_logprobs is not None \
+                and not 0 <= self.prompt_logprobs <= 20:
+            raise ValueError("prompt_logprobs must be in [0, 20]")
         if self.seed is not None:
             if self.seed < 0:
                 raise ValueError("seed must be >= 0")

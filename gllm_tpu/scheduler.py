@@ -365,8 +365,10 @@ class Scheduler:
             seq = it.seq
             if not it.samples or seq.seq_id in self._aborted_ids:
                 return None
-            if seq.sampling_params.repetition_penalty != 1.0:
-                return None  # needs a host-built presence mask
+            sp = seq.sampling_params
+            if (sp.repetition_penalty != 1.0 or sp.presence_penalty != 0.0
+                    or sp.frequency_penalty != 0.0):
+                return None  # needs host-built token counts
             computed_next = it.computed_before + it.num_new_tokens
             # Output length after prev's token is appended; chaining a seq
             # that will finish by max_tokens would waste a step AND change
@@ -444,6 +446,20 @@ class Scheduler:
                     self.mm.free_seq(seq)
             outputs.append(SeqOutput(seq, new_token, finish))
         return outputs
+
+    def finish_seq(self, seq: Sequence, reason: str = "stop") -> None:
+        """Finish a RUNNING seq from outside the output path (host-side
+        stop-string match — the reference finishes these in the frontend).
+        Same page bookkeeping as an EOS finish."""
+        if seq.status is not SequenceStatus.RUNNING:
+            return
+        seq.status = SequenceStatus.FINISHED
+        seq.finish_reason = reason
+        self.running.remove(seq)
+        if seq.num_in_flight > 0:
+            self._deferred_free.add(seq)
+        else:
+            self.mm.free_seq(seq)
 
     # ---- aborts / stats ---------------------------------------------------
 

@@ -65,6 +65,11 @@ class Sequence:
         # Multimodal state (gllm_tpu/engine/mm.py MMState) or None for
         # text-only requests.
         self.mm = None
+        # Logprob accumulators (filled by the engine when requested):
+        # output_logprobs[i] = (chosen, top_ids, top_lps) for output token
+        # i; prompt_logprobs[p] likewise per prompt position (0 → None).
+        self.output_logprobs = None
+        self.prompt_logprobs = None
 
     @property
     def cache_token_ids(self) -> List[int]:

@@ -1,0 +1,123 @@
+"""Subprocess entry for the 2-process multi-host smoke test.
+
+Usage: python tests/multihost_worker.py <coordinator_port> <num_procs>
+       <proc_id> <model_dir> <result_path>
+
+Every process joins a jax.distributed CPU cluster (2 virtual devices
+each → a 4-device global mesh with tp=2 over DCN-emulated collectives),
+builds the SAME engine, and runs the MultihostEngine loop. Process 0
+submits two requests and writes the outputs to result_path.
+"""
+
+import json
+import os
+import sys
+
+
+def main():
+    port, nprocs, pid, model_dir, result_path = sys.argv[1:6]
+    mode = sys.argv[6] if len(sys.argv) > 6 else "engine"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=int(nprocs), process_id=int(pid))
+
+    from gllm_tpu.config import CacheConfig, EngineConfig, ParallelConfig
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.parallel.multihost_engine import MultihostEngine
+    from gllm_tpu.sampling_params import SamplingParams
+
+    # tp spans ALL global devices (2 virtual per process) so the mesh —
+    # and its collectives — cross the process boundary.
+    cfg = EngineConfig(
+        model=model_dir, dtype="float32", max_model_len=64,
+        cache=CacheConfig(page_size=4, num_pages=64),
+        parallel=ParallelConfig(tp=len(jax.devices())))
+    llm = LLM(config=cfg)
+
+    if mode == "http":
+        _run_http(jax, llm, result_path)
+        jax.distributed.shutdown()
+        return
+
+    if jax.process_index() == 0:
+        results = {}
+
+        def on_output(evt):
+            kind = evt[0]
+            if kind == "out":
+                out = evt[1]
+                if out.finish_reason is not None:
+                    seq = out.seq
+                    results[seq.seq_id] = seq.output_token_ids
+
+        eng = MultihostEngine(llm, on_output=on_output)
+        import threading
+        t = threading.Thread(target=eng.run_host0, daemon=True)
+        t.start()
+        sid1 = eng.submit([5, 9, 23],
+                          SamplingParams(temperature=0.0, max_tokens=4,
+                                         ignore_eos=True))
+        sid2 = eng.submit([7, 7],
+                          SamplingParams(temperature=0.0, max_tokens=4,
+                                         ignore_eos=True))
+        import time
+        deadline = time.monotonic() + 120
+        while len(results) < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        eng.shutdown()
+        t.join(timeout=30)
+        with open(result_path, "w") as f:
+            json.dump({"outputs": [results.get(sid1), results.get(sid2)],
+                       "procs": jax.process_count(),
+                       "devices": len(jax.devices())}, f)
+    else:
+        MultihostEngine(llm).run_follower()
+    jax.distributed.shutdown()
+
+
+def _run_http(jax, llm, result_path):
+    """Host 0: HTTP server over MultihostServingEngine; one completion
+    request through the real OpenAI route. Followers mirror the loop."""
+    from gllm_tpu.parallel.multihost_engine import (MultihostEngine,
+                                                    MultihostServingEngine)
+
+    if jax.process_index() != 0:
+        MultihostEngine(llm).run_follower()
+        return
+
+    import http.client
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    from gllm_tpu.entrypoints.api_server import Handler, ServerState
+
+    engine = MultihostServingEngine(llm)
+    state = ServerState(llm, "mh-test", engine=engine)
+    handler = type("BoundHandler", (Handler,), {"state": state})
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    httpd.state = state
+    hport = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    conn = http.client.HTTPConnection("127.0.0.1", hport, timeout=180)
+    conn.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": [5, 9, 23], "max_tokens": 4, "temperature": 0,
+        "ignore_eos": True}),
+        headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    httpd.shutdown()
+    engine.shutdown()
+    with open(result_path, "w") as f:
+        json.dump({"status": resp.status, "body": body}, f)
+
+
+if __name__ == "__main__":
+    main()

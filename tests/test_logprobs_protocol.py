@@ -1,0 +1,241 @@
+"""Logprobs end-to-end + OpenAI protocol completeness (n, best_of, stop
+strings, presence/frequency penalties) — VERDICT r1 item 7."""
+
+import http.client
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(3)
+    d = tmp_path_factory.mktemp("lp_model")
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        max_position_embeddings=256, eos_token_id=0, attention_bias=False))
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+def make_llm(model_dir, **kw):
+    cfg = EngineConfig(model=model_dir, dtype="float32", max_model_len=128,
+                       cache=CacheConfig(page_size=4, num_pages=128), **kw)
+    return LLM(config=cfg)
+
+
+def test_output_logprobs_match_hf(ckpt):
+    model_dir, hf = ckpt
+    llm = make_llm(model_dir)
+    prompt = [5, 17, 93, 41]
+    out = llm.generate(
+        prompt_token_ids=[prompt],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=4,
+                                       logprobs=3, ignore_eos=True))[0]
+    assert out.logprobs is not None and len(out.logprobs) == 4
+    ids = list(prompt)
+    with torch.no_grad():
+        for (chosen, top_ids, top_lps), tok in zip(out.logprobs,
+                                                   out.output_token_ids):
+            logits = hf(torch.tensor([ids])).logits[0, -1]
+            want = torch.log_softmax(logits.float(), -1)
+            assert math.isclose(chosen, float(want[tok]), abs_tol=2e-3)
+            want_top = torch.topk(want, 3)
+            assert top_ids == want_top.indices.tolist()
+            np.testing.assert_allclose(top_lps, want_top.values.numpy(),
+                                       atol=2e-3)
+            ids.append(tok)
+
+
+def test_prompt_logprobs_match_hf(ckpt):
+    model_dir, hf = ckpt
+    llm = make_llm(model_dir)
+    prompt = [5, 17, 93, 41, 7, 30]
+    out = llm.generate(
+        prompt_token_ids=[prompt],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=2,
+                                       prompt_logprobs=2,
+                                       ignore_eos=True))[0]
+    assert out.prompt_logprobs is not None
+    assert out.prompt_logprobs[0] is None
+    with torch.no_grad():
+        logits = hf(torch.tensor([prompt])).logits[0].float()
+        want = torch.log_softmax(logits, -1)
+    for p in range(1, len(prompt)):
+        chosen, top_ids, top_lps = out.prompt_logprobs[p]
+        assert math.isclose(chosen, float(want[p - 1, prompt[p]]),
+                            abs_tol=2e-3), p
+        assert len(top_ids) == 2
+
+
+def test_prompt_logprobs_with_chunked_prefill(ckpt):
+    model_dir, _ = ckpt
+    from gllm_tpu.config import SchedulerConfig
+    cfg = EngineConfig(model=model_dir, dtype="float32", max_model_len=128,
+                       scheduler=SchedulerConfig(max_prefill_tokens=4,
+                                                 min_prefill_tokens=2),
+                       cache=CacheConfig(page_size=4, num_pages=128))
+    llm = LLM(config=cfg)
+    prompt = [5, 17, 93, 41, 7, 30, 2, 9, 77, 15]
+    out = llm.generate(
+        prompt_token_ids=[prompt],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=2,
+                                       prompt_logprobs=1,
+                                       ignore_eos=True))[0]
+    big = make_llm(model_dir).generate(
+        prompt_token_ids=[prompt],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=2,
+                                       prompt_logprobs=1,
+                                       ignore_eos=True))[0]
+    assert out.prompt_logprobs[0] is None and big.prompt_logprobs[0] is None
+    for a, b in zip(out.prompt_logprobs[1:], big.prompt_logprobs[1:]):
+        assert math.isclose(a[0], b[0], abs_tol=2e-3)
+
+
+def test_presence_frequency_penalties_change_output(ckpt):
+    model_dir, _ = ckpt
+    prompt = [[7, 8, 9, 10]]
+    base = make_llm(model_dir).generate(
+        prompt_token_ids=prompt,
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=12,
+                                       ignore_eos=True))[0]
+    pen = make_llm(model_dir).generate(
+        prompt_token_ids=prompt,
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=12,
+                                       ignore_eos=True,
+                                       frequency_penalty=2.0))[0]
+    # the tiny model repeats greedily; a strong frequency penalty breaks it
+    assert base.output_token_ids != pen.output_token_ids
+    assert len(set(pen.output_token_ids)) > len(set(base.output_token_ids))
+
+
+# ---- API server ------------------------------------------------------------
+
+class StubTokenizer:
+    eos_token_id = 0
+
+    def encode(self, text):
+        return [min(ord(c), 120) for c in text][:64]
+
+    def decode(self, ids, skip_special_tokens=False):
+        return "".join(chr(max(32, i % 127)) for i in ids)
+
+    def apply_chat_template(self, messages, add_generation_prompt=True,
+                            **kw):
+        return self.encode(" ".join(str(m.get("content", ""))
+                                    for m in messages) or "hi")
+
+
+@pytest.fixture(scope="module")
+def server(ckpt):
+    from gllm_tpu.entrypoints.api_server import serve
+    model_dir, _ = ckpt
+    llm = make_llm(model_dir)
+    llm.tokenizer = StubTokenizer()
+    httpd = serve(llm, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield port
+    httpd.shutdown()
+    httpd.state.engine.shutdown()
+
+
+def request(port, path, body):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", path, body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = json.loads(resp.read())
+    conn.close()
+    return resp.status, data
+
+
+def test_api_completion_logprobs(server):
+    status, d = request(server, "/v1/completions", {
+        "prompt": [5, 17, 93], "max_tokens": 4, "temperature": 0,
+        "ignore_eos": True, "logprobs": 2})
+    assert status == 200, d
+    lp = d["choices"][0]["logprobs"]
+    assert lp is not None
+    assert len(lp["tokens"]) == 4
+    assert all(isinstance(v, float) for v in lp["token_logprobs"])
+    assert all(len(t) == 2 for t in lp["top_logprobs"])
+
+
+def test_api_chat_logprobs(server):
+    status, d = request(server, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hey"}],
+        "max_tokens": 3, "temperature": 0, "ignore_eos": True,
+        "logprobs": True, "top_logprobs": 2})
+    assert status == 200, d
+    content = d["choices"][0]["logprobs"]["content"]
+    assert len(content) == 3
+    assert all(len(c["top_logprobs"]) == 2 for c in content)
+
+
+def test_api_n_choices(server):
+    status, d = request(server, "/v1/completions", {
+        "prompt": [5, 17, 93], "max_tokens": 4, "temperature": 0,
+        "ignore_eos": True, "n": 3})
+    assert status == 200, d
+    assert len(d["choices"]) == 3
+    assert [c["index"] for c in d["choices"]] == [0, 1, 2]
+    # greedy → all choices identical
+    assert len({c["text"] for c in d["choices"]}) == 1
+    assert d["usage"]["completion_tokens"] == 12
+
+
+def test_api_best_of(server):
+    status, d = request(server, "/v1/completions", {
+        "prompt": [5, 17, 93], "max_tokens": 4, "temperature": 1.0,
+        "ignore_eos": True, "n": 1, "best_of": 3})
+    assert status == 200, d
+    assert len(d["choices"]) == 1
+
+
+def test_api_echo_prompt_logprobs(server):
+    status, d = request(server, "/v1/completions", {
+        "prompt": [5, 17, 93, 41], "max_tokens": 2, "temperature": 0,
+        "ignore_eos": True, "logprobs": 1, "prompt_logprobs": 1,
+        "echo": True})
+    assert status == 200, d
+    lp = d["choices"][0]["logprobs"]
+    assert len(lp["tokens"]) == 6            # 4 prompt + 2 output
+    assert lp["token_logprobs"][0] is None   # first prompt position
+    assert all(isinstance(v, float) for v in lp["token_logprobs"][1:])
+
+
+def test_api_stop_string(server):
+    # find what greedy produces, then stop on a substring of it
+    _, base = request(server, "/v1/completions", {
+        "prompt": [5, 17, 93], "max_tokens": 8, "temperature": 0,
+        "ignore_eos": True})
+    text = base["choices"][0]["text"]
+    assert len(text) >= 3
+    stop = text[1:3]
+    _, d = request(server, "/v1/completions", {
+        "prompt": [5, 17, 93], "max_tokens": 8, "temperature": 0,
+        "ignore_eos": True, "stop": stop})
+    got = d["choices"][0]["text"]
+    assert stop not in got
+    assert d["choices"][0]["finish_reason"] == "stop"
+    assert got == text[:text.find(stop)]
+
+
+def test_api_invalid_params(server):
+    status, d = request(server, "/v1/completions", {
+        "prompt": [1], "n": 2, "best_of": 1})
+    assert status == 400
+    status, d = request(server, "/v1/completions", {
+        "prompt": [1], "presence_penalty": 5.0})
+    assert status == 400

@@ -243,10 +243,10 @@ class TestSampling:
 
     def test_repetition_penalty_discourages_seen_tokens(self):
         logits = jnp.asarray([[2.0, 1.9]])
-        presence = jnp.asarray([[True, False]])
+        counts = jnp.asarray([[1, 0]], jnp.int32)
         md = self._md(1, 0.0)._replace(
             repetition_penalty=jnp.asarray([10.0], jnp.float32))
-        toks = sample(logits, md, presence_mask=presence)
+        toks = sample(logits, md, token_counts=counts)
         assert int(toks[0]) == 1
 
 
