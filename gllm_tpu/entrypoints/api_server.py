@@ -216,7 +216,10 @@ class Handler(BaseHTTPRequestHandler):
         semantics, protocol.py:170-203)."""
         import dataclasses as dc
         st = self.state
-        rank = req.best_of > req.n
+        par = st.llm.config.parallel
+        # Ranking needs per-token logprobs, which dp/pp don't support yet —
+        # degrade to first-n there rather than failing the request.
+        rank = req.best_of > req.n and par.dp == 1 and par.pp == 1
         handles = []
         for i in range(req.best_of):
             sp = dc.replace(req.sampling)

@@ -80,6 +80,16 @@ class ModelConfig:
     def use_mla(self) -> bool:
         return self.kv_lora_rank > 0
 
+    # DeepSeek V3.2 sparse attention (DSA — reference deepseek_v32.py):
+    # lightning indexer scoring + top-k physical-slot selection.
+    index_n_heads: int = 0
+    index_head_dim: int = 0
+    index_topk: int = 0
+
+    @property
+    def use_dsa(self) -> bool:
+        return self.index_topk > 0 and self.index_n_heads > 0
+
     # Multimodal (Qwen-VL family — reference models/qwen2_5_vl.py,
     # rotary_embedding.py:607-706). mrope_section sums to rot_dim/2;
     # vision_config is the raw HF vision sub-config dict, parsed by
@@ -254,6 +264,9 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
         routed_scaling_factor=hf.get("routed_scaling_factor", 1.0) or 1.0,
         n_group=hf.get("n_group", 0) or 0,
         topk_group=hf.get("topk_group", 0) or 0,
+        index_n_heads=hf.get("index_n_heads", 0) or 0,
+        index_head_dim=hf.get("index_head_dim", 0) or 0,
+        index_topk=hf.get("index_topk", 0) or 0,
         scoring_func=hf.get("scoring_func", "softmax") or "softmax",
         topk_method=hf.get("topk_method", "greedy") or "greedy",
         **extra,

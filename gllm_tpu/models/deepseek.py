@@ -43,15 +43,23 @@ Params = dict
 
 
 class LatentKVCache(NamedTuple):
-    """[L, num_pages, page_size, kv_lora_rank + qk_rope_head_dim]."""
+    """latent: [L, num_pages, page_size, kv_lora_rank + qk_rope_head_dim];
+    index_k: parallel DSA indexer-key cache [L, num_pages, page_size,
+    index_head_dim] (V3.2 only — reference store_index_k_fp8 cache)."""
     latent: jnp.ndarray
+    index_k: Optional[jnp.ndarray] = None
 
 
 def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
                   dtype=jnp.bfloat16) -> LatentKVCache:
     width = cfg.kv_lora_rank + cfg.qk_rope_head_dim
-    return LatentKVCache(jnp.zeros(
-        (cfg.num_stage_layers, num_pages, page_size, width), dtype))
+    latent = jnp.zeros(
+        (cfg.num_stage_layers, num_pages, page_size, width), dtype)
+    index_k = None
+    if cfg.use_dsa:
+        index_k = jnp.zeros((cfg.num_stage_layers, num_pages, page_size,
+                             cfg.index_head_dim), dtype)
+    return LatentKVCache(latent, index_k)
 
 
 def make_rope_table(cfg: ModelConfig) -> jnp.ndarray:
@@ -105,17 +113,31 @@ def _moe_block(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
     weights, ids = deepseek_route(logits, lp.get("e_bias"), cfg)
 
-    flat_ids = ids.reshape(-1)
-    sort_idx = jnp.argsort(flat_ids)
-    token_of = sort_idx // K
-    xs = x[token_of]
-    group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
-    gate = jax.lax.ragged_dot(xs, lp["w_gate"], group_sizes)
-    up = jax.lax.ragged_dot(xs, lp["w_up"], group_sizes)
-    act = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
-    out = jax.lax.ragged_dot(act, lp["w_down"], group_sizes)
-    w_sorted = weights.reshape(-1)[sort_idx][:, None].astype(out.dtype)
-    combined = jnp.zeros((T, H), out.dtype).at[token_of].add(out * w_sorted)
+    if cfg.moe_force_dense:
+        # DP vmap path — ragged grouped GEMM has no usable batch rule
+        # (see gllm_tpu/models/moe.py dense fallback).
+        combined = jnp.zeros((T, H), jnp.float32)
+        wf = weights.astype(jnp.float32)
+        for e in range(E):
+            ye = qmm(silu_and_mul(jnp.concatenate(
+                [qmm(x, lp["w_gate"][e]), qmm(x, lp["w_up"][e])],
+                axis=-1)), lp["w_down"][e]).astype(jnp.float32)
+            w_e = jnp.sum(jnp.where(ids == e, wf, 0.0), axis=-1)
+            combined = combined + ye * w_e[:, None]
+        combined = combined.astype(x.dtype)
+    else:
+        flat_ids = ids.reshape(-1)
+        sort_idx = jnp.argsort(flat_ids)
+        token_of = sort_idx // K
+        xs = x[token_of]
+        group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
+        gate = jax.lax.ragged_dot(xs, lp["w_gate"], group_sizes)
+        up = jax.lax.ragged_dot(xs, lp["w_up"], group_sizes)
+        act = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
+        out = jax.lax.ragged_dot(act, lp["w_down"], group_sizes)
+        w_sorted = weights.reshape(-1)[sort_idx][:, None].astype(out.dtype)
+        combined = jnp.zeros((T, H), out.dtype).at[token_of].add(
+            out * w_sorted)
 
     if cfg.n_shared_experts:
         sg = qmm(x, lp["shared_gate_proj"])
@@ -130,9 +152,108 @@ def _moe_block(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
 # MLA attention (absorbed form)
 # ---------------------------------------------------------------------------
 
+def _indexer_topk_slots(lp, x, q_resid, batch: StepBatch, index_cache,
+                        cfg: ModelConfig, cos_sin, *, max_q_len: int):
+    """DSA lightning indexer (reference deepseek_v32.py:86-338): score each
+    query against its sequence's cached indexer keys — ReLU(q·k)·scale
+    weighted per head and summed — causally mask, top-k, and return
+    (updated index cache, [T, k] physical KV slots with -1 padding).
+
+    Indexer rope is NON-interleaved (neox half-split), unlike the main MLA
+    rope; same YaRN table."""
+    from gllm_tpu.ops.rope import apply_rope
+
+    T = x.shape[0]
+    nh, hd = cfg.index_n_heads, cfg.index_head_dim
+    rope = cfg.qk_rope_head_dim
+    md = batch.attn
+
+    q = qmm(q_resid, lp["idx_wq_b"]).reshape(T, nh, hd)
+    k = x @ lp["idx_wk"]                                 # [T, hd]
+    # k_norm is a LayerNorm (weight + bias), unlike the RMSNorms elsewhere.
+    kf = k.astype(jnp.float32)
+    mu = jnp.mean(kf, axis=-1, keepdims=True)
+    var = jnp.mean((kf - mu) ** 2, axis=-1, keepdims=True)
+    k = ((kf - mu) * jax.lax.rsqrt(var + 1e-6)
+         * lp["idx_k_norm_w"].astype(jnp.float32)
+         + lp["idx_k_norm_b"].astype(jnp.float32)).astype(x.dtype)
+
+    q_rot, k_rot = apply_rope(q[..., :rope], k[:, None, :rope],
+                              batch.positions, cos_sin)
+    q = jnp.concatenate([q_rot, q[..., rope:]], axis=-1)
+    k = jnp.concatenate([k_rot[:, 0], k[:, rope:]], axis=-1)
+    # fp32 head weighting with n_heads**-0.5 folded in (reference
+    # head_weights)
+    weights = (x.astype(jnp.float32)
+               @ lp["idx_weights"].astype(jnp.float32)) * nh ** -0.5
+
+    # store this step's keys into the parallel paged index cache
+    P, page, _ = index_cache.shape
+    flat_k = index_cache.reshape(P * page, hd)
+    index_cache = flat_k.at[batch.slot_mapping].set(
+        k.astype(flat_k.dtype)).reshape(index_cache.shape)
+
+    # per-seq gather (same ragged layout as the XLA attention oracle)
+    S, max_pages = md.page_table.shape
+    max_kv = max_pages * page
+    q_lens = md.cu_q_lens[1:] - md.cu_q_lens[:-1]
+    local = jnp.arange(max_q_len, dtype=jnp.int32)
+    q_idx = jnp.clip(md.cu_q_lens[:-1, None] + local[None, :], 0, T - 1)
+    q_valid = local[None, :] < q_lens[:, None]           # [S, Qmax]
+
+    kg = index_cache[md.page_table].reshape(S, max_kv, hd)
+    qg = q[q_idx]                                        # [S, Q, nh, hd]
+    wg = weights[q_idx]                                  # [S, Q, nh]
+    sc = jnp.einsum("sqhd,skd->sqhk", qg.astype(jnp.float32),
+                    kg.astype(jnp.float32)) * hd ** -0.5
+    logits = jnp.einsum("sqhk,sqh->sqk", jax.nn.relu(sc), wg)
+
+    kv_pos = jnp.arange(max_kv, dtype=jnp.int32)
+    q_pos = md.kv_lens[:, None] - q_lens[:, None] + local[None, :]
+    visible = (kv_pos[None, None, :] <= q_pos[:, :, None])
+    visible &= kv_pos[None, None, :] < md.kv_lens[:, None, None]
+    visible &= q_valid[:, :, None]
+    logits = jnp.where(visible, logits, -jnp.inf)
+
+    kk = min(cfg.index_topk, max_kv)
+    top_logits, top_pos = jax.lax.top_k(logits, kk)      # [S, Q, kk]
+    # token position → physical slot; invalid selections → -1
+    slots_all = (md.page_table[:, kv_pos // page] * page
+                 + kv_pos % page)                        # [S, max_kv]
+    sel_slots = jnp.take_along_axis(
+        slots_all[:, None, :].repeat(max_q_len, axis=1), top_pos, axis=2)
+    sel_slots = jnp.where(jnp.isfinite(top_logits), sel_slots, -1)
+
+    # back to the flat token layout [T, kk]
+    flat_sel = jnp.full((T, kk), -1, jnp.int32)
+    src = jnp.where(q_valid[..., None], sel_slots,
+                    -1).reshape(S * max_q_len, kk)
+    flat_sel = flat_sel.at[q_idx.reshape(-1)].max(src.astype(jnp.int32))
+    return index_cache, flat_sel
+
+
+def _sparse_mla(q_full, latent_cache, sel_slots, *, scale, lora):
+    """Attend only the indexer-selected physical slots: gather latent rows
+    per query and run dense attention over [T, k] keys (the role of the
+    reference's sparse FlashMLA kernels; Pallas gather kernel TODO)."""
+    P, page, width = latent_cache.shape
+    flat = latent_cache.reshape(P * page, width)
+    keys = flat[jnp.maximum(sel_slots, 0)]               # [T, k, width]
+    valid = sel_slots >= 0
+    scores = jnp.einsum("thd,tkd->thk", q_full.astype(jnp.float32),
+                        keys.astype(jnp.float32)) * scale
+    scores = jnp.where(valid[:, None, :], scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - jnp.where(jnp.isfinite(m), m, 0.0))
+    p = jnp.where(valid[:, None, :], p, 0.0)
+    denom = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    return jnp.einsum("thk,tkl->thl", p / denom,
+                      keys[..., :lora].astype(jnp.float32))
+
+
 def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
                    cos_sin, *, max_q_len: int, scale: float,
-                   attn_impl: str = "xla"):
+                   attn_impl: str = "xla", index_cache=None):
     T = x.shape[0]
     Hq = cfg.num_heads
     nope, rope, lora = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
@@ -142,6 +263,7 @@ def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
         qa = rms_norm(x @ lp["q_a_proj"], lp["q_a_norm"], cfg.rms_norm_eps)
         q = qmm(qa, lp["q_b_proj"])
     else:
+        qa = x
         q = qmm(x, lp["q_proj"])
     q = q.reshape(T, Hq, nope + rope)
     q_nope, q_pe = q[..., :nope], q[..., nope:]
@@ -163,17 +285,28 @@ def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
                        lp["w_uk"].astype(jnp.float32)).astype(x.dtype)
     q_full = jnp.concatenate([q_lat, q_pe], axis=-1)  # [T, Hq, lora+rope]
 
-    # MQA over the latent cache; values are the latent prefix of the keys
-    # (v_cache=None → the Pallas kernels read v from the k block in VMEM,
-    # one DMA stream; the xla path slices lazily inside its gather).
-    kc = latent_cache[:, :, None, :]                  # [P, page, 1, width]
-    out_lat = paged_attention(q_full, kc, None, batch.attn, scale=scale,
-                              max_q_len=max_q_len, impl=attn_impl,
-                              v_dim=lora)             # [T, Hq, lora]
+    if cfg.use_dsa:
+        # DSA: indexer top-k physical slots, then sparse attention over
+        # only the selected latent rows (reference deepseek_v32.py).
+        index_cache, sel = _indexer_topk_slots(
+            lp, x, qa, batch, index_cache, cfg, cos_sin,
+            max_q_len=max_q_len)
+        out_lat = _sparse_mla(q_full, latent_cache, sel, scale=scale,
+                              lora=lora).astype(x.dtype)
+    else:
+        # MQA over the latent cache; values are the latent prefix of the
+        # keys (v_cache=None → the Pallas kernels read v from the k block
+        # in VMEM, one DMA stream; the xla path slices lazily inside its
+        # gather).
+        kc = latent_cache[:, :, None, :]              # [P, page, 1, width]
+        out_lat = paged_attention(q_full, kc, None, batch.attn,
+                                  scale=scale, max_q_len=max_q_len,
+                                  impl=attn_impl,
+                                  v_dim=lora)         # [T, Hq, lora]
     out = jnp.einsum("thl,hlv->thv", out_lat.astype(jnp.float32),
                      lp["w_uv"].astype(jnp.float32)).astype(x.dtype)
     return (qmm(out.reshape(T, Hq * cfg.v_head_dim), lp["o_proj"]),
-            latent_cache)
+            latent_cache, index_cache)
 
 
 # ---------------------------------------------------------------------------
@@ -202,6 +335,14 @@ def _mla_layer_init(cfg, L, dtype, w, ks):
                            cfg.q_lora_rank ** -0.5)
     else:
         lp["q_proj"] = w(next(ks), (L, H, Hq * (nope + rope)), scale)
+    if cfg.use_dsa:
+        nh, hd = cfg.index_n_heads, cfg.index_head_dim
+        q_in = cfg.q_lora_rank or H
+        lp["idx_wq_b"] = w(next(ks), (L, q_in, nh * hd), q_in ** -0.5)
+        lp["idx_wk"] = w(next(ks), (L, H, hd), scale)
+        lp["idx_k_norm_w"] = jnp.ones((L, hd), dtype)
+        lp["idx_k_norm_b"] = jnp.zeros((L, hd), dtype)
+        lp["idx_weights"] = w(next(ks), (L, H, nh), scale)
     return lp
 
 
@@ -268,35 +409,44 @@ def forward(params, kv: LatentKVCache, batch: StepBatch, cfg: ModelConfig,
         hidden, residual = hidden_in, residual_in
 
     cache = kv.latent
+    icache = kv.index_k if cfg.use_dsa else jnp.zeros((), jnp.float32)
     first, last = cfg.stage_layers
     n_dense = max(0, min(cfg.first_k_dense_replace, last) - first)
 
     def make_step(mlp_fn, layer_offset):
         def layer_step(carry, lp):
-            h, res, cache, li = carry
+            h, res, cache, icache, li = carry
             normed, res = fused_add_rms_norm(h, res, lp["input_norm"],
                                              cfg.rms_norm_eps)
             lc = jax.lax.dynamic_index_in_dim(cache, li, 0, keepdims=False)
-            attn_out, lc = _mla_attention(lp, normed, batch, lc, cfg,
-                                          cos_sin, max_q_len=max_q_len,
-                                          scale=scale, attn_impl=attn_impl)
+            ic = (jax.lax.dynamic_index_in_dim(icache, li, 0,
+                                               keepdims=False)
+                  if cfg.use_dsa else None)
+            attn_out, lc, ic = _mla_attention(
+                lp, normed, batch, lc, cfg, cos_sin, max_q_len=max_q_len,
+                scale=scale, attn_impl=attn_impl, index_cache=ic)
             cache = jax.lax.dynamic_update_index_in_dim(cache, lc, li, 0)
+            if cfg.use_dsa:
+                icache = jax.lax.dynamic_update_index_in_dim(icache, ic,
+                                                             li, 0)
             normed2, res = fused_add_rms_norm(attn_out, res,
                                               lp["post_attn_norm"],
                                               cfg.rms_norm_eps)
-            return (mlp_fn(lp, normed2), res, cache, li + 1), None
+            return (mlp_fn(lp, normed2), res, cache, icache, li + 1), None
         return layer_step
 
     li = jnp.int32(0)
     if "dense_layers" in params:
-        (hidden, residual, cache, li), _ = jax.lax.scan(
-            make_step(dense._mlp, 0), (hidden, residual, cache, li),
+        (hidden, residual, cache, icache, li), _ = jax.lax.scan(
+            make_step(dense._mlp, 0), (hidden, residual, cache, icache,
+                                       li),
             params["dense_layers"])
     if "moe_layers" in params:
-        (hidden, residual, cache, li), _ = jax.lax.scan(
+        (hidden, residual, cache, icache, li), _ = jax.lax.scan(
             make_step(lambda lp, x: _moe_block(lp, x, cfg), n_dense),
-            (hidden, residual, cache, li), params["moe_layers"])
-    return hidden, residual, LatentKVCache(cache)
+            (hidden, residual, cache, icache, li), params["moe_layers"])
+    return hidden, residual, LatentKVCache(
+        cache, icache if cfg.use_dsa else kv.index_k)
 
 
 compute_logits = dense.compute_logits

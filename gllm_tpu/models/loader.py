@@ -290,6 +290,12 @@ def deepseek_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
         "mlp.shared_experts.gate_proj.weight": ("shared_gate_proj", "t"),
         "mlp.shared_experts.up_proj.weight": ("shared_up_proj", "t"),
         "mlp.shared_experts.down_proj.weight": ("shared_down_proj", "t"),
+        # DSA lightning indexer (V3.2, reference deepseek_v32.py:86-233)
+        "self_attn.indexer.wq_b.weight": ("idx_wq_b", "t"),
+        "self_attn.indexer.wk.weight": ("idx_wk", "t"),
+        "self_attn.indexer.k_norm.weight": ("idx_k_norm_w", None),
+        "self_attn.indexer.k_norm.bias": ("idx_k_norm_b", None),
+        "self_attn.indexer.weights_proj.weight": ("idx_weights", "t"),
     }
     expert_leaves = {
         "gate_proj.weight": ("w_gate", "t"),
@@ -342,3 +348,132 @@ def load_deepseek_params(model_dir: str, cfg: ModelConfig,
     template = jax.eval_shape(lambda: deepseek.init_params(cfg, dtype=dtype))
     return _load_params(model_dir, template, deepseek_rules(cfg),
                         progress_cb)
+
+
+# ---------------------------------------------------------------------------
+# EP-pruned / sharding-aware expert loading (reference model_loader.py:363-369
+# skips non-local experts per EP rank; here the same property falls out of
+# building each device's expert shard directly from the checkpoint)
+# ---------------------------------------------------------------------------
+
+# Instrumentation: largest host buffer the EP loader materialized (tests
+# bound peak host RSS with it).
+ep_load_stats = {"max_chunk_bytes": 0}
+
+# (group, leaf) → HF tensor name format, per family. {i}=global layer,
+# {e}=expert id. All expert projections are stored [out, in] → transposed.
+_MOE_EXPERT_FMTS = {
+    ("layers", "w_gate"): ("model.layers.{i}.mlp.experts.{e}."
+                           "gate_proj.weight",
+                           "model.layers.{i}.block_sparse_moe.experts."
+                           "{e}.w1.weight"),
+    ("layers", "w_up"): ("model.layers.{i}.mlp.experts.{e}."
+                         "up_proj.weight",
+                         "model.layers.{i}.block_sparse_moe.experts."
+                         "{e}.w3.weight"),
+    ("layers", "w_down"): ("model.layers.{i}.mlp.experts.{e}."
+                           "down_proj.weight",
+                           "model.layers.{i}.block_sparse_moe.experts."
+                           "{e}.w2.weight"),
+}
+_DEEPSEEK_EXPERT_FMTS = {
+    ("moe_layers", "w_gate"): ("model.layers.{i}.mlp.experts.{e}."
+                               "gate_proj.weight",),
+    ("moe_layers", "w_up"): ("model.layers.{i}.mlp.experts.{e}."
+                             "up_proj.weight",),
+    ("moe_layers", "w_down"): ("model.layers.{i}.mlp.experts.{e}."
+                               "down_proj.weight",),
+}
+
+_EXPERT_LEAVES = ("w_gate", "w_up", "w_down")
+
+
+def load_params_ep(model_dir: str, cfg: ModelConfig, dtype, mesh, specs,
+                   family: str,
+                   progress_cb: Optional[Callable[[int, int], None]] = None,
+                   ) -> dict:
+    """Load an MoE checkpoint with expert stacks built shard-by-shard.
+
+    Non-expert weights stream through the normal rule loop. Expert stacks
+    ([L, E, in, out], sharded on the expert axis) are assembled via
+    ``jax.make_array_from_callback``: jax asks for each device's shard and
+    the callback reads ONLY those experts from the safetensors index — the
+    peak host buffer is one shard, not the full expert stack, and on a
+    multi-host EP mesh each process never touches non-local experts
+    (the reference's EP-pruned loading, model_loader.py:363-369).
+    """
+    from jax.sharding import NamedSharding
+
+    if family == "deepseek":
+        from gllm_tpu.models import deepseek as model_mod
+        rules = deepseek_rules(cfg)
+        fmts = _DEEPSEEK_EXPERT_FMTS
+        first, _ = cfg.stage_layers
+        layer_of = lambda li: li + max(first, cfg.first_k_dense_replace)  # noqa: E731
+    else:
+        from gllm_tpu.models import moe as model_mod
+        rules = moe_rules(cfg)
+        fmts = _MOE_EXPERT_FMTS
+        first, _ = cfg.stage_layers
+        layer_of = lambda li: li + first                  # noqa: E731
+
+    template = jax.eval_shape(
+        lambda: model_mod.init_params(cfg, dtype=dtype))
+
+    def rules_no_experts(name: str):
+        r = rules(name)
+        if r is not None and isinstance(r[0][-1], str) \
+                and r[0][-1] in _EXPERT_LEAVES:
+            return None
+        return r
+
+    host = _load_params(model_dir, template, rules_no_experts, progress_cb)
+    lazy = LazySafetensors(model_dir)
+
+    def place(path_keys, leaf, spec):
+        arr = host
+        for k in path_keys:
+            arr = arr[k]
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    out: dict = {}
+    for group, group_tree in template.items():
+        if not isinstance(group_tree, dict):
+            out[group] = place((group,), None, specs[group])
+            continue
+        out[group] = {}
+        for leaf_name, leaf in group_tree.items():
+            spec = specs[group][leaf_name]
+            if leaf_name not in _EXPERT_LEAVES:
+                out[group][leaf_name] = place((group, leaf_name), leaf,
+                                              spec)
+                continue
+            name_fmts = (fmts.get((group, leaf_name))
+                         or fmts.get(("layers", leaf_name)))
+            shape, ldtype = leaf.shape, leaf.dtype
+
+            def cb(index, _fmts=name_fmts, _shape=shape, _dtype=ldtype,
+                   _layer_of=layer_of):
+                # index: per-dim slices of the requested shard
+                li_sl, e_sl = index[0], index[1]
+                li_range = range(*li_sl.indices(_shape[0]))
+                e_range = range(*e_sl.indices(_shape[1]))
+                buf = np.zeros((len(li_range), len(e_range))
+                               + tuple(_shape[2:]), _dtype)
+                ep_load_stats["max_chunk_bytes"] = max(
+                    ep_load_stats["max_chunk_bytes"], buf.nbytes)
+                for a, li in enumerate(li_range):
+                    for b, e in enumerate(e_range):
+                        t = None
+                        for fmt in _fmts:
+                            nm = fmt.format(i=_layer_of(li), e=e)
+                            if nm in lazy:
+                                t = np.asarray(lazy.get(nm)).T
+                                break
+                        assert t is not None, (li, e, _fmts)
+                        buf[a, b] = t.astype(_dtype)
+                return buf
+
+            out[group][leaf_name] = jax.make_array_from_callback(
+                shape, NamedSharding(mesh, spec), cb)
+    return out

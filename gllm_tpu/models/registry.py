@@ -109,6 +109,7 @@ _MOE_ARCHS = (
 _MLA_ARCHS = (
     "DeepseekV2ForCausalLM",
     "DeepseekV3ForCausalLM",
+    "DeepseekV32ForCausalLM",
 )
 
 _VL_ARCHS = (

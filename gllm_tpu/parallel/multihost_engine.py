@@ -84,6 +84,7 @@ class MultihostEngine:
         self.is_host0 = jax.process_index() == 0
         self._pending: List[RequestDesc] = []
         self._pending_aborts: List[int] = []
+        self._seqs: dict = {}          # host-0: seq_id → allocated Sequence
         self._shutdown = False
         import threading
         self._lock = threading.Lock()
@@ -103,7 +104,6 @@ class MultihostEngine:
             self._pending.append(RequestDesc(
                 seq.seq_id, list(token_ids),
                 dataclasses.asdict(sampling_params)))
-            self._seqs = getattr(self, "_seqs", {})
             self._seqs[seq.seq_id] = seq
         return seq.seq_id
 

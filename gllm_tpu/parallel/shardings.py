@@ -107,7 +107,9 @@ def kv_cache_specs(cfg: ModelConfig, tp: int):
 def latent_kv_specs(cfg: ModelConfig, tp: int):
     """MLA latent cache is MQA-shaped (no head axis) → replicated over tp."""
     from gllm_tpu.models.deepseek import LatentKVCache
-    return LatentKVCache(P(None, None, None, None))
+    return LatentKVCache(
+        P(None, None, None, None),
+        P(None, None, None, None) if cfg.use_dsa else None)
 
 
 def shard_params(params, specs, mesh: Optional[Mesh]):
@@ -160,6 +162,14 @@ def deepseek_param_specs(cfg: ModelConfig, tp: int) -> dict:
             d["q_b_proj"] = P(None, None, h)
         else:
             d["q_proj"] = P(None, None, h)
+        if cfg.use_dsa:
+            # indexer replicates (cheap, per-head scores are summed —
+            # reference keeps it unsharded, deepseek_v32.py:127-131)
+            d["idx_wq_b"] = P(None, None, None)
+            d["idx_wk"] = P(None, None, None)
+            d["idx_k_norm_w"] = P(None, None)
+            d["idx_k_norm_b"] = P(None, None)
+            d["idx_weights"] = P(None, None, None)
         return d
 
     specs: dict = {}

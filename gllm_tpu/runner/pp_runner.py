@@ -33,6 +33,7 @@ import logging
 from typing import List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from gllm_tpu.config import EngineConfig
@@ -121,15 +122,16 @@ class PPModelRunner(ModelRunner):
 
         bounds = split_layers(model_cfg.num_layers, pp,
                               config.parallel.assigned_layers)
-        self.num_pages = config.cache.num_pages or 2048
 
-        self.stages: List[_Stage] = []
+        # Phase 1: load (and optionally quantize) every stage's weights so
+        # page sizing sees the real post-load memory on each stage device.
+        staged = []
         for i, (first, last) in enumerate(bounds):
             scfg = dataclasses.replace(model_cfg, first_layer=first,
                                        last_layer=last)
             stage_devs = devices[i * tp:(i + 1) * tp]
             if tp > 1:
-                from jax.sharding import Mesh, NamedSharding
+                from jax.sharding import Mesh
                 smesh = Mesh(np.asarray(stage_devs).reshape(1, tp),
                              ("dp", "tp"))
             else:
@@ -141,6 +143,26 @@ class PPModelRunner(ModelRunner):
             else:
                 sparams = self.model_def.load_params(config.model, scfg,
                                                      dtype=self.dtype)
+            if config.quantization:
+                from gllm_tpu.ops.quant import (param_bytes,
+                                                quantize_params)
+                before = param_bytes(sparams)
+                qdtype = {"int8": jnp.int8,
+                          "fp8": jnp.float8_e4m3fn}[config.quantization]
+                sparams = quantize_params(sparams, qdtype)
+                logger.info(
+                    "stage %d quantized (%s): %.2f GB -> %.2f GB", i,
+                    config.quantization, before / 1e9,
+                    param_bytes(sparams) / 1e9)
+            staged.append((scfg, stage_devs, smesh, sparams))
+
+        # Phase 2: one shared page count from the TIGHTEST stage device
+        # (page tables are global; honors cache.memory_util).
+        self.num_pages = (config.cache.num_pages
+                          or self._determine_num_pages(bounds, staged))
+
+        self.stages: List[_Stage] = []
+        for i, (scfg, stage_devs, smesh, sparams) in enumerate(staged):
             skv = self.model_def.init_kv_cache(
                 scfg, self.num_pages, config.cache.page_size,
                 self.dtype if config.cache.kv_cache_dtype == "auto"
@@ -165,6 +187,34 @@ class PPModelRunner(ModelRunner):
         self.cos_sin = self.model_def.make_rope_table(model_cfg)
         logger.info("pipeline: %d stages %s × tp=%d, %d KV pages/stage",
                     pp, bounds, tp, self.num_pages)
+
+    def _determine_num_pages(self, bounds, staged) -> int:
+        """Size the shared KV page count from the TIGHTEST stage: every
+        stage's weights are already resident (phase 1), so each stage
+        device's free memory divided by that stage's per-page KV bytes
+        (via the shared _kv_bytes_per_page, with the stage's layer count)
+        bounds its page budget; take the minimum (reference
+        profile-then-size discipline, memory_manager.py:476-526)."""
+        best = None
+        for (scfg, stage_devs, _, _), (first, last) in zip(staged, bounds):
+            try:
+                stats = stage_devs[0].memory_stats()
+                limit = stats["bytes_limit"]
+                in_use = stats["bytes_in_use"]
+            except Exception:
+                return 2048        # CPU / no memory_stats
+            free = limit * self.config.cache.memory_util - in_use
+            free -= 512 * 1024 * 1024      # activation headroom
+            per_page = self._kv_bytes_per_page(n_layers=last - first)
+            num = int(free // per_page)
+            best = num if best is None else min(best, num)
+        min_pages = cdiv(self.config.max_model_len,
+                         self.config.cache.page_size) + 2
+        if best < min_pages:
+            raise RuntimeError(
+                f"not enough device memory for PP KV cache: {best} pages "
+                f"(need >= {min_pages})")
+        return best
 
     # ---- stage programs ---------------------------------------------------
 

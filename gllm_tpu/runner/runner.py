@@ -85,11 +85,27 @@ class ModelRunner:
         self.rng_key = jax.random.key(config.seed)
         self._step_count = 0
 
+        ep_loaded = False
         if params is not None:
             self.params = params
         elif config.load_format == "dummy" or not config.model:
             self.params = self.model_def.init_params(
                 model_cfg, seed=config.seed, dtype=self.dtype)
+        elif (self.mesh is not None
+              and self.model_def.family in ("moe", "deepseek")):
+            # Sharded-aware MoE load: expert stacks are built per device
+            # shard straight from the checkpoint — peak host memory is one
+            # shard, and a multi-host EP mesh never reads non-local
+            # experts (reference EP-pruned loading,
+            # model_loader.py:363-369).
+            from gllm_tpu.models import loader as loader_mod
+            logger.info("loading weights from %s (EP-sharded experts)",
+                        config.model)
+            self.params = loader_mod.load_params_ep(
+                config.model, model_cfg, self.dtype, self.mesh,
+                self.model_def.param_specs(model_cfg, config.parallel.tp),
+                self.model_def.family)
+            ep_loaded = True
         else:
             logger.info("loading weights from %s", config.model)
             self.params = self.model_def.load_params(
@@ -106,7 +122,7 @@ class ModelRunner:
                         config.quantization, before / 1e9,
                         param_bytes(self.params) / 1e9)
 
-        if self.mesh is not None:
+        if self.mesh is not None and not ep_loaded:
             from gllm_tpu.parallel.shardings import shard_params
             specs = self.model_def.param_specs(model_cfg, config.parallel.tp)
             self.params = shard_params(self.params, specs, self.mesh)
@@ -183,22 +199,26 @@ class ModelRunner:
         kd = self.config.cache.kv_cache_dtype
         return self.dtype if kd == "auto" else _DTYPES[kd]
 
-    def _kv_bytes_per_page(self) -> int:
+    def _kv_bytes_per_page(self, n_layers: Optional[int] = None) -> int:
         """Per-DEVICE bytes per page (the cache shards over kv heads when
-        divisible, so each chip holds 1/tp of every page)."""
+        divisible, so each chip holds 1/tp of every page). ``n_layers``
+        overrides the layer count (PP sizes per stage)."""
         cfg, page = self.model_cfg, self.config.cache.page_size
         itemsize = jnp.dtype(self._kv_dtype()).itemsize
         if cfg.use_mla:
             # MLA latent cache: one [lora+rope] row per token, replicated
-            # over tp (MQA-shaped).
+            # over tp (MQA-shaped); DSA adds the parallel index-K cache.
             width = cfg.kv_lora_rank + cfg.qk_rope_head_dim
-            return cfg.num_stage_layers * page * width * itemsize
+            if cfg.use_dsa:
+                width += cfg.index_head_dim
+            return (n_layers or cfg.num_stage_layers) * page * width \
+                * itemsize
         tp = self.config.parallel.tp
         shards = tp if (self.mesh is not None
                         and cfg.num_kv_heads % tp == 0) else 1
         # Hybrid: only the full-attention layers hold paged KV.
-        n_kv_layers = (cfg.num_attn_layers if cfg.use_hybrid
-                       else cfg.num_stage_layers)
+        n_kv_layers = n_layers or (cfg.num_attn_layers if cfg.use_hybrid
+                                   else cfg.num_stage_layers)
         return (2 * n_kv_layers * page * cfg.num_kv_heads
                 * cfg.head_dim * itemsize) // shards
 

@@ -53,6 +53,8 @@ class SamplingParams:
         if self.prompt_logprobs is not None \
                 and not 0 <= self.prompt_logprobs <= 20:
             raise ValueError("prompt_logprobs must be in [0, 20]")
+        if any(not s for s in self.stop):
+            raise ValueError("stop strings must be non-empty")
         if self.seed is not None:
             if self.seed < 0:
                 raise ValueError("seed must be >= 0")

@@ -1,0 +1,84 @@
+"""EP-pruned / sharding-aware MoE weight loading (VERDICT r1 item 10).
+
+Expert stacks are assembled per device shard via make_array_from_callback:
+peak host buffer is bounded by one shard (not the full expert stack), and
+the engine output is byte-identical to the full-host-then-shard path.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from gllm_tpu.config import (CacheConfig, EngineConfig, ParallelConfig)
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.models import loader
+from gllm_tpu.sampling_params import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def moe_ckpt(tmp_path_factory):
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+    torch.manual_seed(12)
+    d = tmp_path_factory.mktemp("ep_moe")
+    Qwen2MoeForCausalLM(Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        moe_intermediate_size=32, shared_expert_intermediate_size=48,
+        num_experts=8, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=256, eos_token_id=0)).save_pretrained(
+        d, safe_serialization=True)
+    return str(d)
+
+
+def run(ckpt, tp):
+    cfg = EngineConfig(
+        model=ckpt, dtype="float32", max_model_len=128,
+        cache=CacheConfig(page_size=4, num_pages=64),
+        parallel=ParallelConfig(tp=tp, enable_ep=True))
+    llm = LLM(config=cfg)
+    return [o.output_token_ids for o in llm.generate(
+        prompt_token_ids=[[7, 3, 56], [99, 14, 2, 8]],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=6,
+                                       ignore_eos=True))]
+
+
+def test_ep_sharded_load_matches_full_load(moe_ckpt):
+    loader.ep_load_stats["max_chunk_bytes"] = 0
+    sharded = run(moe_ckpt, tp=4)
+    assert loader.ep_load_stats["max_chunk_bytes"] > 0  # EP path taken
+    full = run(moe_ckpt, tp=1)                          # single-device path
+    assert sharded == full
+
+
+def test_ep_load_peak_host_buffer_bounded(moe_ckpt):
+    """The biggest host buffer materialized for expert weights must be one
+    tp shard, not the full [L, E, ...] stack."""
+    loader.ep_load_stats["max_chunk_bytes"] = 0
+    run(moe_ckpt, tp=4)
+    # full stack for the largest expert leaf: L*E*H*I*4 bytes
+    full_stack = 2 * 8 * 64 * 32 * 4
+    assert 0 < loader.ep_load_stats["max_chunk_bytes"] <= full_stack // 4
+
+
+def test_ep_load_deepseek(moe_ckpt, tmp_path):
+    """Same discipline for the DeepSeek family (dense+MoE layer groups)."""
+    from tests.test_deepseek import make_ckpt
+    make_ckpt("DeepseekV2ForCausalLM", tmp_path, q_lora_rank=None,
+              topk_method="greedy", n_group=None, topk_group=None,
+              scoring_func="softmax", norm_topk_prob=False)
+
+    def run_ds(tp):
+        cfg = EngineConfig(
+            model=str(tmp_path), dtype="float32", max_model_len=128,
+            cache=CacheConfig(page_size=4, num_pages=128),
+            parallel=ParallelConfig(tp=tp, enable_ep=True))
+        return [o.output_token_ids for o in LLM(config=cfg).generate(
+            prompt_token_ids=[[7, 3, 56, 21]],
+            sampling_params=SamplingParams(temperature=0.0, max_tokens=6,
+                                           ignore_eos=True))]
+
+    loader.ep_load_stats["max_chunk_bytes"] = 0
+    sharded = run_ds(2)
+    assert loader.ep_load_stats["max_chunk_bytes"] > 0
+    assert sharded == run_ds(1)

