@@ -40,7 +40,7 @@ class CacheConfig:
     page_size: int = 16
     memory_util: float = 0.9              # fraction of free HBM given to KV
     num_pages: Optional[int] = None       # explicit override (tests/benchmarks)
-    kv_cache_dtype: str = "auto"          # auto | bfloat16 | float32
+    kv_cache_dtype: str = "auto"          # auto | bfloat16 | float32 | fp8
     enable_prefix_caching: bool = False
     # Hybrid (GDN) models: cached-prefix SSM state slots (reference
     # --max-snapshot-ssm-slots; 0 disables the SSM half of prefix caching)
@@ -80,8 +80,10 @@ class EngineConfig:
     # chain decode steps on-device so the host round trip between decode
     # iterations disappears.
     overlap_scheduling: bool = False
-    # Weight-only quantization: None | "int8" | "fp8" (per-output-channel,
-    # XLA-fused dequant — reference quantization stack SURVEY §2.6)
+    # Quantization: None | "int8" | "fp8" | "int4" (weight-only,
+    # per-output-channel, XLA-fused dequant) | "w8a8" (int8 weights +
+    # per-token int8 activations on the MXU) — reference quantization
+    # stack SURVEY §2.6
     quantization: Optional[str] = None
     enforce_eager: bool = False           # disable donation/async tricks (debug)
     attention_impl: str = "auto"          # auto | pallas | xla
@@ -103,7 +105,7 @@ class EngineConfig:
         ):
             raise ValueError(
                 f"unknown schedule_method {self.scheduler.schedule_method!r}")
-        if self.quantization not in (None, "int8", "fp8"):
+        if self.quantization not in (None, "int8", "fp8", "int4", "w8a8"):
             raise ValueError(
                 f"unknown quantization {self.quantization!r} "
-                "(choices: int8, fp8)")
+                "(choices: int8, fp8, int4, w8a8)")

@@ -464,7 +464,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-pages", type=int, default=None)
     p.add_argument("--kv-cache-dtype", default="auto")
     p.add_argument("--quantization", default=None,
-                   choices=["int8", "fp8"],
+                   choices=["int8", "fp8", "int4", "w8a8"],
                    help="weight-only quantization")
     p.add_argument("--enable-prefix-caching", action="store_true")
     p.add_argument("--overlap-scheduling", action="store_true",

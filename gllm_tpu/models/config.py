@@ -217,6 +217,9 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
                        "Qwen3NextForCausalLM", "Qwen3_5ForCausalLM",
                        "Qwen3_5MoeForCausalLM")
     is_glm4 = arch in ("Glm4ForCausalLM",)
+    # GLM-4 base (GlmForCausalLM): interleaved partial rotary like GLM4
+    # but WITHOUT the sandwich norms
+    is_glm = arch in ("GlmForCausalLM",)
     attention_bias = hf.get("attention_bias",
                             arch in ("Qwen2ForCausalLM",
                                      "Qwen2MoeForCausalLM",
@@ -239,7 +242,7 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
         attention_bias=attention_bias,
         qk_norm=qk_norm,
         partial_rotary_factor=hf.get("partial_rotary_factor", 1.0) or 1.0,
-        rope_interleaved=is_glm4,
+        rope_interleaved=is_glm4 or is_glm,
         sandwich_norms=is_glm4,
         eos_token_id=_eos_tuple(hf.get("eos_token_id")),
         bos_token_id=_first_eos(hf.get("bos_token_id")),

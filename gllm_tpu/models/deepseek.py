@@ -35,7 +35,7 @@ from gllm_tpu.models.moe import select_experts
 from gllm_tpu.ops import (fused_add_rms_norm, paged_attention, rms_norm,
                           silu_and_mul)
 from gllm_tpu.ops.attention import AttentionMetadata
-from gllm_tpu.ops.quant import qmm
+from gllm_tpu.ops.quant import deq, qmm
 from gllm_tpu.ops.rope import (apply_rope_interleaved, compute_rope_cos_sin,
                                yarn_softmax_scale_mult)
 
@@ -113,6 +113,9 @@ def _moe_block(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
     weights, ids = deepseek_route(logits, lp.get("e_bias"), cfg)
 
+    w_gate = deq(lp["w_gate"], x.dtype)
+    w_up = deq(lp["w_up"], x.dtype)
+    w_down = deq(lp["w_down"], x.dtype)
     if cfg.moe_force_dense:
         # DP vmap path — ragged grouped GEMM has no usable batch rule
         # (see gllm_tpu/models/moe.py dense fallback).
@@ -120,8 +123,8 @@ def _moe_block(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
         wf = weights.astype(jnp.float32)
         for e in range(E):
             ye = qmm(silu_and_mul(jnp.concatenate(
-                [qmm(x, lp["w_gate"][e]), qmm(x, lp["w_up"][e])],
-                axis=-1)), lp["w_down"][e]).astype(jnp.float32)
+                [qmm(x, w_gate[e]), qmm(x, w_up[e])],
+                axis=-1)), w_down[e]).astype(jnp.float32)
             w_e = jnp.sum(jnp.where(ids == e, wf, 0.0), axis=-1)
             combined = combined + ye * w_e[:, None]
         combined = combined.astype(x.dtype)
@@ -131,10 +134,10 @@ def _moe_block(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
         token_of = sort_idx // K
         xs = x[token_of]
         group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
-        gate = jax.lax.ragged_dot(xs, lp["w_gate"], group_sizes)
-        up = jax.lax.ragged_dot(xs, lp["w_up"], group_sizes)
+        gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
+        up = jax.lax.ragged_dot(xs, w_up, group_sizes)
         act = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
-        out = jax.lax.ragged_dot(act, lp["w_down"], group_sizes)
+        out = jax.lax.ragged_dot(act, w_down, group_sizes)
         w_sorted = weights.reshape(-1)[sort_idx][:, None].astype(out.dtype)
         combined = jnp.zeros((T, H), out.dtype).at[token_of].add(
             out * w_sorted)

@@ -31,7 +31,7 @@ from gllm_tpu.models import dense
 from gllm_tpu.models.config import ModelConfig
 from gllm_tpu.models.dense import KVCache
 from gllm_tpu.ops import silu_and_mul
-from gllm_tpu.ops.quant import qmm
+from gllm_tpu.ops.quant import deq, qmm
 
 Params = dict
 
@@ -57,6 +57,11 @@ def moe_mlp(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     router_logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
     weights, ids = select_experts(router_logits, K, cfg.norm_topk_prob)
 
+    # Quantized expert stacks dequantize once per call (XLA keeps the
+    # narrow copy in HBM; the dense copy is a fused transient).
+    w_gate = deq(lp["w_gate"], x.dtype)
+    w_up = deq(lp["w_up"], x.dtype)
+    w_down = deq(lp["w_down"], x.dtype)
     if cfg.moe_force_dense:
         # Under vmap (DP replicas in one program) lax.ragged_dot's batch
         # rule can't handle the carried-weight layout — fall back to a
@@ -66,8 +71,8 @@ def moe_mlp(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
         wf = weights.astype(jnp.float32)
         for e in range(E):
             ye = qmm(silu_and_mul(jnp.concatenate(
-                [qmm(x, lp["w_gate"][e]), qmm(x, lp["w_up"][e])],
-                axis=-1)), lp["w_down"][e]).astype(jnp.float32)
+                [qmm(x, w_gate[e]), qmm(x, w_up[e])],
+                axis=-1)), w_down[e]).astype(jnp.float32)
             w_e = jnp.sum(jnp.where(ids == e, wf, 0.0), axis=-1)
             combined = combined + ye * w_e[:, None]
         combined = combined.astype(x.dtype)
@@ -79,10 +84,10 @@ def moe_mlp(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
         xs = x[token_of]                                # [T*K, H]
         group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
 
-        gate = jax.lax.ragged_dot(xs, lp["w_gate"], group_sizes)
-        up = jax.lax.ragged_dot(xs, lp["w_up"], group_sizes)
+        gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
+        up = jax.lax.ragged_dot(xs, w_up, group_sizes)
         act = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
-        out = jax.lax.ragged_dot(act, lp["w_down"],
+        out = jax.lax.ragged_dot(act, w_down,
                                  group_sizes)           # [T*K, H]
 
         # Weight by routing prob and scatter-add back to token rows.

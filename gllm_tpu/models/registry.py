@@ -45,6 +45,7 @@ def _dense_def() -> ModelDef:
 
 _DENSE_ARCHS = (
     "Glm4ForCausalLM",
+    "GlmForCausalLM",
     "LlamaForCausalLM",
     "MistralForCausalLM",
     "Qwen2ForCausalLM",

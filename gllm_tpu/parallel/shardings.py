@@ -120,20 +120,21 @@ def shard_params(params, specs, mesh: Optional[Mesh]):
     axis on size-1 dims (a sharded singleton is impossible)."""
     if mesh is None:
         return params
-    from gllm_tpu.ops.quant import Quantized
+    from gllm_tpu.ops.quant import Quantized, Quantized4, QuantizedW8A8
+    qtypes = (Quantized, Quantized4, QuantizedW8A8)
 
     def place(x, s):
-        if isinstance(x, Quantized):
+        if isinstance(x, qtypes):
             dims = list(s) + [None] * (x.q.ndim - len(s))
             scale_spec = P(*[None if x.scale.shape[i] == 1 else dims[i]
                              for i in range(x.scale.ndim)])
-            return Quantized(
+            return type(x)(
                 jax.device_put(x.q, NamedSharding(mesh, s)),
                 jax.device_put(x.scale, NamedSharding(mesh, scale_spec)))
         return jax.device_put(x, NamedSharding(mesh, s))
 
     return jax.tree.map(place, params, specs,
-                        is_leaf=lambda n: isinstance(n, Quantized))
+                        is_leaf=lambda n: isinstance(n, qtypes))
 
 
 def deepseek_param_specs(cfg: ModelConfig, tp: int) -> dict:

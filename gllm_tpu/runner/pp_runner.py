@@ -147,9 +147,8 @@ class PPModelRunner(ModelRunner):
                 from gllm_tpu.ops.quant import (param_bytes,
                                                 quantize_params)
                 before = param_bytes(sparams)
-                qdtype = {"int8": jnp.int8,
-                          "fp8": jnp.float8_e4m3fn}[config.quantization]
-                sparams = quantize_params(sparams, qdtype)
+                sparams = quantize_params(sparams,
+                                          mode=config.quantization)
                 logger.info(
                     "stage %d quantized (%s): %.2f GB -> %.2f GB", i,
                     config.quantization, before / 1e9,

@@ -37,7 +37,10 @@ from gllm_tpu.utils import bucket_size, cdiv, next_pow2
 logger = logging.getLogger(__name__)
 
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
-           "float16": jnp.float16}
+           "float16": jnp.float16,
+           # fp8 KV storage (MLA latent / dense KV) — reference
+           # concat_and_cache_mla_fp8 packed cache, cache_kernels.py
+           "fp8": jnp.float8_e4m3fn}
 
 
 def _to_host(x) -> np.ndarray:
@@ -115,9 +118,8 @@ class ModelRunner:
         if config.quantization:
             from gllm_tpu.ops.quant import param_bytes, quantize_params
             before = param_bytes(self.params)
-            qdtype = {"int8": jnp.int8,
-                      "fp8": jnp.float8_e4m3fn}[config.quantization]
-            self.params = quantize_params(self.params, qdtype)
+            self.params = quantize_params(self.params,
+                                          mode=config.quantization)
             logger.info("quantized weights (%s): %.2f GB -> %.2f GB",
                         config.quantization, before / 1e9,
                         param_bytes(self.params) / 1e9)

@@ -37,3 +37,38 @@ def test_glm4_greedy_equivalence(tmp_path):
         assert out.output_token_ids == ids[len(p):], (p,
                                                       out.output_token_ids,
                                                       ids[len(p):])
+
+
+def test_glm_base_greedy_equivalence(tmp_path):
+    """GLM-4 base (GlmForCausalLM): interleaved partial rotary + fused
+    gate_up + qkv bias, WITHOUT GLM4's sandwich norms."""
+    from transformers import GlmConfig, GlmForCausalLM
+    torch.manual_seed(17)
+    hf = GlmForCausalLM(GlmConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=96, partial_rotary_factor=0.5,
+        attention_bias=True, max_position_embeddings=256,
+        eos_token_id=0, pad_token_id=0))
+    hf.eval()
+    hf.save_pretrained(tmp_path, safe_serialization=True)
+
+    from gllm_tpu.config import CacheConfig, EngineConfig
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.sampling_params import SamplingParams
+    llm = LLM(config=EngineConfig(
+        model=str(tmp_path), dtype="float32", max_model_len=128,
+        cache=CacheConfig(page_size=4, num_pages=64)))
+    prompts = [[5, 17, 93, 41], [9, 3, 77]]
+    outs = llm.generate(
+        prompt_token_ids=prompts,
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
+                                       ignore_eos=True))
+    import torch as _t
+    for p, o in zip(prompts, outs):
+        ids = list(p)
+        with _t.no_grad():
+            for _ in range(8):
+                logits = hf(_t.tensor([ids])).logits[0, -1]
+                ids.append(int(logits.argmax()))
+        assert o.output_token_ids == ids[len(p):], (p, o.output_token_ids)

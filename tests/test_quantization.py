@@ -108,4 +108,117 @@ def test_deepseek_int8_quantized_runs(tmp_path):
 
 def test_bad_quantization_value_rejected():
     with pytest.raises(ValueError, match="quantization"):
-        EngineConfig(quantization="int4").validate()
+        EngineConfig(quantization="int3").validate()
+
+
+def test_int4_pack_roundtrip():
+    import numpy as np
+
+    from gllm_tpu.ops.quant import deq, quantize_weight_int4
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((2, 16, 8)).astype(np.float32))
+    q4 = quantize_weight_int4(w)
+    assert q4.q.shape == (2, 8, 8)               # packed in-axis
+    back = np.asarray(deq(q4, jnp.float32))
+    # int4 per-output-channel: max error bounded by scale/2
+    scale = np.asarray(q4.scale)
+    assert np.all(np.abs(back - np.asarray(w)) <= scale * 0.51 + 1e-6)
+
+
+@pytest.mark.parametrize("quant", ["int4", "w8a8"])
+def test_engine_int4_w8a8_close_to_full_precision(tmp_path, quant):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(3)
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        max_position_embeddings=128, eos_token_id=0,
+        attention_bias=False)).save_pretrained(tmp_path,
+                                               safe_serialization=True)
+
+    def run(q):
+        cfg = EngineConfig(model=str(tmp_path), dtype="float32",
+                           max_model_len=64, quantization=q,
+                           cache=CacheConfig(page_size=4, num_pages=64))
+        return LLM(config=cfg).generate(
+            prompt_token_ids=[[5, 9, 23, 41]],
+            sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
+                                           ignore_eos=True))[0]
+
+    full = run(None)
+    quantized = run(quant)
+    assert quantized.output_token_ids[:2] == full.output_token_ids[:2]
+    assert len(quantized.output_token_ids) == 8
+
+
+def test_moe_experts_are_quantized_and_close(tmp_path):
+    """Routed expert stacks quantize too (the reference's weight-only path
+    skipped them — VERDICT r1 item 10)."""
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    from gllm_tpu.ops.quant import Quantized, param_bytes
+    torch.manual_seed(9)
+    Qwen2MoeForCausalLM(Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        moe_intermediate_size=32, shared_expert_intermediate_size=48,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=128, eos_token_id=0)).save_pretrained(
+        tmp_path, safe_serialization=True)
+
+    def make(q):
+        cfg = EngineConfig(model=str(tmp_path), dtype="float32",
+                           max_model_len=64, quantization=q,
+                           cache=CacheConfig(page_size=4, num_pages=64))
+        return LLM(config=cfg)
+
+    llm_q = make("int8")
+    assert isinstance(llm_q.runner.params["layers"]["w_gate"], Quantized)
+    llm_f = make(None)
+    assert param_bytes(llm_q.runner.params) < \
+        0.5 * param_bytes(llm_f.runner.params)
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    a = llm_q.generate(prompt_token_ids=[[5, 9, 23]],
+                       sampling_params=sp)[0]
+    b = llm_f.generate(prompt_token_ids=[[5, 9, 23]],
+                       sampling_params=sp)[0]
+    assert a.output_token_ids[:2] == b.output_token_ids[:2]
+
+
+def test_hybrid_gdn_int8_quantized_runs(tmp_path):
+    """Hybrid GDN projections (in_qkvz/out_proj) route through qmm."""
+    from tests.test_hybrid_qwen3next import make_ckpt
+    make_ckpt(tmp_path)
+    cfg = EngineConfig(model=str(tmp_path), dtype="float32",
+                       max_model_len=64, quantization="int8",
+                       cache=CacheConfig(page_size=4, num_pages=64))
+    out = LLM(config=cfg).generate(
+        prompt_token_ids=[[5, 9, 23]],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=4,
+                                       ignore_eos=True))[0]
+    assert len(out.output_token_ids) == 4
+
+
+def test_mla_fp8_kv_cache_close(tmp_path):
+    """fp8 latent-KV storage (reference concat_and_cache_mla_fp8): runs
+    and stays close to the full-precision cache on short greedy runs."""
+    from tests.test_deepseek import make_ckpt
+    make_ckpt("DeepseekV2ForCausalLM", tmp_path, q_lora_rank=None,
+              topk_method="greedy", n_group=None, topk_group=None,
+              scoring_func="softmax", norm_topk_prob=False)
+
+    def run(kv_dtype):
+        cfg = EngineConfig(model=str(tmp_path), dtype="float32",
+                           max_model_len=64,
+                           cache=CacheConfig(page_size=4, num_pages=64,
+                                             kv_cache_dtype=kv_dtype))
+        return LLM(config=cfg).generate(
+            prompt_token_ids=[[7, 3, 56, 21]],
+            sampling_params=SamplingParams(temperature=0.0, max_tokens=6,
+                                           ignore_eos=True))[0]
+
+    full = run("auto")
+    fp8 = run("fp8")
+    assert fp8.output_token_ids[:2] == full.output_token_ids[:2]
+    assert len(fp8.output_token_ids) == 6
