@@ -10,10 +10,12 @@ prefill attends to all cached tokens plus the causal part of its own chunk).
 Two implementations:
 - ``xla``: gather-based reference. Runs on any backend (CPU tests, fallback),
   numerically the oracle for the Pallas kernels.
-- ``pallas``: pure-decode batches (max_q_len == 1) run the TPU kernel
-  (gllm_tpu/ops/pallas/decode_attention.py, double-buffered DMA over HBM KV
-  pages); mixed/prefill batches currently take the XLA path until the
-  unified ragged-prefill kernel lands.
+- ``pallas``: pure-decode batches (max_q_len == 1) run the per-sequence
+  decode kernel (gllm_tpu/ops/pallas/decode_attention.py); mixed/prefill
+  batches run the ragged varlen kernel
+  (gllm_tpu/ops/pallas/ragged_attention.py). Both stream KV pages through
+  VMEM with double-buffered DMA; MLA passes ``v_cache=None`` so values are
+  read as the latent prefix of each key block (one DMA stream).
 
 Metadata layout (built by the runner, all padded to static bucket shapes):
 - cu_q_lens: [S+1] int32 — cumulative query lengths (padded seqs repeat the
