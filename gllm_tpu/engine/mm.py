@@ -47,7 +47,9 @@ def content_hash(pixels: np.ndarray, grid_thw) -> bytes:
 @dataclasses.dataclass
 class MMItem:
     modality: str                 # "image" | "video"
-    pixels: np.ndarray            # [n_patches, C*tps*ps*ps]
+    # [n_patches, C*tps*ps*ps]; None for disagg items (the encoder process
+    # owns the pixels; only grid + content hash reach the LM).
+    pixels: Optional[np.ndarray]
     grid_thw: Tuple[int, int, int]
     hash: bytes
 
@@ -74,6 +76,16 @@ def build_mm_state(token_ids: Sequence[int], cfg: ModelConfig,
     ``pixel_values`` is the processor's concatenation over image items;
     per-item slices are recovered from grid_thw (t*h*w rows each).
     """
+    if cfg.mm_per_frame_video and video_grid_thw is not None:
+        # Qwen3-VL: each temporal frame is its own vision span (HF
+        # get_rope_index splits video_grid_thw the same way, and frames
+        # are independent attention segments inside the ViT), so normalize
+        # grids to t=1 per-frame items before slicing/hashing.
+        grids = []
+        for g in np.asarray(video_grid_thw):
+            grids.extend([[1, int(g[1]), int(g[2])]] * int(g[0]))
+        video_grid_thw = grids
+
     items: List[MMItem] = []
 
     def split_items(pixels, grids, modality):
@@ -91,7 +103,17 @@ def build_mm_state(token_ids: Sequence[int], cfg: ModelConfig,
 
     split_items(pixel_values, image_grid_thw, "image")
     split_items(video_pixel_values, video_grid_thw, "video")
+    return finish_mm_state(token_ids, cfg, items, second_per_grid_ts)
 
+
+def finish_mm_state(token_ids: Sequence[int], cfg: ModelConfig,
+                    items: List[MMItem],
+                    second_per_grid_ts=None) -> MMState:
+    """The pixel-independent half: positions / vis-index / hash ids from an
+    items list. Also the disagg entry point — items built from MmItemMeta
+    (pixels=None, hash from the encoder) go through the same logic so the
+    disagg stack is byte-identical to the monolith (reference oracle,
+    docs/encoder_disaggregation_usage.md §11)."""
     positions, delta = get_mrope_input_positions(
         token_ids,
         [it.grid_thw for it in items if it.modality == "image"],

@@ -95,13 +95,29 @@ class ModelConfig:
     # vision_config is the raw HF vision sub-config dict, parsed by
     # gllm_tpu/models/vision.py.
     mrope_section: Tuple[int, ...] = ()
+    # Qwen3-VL: frequency-interleaved [THTHW...] mrope layout instead of
+    # chunked [T|H|W] sections (HF apply_interleaved_mrope).
+    mrope_interleaved: bool = False
     image_token_id: int = -1
     video_token_id: int = -1
     vision_config: Optional[Dict[str, Any]] = None
+    # Qwen3-VL deepstack: the ViT emits (1 + n) stacked features per visual
+    # token; level i is added to the LM hidden stream after layer i
+    # (reference qwen3_vl.py:436-469 Qwen3LLMModel deepstack injection).
+    deepstack_num_levels: int = 0
+    # Qwen3-VL videos: each temporal frame is its own vision span with a
+    # timestamp text run between frames; grids are normalized to t=1
+    # per-frame items (HF get_rope_index splits video_grid_thw the same way).
+    mm_per_frame_video: bool = False
 
     @property
     def use_mm(self) -> bool:
         return self.vision_config is not None
+
+    @property
+    def mm_embed_dim(self) -> int:
+        """Width of one spliced visual row ([main ‖ deepstack levels])."""
+        return self.hidden_size * (1 + self.deepstack_num_levels)
 
     # Hybrid linear-attention (Qwen3-Next / Qwen3.5 — reference
     # models/qwen3_5.py). layer_types marks each layer "linear_attention"
@@ -177,6 +193,29 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
             or (hf.get("text_config") or {}).get("architectures")
             or ["LlamaForCausalLM"])[0]
     extra: Dict[str, Any] = {}
+    if arch in ("Qwen3VLForConditionalGeneration",
+                "Qwen3VLMoeForConditionalGeneration"):
+        vision = hf.get("vision_config") or {}
+        text = dict(hf.get("text_config") or hf)
+        rope_scaling = text.get("rope_scaling") or {}
+        extra = dict(
+            mrope_section=tuple(rope_scaling.get("mrope_section", ())),
+            mrope_interleaved=True,
+            image_token_id=hf.get("image_token_id",
+                                  text.get("image_token_id", -1)),
+            video_token_id=hf.get("video_token_id",
+                                  text.get("video_token_id", -1)),
+            vision_config=vision,
+            deepstack_num_levels=len(
+                vision.get("deepstack_visual_indexes", ())),
+            mm_per_frame_video=True,
+        )
+        if rope_scaling.get("type") == "mrope" \
+                or rope_scaling.get("rope_type") == "mrope":
+            text["rope_scaling"] = None
+        hf = {**text, "architectures": [arch],
+              "eos_token_id": hf.get("eos_token_id",
+                                     text.get("eos_token_id"))}
     if arch in ("Qwen2_5_VLForConditionalGeneration",
                 "Qwen2VLForConditionalGeneration"):
         # VL configs nest the LM under text_config (newer transformers) or
@@ -215,7 +254,9 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
     head_dim = hf.get("head_dim") or hidden // num_heads
     qk_norm = arch in ("Qwen3ForCausalLM", "Qwen3MoeForCausalLM",
                        "Qwen3NextForCausalLM", "Qwen3_5ForCausalLM",
-                       "Qwen3_5MoeForCausalLM")
+                       "Qwen3_5MoeForCausalLM",
+                       "Qwen3VLForConditionalGeneration",
+                       "Qwen3VLMoeForConditionalGeneration")
     is_glm4 = arch in ("Glm4ForCausalLM",)
     # GLM-4 base (GlmForCausalLM): interleaved partial rotary like GLM4
     # but WITHOUT the sandwich norms

@@ -127,7 +127,8 @@ def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
     if cfg.mrope_section and batch.mrope_positions is not None:
         q, k = apply_mrope(q, k, batch.mrope_positions, cos_sin,
-                           cfg.mrope_section)
+                           cfg.mrope_section,
+                           interleaved=cfg.mrope_interleaved)
     else:
         rope_fn = (apply_rope_interleaved if cfg.rope_interleaved
                    else apply_rope)
@@ -159,13 +160,16 @@ def forward(
     hidden_in: Optional[jnp.ndarray] = None,
     residual_in: Optional[jnp.ndarray] = None,
     mlp_fn=None,
+    deepstack: Optional[jnp.ndarray] = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, KVCache]:
     """Run this stage's layers. Returns (hidden, residual, new_kv).
 
     First stage embeds `batch.token_ids`; later PP stages take
     (hidden_in, residual_in) received from the previous stage. ``mlp_fn``
     swaps the MLP half of each block (MoE models pass their routed-expert
-    MLP); the attention half and scan plumbing are shared.
+    MLP); the attention half and scan plumbing are shared. ``deepstack``
+    is [n_levels, T, H] visual residuals: level i is added to the hidden
+    stream after global layer i (Qwen3-VL; reference qwen3_vl.py:436-469).
     """
     if mlp_fn is None:
         mlp_fn = _mlp
@@ -175,9 +179,9 @@ def forward(
             # Visual rows come pre-embedded by the vision tower; splice
             # them over the placeholder-token embeddings (reference
             # embed_input_ids merge, qwen2_5_vl.py:972-996).
+            mm_main = batch.mm_embeds[:, :cfg.hidden_size]
             hidden = jnp.where(batch.mm_mask[:, None],
-                               batch.mm_embeds.astype(hidden.dtype),
-                               hidden)
+                               mm_main.astype(hidden.dtype), hidden)
         residual = jnp.zeros_like(hidden)
     else:
         hidden, residual = hidden_in, residual_in
@@ -203,6 +207,16 @@ def forward(
         if cfg.sandwich_norms:
             mlp_out = rms_norm(mlp_out, lp["post_mlp_norm"],
                                cfg.rms_norm_eps)
+        if deepstack is not None:
+            # residual stream after this layer = mlp_out + res; adding the
+            # level-indexed visual delta to mlp_out is equivalent to HF's
+            # hidden_states += deepstack_input_embeds[layer_idx].
+            nds = deepstack.shape[0]
+            gl = li + cfg.first_layer
+            ds = jax.lax.dynamic_index_in_dim(
+                deepstack, jnp.minimum(gl, nds - 1), 0, keepdims=False)
+            mlp_out = mlp_out + jnp.where(gl < nds, ds,
+                                          jnp.zeros_like(ds))
         return (mlp_out, res, k_all, v_all, li + 1), None
 
     init = (hidden, residual, kv.k, kv.v, jnp.int32(0))

@@ -110,3 +110,8 @@ def load_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16,
     from gllm_tpu.models.loader import _load_params
     template = jax.eval_shape(lambda: init_params(cfg, dtype=dtype))
     return _load_params(model_dir, template, _vl_rules(cfg), progress_cb)
+
+
+def embed_mm(params, cfg: ModelConfig, pixels, grid_thw) -> jnp.ndarray:
+    return vision.embed_single(params["visual"], vision_cfg(cfg), pixels,
+                               grid_thw)

@@ -1,0 +1,176 @@
+"""Qwen3-VL (+MoE): deepstack vision tower + Qwen3 LM with interleaved mrope.
+
+Reference: /root/reference/gllm/models/qwen3_vl.py (986 LoC) and
+qwen3_vl_moe.py. The LM half is our dense Qwen3 decoder (qk-norm) or the
+Qwen3-MoE decoder; deepstack visual residuals enter via
+``dense.forward(deepstack=...)`` (level i added after global layer i,
+reference Qwen3LLMModel.forward :436-469). The vision tower lives in
+gllm_tpu/models/vision_qwen3.py and emits [L/mu, out*(1+n_levels)] rows;
+this module splits them into the embedding splice + per-layer residual
+stack and owns the checkpoint rules for both halves.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gllm_tpu.batching import StepBatch
+from gllm_tpu.models import dense, moe, vision_qwen3
+from gllm_tpu.models.config import ModelConfig
+
+init_kv_cache = dense.init_kv_cache
+compute_logits = dense.compute_logits
+
+
+def vision_cfg(cfg: ModelConfig) -> vision_qwen3.VisionConfig3:
+    assert cfg.vision_config is not None
+    return vision_qwen3.from_hf_vision_config(cfg.vision_config)
+
+
+def make_rope_table(cfg: ModelConfig) -> jnp.ndarray:
+    # mrope indices can exceed the token count; size like qwen2_5_vl
+    rot_dim = int(cfg.head_dim * cfg.partial_rotary_factor)
+    from gllm_tpu.ops import compute_rope_cos_sin
+    return compute_rope_cos_sin(rot_dim, cfg.max_position * 4,
+                                cfg.rope_theta, cfg.rope_scaling)
+
+
+def _split_deepstack(batch: StepBatch, cfg: ModelConfig):
+    """mm_embeds [T, (1+n)*H] → (batch with main rows, deepstack [n, T, H]
+    zeroed off visual rows) — the runner-side equivalent of HF
+    _compute_deepstack_embeds + the zeroed per-batch buffer."""
+    if batch.mm_embeds is None or not cfg.deepstack_num_levels:
+        return batch, None
+    H, n = cfg.hidden_size, cfg.deepstack_num_levels
+    T = batch.mm_embeds.shape[0]
+    ds = batch.mm_embeds[:, H:].reshape(T, n, H).transpose(1, 0, 2)
+    ds = jnp.where(batch.mm_mask[None, :, None], ds, 0.0)
+    return batch, ds
+
+
+def forward(params, kv, batch: StepBatch, cfg: ModelConfig, *, cos_sin,
+            attn_impl: str = "xla", max_q_len: int,
+            hidden_in=None, residual_in=None):
+    batch, ds = _split_deepstack(batch, cfg)
+    mlp_fn = ((lambda lp, x: moe.moe_mlp(lp, x, cfg))
+              if cfg.num_experts else None)
+    return dense.forward(
+        params, kv, batch, cfg, cos_sin=cos_sin, attn_impl=attn_impl,
+        max_q_len=max_q_len, hidden_in=hidden_in, residual_in=residual_in,
+        mlp_fn=mlp_fn, deepstack=ds)
+
+
+def init_params(cfg: ModelConfig, seed: int = 0,
+                dtype=jnp.bfloat16) -> dict:
+    base = moe if cfg.num_experts else dense
+    params = base.init_params(cfg, seed=seed, dtype=dtype)
+    params["visual"] = vision_qwen3.init_vision_params(
+        vision_cfg(cfg), seed=seed, dtype=dtype)
+    return params
+
+
+def embed_mm(params, cfg: ModelConfig, pixels, grid_thw) -> jnp.ndarray:
+    return vision_qwen3.embed_single(params["visual"], vision_cfg(cfg),
+                                     pixels, grid_thw)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint rules
+# ---------------------------------------------------------------------------
+
+def _vl3_rules(cfg: ModelConfig):
+    from gllm_tpu.models.loader import dense_rules, moe_rules
+    base = moe_rules(cfg) if cfg.num_experts else dense_rules(cfg)
+    first, last = cfg.stage_layers
+    vcfg = vision_cfg(cfg)
+
+    vis_leaves = {
+        "norm1.weight": ("norm1_w", None), "norm1.bias": ("norm1_b", None),
+        "norm2.weight": ("norm2_w", None), "norm2.bias": ("norm2_b", None),
+        "attn.qkv.weight": ("qkv_w", "t"), "attn.qkv.bias": ("qkv_b", None),
+        "attn.proj.weight": ("proj_w", "t"),
+        "attn.proj.bias": ("proj_b", None),
+        "mlp.linear_fc1.weight": ("fc1_w", "t"),
+        "mlp.linear_fc1.bias": ("fc1_b", None),
+        "mlp.linear_fc2.weight": ("fc2_w", "t"),
+        "mlp.linear_fc2.bias": ("fc2_b", None),
+    }
+    merger_leaves = {
+        "norm.weight": ("norm_w", None), "norm.bias": ("norm_b", None),
+        "linear_fc1.weight": ("fc1_w", "t"),
+        "linear_fc1.bias": ("fc1_b", None),
+        "linear_fc2.weight": ("fc2_w", "t"),
+        "linear_fc2.bias": ("fc2_b", None),
+    }
+
+    def patch_embed_tf(t: np.ndarray) -> dict:
+        # HF Conv3d weight [H, C, tps, ps, ps] → [C*tps*ps*ps, H] matmul
+        return {"patch_embed": t.reshape(vcfg.hidden_size, -1).T}
+
+    def split_gate_up_experts(t: np.ndarray) -> dict:
+        # HF fused expert stack [E, H, 2I] → w_gate/w_up [E, H, I]
+        gate, up = np.split(t, 2, axis=-1)
+        return {"w_gate": gate, "w_up": up}
+
+    def rule(name: str):
+        # transformers >= 4.52 nests the LM under model.language_model.*
+        if name.startswith("model.language_model."):
+            name = "model." + name[len("model.language_model."):]
+        elif name.startswith("model.visual."):
+            name = name[len("model."):]
+        if name.startswith("visual."):
+            rest = name[len("visual."):]
+            if rest == "patch_embed.proj.weight":
+                return (("visual", "__multi__"), None, patch_embed_tf)
+            if rest == "patch_embed.proj.bias":
+                return (("visual", "patch_bias"), None, None)
+            if rest == "pos_embed.weight":
+                return (("visual", "pos_embed"), None, None)
+            if rest.startswith("blocks."):
+                idx_s, _, leaf = rest[len("blocks."):].partition(".")
+                if leaf in vis_leaves:
+                    target, tf = vis_leaves[leaf]
+                    return (("visual", "blocks", target), int(idx_s), tf)
+                return None
+            if rest.startswith("merger."):
+                leaf = rest[len("merger."):]
+                if leaf in merger_leaves:
+                    target, tf = merger_leaves[leaf]
+                    return (("visual", "merger", target), None, tf)
+                return None
+            if rest.startswith("deepstack_merger_list."):
+                idx_s, _, leaf = \
+                    rest[len("deepstack_merger_list."):].partition(".")
+                if leaf in merger_leaves:
+                    target, tf = merger_leaves[leaf]
+                    return (("visual", "deepstack", int(idx_s), target),
+                            None, tf)
+                return None
+            return None
+        # Qwen3-VL-MoE fused expert stacks (HF modeling_qwen3_vl_moe:
+        # experts.gate_up_proj [E, H, 2I], experts.down_proj [E, I, H])
+        if cfg.num_experts and name.startswith("model.layers."):
+            rest = name[len("model.layers."):]
+            idx_s, _, leaf = rest.partition(".")
+            i = int(idx_s)
+            if first <= i < last:
+                li = i - first
+                if leaf == "mlp.experts.gate_up_proj":
+                    return (("layers", "__multi__"), li,
+                            split_gate_up_experts)
+                if leaf == "mlp.experts.down_proj":
+                    return (("layers", "w_down"), li, None)
+        return base(name)
+
+    return rule
+
+
+def load_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16,
+                progress_cb=None) -> dict:
+    from gllm_tpu.models.loader import _load_params
+    template = jax.eval_shape(lambda: init_params(cfg, dtype=dtype))
+    return _load_params(model_dir, template, _vl3_rules(cfg), progress_cb)

@@ -8,7 +8,7 @@ reference's architecture→class table (/root/reference/gllm/model_loader.py:
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 from gllm_tpu.models.config import ModelConfig
 
@@ -24,6 +24,8 @@ class ModelDef:
     init_kv_cache: Callable
     param_specs: Callable          # (cfg, tp) -> PartitionSpec pytree
     kv_specs: Callable             # (cfg, tp) -> cache PartitionSpec pytree
+    # VL models: (params, cfg, pixels, grid_thw) -> [n_rows, mm_embed_dim]
+    embed_mm: Optional[Callable] = None
 
 
 def _dense_def() -> ModelDef:
@@ -66,6 +68,24 @@ def _vl_def() -> ModelDef:
         init_kv_cache=qwen2_5_vl.init_kv_cache,
         param_specs=vl_param_specs,
         kv_specs=kv_cache_specs,
+        embed_mm=qwen2_5_vl.embed_mm,
+    )
+
+
+def _vl3_def() -> ModelDef:
+    from gllm_tpu.models import qwen3_vl
+    from gllm_tpu.parallel.shardings import kv_cache_specs, vl3_param_specs
+    return ModelDef(
+        family="vl3",
+        init_params=qwen3_vl.init_params,
+        forward=qwen3_vl.forward,
+        compute_logits=qwen3_vl.compute_logits,
+        make_rope_table=qwen3_vl.make_rope_table,
+        load_params=qwen3_vl.load_params,
+        init_kv_cache=qwen3_vl.init_kv_cache,
+        param_specs=vl3_param_specs,
+        kv_specs=kv_cache_specs,
+        embed_mm=qwen3_vl.embed_mm,
     )
 
 
@@ -80,6 +100,8 @@ def get_model_def(cfg: ModelConfig) -> ModelDef:
         return deepseek_def()
     if cfg.architecture in _VL_ARCHS:
         return _vl_def()
+    if cfg.architecture in _VL3_ARCHS:
+        return _vl3_def()
     if cfg.architecture in _HYBRID_ARCHS:
         from gllm_tpu.models import hybrid
         from gllm_tpu.parallel.shardings import (hybrid_kv_specs,
@@ -117,6 +139,11 @@ _VL_ARCHS = (
     "Qwen2_5_VLForConditionalGeneration",
 )
 
+_VL3_ARCHS = (
+    "Qwen3VLForConditionalGeneration",
+    "Qwen3VLMoeForConditionalGeneration",
+)
+
 _HYBRID_ARCHS = (
     "Qwen3NextForCausalLM",
     "Qwen3_5ForCausalLM",
@@ -129,5 +156,6 @@ def supported_architectures() -> Dict[str, str]:
     out.update({a: "moe" for a in _MOE_ARCHS})
     out.update({a: "mla-moe" for a in _MLA_ARCHS})
     out.update({a: "vl" for a in _VL_ARCHS})
+    out.update({a: "vl3" for a in _VL3_ARCHS})
     out.update({a: "hybrid" for a in _HYBRID_ARCHS})
     return out

@@ -1,0 +1,334 @@
+"""Qwen3-VL vision tower (ViT + interpolated pos-embed + deepstack mergers).
+
+TPU-native re-design of the reference Qwen3 vision transformer
+(/root/reference/gllm/models/qwen3_vl.py:193-434). Differences from the
+Qwen2.5 tower (gllm_tpu/models/vision.py):
+
+- **No window attention**: every block attends globally within each
+  temporal frame (HF splits by cu_seqlens per frame); we mask by frame
+  segment id, q-chunked above a size threshold like the 2.5 full layers.
+- **LayerNorm (with bias) norms**, biased patch embed, non-gated MLP
+  (linear_fc1 → act → linear_fc2) with ``gelu_pytorch_tanh``.
+- **Learned position embeddings** bilinearly interpolated from a
+  ``num_position_embeddings`` grid to the image grid (HF
+  fast_pos_embed_interpolate); interpolation indices/weights are pure
+  functions of (h, w) — precomputed per grid in numpy and lru-cached.
+- **Deepstack**: after blocks listed in ``deepstack_visual_indexes`` a
+  dedicated patch merger (post-shuffle LayerNorm) produces one extra
+  feature level per merged token; the tower returns
+  ``[L/mu, out*(1+n_levels)]`` = [main ‖ level0 ‖ level1 ‖ ...], which the
+  LM splits into the embedding splice + per-layer residuals.
+
+Weight layout is [in, out] (x @ W) like the LM modules; token order is the
+HF processor's merge-grouped raster order throughout (no permutes needed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+# Frame-masked global attention materializes dense scores below this many
+# tokens; above it the q axis is chunked (exact, O(L·chunk) memory).
+_FULL_DENSE_MAX = 2048
+_FULL_CHUNK = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig3:
+    depth: int
+    hidden_size: int
+    intermediate_size: int
+    num_heads: int
+    patch_size: int
+    temporal_patch_size: int
+    in_channels: int
+    spatial_merge_size: int
+    out_hidden_size: int
+    num_position_embeddings: int
+    deepstack_visual_indexes: Tuple[int, ...]
+    hidden_act: str = "gelu_pytorch_tanh"
+    norm_eps: float = 1e-6
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def merge_unit(self) -> int:
+        return self.spatial_merge_size ** 2
+
+    @property
+    def patch_input_dim(self) -> int:
+        return (self.in_channels * self.temporal_patch_size
+                * self.patch_size ** 2)
+
+    @property
+    def num_grid_per_side(self) -> int:
+        return int(self.num_position_embeddings ** 0.5)
+
+
+def from_hf_vision_config(d: Dict[str, Any]) -> VisionConfig3:
+    return VisionConfig3(
+        depth=d.get("depth", 27),
+        hidden_size=d.get("hidden_size", 1152),
+        intermediate_size=d.get("intermediate_size", 4304),
+        num_heads=d.get("num_heads", 16),
+        patch_size=d.get("patch_size", 16),
+        temporal_patch_size=d.get("temporal_patch_size", 2),
+        in_channels=d.get("in_channels", 3),
+        spatial_merge_size=d.get("spatial_merge_size", 2),
+        out_hidden_size=d.get("out_hidden_size", 3584),
+        num_position_embeddings=d.get("num_position_embeddings", 2304),
+        deepstack_visual_indexes=tuple(
+            d.get("deepstack_visual_indexes", (8, 16, 24))),
+        hidden_act=d.get("hidden_act", "gelu_pytorch_tanh"),
+    )
+
+
+def _merger_params(key, cfg: VisionConfig3, dtype) -> Params:
+    muH, out = cfg.merge_unit * cfg.hidden_size, cfg.out_hidden_size
+    k1, k2 = jax.random.split(key)
+    s = muH ** -0.5
+
+    def w(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * s).astype(dtype)
+
+    return {
+        "norm_w": jnp.ones((muH,), dtype), "norm_b": jnp.zeros((muH,), dtype),
+        "fc1_w": w(k1, (muH, muH)), "fc1_b": jnp.zeros((muH,), dtype),
+        "fc2_w": w(k2, (muH, out)), "fc2_b": jnp.zeros((out,), dtype),
+    }
+
+
+def init_vision_params(cfg: VisionConfig3, seed: int = 0,
+                       dtype=jnp.float32) -> Params:
+    L, H, I = cfg.depth, cfg.hidden_size, cfg.intermediate_size
+    key = jax.random.key(seed + 13)
+    ks = iter(jax.random.split(key, 8 + len(cfg.deepstack_visual_indexes)))
+
+    def w(k, shape, scale):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * scale).astype(dtype)
+
+    s = H ** -0.5
+    p: Params = {
+        "patch_embed": w(next(ks), (cfg.patch_input_dim, H),
+                         cfg.patch_input_dim ** -0.5),
+        "patch_bias": jnp.zeros((H,), dtype),
+        "pos_embed": w(next(ks), (cfg.num_position_embeddings, H), 0.02),
+        "blocks": {
+            "norm1_w": jnp.ones((L, H), dtype),
+            "norm1_b": jnp.zeros((L, H), dtype),
+            "norm2_w": jnp.ones((L, H), dtype),
+            "norm2_b": jnp.zeros((L, H), dtype),
+            "qkv_w": w(next(ks), (L, H, 3 * H), s),
+            "qkv_b": jnp.zeros((L, 3 * H), dtype),
+            "proj_w": w(next(ks), (L, H, H), s),
+            "proj_b": jnp.zeros((L, H), dtype),
+            "fc1_w": w(next(ks), (L, H, I), s),
+            "fc1_b": jnp.zeros((L, I), dtype),
+            "fc2_w": w(next(ks), (L, I, H), I ** -0.5),
+            "fc2_b": jnp.zeros((L, H), dtype),
+        },
+        # main merger norms pre-shuffle over H (rows broadcast to mu*H so
+        # one merger code path serves both)
+        "merger": _merger_params(next(ks), cfg, dtype),
+        "deepstack": [
+            _merger_params(next(ks), cfg, dtype)
+            for _ in cfg.deepstack_visual_indexes
+        ],
+    }
+    # the MAIN merger's LayerNorm is over H (pre-shuffle); overwrite shape
+    p["merger"]["norm_w"] = jnp.ones((cfg.hidden_size,), dtype)
+    p["merger"]["norm_b"] = jnp.zeros((cfg.hidden_size,), dtype)
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Host precompute per (t, h, w) grid
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=512)
+def _grid_precompute(t: int, h: int, w: int, merge: int, head_dim: int,
+                     num_grid_per_side: int):
+    """Static per-grid data in merge-grouped processor order:
+
+    (pos_idx [4, L], pos_w [4, L] f32, seg [L] frame ids,
+     cos/sin [L, head_dim] f32)
+
+    Port of HF fast_pos_embed_interpolate + rot_pos_ids (qwen3_vl.py:
+    289-389); everything here is a pure function of the grid.
+    """
+    L = t * h * w
+
+    def merge_order(p2d):
+        return p2d.reshape(h // merge, merge, w // merge, merge) \
+                  .transpose(0, 2, 1, 3).reshape(-1)
+
+    # --- bilinear pos-embed interpolation (per frame, tiled over t) ---
+    side = num_grid_per_side
+    h_idx = np.linspace(0, side - 1, h, dtype=np.float32)
+    w_idx = np.linspace(0, side - 1, w, dtype=np.float32)
+    h_floor = h_idx.astype(np.int64)
+    w_floor = w_idx.astype(np.int64)
+    h_ceil = np.minimum(h_floor + 1, side - 1)
+    w_ceil = np.minimum(w_floor + 1, side - 1)
+    dh = (h_idx - h_floor)[:, None]
+    dw = (w_idx - w_floor)[None, :]
+    w11 = dh * dw
+    w10 = dh - w11
+    w01 = dw - w11
+    w00 = 1 - dh - w01
+    hg = [h_floor, h_floor, h_ceil, h_ceil]
+    wg = [w_floor, w_ceil, w_floor, w_ceil]
+    idx = np.stack([(hg[i][:, None] * side + wg[i][None, :]).reshape(-1)
+                    for i in range(4)])                     # [4, h*w]
+    wts = np.stack([np.broadcast_to(x, (h, w)).reshape(-1)
+                    for x in (w00, w01, w10, w11)])         # [4, h*w]
+    # merge-grouped order, tiled over frames
+    idx = np.stack([np.tile(merge_order(r), t) for r in idx])
+    wts = np.stack([np.tile(merge_order(r), t) for r in wts])
+
+    # --- frame segments ---
+    seg = np.repeat(np.arange(t), h * w)
+
+    # --- 2-D rotary ---
+    hpos = np.broadcast_to(np.arange(h)[:, None], (h, w))
+    wpos = np.broadcast_to(np.arange(w)[None, :], (h, w))
+    hpos = np.tile(merge_order(hpos), t)
+    wpos = np.tile(merge_order(wpos), t)
+    inv_freq = 1.0 / (10000.0 ** (np.arange(0, head_dim // 2, 2,
+                                            dtype=np.float64)
+                                  / (head_dim // 2)))
+    freqs = np.concatenate([hpos[:, None] * inv_freq[None, :],
+                            wpos[:, None] * inv_freq[None, :]],
+                           axis=-1)                         # [L, head_dim/2]
+    emb = np.concatenate([freqs, freqs], axis=-1)           # [L, head_dim]
+    return (idx.astype(np.int32), wts.astype(np.float32),
+            seg.astype(np.int32), np.cos(emb).astype(np.float32),
+            np.sin(emb).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return (((xf - mu) * jax.lax.rsqrt(var + eps)) * w + b).astype(x.dtype)
+
+
+def _rope(a, cos, sin):
+    """rotate-half rope over the full head dim (HF
+    apply_rotary_pos_emb_vision). a: [L, nh, hd]; cos/sin: [L, hd]."""
+    hd = a.shape[-1]
+    af = a.astype(jnp.float32)
+    half = jnp.concatenate([-af[..., hd // 2:], af[..., :hd // 2]], axis=-1)
+    return (af * cos[:, None, :] + half * sin[:, None, :]).astype(a.dtype)
+
+
+def _frame_attention(bp, x, cos, sin, seg, cfg: VisionConfig3):
+    """Global attention masked to frame segments, q-chunked above
+    _FULL_DENSE_MAX tokens (same scheme as vision.py's full layers)."""
+    L, H = x.shape
+    nh, hd = cfg.num_heads, cfg.head_dim
+    qkv = x @ bp["qkv_w"] + bp["qkv_b"]
+    q, k, v = [a.reshape(L, nh, hd) for a in jnp.split(qkv, 3, axis=-1)]
+    q, k = _rope(q, cos, sin), _rope(k, cos, sin)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    def attend(qb, segb):
+        scores = jnp.einsum("qhd,khd->hqk", qb.astype(jnp.float32),
+                            kf) * hd ** -0.5
+        mask = segb[:, None] == seg[None, :]
+        scores = jnp.where(mask[None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("hqk,khd->qhd", probs, vf)
+
+    if L <= _FULL_DENSE_MAX:
+        out = attend(q, seg)
+    else:
+        pad = (-L) % _FULL_CHUNK
+        qp = jnp.pad(q, ((0, pad), (0, 0), (0, 0)))
+        segp = jnp.pad(seg, (0, pad), constant_values=-1)
+        nb = qp.shape[0] // _FULL_CHUNK
+        out = jax.lax.map(
+            lambda args: attend(*args),
+            (qp.reshape(nb, _FULL_CHUNK, nh, hd),
+             segp.reshape(nb, _FULL_CHUNK)))
+        out = out.reshape(-1, nh, hd)[:L]
+    out = out.reshape(L, H).astype(x.dtype)
+    return out @ bp["proj_w"] + bp["proj_b"]
+
+
+def _merger(mp, x, cfg: VisionConfig3, postshuffle: bool):
+    """Patch merger (HF Qwen3VLVisionPatchMerger): LayerNorm over H
+    pre-shuffle (main) or over mu*H post-shuffle (deepstack), then
+    fc1 → exact GELU → fc2."""
+    mu = cfg.merge_unit
+    if postshuffle:
+        x = x.reshape(-1, mu * cfg.hidden_size)
+        x = _layer_norm(x, mp["norm_w"], mp["norm_b"], cfg.norm_eps)
+    else:
+        x = _layer_norm(x, mp["norm_w"], mp["norm_b"], cfg.norm_eps)
+        x = x.reshape(-1, mu * cfg.hidden_size)
+    x = x @ mp["fc1_w"] + mp["fc1_b"]
+    x = jax.nn.gelu(x.astype(jnp.float32), approximate=False).astype(x.dtype)
+    return x @ mp["fc2_w"] + mp["fc2_b"]
+
+
+def _vit_jit(params, pixels, pos_idx, pos_w, seg, cos, sin,
+             cfg: VisionConfig3):
+    x = pixels @ params["patch_embed"] + params["patch_bias"]     # [L, H]
+    pos = (params["pos_embed"][pos_idx].astype(jnp.float32)
+           * pos_w[:, :, None]).sum(0)
+    x = x + pos.astype(x.dtype)
+
+    if cfg.hidden_act == "silu":
+        act = jax.nn.silu
+    else:           # gelu_pytorch_tanh
+        act = functools.partial(jax.nn.gelu, approximate=True)
+
+    ds_feats = []
+    for i in range(cfg.depth):
+        bp = jax.tree.map(lambda a: a[i], params["blocks"])
+        h = _layer_norm(x, bp["norm1_w"], bp["norm1_b"], cfg.norm_eps)
+        x = x + _frame_attention(bp, h, cos, sin, seg, cfg)
+        h = _layer_norm(x, bp["norm2_w"], bp["norm2_b"], cfg.norm_eps)
+        h = h @ bp["fc1_w"] + bp["fc1_b"]
+        h = act(h.astype(jnp.float32)).astype(x.dtype)
+        x = x + (h @ bp["fc2_w"] + bp["fc2_b"])
+        if i in cfg.deepstack_visual_indexes:
+            di = cfg.deepstack_visual_indexes.index(i)
+            ds_feats.append(_merger(params["deepstack"][di], x, cfg,
+                                    postshuffle=True))
+
+    main = _merger(params["merger"], x, cfg, postshuffle=False)
+    return jnp.concatenate([main] + ds_feats, axis=1)  # [L/mu, out*(1+n)]
+
+
+_vit_jit = jax.jit(_vit_jit, static_argnames=("cfg",))
+
+
+def embed_single(params: Params, cfg: VisionConfig3, pixels,
+                 grid_thw: Tuple[int, int, int]) -> jnp.ndarray:
+    """One image/frame item: pixels [t*h*w, C*tps*ps*ps] → merged visual
+    embeddings [t*h*w/mu, out*(1+n_deepstack)]."""
+    t, h, w = (int(v) for v in grid_thw)
+    pos_idx, pos_w, seg, cos, sin = _grid_precompute(
+        t, h, w, cfg.spatial_merge_size, cfg.head_dim,
+        cfg.num_grid_per_side)
+    return _vit_jit(params, jnp.asarray(pixels), jnp.asarray(pos_idx),
+                    jnp.asarray(pos_w), jnp.asarray(seg),
+                    jnp.asarray(cos), jnp.asarray(sin), cfg)

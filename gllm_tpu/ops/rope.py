@@ -154,12 +154,16 @@ def apply_rope(q: jnp.ndarray, k: jnp.ndarray, positions: jnp.ndarray,
 
 
 def apply_mrope(q: jnp.ndarray, k: jnp.ndarray, positions3: jnp.ndarray,
-                cos_sin: jnp.ndarray, mrope_section: Tuple[int, ...]):
+                cos_sin: jnp.ndarray, mrope_section: Tuple[int, ...],
+                interleaved: bool = False):
     """Multimodal rotary (Qwen-VL family).
 
-    The half-rotary-dim axis is split into [T|H|W] sections; section ``i``
-    rotates with the position of axis ``i`` (reference
-    rotary_embedding.py:607-706 MRotaryEmbedding, non-interleaved layout).
+    The half-rotary-dim axis reads per-dim from one of the three position
+    axes. Chunked layout (Qwen2.5-VL, reference rotary_embedding.py:607-706):
+    sections [T|H|W]. Interleaved layout (Qwen3-VL, HF
+    apply_interleaved_mrope): dim d reads H when ``d % 3 == 1 and
+    d < 3*sec_h``, W when ``d % 3 == 2 and d < 3*sec_w``, else T —
+    [THWTHW...TT], preserving frequency continuity per axis.
 
     positions3: [3, T] int32 (temporal/height/width); text tokens carry the
     same value on all three axes, so this degenerates to standard rope.
@@ -168,9 +172,20 @@ def apply_mrope(q: jnp.ndarray, k: jnp.ndarray, positions3: jnp.ndarray,
     half = rot_dim // 2
     assert sum(mrope_section) == half, (mrope_section, half)
     cs = cos_sin[positions3]                         # [3, T, rot_dim]
-    # which axis each half-dim reads from: [sec0 zeros | sec1 ones | ...]
-    axis_of_dim = jnp.concatenate([
-        jnp.full((n,), i, jnp.int32) for i, n in enumerate(mrope_section)])
+    # which axis each half-dim reads from
+    if interleaved:
+        import numpy as _np
+        axes = _np.zeros(half, _np.int32)
+        for ax, sec in ((1, mrope_section[1]), (2, mrope_section[2])):
+            # HF uses freqs[..., offset:3*sec:3] — python slices clamp to
+            # the array length, so bound by half as well
+            d = _np.arange(ax, min(3 * sec, half), 3)
+            axes[d] = ax
+        axis_of_dim = jnp.asarray(axes)
+    else:
+        axis_of_dim = jnp.concatenate([
+            jnp.full((n,), i, jnp.int32)
+            for i, n in enumerate(mrope_section)])
     cs_sel = jnp.take_along_axis(
         cs.transpose(1, 2, 0),                       # [T, rot_dim, 3]
         jnp.concatenate([axis_of_dim, axis_of_dim])[None, :, None],

@@ -220,6 +220,20 @@ def vl_param_specs(cfg: ModelConfig, tp: int) -> dict:
     return specs
 
 
+def vl3_param_specs(cfg: ModelConfig, tp: int) -> dict:
+    """Qwen3-VL: dense/MoE text specs + replicated vision tower."""
+    import jax
+
+    from gllm_tpu.models import qwen3_vl, vision_qwen3
+    specs = (moe_param_specs(cfg, tp) if cfg.num_experts
+             else dense_param_specs(cfg, tp))
+    vtemplate = jax.eval_shape(
+        lambda: vision_qwen3.init_vision_params(qwen3_vl.vision_cfg(cfg)))
+    specs["visual"] = jax.tree.map(lambda s: P(*([None] * len(s.shape))),
+                                   vtemplate)
+    return specs
+
+
 def hybrid_param_specs(cfg: ModelConfig, tp: int) -> dict:
     """Qwen3-Next hybrid shardings: attention halves shard like dense
     (head axis), GDN projections shard on their output/head axes, MoE

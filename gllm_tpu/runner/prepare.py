@@ -30,11 +30,15 @@ from gllm_tpu.utils import bucket_size, cdiv
 class BatchBuilder:
     def __init__(self, config: EngineConfig, page_size: int,
                  vocab_size: int = 0, hidden_size: int = 0,
-                 use_mm: bool = False, use_ssm: bool = False):
+                 use_mm: bool = False, use_ssm: bool = False,
+                 mm_embed_dim: int = 0):
         self.config = config
         self.page_size = page_size
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
+        # visual-row width: hidden_size, or (1+n_deepstack)*hidden for
+        # Qwen3-VL stacked features
+        self.mm_embed_dim = mm_embed_dim or hidden_size
         self.use_mm = use_mm
         self.use_ssm = use_ssm
         sc = config.scheduler
@@ -211,7 +215,7 @@ class BatchBuilder:
                     if sel.any():
                         if mm_embeds is None:
                             mm_embeds = np.zeros(
-                                (t_pad, self.hidden_size), np.float32)
+                                (t_pad, self.mm_embed_dim), np.float32)
                         mm_mask[off:off + n] = sel
                         mm_embeds[off:off + n][sel] = \
                             mm.vis_embeds[vis[sel]]

@@ -81,7 +81,8 @@ class ModelRunner:
                                     vocab_size=model_cfg.vocab_size,
                                     hidden_size=model_cfg.hidden_size,
                                     use_mm=model_cfg.use_mm,
-                                    use_ssm=model_cfg.use_hybrid)
+                                    use_ssm=model_cfg.use_hybrid,
+                                    mm_embed_dim=model_cfg.mm_embed_dim)
         if model_cfg.use_mm:
             from gllm_tpu.utils import LRUBytesCache
             self._mm_cache = LRUBytesCache()
@@ -124,9 +125,16 @@ class ModelRunner:
                         config.quantization, before / 1e9,
                         param_bytes(self.params) / 1e9)
 
+        if config.skip_visual_load and "visual" in self.params:
+            # disagg LM node: the forward path never reads the tower
+            # (embeddings arrive pre-computed from the encoder fleet)
+            del self.params["visual"]
+
         if self.mesh is not None and not ep_loaded:
             from gllm_tpu.parallel.shardings import shard_params
             specs = self.model_def.param_specs(model_cfg, config.parallel.tp)
+            if "visual" not in self.params:
+                specs.pop("visual", None)
             self.params = shard_params(self.params, specs, self.mesh)
 
         self.dp = config.parallel.dp
@@ -193,6 +201,14 @@ class ModelRunner:
                     "use attention_impl='xla' (or 'auto')")
             return impl
         if tp_sharded:
+            return "xla"
+        # Mosaic tiles the lane (last) dimension at 128: a head_dim that
+        # isn't a multiple of 128 fails kernel compile ("Slice shape along
+        # dimension 3 must be aligned to tiling (128)") — real checkpoints
+        # use 64/128/192; tiny test configs fall back to the XLA path.
+        hd = (self.model_cfg.kv_lora_rank + self.model_cfg.qk_rope_head_dim
+              if self.model_cfg.use_mla else self.model_cfg.head_dim)
+        if hd % 128 != 0:
             return "xla"
         return ("pallas" if jax.default_backend() in ("tpu", "axon")
                 else "xla")
@@ -334,8 +350,6 @@ class ModelRunner:
         visual items; ViT outputs are LRU-cached by content hash (reference
         MultiModalEmbeddingCache) and attached to the sequence as host rows
         for the batch builder to splice."""
-        from gllm_tpu.models import qwen2_5_vl, vision
-        vcfg = qwen2_5_vl.vision_cfg(self.model_cfg)
         for it in sched_batch.items:
             mm = it.seq.mm
             if mm is None or mm.vis_embeds is not None:
@@ -344,15 +358,15 @@ class ModelRunner:
             for item in mm.items:
                 cached = self._mm_cache.get(item.hash)
                 if cached is None:
-                    out = vision.embed_single(
-                        self.params["visual"], vcfg,
+                    out = self.model_def.embed_mm(
+                        self.params, self.model_cfg,
                         jnp.asarray(item.pixels).astype(self.dtype),
                         item.grid_thw)
                     cached = np.asarray(out, np.float32)
                     self._mm_cache.put(item.hash, cached)
                 chunks.append(cached)
             mm.vis_embeds = (np.concatenate(chunks) if chunks
-                             else np.zeros((0, self.model_cfg.hidden_size),
+                             else np.zeros((0, self.model_cfg.mm_embed_dim),
                                            np.float32))
             assert mm.vis_embeds.shape[0] == mm.num_vis_tokens, \
                 (mm.vis_embeds.shape, mm.num_vis_tokens)

@@ -1,0 +1,216 @@
+"""Qwen3-VL (+MoE): HF-greedy equivalence through the full engine.
+
+Deepstack coverage per SURVEY.md §2.3 (reference qwen3_vl.py /
+qwen3_vl_moe.py): interpolated pos-embeds, per-frame ViT attention,
+deepstack per-layer residual injection, interleaved mrope, per-frame video
+spans, and the fused-expert MoE text backbone.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.sampling_params import SamplingParams
+
+IMG, VID, VSTART, VEND = 150, 151, 152, 153
+
+TEXT = dict(
+    vocab_size=160, hidden_size=64, num_hidden_layers=3,
+    num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+    intermediate_size=96, max_position_embeddings=512, rms_norm_eps=1e-6,
+    rope_theta=10000.0, tie_word_embeddings=False,
+    rope_scaling={"rope_type": "default", "mrope_section": [2, 3, 3],
+                  "mrope_interleaved": True},
+)
+VISION = dict(
+    depth=3, hidden_size=32, intermediate_size=48, num_heads=4,
+    patch_size=2, temporal_patch_size=2, in_channels=3,
+    spatial_merge_size=2, out_hidden_size=64, num_position_embeddings=16,
+    deepstack_visual_indexes=[0, 2], hidden_act="gelu_pytorch_tanh",
+)
+
+
+@pytest.fixture(scope="module")
+def vl3_ckpt(tmp_path_factory):
+    from transformers import (Qwen3VLConfig,
+                              Qwen3VLForConditionalGeneration)
+    torch.manual_seed(21)
+    cfg = Qwen3VLConfig(
+        text_config=TEXT, vision_config=VISION,
+        image_token_id=IMG, video_token_id=VID,
+        vision_start_token_id=VSTART, vision_end_token_id=VEND,
+        eos_token_id=0, bos_token_id=1)
+    model = Qwen3VLForConditionalGeneration(cfg)
+    model.eval()
+    d = tmp_path_factory.mktemp("tiny_vl3")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+def make_image(rng, grid=(1, 4, 4)):
+    t, h, w = grid
+    dim = 3 * 2 * 2 * 2
+    pix = rng.standard_normal((t * h * w, dim)).astype(np.float32)
+    n_tok = t * (h // 2) * (w // 2)
+    return pix, np.asarray([list(grid)]), n_tok
+
+
+def vl_prompt(pre, grid_toks, post, tok=IMG):
+    return list(pre) + [VSTART] + [tok] * grid_toks + [VEND] + list(post)
+
+
+def hf_greedy(model, ids, n, **mm):
+    with torch.no_grad():
+        out = model.generate(input_ids=torch.tensor([ids]),
+                             max_new_tokens=n, do_sample=False, **mm)
+    return out[0, len(ids):].tolist()
+
+
+def make_llm(model_dir, prefix=False, **sched):
+    cfg = EngineConfig(
+        model=model_dir, dtype="float32", max_model_len=256,
+        scheduler=SchedulerConfig(**sched) if sched else SchedulerConfig(),
+        cache=CacheConfig(page_size=4, num_pages=128,
+                          enable_prefix_caching=prefix))
+    return LLM(config=cfg)
+
+
+def test_vl3_greedy_equivalence(vl3_ckpt):
+    model_dir, hf = vl3_ckpt
+    rng = np.random.default_rng(0)
+    pix, grid, n_tok = make_image(rng)
+    ids = vl_prompt([5, 9, 23], n_tok, [7, 30, 41])
+    want = hf_greedy(hf, ids, 8, pixel_values=torch.tensor(pix),
+                     image_grid_thw=torch.tensor(grid))
+
+    llm = make_llm(model_dir)
+    got = llm.generate(
+        prompt_token_ids=[ids],
+        mm_inputs=[{"pixel_values": pix, "image_grid_thw": grid}],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
+                                       ignore_eos=True))[0]
+    assert got.output_token_ids == want, (got.output_token_ids, want)
+
+
+def test_vl3_two_images_chunked_and_text_mix(vl3_ckpt):
+    model_dir, hf = vl3_ckpt
+    rng = np.random.default_rng(3)
+    pix_a, grid_a, n_a = make_image(rng, (1, 4, 4))
+    pix_b, grid_b, n_b = make_image(rng, (1, 4, 8))
+    two_pix = np.concatenate([pix_a, pix_b])
+    two_grid = np.concatenate([grid_a, grid_b])
+    ids2 = (vl_prompt([5, 9], n_a, [12])
+            + [VSTART] + [IMG] * n_b + [VEND] + [44, 3])
+    want2 = hf_greedy(hf, ids2, 6, pixel_values=torch.tensor(two_pix),
+                      image_grid_thw=torch.tensor(two_grid))
+
+    text_ids = [5, 17, 93, 41, 7]
+    cur = list(text_ids)
+    with torch.no_grad():
+        for _ in range(6):
+            logits = hf(input_ids=torch.tensor([cur])).logits[0, -1]
+            cur.append(int(logits.argmax()))
+    wantt = cur[len(text_ids):]
+
+    llm = make_llm(model_dir, max_prefill_tokens=8, min_prefill_tokens=4)
+    outs = llm.generate(
+        prompt_token_ids=[ids2, text_ids],
+        mm_inputs=[{"pixel_values": two_pix, "image_grid_thw": two_grid},
+                   None],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=6,
+                                       ignore_eos=True))
+    assert outs[0].output_token_ids == want2, (outs[0].output_token_ids,
+                                               want2)
+    assert outs[1].output_token_ids == wantt
+
+
+def test_vl3_video_per_frame_spans(vl3_ckpt):
+    """t=2 video: HF splits the grid into per-frame spans (timestamp text
+    between); our engine must normalize grids the same way."""
+    model_dir, hf = vl3_ckpt
+    rng = np.random.default_rng(7)
+    pix, grid, _ = make_image(rng, (2, 4, 4))
+    per_frame = 1 * 2 * 2
+    # <t1> <vstart> frame1 <vend> <t2> <vstart> frame2 <vend> text
+    ids = ([5, 11] + [VSTART] + [VID] * per_frame + [VEND]
+           + [12] + [VSTART] + [VID] * per_frame + [VEND] + [7, 30])
+    want = hf_greedy(hf, ids, 6,
+                     pixel_values_videos=torch.tensor(pix),
+                     video_grid_thw=torch.tensor(grid))
+
+    llm = make_llm(model_dir)
+    got = llm.generate(
+        prompt_token_ids=[ids],
+        mm_inputs=[{"video_pixel_values": pix, "video_grid_thw": grid}],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=6,
+                                       ignore_eos=True))[0]
+    assert got.output_token_ids == want, (got.output_token_ids, want)
+
+
+def test_vl3_prefix_cache_cold_warm(vl3_ckpt):
+    model_dir, _ = vl3_ckpt
+    rng = np.random.default_rng(9)
+    pix, grid, n_tok = make_image(rng, (1, 4, 4))
+    ids = vl_prompt([5, 9, 23, 8], n_tok, [7, 30, 2, 2, 9])
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    llm = make_llm(model_dir, prefix=True)
+
+    def run():
+        return llm.generate(
+            prompt_token_ids=[ids],
+            mm_inputs=[{"pixel_values": pix, "image_grid_thw": grid}],
+            sampling_params=sp)[0].output_token_ids
+
+    cold = run()
+    hits0 = llm.memory_manager.hit_tokens
+    warm = run()
+    assert warm == cold
+    assert llm.memory_manager.hit_tokens > hits0
+
+
+MOE_TEXT = dict(
+    vocab_size=160, hidden_size=64, num_hidden_layers=2,
+    num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+    intermediate_size=96, moe_intermediate_size=32, num_experts=4,
+    num_experts_per_tok=2, norm_topk_prob=True, decoder_sparse_step=1,
+    max_position_embeddings=512, rms_norm_eps=1e-6, rope_theta=10000.0,
+    tie_word_embeddings=False,
+    rope_scaling={"rope_type": "default", "mrope_section": [2, 3, 3],
+                  "mrope_interleaved": True},
+)
+
+
+@pytest.fixture(scope="module")
+def vl3_moe_ckpt(tmp_path_factory):
+    from transformers import (Qwen3VLMoeConfig,
+                              Qwen3VLMoeForConditionalGeneration)
+    torch.manual_seed(23)
+    cfg = Qwen3VLMoeConfig(
+        text_config=MOE_TEXT, vision_config=VISION,
+        image_token_id=IMG, video_token_id=VID,
+        vision_start_token_id=VSTART, vision_end_token_id=VEND,
+        eos_token_id=0, bos_token_id=1)
+    model = Qwen3VLMoeForConditionalGeneration(cfg)
+    model.eval()
+    d = tmp_path_factory.mktemp("tiny_vl3_moe")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d), model
+
+
+def test_vl3_moe_greedy_equivalence(vl3_moe_ckpt):
+    model_dir, hf = vl3_moe_ckpt
+    rng = np.random.default_rng(1)
+    pix, grid, n_tok = make_image(rng)
+    ids = vl_prompt([5, 9, 23], n_tok, [7, 30])
+    want = hf_greedy(hf, ids, 6, pixel_values=torch.tensor(pix),
+                     image_grid_thw=torch.tensor(grid))
+
+    llm = make_llm(model_dir)
+    got = llm.generate(
+        prompt_token_ids=[ids],
+        mm_inputs=[{"pixel_values": pix, "image_grid_thw": grid}],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=6,
+                                       ignore_eos=True))[0]
+    assert got.output_token_ids == want, (got.output_token_ids, want)
