@@ -87,6 +87,11 @@ class EngineConfig:
     quantization: Optional[str] = None
     enforce_eager: bool = False           # disable donation/async tricks (debug)
     attention_impl: str = "auto"          # auto | pallas | xla
+    # Disagg LM nodes: drop the vision tower from params after load —
+    # visual embeddings arrive from the encoder fleet (reference
+    # DisaggConfig.skip_visual). The engine can then only serve disagg
+    # (or text-only) requests.
+    skip_visual_load: bool = False
     scheduler: SchedulerConfig = dataclasses.field(default_factory=SchedulerConfig)
     cache: CacheConfig = dataclasses.field(default_factory=CacheConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)

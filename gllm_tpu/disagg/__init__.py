@@ -1,0 +1,16 @@
+"""Encoder disaggregation: vision towers in separate processes.
+
+TPU-native re-design of the reference subsystem
+(/root/reference/gllm/disagg/, ~2600 LoC): an LM server runs the language
+model only (``skip_visual``); one or more encoder servers own pixel IO +
+the ViT; a discovery registry with TTL leases lets either side start
+first. The reference moves embeddings GPU→GPU over NIXL/UCX RDMA; on TPU
+the natural landing zone is host RAM — our batch builder splices visual
+rows host-side and ships them with the per-step H2D transfer — so the
+data plane is a TCP slot-pool write (gllm_tpu/disagg/transfer.py), with
+the same register/write/notify contract NIXL provides.
+"""
+
+from gllm_tpu.disagg.config import DisaggConfig
+
+__all__ = ["DisaggConfig"]

@@ -1,0 +1,34 @@
+"""Encoder-disaggregation configuration, threaded from the entrypoints.
+
+Mirrors the reference's explicit config object
+(/root/reference/gllm/disagg/config.py): role flags consumed by the model
+loader (skip_visual / skip_language) plus the LM-side coordinator knobs.
+Runtime failure-injection / watchdog tuning stays in env vars like the
+reference (GLLM_TPU_ENC_FAIL_FIRST_N, GLLM_TPU_DISAGG_REDISPATCH_TIMEOUT_S,
+GLLM_TPU_DISAGG_MAX_REDISPATCH).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class DisaggConfig:
+    # Model-loader role flags.
+    skip_visual: bool = False     # LM node: no vision tower
+    skip_language: bool = False   # encoder node: vision tower only
+
+    # LM-side coordinator (None fields use defaults / derived values).
+    is_lm: bool = False
+    discovery_endpoint: str = ""          # "host:port"
+    lm_id: Optional[str] = None
+    processor_config_hash: str = ""
+    advertise_host: str = "127.0.0.1"
+    num_slots: int = 8
+    max_vis_tokens: int = 4096            # per-slot row capacity
+    # Gate B overlap: admit at meta-complete and prefill up to the first
+    # unready span (reference GLLM_DISAGG_OVERLAP). Off → admit only when
+    # every embedding landed.
+    overlap: bool = True

@@ -1,0 +1,218 @@
+"""Dynamic discovery registry with TTL leases.
+
+Re-design of the reference's zmq DEALER/ROUTER registry
+(/root/reference/gllm/disagg/discovery.py): encoder and LM servers are
+decoupled processes that find each other via a shared registry. Each side
+``publish``-es its role payload (control address, feat_dim, processor-config
+hash) and ``poll_events``-es the peer role for ADD/UPDATE/REMOVE diffs:
+
+* either side may start first (publish + watch are symmetric);
+* a killed member's lease expires → peers see REMOVE and drop it;
+* a restarted member re-publishes → ADD and reconnect;
+* processor-config mismatches are rejected at connect time.
+
+Transport is the stdlib framed-TCP server (gllm_tpu/disagg/wire.py);
+publishers renew every ttl/3, the server reaps stale leases on read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from gllm_tpu.disagg.wire import MsgServer, connect, recv_msg, send_msg
+
+
+@dataclass
+class Event:
+    kind: str          # "ADD" | "UPDATE" | "REMOVE"
+    identity: str
+    payload: dict
+
+
+def make_payload(*, role: str, addr: str, feat_dim: int = 0,
+                 processor_config_hash: str = "",
+                 extra: Optional[dict] = None) -> dict:
+    """Discovery payload for one member: ``addr`` is the member's control
+    endpoint ("host:port" of its job/meta server)."""
+    return {"role": role, "addr": addr, "feat_dim": int(feat_dim),
+            "processor_config_hash": processor_config_hash,
+            "extra": extra or {}}
+
+
+class DiscoveryServer:
+    """The standalone registry process (reference DiscoveryServer).
+
+    State: {identity: (payload, version, lease_deadline)}. Requests:
+      ("publish", identity, payload, ttl_ms) → ("ok",)
+      ("renew", identity)                    → ("ok"|"unknown",)
+      ("revoke", identity)                   → ("ok",)
+      ("list", role)                         → ("ok", {identity: (payload,
+                                                version)})
+    """
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 default_ttl_ms: float = 3000.0):
+        self._members: Dict[str, Tuple[dict, int, float, float]] = {}
+        self._lock = threading.Lock()
+        self.default_ttl_ms = default_ttl_ms
+        self._server = MsgServer(host, port, self._handle)
+        self.port = self._server.port
+
+    def start(self) -> "DiscoveryServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop()
+
+    def _reap(self, now: float) -> None:
+        dead = [k for k, (_, _, _, dl) in self._members.items() if now > dl]
+        for k in dead:
+            del self._members[k]
+
+    def _handle(self, msg, sock) -> None:
+        kind = msg[0]
+        now = time.monotonic() * 1000.0
+        with self._lock:
+            self._reap(now)
+            if kind == "publish":
+                _, identity, payload, ttl_ms = msg
+                ttl = ttl_ms or self.default_ttl_ms
+                old = self._members.get(identity)
+                version = (old[1] + 1) if old else 1
+                self._members[identity] = (payload, version, ttl, now + ttl)
+                send_msg(sock, ("ok",))
+            elif kind == "renew":
+                _, identity = msg
+                m = self._members.get(identity)
+                if m is None:
+                    send_msg(sock, ("unknown",))
+                else:
+                    payload, version, ttl, _ = m
+                    self._members[identity] = (payload, version, ttl,
+                                               now + ttl)
+                    send_msg(sock, ("ok",))
+            elif kind == "revoke":
+                _, identity = msg
+                self._members.pop(identity, None)
+                send_msg(sock, ("ok",))
+            elif kind == "list":
+                _, role = msg
+                out = {k: (p, v) for k, (p, v, _, _) in
+                       self._members.items() if p.get("role") == role}
+                send_msg(sock, ("ok", out))
+            else:
+                send_msg(sock, ("error", f"unknown request {kind!r}"))
+
+
+def serve_discovery(host: str = "0.0.0.0", port: int = 7606) -> None:
+    """Blocking entrypoint for a standalone registry (reference
+    discovery_server.py)."""
+    srv = DiscoveryServer(host, port).start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+class NetworkDiscovery:
+    """Client: publish-with-renewal + poll_events diffing for one watched
+    role (reference NetworkDiscovery)."""
+
+    def __init__(self, endpoint: str, ttl_ms: float = 3000.0):
+        host, _, port = endpoint.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.ttl_ms = ttl_ms
+        self._lock = threading.Lock()
+        self._sock = None
+        self._published: Dict[str, dict] = {}
+        self._seen: Dict[str, Tuple[dict, int]] = {}
+        self._renew_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def _request(self, msg):
+        with self._lock:
+            for attempt in (0, 1):
+                try:
+                    if self._sock is None:
+                        self._sock = connect(self._addr)
+                    send_msg(self._sock, msg)
+                    out = recv_msg(self._sock)
+                    if out is None:
+                        raise ConnectionError("registry EOF")
+                    return out
+                except (ConnectionError, OSError):
+                    try:
+                        if self._sock is not None:
+                            self._sock.close()
+                    finally:
+                        self._sock = None
+                    if attempt:
+                        raise
+            return None
+
+    def publish(self, identity: str, payload: dict) -> None:
+        self._request(("publish", identity, payload, self.ttl_ms))
+        self._published[identity] = payload
+        if self._renew_thread is None:
+            self._renew_thread = threading.Thread(target=self._renew_loop,
+                                                  daemon=True)
+            self._renew_thread.start()
+
+    def revoke(self, identity: str) -> None:
+        self._published.pop(identity, None)
+        try:
+            self._request(("revoke", identity))
+        except (ConnectionError, OSError):
+            pass
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(self.ttl_ms / 3000.0):
+            for identity, payload in list(self._published.items()):
+                try:
+                    out = self._request(("renew", identity))
+                    if out and out[0] == "unknown":
+                        # registry restarted → re-publish
+                        self._request(("publish", identity, payload,
+                                       self.ttl_ms))
+                except (ConnectionError, OSError):
+                    pass  # registry down; retry next tick
+
+    def list(self, role: str) -> Dict[str, dict]:
+        out = self._request(("list", role))
+        return {k: p for k, (p, _) in out[1].items()} if out else {}
+
+    def poll_events(self, role: str) -> List[Event]:
+        """Diff the registry's view of ``role`` against what we've seen."""
+        try:
+            out = self._request(("list", role))
+        except (ConnectionError, OSError):
+            return []
+        if not out or out[0] != "ok":
+            return []
+        current: Dict[str, Tuple[dict, int]] = out[1]
+        events: List[Event] = []
+        for identity, (payload, version) in current.items():
+            seen = self._seen.get(identity)
+            if seen is None:
+                events.append(Event("ADD", identity, payload))
+            elif seen[1] != version:
+                events.append(Event("UPDATE", identity, payload))
+        for identity, (payload, _) in list(self._seen.items()):
+            if identity not in current:
+                events.append(Event("REMOVE", identity, payload))
+        self._seen = dict(current)
+        return events
+
+    def close(self) -> None:
+        self._stop.set()
+        for identity in list(self._published):
+            self.revoke(identity)
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

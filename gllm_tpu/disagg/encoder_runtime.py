@@ -1,0 +1,295 @@
+"""Encoder node: pixel IO + ViT, serving encode jobs from LM nodes.
+
+Re-design of /root/reference/gllm/disagg/encoder_runtime.py +
+encoder_engine.py: the encoder process loads ONLY the vision tower
+(skip_language), publishes itself on the discovery registry, accepts
+EncoderJob messages, and for each job
+
+  1. runs the image processor on the raw content → pixels + grid,
+  2. sends MmItemMeta to the LM's meta endpoint (control plane, BEFORE the
+     ViT — this unblocks gate-A admission),
+  3. runs the ViT (LRU-cached by content hash),
+  4. writes the embedding into the LM's slot pool (the write ack doubles
+     as the embedding-ready notification).
+
+Failure injection: GLLM_TPU_ENC_FAIL_FIRST_N=<n> silently drops the first
+n jobs (reference GLLM_ENC_FAIL_FIRST_N) so the LM watchdog paths can be
+tested.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import logging
+import os
+import queue
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from gllm_tpu.disagg.discovery import NetworkDiscovery, make_payload
+from gllm_tpu.disagg.protocol import EncodeFailed, EncoderJob, MmItemMeta
+from gllm_tpu.disagg.transfer import TransferClient
+from gllm_tpu.disagg.wire import MsgServer, connect, send_msg
+from gllm_tpu.utils import LRUBytesCache
+
+logger = logging.getLogger(__name__)
+
+
+def load_raw_image(content):
+    """Raw job content → PIL image. Accepts PIL images, data URLs, base64
+    strings, file paths, and raw bytes."""
+    from PIL import Image
+    if hasattr(content, "convert"):          # PIL image
+        return content
+    if isinstance(content, bytes):
+        return Image.open(io.BytesIO(content)).convert("RGB")
+    if isinstance(content, str):
+        if content.startswith("data:"):
+            _, _, b64 = content.partition(",")
+            return Image.open(io.BytesIO(
+                base64.b64decode(b64))).convert("RGB")
+        if os.path.exists(content):
+            return Image.open(content).convert("RGB")
+        # bare base64
+        return Image.open(io.BytesIO(
+            base64.b64decode(content))).convert("RGB")
+    raise ValueError(f"unsupported image content type {type(content)!r}")
+
+
+class EncoderEngine:
+    """Processor + vision tower + per-item embedding cache (reference
+    encoder_engine.py:35-178)."""
+
+    def __init__(self, model_dir: str, dtype="float32"):
+        import jax.numpy as jnp
+
+        from gllm_tpu.models.config import from_hf_config
+        from gllm_tpu.models.loader import load_hf_config
+        from gllm_tpu.models.registry import get_model_def
+
+        self.model_cfg = from_hf_config(load_hf_config(model_dir))
+        assert self.model_cfg.use_mm, "encoder node needs a VL checkpoint"
+        self.model_def = get_model_def(self.model_cfg)
+        self.dtype = {"float32": jnp.float32,
+                      "bfloat16": jnp.bfloat16}[dtype]
+        # vision-only load: the full-template rules, filtered to visual.*
+        self.params = self._load_visual(model_dir)
+        from gllm_tpu.engine.mm_processing import load_image_processor
+        self.processor = load_image_processor(
+            model_dir, self.model_cfg.vision_config or {})
+        self._cache = LRUBytesCache()
+        merge = (self.model_cfg.vision_config or {}).get(
+            "spatial_merge_size", 2)
+        self._merge_unit = merge * merge
+
+    def _load_visual(self, model_dir: str) -> dict:
+        """Load only the visual.* half of the checkpoint (reference
+        skip_language, model_loader.py use_mm flags)."""
+        import jax
+
+        from gllm_tpu.models import loader as loader_mod
+        full = jax.eval_shape(
+            lambda: self.model_def.init_params(self.model_cfg,
+                                               dtype=self.dtype))
+        template = {"visual": full["visual"]}
+        if self.model_cfg.architecture.startswith("Qwen3VL"):
+            from gllm_tpu.models.qwen3_vl import _vl3_rules
+            base_rules = _vl3_rules(self.model_cfg)
+        else:
+            from gllm_tpu.models.qwen2_5_vl import _vl_rules
+            base_rules = _vl_rules(self.model_cfg)
+
+        def rules(name):
+            r = base_rules(name)
+            return r if r is not None and r[0][0] == "visual" else None
+
+        return loader_mod._load_params(model_dir, template, rules)
+
+    @property
+    def feat_dim(self) -> int:
+        return self.model_cfg.mm_embed_dim
+
+    def process(self, modality: str, content) -> Dict:
+        """Raw content → {pixels [n, patch_dim], grid_thw (t, h, w)}."""
+        if isinstance(content, dict) and "pixel_values" in content:
+            grid = np.asarray(content["grid_thw"]).reshape(-1)
+            assert grid.size == 3, \
+                f"one grid row per item, got shape {grid.shape}"
+            return {"pixels": np.asarray(content["pixel_values"],
+                                         np.float32),
+                    "grid_thw": tuple(int(v) for v in grid)}
+        if modality != "image":
+            raise NotImplementedError(
+                "video jobs must ship pre-processed pixels")
+        img = load_raw_image(content)
+        out = self.processor(images=[img], return_tensors="np")
+        grid = np.asarray(out["image_grid_thw"]).reshape(-1)[:3]
+        return {"pixels": np.asarray(out["pixel_values"], np.float32),
+                "grid_thw": tuple(int(v) for v in grid)}
+
+    def num_vis_tokens(self, grid_thw) -> int:
+        t, h, w = grid_thw
+        return t * h * w // self._merge_unit
+
+    def encode(self, pixels: np.ndarray, grid_thw,
+               content_hash: bytes) -> np.ndarray:
+        cached = self._cache.get(content_hash)
+        if cached is not None:
+            return cached
+        import jax.numpy as jnp
+        out = self.model_def.embed_mm(
+            self.params, self.model_cfg,
+            jnp.asarray(pixels).astype(self.dtype), grid_thw)
+        arr = np.asarray(out, np.float32)
+        self._cache.put(content_hash, arr)
+        return arr
+
+
+class EncoderRuntime:
+    """Job server + discovery client + worker thread (reference
+    encoder_runtime.py:47-423)."""
+
+    def __init__(self, engine: EncoderEngine, discovery_endpoint: str,
+                 encoder_id: str = "enc0", advertise_host: str = "127.0.0.1",
+                 processor_config_hash: str = "", port: int = 0):
+        self.engine = engine
+        self.encoder_id = encoder_id
+        self._jobs: "queue.Queue[EncoderJob]" = queue.Queue()
+        self._server = MsgServer("0.0.0.0", port, self._handle)
+        self.port = self._server.port
+        self._discovery = NetworkDiscovery(discovery_endpoint)
+        self._payload = make_payload(
+            role="encoder", addr=f"{advertise_host}:{self.port}",
+            feat_dim=engine.feat_dim,
+            processor_config_hash=processor_config_hash)
+        self._transfer: Dict[str, TransferClient] = {}
+        self._meta_socks: Dict[str, object] = {}
+        self._fail_first_n = int(os.environ.get(
+            "GLLM_TPU_ENC_FAIL_FIRST_N", "0"))
+        self._jobs_seen = 0
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+
+    def _handle(self, msg, sock) -> None:
+        if isinstance(msg, EncoderJob):
+            self._jobs.put(msg)
+        else:
+            logger.warning("encoder: unknown message %r", type(msg))
+
+    def _send_meta(self, addr: str, obj) -> None:
+        sock = self._meta_socks.get(addr)
+        for attempt in (0, 1):
+            try:
+                if sock is None:
+                    host, _, port = addr.rpartition(":")
+                    sock = connect((host or "127.0.0.1", int(port)))
+                    self._meta_socks[addr] = sock
+                send_msg(sock, obj)
+                return
+            except (ConnectionError, OSError):
+                if sock is not None:
+                    sock.close()
+                self._meta_socks.pop(addr, None)
+                sock = None
+                if attempt:
+                    raise
+
+    def _transfer_client(self, addr: str) -> TransferClient:
+        cli = self._transfer.get(addr)
+        if cli is None:
+            cli = self._transfer[addr] = TransferClient(addr)
+        return cli
+
+    def _meta_phase(self, job: EncoderJob):
+        """Cheap CPU half: processor + hash + meta send. Returns the prep
+        dict for the ViT phase, or None (dropped / failed)."""
+        self._jobs_seen += 1
+        if self._jobs_seen <= self._fail_first_n:
+            logger.warning("encoder %s: dropping job %d/%d (fail "
+                           "injection)", self.encoder_id, self._jobs_seen,
+                           self._fail_first_n)
+            return None
+        from gllm_tpu.engine.mm import content_hash
+        try:
+            prep = self.engine.process(job.modality, job.content)
+        except Exception as e:  # bad image / IO error → tell the LM
+            logger.exception("encoder %s: processing failed", self.encoder_id)
+            self._send_meta(job.lm_meta_addr,
+                            EncodeFailed(job.seq_id, job.item_idx, str(e)))
+            return None
+        grid = prep["grid_thw"]
+        prep["hash"] = content_hash(prep["pixels"], grid)
+        meta = MmItemMeta(
+            seq_id=job.seq_id, item_idx=job.item_idx,
+            modality=job.modality,
+            num_tokens=self.engine.num_vis_tokens(grid),
+            feat_dim=self.engine.feat_dim, grid_thw=grid,
+            content_hash=prep["hash"], slot_id=job.slot_id)
+        self._send_meta(job.lm_meta_addr, meta)       # control plane first
+        return prep
+
+    def _vit_phase(self, job: EncoderJob, prep) -> None:
+        emb = self.engine.encode(prep["pixels"], prep["grid_thw"],
+                                 prep["hash"])
+        self._transfer_client(job.lm_transfer_addr).write(
+            job.seq_id, job.item_idx, job.slot_id, emb)
+
+    def _worker_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                batch = [self._jobs.get(timeout=0.1)]
+            except queue.Empty:
+                continue
+            # Drain everything available so the cheap meta phase runs for
+            # ALL queued jobs before any heavy ViT — metas unblock gate-A
+            # admission on the LM (reference encoder_runtime.py:373-376).
+            while True:
+                try:
+                    batch.append(self._jobs.get_nowait())
+                except queue.Empty:
+                    break
+            preps = []
+            for job in batch:
+                try:
+                    preps.append((job, self._meta_phase(job)))
+                except Exception:
+                    logger.exception("encoder %s: meta (%d, %d) failed",
+                                     self.encoder_id, job.seq_id,
+                                     job.item_idx)
+                    preps.append((job, None))
+            for job, prep in preps:
+                if prep is None:
+                    continue
+                try:
+                    self._vit_phase(job, prep)
+                except Exception:
+                    logger.exception("encoder %s: job (%d, %d) failed",
+                                     self.encoder_id, job.seq_id,
+                                     job.item_idx)
+
+    def start(self) -> "EncoderRuntime":
+        self._server.start()
+        self._discovery.publish(self.encoder_id, self._payload)
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        daemon=True)
+        self._worker.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self.start()
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            self.stop()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._discovery.close()
+        self._server.stop()
+        for cli in self._transfer.values():
+            cli.close()

@@ -1,0 +1,496 @@
+"""LM-side disaggregation coordinator: dispatch, two-gate admission,
+watchdog redispatch.
+
+Re-design of /root/reference/gllm/disagg/lm_manager.py (962 LoC) for the
+single-controller engine: the reference splits receive endpoints per TP
+rank (NIXL multi-write) and fans DisaggEvents out over zmq so replicated
+schedulers stay deterministic; our engine has ONE controller thread per
+host driving all chips through GSPMD, so there is exactly one slot pool
+and ``poll()`` is called inline from the engine step loop — no event
+fan-out, no lockstep protocol.
+
+Gate A: all per-item metas arrived → expand skeleton sentinels, build
+MMState (positions / prefix-cache hash ids) via the SAME
+``finish_mm_state`` path the monolith uses, admit to the scheduler.
+Gate B: embeddings stream in progressively; ``Sequence.disagg_prefill_limit``
+caps chunked prefill at the first unready span (scheduler honors it).
+
+Watchdog: an item with no meta+embedding within the timeout is
+re-dispatched to another encoder replica (bounded attempts), then the seq
+is aborted (reference lm_manager.py:702-792).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gllm_tpu.disagg.config import DisaggConfig
+from gllm_tpu.disagg.discovery import NetworkDiscovery, make_payload
+from gllm_tpu.disagg.protocol import EncodeFailed, EncoderJob, MmItemMeta
+from gllm_tpu.disagg.transfer import SlotPool
+from gllm_tpu.disagg.wire import MsgServer, connect, send_msg
+
+logger = logging.getLogger(__name__)
+
+def _watchdog_params():
+    """(timeout_s, max_redispatch) — read per call so tests can tune."""
+    return (float(os.environ.get(
+                "GLLM_TPU_DISAGG_REDISPATCH_TIMEOUT_S", "10.0")),
+            int(os.environ.get("GLLM_TPU_DISAGG_MAX_REDISPATCH", "2")))
+
+
+@dataclass
+class DisaggSeqState:
+    """Per-seq gate state, attached as ``Sequence.disagg`` at admission.
+
+    ``item_span`` / ``vis_span`` are in image-then-video order (matching
+    the mm.vis_embeds row layout); spans are (start, end) in token space
+    and visual-row space respectively."""
+    item_span: List[Tuple[int, int]]
+    vis_span: List[Tuple[int, int]]
+    ready: List[bool]
+
+    def prefill_limit(self) -> Optional[int]:
+        unready = [s for (s, _), r in zip(self.item_span, self.ready)
+                   if not r]
+        return min(unready) if unready else None
+
+    @property
+    def all_ready(self) -> bool:
+        return all(self.ready)
+
+
+@dataclass
+class _PendingItem:
+    item_idx: int
+    modality: str
+    content: object
+    slot_id: int = -1
+    meta: Optional[MmItemMeta] = None
+    embedding: Optional[Tuple[int, int]] = None   # (slot_id, num_tokens)
+    encoder_identity: Optional[str] = None
+    queued_at: float = 0.0         # submit time (give-up clock when no
+    dispatched_at: float = 0.0     # encoder ever takes the job)
+    attempts: int = 0
+
+    @property
+    def done(self) -> bool:
+        return self.meta is not None and self.embedding is not None
+
+
+@dataclass
+class _PendingSeq:
+    seq: object
+    items: List[_PendingItem]
+    admitted: bool = False
+    failed: bool = False
+    # image-then-video ordering of items (mm.vis_embeds row layout),
+    # fixed at admission
+    ordered: Optional[List[_PendingItem]] = None
+
+    @property
+    def meta_complete(self) -> bool:
+        return all(it.meta is not None for it in self.items)
+
+    @property
+    def all_embeddings_ready(self) -> bool:
+        return all(it.embedding is not None for it in self.items)
+
+
+@dataclass
+class _EncoderConn:
+    identity: str
+    addr: str
+    sock: object = None
+
+
+@dataclass
+class DisaggEvents:
+    """Per-poll decisions for the engine step loop."""
+    admits: List[object] = field(default_factory=list)    # Sequences
+    aborts: List[object] = field(default_factory=list)    # Sequences
+
+    def __bool__(self) -> bool:
+        return bool(self.admits or self.aborts)
+
+
+class DisaggCoordinator:
+    def __init__(self, model_cfg, cfg: DisaggConfig):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.pool = SlotPool(cfg.num_slots, cfg.max_vis_tokens,
+                             model_cfg.mm_embed_dim)
+        self._meta_lock = threading.Lock()
+        self._metas: List[object] = []
+        self._meta_server = MsgServer("0.0.0.0", 0, self._on_meta)
+        self._meta_server.start()
+        self.meta_addr = f"{cfg.advertise_host}:{self._meta_server.port}"
+        self.transfer_addr = f"{cfg.advertise_host}:{self.pool.port}"
+        self._discovery = NetworkDiscovery(cfg.discovery_endpoint)
+        self._lm_id = cfg.lm_id or "lm0"
+        self._discovery.publish(self._lm_id, make_payload(
+            role="lm", addr=self.meta_addr,
+            feat_dim=model_cfg.mm_embed_dim,
+            processor_config_hash=cfg.processor_config_hash))
+        self._encoders: Dict[str, _EncoderConn] = {}
+        self._rr = 0
+        self._pending: Dict[int, _PendingSeq] = {}
+        # (seq, item) pairs awaiting dispatch; submit() runs on request
+        # threads while poll() runs on the engine thread
+        self._dispatch_queue: List[Tuple[int, int]] = []
+        # abort requests from HTTP threads, applied inside poll() so slot
+        # frees never race _apply_ready on the engine thread
+        self._abort_requests: List[int] = []
+        self._queue_lock = threading.Lock()
+
+    # ---- encoder connections ----------------------------------------------
+
+    def _drain_discovery(self) -> None:
+        for ev in self._discovery.poll_events("encoder"):
+            if ev.kind in ("ADD", "UPDATE"):
+                pl = ev.payload
+                if (self.cfg.processor_config_hash
+                        and pl.get("processor_config_hash")
+                        and pl["processor_config_hash"]
+                        != self.cfg.processor_config_hash):
+                    logger.warning("encoder %s rejected: processor config "
+                                   "mismatch", ev.identity)
+                    continue
+                old = self._encoders.get(ev.identity)
+                if old is not None and old.sock is not None:
+                    try:
+                        old.sock.close()
+                    except OSError:
+                        pass
+                self._encoders[ev.identity] = _EncoderConn(
+                    ev.identity, pl["addr"])
+                logger.info("encoder %s connected (%s)", ev.identity,
+                            pl["addr"])
+            elif ev.kind == "REMOVE":
+                conn = self._encoders.pop(ev.identity, None)
+                if conn is not None and conn.sock is not None:
+                    try:
+                        conn.sock.close()
+                    except OSError:
+                        pass
+                logger.info("encoder %s removed", ev.identity)
+
+    def _pick_encoder(self, avoid: Optional[str] = None) \
+            -> Optional[_EncoderConn]:
+        conns = list(self._encoders.values())
+        if not conns:
+            return None
+        if avoid and len(conns) > 1:
+            conns = [c for c in conns if c.identity != avoid]
+        self._rr += 1
+        return conns[self._rr % len(conns)]
+
+    def _send_job(self, conn: _EncoderConn, job: EncoderJob) -> bool:
+        try:
+            if conn.sock is None:
+                host, _, port = conn.addr.rpartition(":")
+                conn.sock = connect((host or "127.0.0.1", int(port)))
+            send_msg(conn.sock, job)
+            return True
+        except (ConnectionError, OSError):
+            if conn.sock is not None:
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass
+                conn.sock = None
+            return False
+
+    # ---- request intake ----------------------------------------------------
+
+    def submit(self, seq, raw_items: List[Tuple[str, object]]) -> None:
+        """``seq.token_ids`` is the text-only skeleton (one sentinel per
+        item); ``raw_items`` is [(modality, content)] in prompt order."""
+        n_sentinels = sum(
+            1 for t in seq.token_ids
+            if t in (self.model_cfg.image_token_id,
+                     self.model_cfg.video_token_id))
+        assert n_sentinels == len(raw_items), \
+            f"{n_sentinels} sentinels != {len(raw_items)} items"
+        if self.model_cfg.mm_per_frame_video and any(
+                m == "video" for m, _ in raw_items):
+            # per-frame-video models (Qwen3-VL) need per-frame grid
+            # normalization that disagg metas don't carry yet; reject
+            # cleanly instead of silently diverging from the monolith
+            raise ValueError("video items over disagg are not supported "
+                             "for per-frame-video models yet")
+        now = time.monotonic()
+        ps = _PendingSeq(seq=seq, items=[
+            _PendingItem(item_idx=i, modality=m, content=c, queued_at=now)
+            for i, (m, c) in enumerate(raw_items)])
+        with self._queue_lock:
+            self._pending[seq.seq_id] = ps
+            for it in ps.items:
+                self._dispatch_queue.append((seq.seq_id, it.item_idx))
+
+    def _try_dispatch(self) -> None:
+        with self._queue_lock:
+            todo, self._dispatch_queue = self._dispatch_queue, []
+        remaining = []
+        for seq_id, item_idx in todo:
+            ps = self._pending.get(seq_id)
+            if ps is None or ps.failed:
+                continue
+            it = ps.items[item_idx]
+            conn = self._pick_encoder()
+            if conn is None:
+                remaining.append((seq_id, item_idx))
+                continue
+            if it.slot_id < 0:
+                slot = self.pool.alloc()
+                if slot is None:
+                    remaining.append((seq_id, item_idx))
+                    continue
+                it.slot_id = slot
+            self.pool.expect(seq_id, item_idx, it.slot_id)
+            job = EncoderJob(
+                seq_id=seq_id, item_idx=item_idx, modality=it.modality,
+                content=it.content, slot_id=it.slot_id,
+                lm_transfer_addr=self.transfer_addr,
+                lm_meta_addr=self.meta_addr)
+            if not self._send_job(conn, job):
+                remaining.append((seq_id, item_idx))
+                continue
+            it.encoder_identity = conn.identity
+            it.dispatched_at = time.monotonic()
+            it.attempts += 1
+        with self._queue_lock:
+            self._dispatch_queue = remaining + self._dispatch_queue
+
+    # ---- inbound control ---------------------------------------------------
+
+    def _on_meta(self, msg, sock) -> None:
+        with self._meta_lock:
+            self._metas.append(msg)
+
+    def _drain_meta(self, events: DisaggEvents) -> None:
+        with self._meta_lock:
+            msgs, self._metas = self._metas, []
+        for msg in msgs:
+            ps = self._pending.get(getattr(msg, "seq_id", -1))
+            if ps is None:
+                continue
+            if isinstance(msg, EncodeFailed):
+                logger.warning("encode failed for seq %d item %d: %s",
+                               msg.seq_id, msg.item_idx, msg.error)
+                self._fail_seq(ps, events)
+                continue
+            assert isinstance(msg, MmItemMeta)
+            it = ps.items[msg.item_idx]
+            if it.meta is None:
+                if msg.num_tokens > self.pool.max_tokens:
+                    logger.warning(
+                        "seq %d item %d: %d visual tokens exceed the slot "
+                        "capacity %d", msg.seq_id, msg.item_idx,
+                        msg.num_tokens, self.pool.max_tokens)
+                    self._fail_seq(ps, events)
+                    continue
+                it.meta = msg
+
+    def _drain_landed(self) -> None:
+        for (seq_id, item_idx), (slot_id, n) in \
+                self.pool.drain_landed().items():
+            ps = self._pending.get(seq_id)
+            if ps is None:
+                # aborted while in flight; reclaim the slot if it was ours
+                continue
+            it = ps.items[item_idx]
+            if it.embedding is None and it.slot_id == slot_id:
+                it.embedding = (slot_id, n)
+
+    # ---- watchdog ----------------------------------------------------------
+
+    def _check_watchdog(self, events: DisaggEvents) -> None:
+        timeout_s, max_redispatch = _watchdog_params()
+        now = time.monotonic()
+        for ps in list(self._pending.values()):
+            if ps.failed:
+                continue
+            for it in ps.items:
+                if it.done:
+                    continue
+                if it.attempts == 0:
+                    # never dispatched (no encoder / no free slot): give
+                    # the fleet the whole redispatch budget, then abort so
+                    # clients don't hang forever
+                    if now - it.queued_at > timeout_s * (max_redispatch
+                                                         + 1):
+                        logger.warning("seq %d item %d: no encoder took "
+                                       "the job; aborting",
+                                       ps.seq.seq_id, it.item_idx)
+                        self._fail_seq(ps, events)
+                        break
+                    continue
+                if now - it.dispatched_at < timeout_s:
+                    continue
+                if it.attempts > max_redispatch:
+                    logger.warning("seq %d item %d: encode gave up after "
+                                   "%d attempts", ps.seq.seq_id,
+                                   it.item_idx, it.attempts)
+                    self._fail_seq(ps, events)
+                    break
+                conn = self._pick_encoder(avoid=it.encoder_identity)
+                if conn is None:
+                    it.dispatched_at = now   # re-arm; no replica yet
+                    continue
+                logger.warning("seq %d item %d: re-dispatching to %s "
+                               "(attempt %d)", ps.seq.seq_id, it.item_idx,
+                               conn.identity, it.attempts + 1)
+                job = EncoderJob(
+                    seq_id=ps.seq.seq_id, item_idx=it.item_idx,
+                    modality=it.modality, content=it.content,
+                    slot_id=it.slot_id,
+                    lm_transfer_addr=self.transfer_addr,
+                    lm_meta_addr=self.meta_addr)
+                if self._send_job(conn, job):
+                    it.encoder_identity = conn.identity
+                    it.dispatched_at = now
+                    it.attempts += 1
+
+    def _fail_seq(self, ps: _PendingSeq, events: DisaggEvents) -> None:
+        ps.failed = True
+        self._release_slots(ps)
+        events.aborts.append(ps.seq)
+        self._pending.pop(ps.seq.seq_id, None)
+
+    def _release_slots(self, ps: _PendingSeq) -> None:
+        for it in ps.items:
+            if it.slot_id >= 0:
+                self.pool.free(it.slot_id)
+                it.slot_id = -1
+
+    # ---- admission (gate A) ------------------------------------------------
+
+    def _admit(self, ps: _PendingSeq) -> None:
+        from gllm_tpu.engine.mm import MMItem, finish_mm_state
+        seq = ps.seq
+        cfg = self.model_cfg
+
+        # 1) expand skeleton sentinels → num_tokens placeholder ids
+        expanded: List[int] = []
+        spans: List[Tuple[int, int]] = []     # token spans, item order
+        cursor = 0
+        for tid in seq.token_ids:
+            if tid in (cfg.image_token_id, cfg.video_token_id):
+                n = ps.items[cursor].meta.num_tokens
+                spans.append((len(expanded), len(expanded) + n))
+                expanded.extend([tid] * n)
+                cursor += 1
+            else:
+                expanded.append(tid)
+        assert cursor == len(ps.items)
+
+        # 2) MMState through the monolith's own path (pixels=None items;
+        #    positions / hash ids / vis_index identical by construction)
+        items = [MMItem(it.modality, None,
+                        tuple(int(v) for v in it.meta.grid_thw),
+                        it.meta.content_hash)
+                 for it in ps.items]
+        mm = finish_mm_state(expanded, cfg, items)
+        mm.vis_embeds = np.zeros((mm.num_vis_tokens, cfg.mm_embed_dim),
+                                 np.float32)
+
+        # 3) visual-row spans in image-then-video order (mm row layout)
+        ordered = ([it for it in ps.items if it.modality == "image"]
+                   + [it for it in ps.items if it.modality == "video"])
+        vis_spans = []
+        row = 0
+        for it in ordered:
+            vis_spans.append((row, row + it.meta.num_tokens))
+            row += it.meta.num_tokens
+        token_spans = [spans[it.item_idx] for it in ordered]
+
+        # 4) rewrite the seq into a fully-formed prefill request
+        seq.token_ids = expanded
+        seq.raw_prompt_len = len(expanded)
+        seq.prompt_len = len(expanded)
+        seq.detok_prefix_offset = max(0, len(expanded) - 6)
+        seq.detok_read_offset = len(expanded)
+        seq.mm = mm
+        seq.disagg = DisaggSeqState(
+            item_span=token_spans, vis_span=vis_spans,
+            ready=[False] * len(ordered))
+        ps.ordered = ordered
+        ps.admitted = True
+
+    def _apply_ready(self, ps: _PendingSeq) -> None:
+        """Clone landed embeddings into mm.vis_embeds + flip gate-B flags
+        + return slots to the pool."""
+        if not ps.admitted:
+            return
+        st = ps.seq.disagg
+        for k, it in enumerate(ps.ordered):
+            if st.ready[k] or it.embedding is None:
+                continue
+            slot_id, n = it.embedding
+            vs, ve = st.vis_span[k]
+            assert n == ve - vs, (n, vs, ve)
+            ps.seq.mm.vis_embeds[vs:ve] = self.pool.clone(slot_id, n)
+            st.ready[k] = True
+            self.pool.free(slot_id)
+            it.slot_id = -1
+
+    # ---- the per-step poll -------------------------------------------------
+
+    def poll(self) -> DisaggEvents:
+        events = DisaggEvents()
+        with self._queue_lock:
+            aborts, self._abort_requests = self._abort_requests, []
+        for sid in aborts:
+            ps = self._pending.pop(sid, None)
+            if ps is not None:
+                ps.failed = True
+                self._release_slots(ps)
+        self._drain_discovery()
+        self._drain_meta(events)
+        self._drain_landed()
+        self._try_dispatch()
+        self._check_watchdog(events)
+        for ps in list(self._pending.values()):
+            if ps.failed:
+                continue
+            if not ps.admitted and ps.meta_complete:
+                if self.cfg.overlap or ps.all_embeddings_ready:
+                    self._admit(ps)
+                    self._apply_ready(ps)
+                    events.admits.append(ps.seq)
+                    continue
+            if ps.admitted:
+                self._apply_ready(ps)
+            if ps.admitted and ps.seq.disagg.all_ready:
+                self._pending.pop(ps.seq.seq_id, None)
+        return events
+
+    def abort(self, seq_ids) -> None:
+        """Thread-safe: records the request; slot frees happen inside the
+        next poll() on the engine thread (a free racing _apply_ready would
+        double-free a slot)."""
+        with self._queue_lock:
+            self._abort_requests.extend(seq_ids)
+
+    @property
+    def num_pending(self) -> int:
+        return len(self._pending)
+
+    def close(self) -> None:
+        self._discovery.close()
+        self._meta_server.stop()
+        self.pool.close()
+        for conn in self._encoders.values():
+            if conn.sock is not None:
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass

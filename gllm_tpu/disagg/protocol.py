@@ -1,0 +1,60 @@
+"""Wire formats for the encoder-disaggregation control plane.
+
+Tiny picklable dataclasses (reference /root/reference/gllm/disagg/
+protocol.py); the bulk payload (the visual embedding) never travels the
+control plane — it goes over the transfer slot pool
+(gllm_tpu/disagg/transfer.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+@dataclass
+class EncoderJob:
+    """LM → encoder: "encode this one mm item into that slot"."""
+    seq_id: int
+    item_idx: int        # prompt order; pairs with the i-th sentinel
+    modality: str        # "image" | "video"
+    content: object      # raw mm reference (URL / base64 / ndarray dict)
+    slot_id: int = -1
+    # LM transfer endpoint ("host:port") + meta endpoint so a freshly
+    # discovered encoder can reply without a registry round-trip.
+    lm_transfer_addr: str = ""
+    lm_meta_addr: str = ""
+
+
+@dataclass
+class MmItemMeta:
+    """Encoder → LM: per-item shape/hash, sent BEFORE the ViT runs.
+
+    Lets the LM expand skeleton sentinels and build prefix-cache keys +
+    mrope positions without waiting for embedding bytes (gate A)."""
+    seq_id: int
+    item_idx: int
+    modality: str
+    num_tokens: int              # prod(grid)/merge² visual tokens
+    feat_dim: int
+    grid_thw: Tuple[int, ...]
+    content_hash: bytes
+    slot_id: int = -1
+    second_per_grid_ts: Optional[float] = None
+
+
+@dataclass
+class EmbNotif:
+    """Encoder → LM: "(seq, item) embedding landed in its slot"."""
+    seq_id: int
+    item_idx: int
+    slot_id: int
+    num_tokens: int
+
+
+@dataclass
+class EncodeFailed:
+    """Encoder → LM: processing this item raised (bad image, IO error)."""
+    seq_id: int
+    item_idx: int
+    error: str = ""

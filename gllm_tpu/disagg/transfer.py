@@ -1,0 +1,141 @@
+"""Embedding transfer: a host-RAM slot pool with a TCP write endpoint.
+
+The NIXL/UCX stand-in (/root/reference/gllm/transfer/nixl_transfer.py):
+same register/write/notify contract, different landing zone. The reference
+RDMA-writes GPU→GPU because its model consumes embeddings from device
+memory; our batch builder splices visual rows host-side and ships them
+with the per-step fused H2D transfer (gllm_tpu/runner/prepare.py), so the
+right destination is pinned host memory — a TCP stream into a numpy pool.
+On multi-NIC hosts this rides DCN exactly like the reference's UCX path.
+
+LM side: ``SlotPool`` — ``[num_slots, max_tokens, feat_dim]`` float32 pool
++ a server accepting WRITE frames. Encoder side: ``TransferClient`` —
+connect once per LM, stream (header, raw bytes) per item.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gllm_tpu.disagg.wire import MsgServer, connect, recv_raw, send_msg
+
+
+class SlotPool:
+    """Pre-registered receive slots + free-list (reference DisaggReceiver
+    slot pool, lm_manager.py:156-254)."""
+
+    def __init__(self, num_slots: int, max_tokens: int, feat_dim: int,
+                 host: str = "0.0.0.0", port: int = 0):
+        self.num_slots = num_slots
+        self.max_tokens = max_tokens
+        self.feat_dim = feat_dim
+        self.pool = np.zeros((num_slots, max_tokens, feat_dim), np.float32)
+        self._free: List[int] = list(range(num_slots))
+        self._lock = threading.Lock()
+        # (seq_id, item_idx) → (slot_id, num_tokens) writes that landed
+        self._landed: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        # (seq_id, item_idx) → slot_id reservations; writes that don't
+        # match are dropped (guards a freed-and-reused slot against a late
+        # write from a redispatch-superseded encoder)
+        self._expected: Dict[Tuple[int, int], int] = {}
+        self._server = MsgServer(host, port, self._handle)
+        self.port = self._server.port
+        self._server.start()
+
+    @property
+    def num_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def alloc(self) -> Optional[int]:
+        with self._lock:
+            return self._free.pop() if self._free else None
+
+    def free(self, slot_id: int) -> None:
+        with self._lock:
+            assert slot_id not in self._free, f"double free of {slot_id}"
+            self._free.append(slot_id)
+            for key, sid in list(self._expected.items()):
+                if sid == slot_id:
+                    del self._expected[key]
+
+    def expect(self, seq_id: int, item_idx: int, slot_id: int) -> None:
+        with self._lock:
+            self._expected[(seq_id, item_idx)] = slot_id
+
+    def _handle(self, msg, sock) -> None:
+        kind = msg[0]
+        if kind == "write":
+            # ("write", seq_id, item_idx, slot_id, num_tokens) + raw f32
+            _, seq_id, item_idx, slot_id, n = msg
+            raw = recv_raw(sock)
+            if raw is None:
+                return
+            # check + copy + record under one lock: a write racing a
+            # free/re-alloc of the same slot (redispatch-superseded
+            # encoder) must not land after the reservation moved on
+            with self._lock:
+                ok = self._expected.get((seq_id, item_idx)) == slot_id
+                if ok:
+                    arr = np.frombuffer(raw, np.float32).reshape(
+                        n, self.feat_dim)
+                    self.pool[slot_id, :n] = arr
+                    self._landed[(seq_id, item_idx)] = (slot_id, n)
+            send_msg(sock, ("ok",) if ok else ("stale",))
+        else:
+            send_msg(sock, ("error", f"unknown request {kind!r}"))
+
+    def drain_landed(self) -> Dict[Tuple[int, int], Tuple[int, int]]:
+        """Landed writes since the last drain (the notification channel —
+        the write ack IS the notif, so 'notified' implies bytes visible)."""
+        with self._lock:
+            out, self._landed = self._landed, {}
+        return out
+
+    def clone(self, slot_id: int, num_tokens: int) -> np.ndarray:
+        return self.pool[slot_id, :num_tokens].copy()
+
+    def close(self) -> None:
+        self._server.stop()
+
+
+class TransferClient:
+    """Encoder-side writer: one persistent connection per LM endpoint."""
+
+    def __init__(self, addr: str):
+        host, _, port = addr.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def write(self, seq_id: int, item_idx: int, slot_id: int,
+              embedding: np.ndarray) -> None:
+        """Blocking write + ack; raises on connection failure (the caller
+        retries / redispatches)."""
+        emb = np.ascontiguousarray(embedding, np.float32)
+        with self._lock:
+            if self._sock is None:
+                self._sock = connect(self._addr)
+            from gllm_tpu.disagg.wire import recv_msg, send_msg as _send
+            try:
+                _send(self._sock,
+                      ("write", seq_id, item_idx, slot_id, emb.shape[0]),
+                      raw=emb.tobytes())
+                out = recv_msg(self._sock)
+            except (ConnectionError, OSError):
+                self._sock.close()
+                self._sock = None
+                raise
+            # "stale" = the reservation moved on (redispatch superseded
+            # this write); nothing more for the encoder to do.
+            if not out or out[0] not in ("ok", "stale"):
+                raise ConnectionError(f"transfer write failed: {out!r}")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

@@ -1,0 +1,109 @@
+"""Length-prefixed pickle framing over TCP (the control/data plane wire).
+
+The reference ships control messages as pickled dataclasses over zmq
+PUSH/PULL (/root/reference/gllm/disagg/protocol.py:1-10) and bulk bytes
+over NIXL. We use one stdlib framing for both: ``[u32 length][pickle]``
+on a blocking TCP socket, with a tiny threaded dispatcher for servers.
+Messages stay small on the control plane; the transfer plane (transfer.py)
+sends embedding bytes as a raw buffer after its header message to avoid
+pickling multi-MB arrays.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Optional, Tuple
+
+_LEN = struct.Struct("!I")
+
+
+def send_msg(sock: socket.socket, obj, raw: Optional[bytes] = None) -> None:
+    """Send one framed message; ``raw`` (if given) follows as
+    ``[u32 length][bytes]`` without pickling."""
+    payload = pickle.dumps(obj)
+    parts = [_LEN.pack(len(payload)), payload]
+    if raw is not None:
+        parts += [_LEN.pack(len(raw)), raw]
+    sock.sendall(b"".join(parts))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket):
+    """Receive one framed message; returns None on clean EOF."""
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    payload = _recv_exact(sock, _LEN.unpack(head)[0])
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def recv_raw(sock: socket.socket) -> Optional[bytes]:
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    return _recv_exact(sock, _LEN.unpack(head)[0])
+
+
+def connect(addr: Tuple[str, int], timeout: float = 10.0) -> socket.socket:
+    sock = socket.create_connection(addr, timeout=timeout)
+    sock.settimeout(None)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+class MsgServer:
+    """Threaded TCP server: one handler thread per connection, each loop
+    iteration reads a framed message and passes (msg, sock) to ``handle``.
+    The handler may read additional frames (e.g. a raw buffer) from the
+    socket and reply with send_msg."""
+
+    def __init__(self, host: str, port: int,
+                 handle: Callable[[object, socket.socket], None]):
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                self.request.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                while True:
+                    try:
+                        msg = recv_msg(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    if msg is None:
+                        return
+                    outer._handle(msg, self.request)
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._handle = handle
+        self._server = _Server((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True)
+
+    def start(self) -> "MsgServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

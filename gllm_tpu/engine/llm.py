@@ -128,6 +128,61 @@ class LLM:
         self._next_seq_id = 0
         from collections import deque
         self._in_flight = deque()
+        # Encoder disaggregation (gllm_tpu/disagg/): set by init_disagg on
+        # LM nodes; monolith engines leave it None.
+        self.disagg_coordinator = None
+
+    def init_disagg(self, disagg_cfg) -> None:
+        """Become a disagg LM node: start the coordinator (slot pool,
+        discovery, meta server). Reference Worker._maybe_init_disagg."""
+        from gllm_tpu.disagg.lm_manager import DisaggCoordinator
+        if not self.model_cfg.use_mm:
+            raise ValueError("disagg LM mode needs a VL checkpoint")
+        if self.dp > 1 or self.config.parallel.pp > 1:
+            raise NotImplementedError("disagg with dp/pp > 1")
+        self.disagg_coordinator = DisaggCoordinator(self.model_cfg,
+                                                    disagg_cfg)
+
+    def submit_disagg(self, seq: Sequence, raw_items) -> None:
+        """Hand a skeleton-tokenized MM request to the coordinator; it is
+        admitted to the scheduler once all item metas arrive (gate A)."""
+        self.disagg_coordinator.submit(seq, raw_items)
+
+    def encode_skeleton(self, messages, **template_kwargs):
+        """Text-only chat tokenization: one placeholder sentinel per mm
+        item, pixels never opened (reference mm_common.tokenize_text_only).
+        Returns (token_ids, [(modality, raw_content), ...])."""
+        from gllm_tpu.engine.mm_processing import extract_mm_items
+        if self.tokenizer is None:
+            raise ValueError("skeleton tokenization needs a tokenizer")
+        items = extract_mm_items(messages)
+        ids = self.tokenizer.apply_chat_template(
+            messages, add_generation_prompt=True, **template_kwargs)
+        if ids and isinstance(ids[0], list):
+            ids = ids[0]
+        return [int(t) for t in ids], items
+
+    def _poll_disagg(self) -> None:
+        from gllm_tpu.sequence import SequenceStatus
+        events = self.disagg_coordinator.poll()
+        for seq in events.admits:
+            try:
+                self.add_seq(seq)
+            except ValueError as e:
+                # e.g. the expanded prompt exceeds max_model_len — reject
+                # THIS request; don't let the error escape step() and fail
+                # every in-flight stream
+                logger.warning("disagg admit rejected seq %d: %s",
+                               seq.seq_id, e)
+                self.disagg_coordinator.abort([seq.seq_id])
+                seq.status = SequenceStatus.ABORTED
+                seq.finish_reason = "abort"
+        for seq in events.aborts:
+            if seq.seq_id in self._seq_replica:     # already admitted
+                self.abort(seq.seq_id)
+            else:                                   # never reached a
+                seq.status = SequenceStatus.ABORTED  # scheduler
+                seq.finish_reason = "abort"
 
     # ---- intake -----------------------------------------------------------
 
@@ -159,8 +214,10 @@ class LLM:
 
     @property
     def has_unfinished(self) -> bool:
-        return any(s.has_unfinished for s in self.schedulers) \
-            or bool(self._in_flight)
+        return (any(s.has_unfinished for s in self.schedulers)
+                or bool(self._in_flight)
+                or (self.disagg_coordinator is not None
+                    and self.disagg_coordinator.num_pending > 0))
 
     # ---- main loops -------------------------------------------------------
 
@@ -173,6 +230,13 @@ class LLM:
         launch-one/collect-one, with jax async dispatch hiding host work
         behind the device step.
         """
+        if self.disagg_coordinator is not None:
+            self._poll_disagg()
+            if not any(s.has_unfinished for s in self.schedulers) \
+                    and not self._in_flight:
+                # only disagg-pending work: don't spin the poll loop hot
+                time.sleep(0.002)
+                return []
         if self.dp > 1:
             return self._step_dp()
         depth = max(1, self.config.parallel.pp)
@@ -196,6 +260,9 @@ class LLM:
                 break
             self._in_flight.append((batch, self.runner.step_async(batch)))
         if not self._in_flight:
+            if self.disagg_coordinator is not None:
+                # gate-B-blocked seqs park in waiting; don't spin hot
+                time.sleep(0.002)
             return []
         batch, handle = self._in_flight.popleft()
         tokens, aux = self.runner.collect(handle)
@@ -423,5 +490,7 @@ class LLM:
     def abort(self, seq_id: int) -> None:
         # aborted seqs never emit a finishing SeqOutput — drop the routing
         # entry here
+        if self.disagg_coordinator is not None:
+            self.disagg_coordinator.abort([seq_id])
         r = self._seq_replica.pop(seq_id, 0)
         self.schedulers[r].abort_seq(seq_id)

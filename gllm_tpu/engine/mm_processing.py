@@ -19,6 +19,22 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def processor_config_hash(model_dir: str) -> str:
+    """Digest of the checkpoint's processor configs — encoder and LM must
+    agree on preprocessing for disagg (reference mm_common.py:23-58)."""
+    import hashlib
+    import os
+    h = hashlib.sha256()
+    for fname in ("preprocessor_config.json", "processor_config.json",
+                  "video_preprocessor_config.json"):
+        path = os.path.join(model_dir, fname)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                h.update(fname.encode())
+                h.update(f.read())
+    return h.hexdigest()[:16]
+
+
 def extract_mm_items(messages: List[dict]) -> List[Tuple[str, object]]:
     """Ordered [(modality, content), ...] from normalized messages
     (reference extract_mm_items_ordered)."""

@@ -112,7 +112,8 @@ class ServingEngine:
 
     def submit(self, token_ids: List[int],
                sampling_params: SamplingParams,
-               mm_input: Optional[dict] = None) -> RequestHandle:
+               mm_input: Optional[dict] = None,
+               disagg_items: Optional[list] = None) -> RequestHandle:
         sampling_params.validate()
         mm_state = None
         if mm_input:
@@ -125,6 +126,9 @@ class ServingEngine:
         with self._lock:
             seq = self.llm._allocate_seq(token_ids, sampling_params)
             seq.mm = mm_state
+            if disagg_items is not None:
+                # skeleton request → coordinator (gate A admits it later)
+                seq._disagg_items = disagg_items
             handle = RequestHandle(seq.seq_id, len(token_ids))
             self._handles[seq.seq_id] = handle
             self._seqs[seq.seq_id] = seq
@@ -153,7 +157,11 @@ class ServingEngine:
                 except queue.Empty:
                     break
                 try:
-                    llm.add_seq(seq)
+                    items = getattr(seq, "_disagg_items", None)
+                    if items is not None:
+                        llm.submit_disagg(seq, items)
+                    else:
+                        llm.add_seq(seq)
                 except ValueError as e:
                     self._deliver_error(seq.seq_id, str(e))
                 drained = True

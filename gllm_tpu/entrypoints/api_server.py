@@ -55,6 +55,13 @@ class ServerState:
         if self.llm.model_cfg.use_mm:
             messages = _normalize_mm_messages(req.messages)
             try:
+                if self.llm.disagg_coordinator is not None:
+                    # disagg LM node: text-only skeleton; pixels never
+                    # opened here — items ship raw to the encoder fleet
+                    ids, items = self.llm.encode_skeleton(messages,
+                                                          **kwargs)
+                    return ids, ({"disagg_items": items} if items
+                                 else None)
                 return self.llm.process_mm_messages(messages, **kwargs)
             except proto.ProtocolError:
                 raise
@@ -74,6 +81,14 @@ class ServerState:
             raise proto.ProtocolError(
                 "server has no tokenizer; send token-array prompts")
         return self.llm.tokenizer.encode(req.prompt)
+
+
+def _split_disagg(mm_input):
+    """(mm_input, disagg_items): disagg skeleton requests carry raw items
+    under "disagg_items" instead of processor outputs."""
+    if mm_input and "disagg_items" in mm_input:
+        return None, mm_input["disagg_items"]
+    return mm_input, None
 
 
 def _normalize_mm_messages(messages):
@@ -220,6 +235,7 @@ class Handler(BaseHTTPRequestHandler):
         # Ranking needs per-token logprobs, which dp/pp don't support yet —
         # degrade to first-n there rather than failing the request.
         rank = req.best_of > req.n and par.dp == 1 and par.pp == 1
+        mm_input, disagg_items = _split_disagg(mm_input)
         handles = []
         for i in range(req.best_of):
             sp = dc.replace(req.sampling)
@@ -228,7 +244,8 @@ class Handler(BaseHTTPRequestHandler):
             if rank and sp.logprobs is None:
                 sp.logprobs = 0      # chosen-logprob only, for ranking
             handles.append(st.engine.submit(list(ids), sp,
-                                            mm_input=mm_input))
+                                            mm_input=mm_input,
+                                            disagg_items=disagg_items))
         results = [self._collect(h) for h in handles]
         if rank:
             def score(r):
@@ -273,8 +290,10 @@ class Handler(BaseHTTPRequestHandler):
             self._json(proto.chat_completion_response(req.model, choices,
                                                       usage))
             return
+        mm_input, disagg_items = _split_disagg(mm_input)
         handle = st.engine.submit(list(ids), req.sampling,
-                                  mm_input=mm_input)
+                                  mm_input=mm_input,
+                                  disagg_items=disagg_items)
         parse_tools = bool(req.tools) and req.tool_choice != "none"
         if req.stream and parse_tools:
             # Tool markup can't be parsed incrementally with certainty —

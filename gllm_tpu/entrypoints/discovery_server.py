@@ -1,0 +1,25 @@
+"""Standalone discovery-registry entrypoint.
+
+Reference: /root/reference/gllm/entrypoints/discovery_server.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    p = argparse.ArgumentParser("gllm-tpu discovery server")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=7606)
+    args = p.parse_args(argv)
+    from gllm_tpu.disagg.discovery import serve_discovery
+    logging.getLogger(__name__).info("discovery registry on %s:%d",
+                                     args.host, args.port)
+    serve_discovery(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Encoder-node entrypoint: vision tower only, serving encode jobs.
+
+Reference: /root/reference/gllm/entrypoints/encoder_server.py (157 LoC).
+Loads ONLY the visual half of the checkpoint (skip_language), publishes on
+the discovery registry, and encodes jobs dispatched by LM nodes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def make_parser():
+    p = argparse.ArgumentParser("gllm-tpu encoder server")
+    p.add_argument("--model", required=True)
+    p.add_argument("--discovery-endpoint", required=True)
+    p.add_argument("--encoder-id", default="enc0")
+    p.add_argument("--advertise-host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="job-server port (0 = ephemeral)")
+    p.add_argument("--dtype", default="bfloat16")
+    return p
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = make_parser().parse_args(argv)
+    from gllm_tpu.disagg.encoder_runtime import EncoderEngine, EncoderRuntime
+    from gllm_tpu.engine.mm_processing import processor_config_hash
+    engine = EncoderEngine(args.model, dtype=args.dtype)
+    runtime = EncoderRuntime(
+        engine, args.discovery_endpoint, encoder_id=args.encoder_id,
+        advertise_host=args.advertise_host,
+        processor_config_hash=processor_config_hash(args.model),
+        port=args.port)
+    logger.info("encoder %s serving %s (port %d)", args.encoder_id,
+                args.model, runtime.port)
+    runtime.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

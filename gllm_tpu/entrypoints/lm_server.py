@@ -1,0 +1,75 @@
+"""Disaggregated LM-node entrypoint: api_server routes + disagg coordinator.
+
+Reference: /root/reference/gllm/entrypoints/lm_server.py (223 LoC). The LM
+node serves the full OpenAI surface but never opens pixels: chat requests
+are skeleton-tokenized (one sentinel per mm item) and the raw items are
+dispatched to the encoder fleet found via ``--discovery-endpoint``.
+
+Usage:
+  python -m gllm_tpu.entrypoints.discovery_server --port 7606
+  python -m gllm_tpu.entrypoints.encoder_server --model M \
+      --discovery-endpoint host:7606
+  python -m gllm_tpu.entrypoints.lm_server --model M \
+      --discovery-endpoint host:7606
+"""
+
+from __future__ import annotations
+
+import logging
+
+from gllm_tpu.disagg.config import DisaggConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.entrypoints.api_server import (build_engine_config,
+                                             make_parser, serve)
+
+logger = logging.getLogger(__name__)
+
+
+def add_disagg_args(p):
+    p.add_argument("--discovery-endpoint", required=True,
+                   help="discovery registry host:port")
+    p.add_argument("--lm-id", default=None)
+    p.add_argument("--advertise-host", default="127.0.0.1",
+                   help="address encoders use to reach this node")
+    p.add_argument("--num-slots", type=int, default=8)
+    p.add_argument("--max-vis-tokens", type=int, default=4096)
+    p.add_argument("--no-disagg-overlap", action="store_true",
+                   help="admit only when every embedding landed "
+                        "(disables gate-B chunked-prefill overlap)")
+    return p
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = add_disagg_args(make_parser()).parse_args(argv)
+    cfg = build_engine_config(args)
+    cfg.skip_visual_load = True
+    llm = LLM(config=cfg)
+    if not args.skip_warmup:
+        llm.runner.warmup()
+    from gllm_tpu.engine.mm_processing import processor_config_hash
+    llm.init_disagg(DisaggConfig(
+        is_lm=True, skip_visual=True,
+        discovery_endpoint=args.discovery_endpoint,
+        lm_id=args.lm_id,
+        processor_config_hash=processor_config_hash(args.model),
+        advertise_host=args.advertise_host,
+        num_slots=args.num_slots,
+        max_vis_tokens=args.max_vis_tokens,
+        overlap=not args.no_disagg_overlap))
+    httpd = serve(llm, args.host, args.port,
+                  args.served_model_name or args.model,
+                  tool_parser=args.tool_call_parser)
+    logger.info("disagg LM serving %s on %s:%d", args.model, args.host,
+                args.port)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.state.engine.shutdown()
+        llm.disagg_coordinator.close()
+
+
+if __name__ == "__main__":
+    main()

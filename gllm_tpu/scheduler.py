@@ -295,8 +295,16 @@ class Scheduler:
                     if s.num_remaining_tokens > 1 and not s.num_in_flight]:
             if token_budget <= 0 or len(items) >= max_seqs:
                 break
-            n = self._ssm_align_chunk(
-                seq, min(seq.num_remaining_tokens, token_budget))
+            avail = seq.num_remaining_tokens
+            # Encoder-disagg gate B (reference scheduler.py:444-458): only
+            # prefill up to the first visual span whose embedding hasn't
+            # landed.
+            limit = seq.disagg_prefill_limit
+            if limit is not None:
+                if limit <= seq.num_computed_tokens:
+                    continue        # nothing prefillable yet; stay parked
+                avail = min(avail, limit - seq.num_computed_tokens)
+            n = self._ssm_align_chunk(seq, min(avail, token_budget))
             protect.add(seq.seq_id)
             if not self._allocate_with_preemption(seq, n, protect):
                 protect.discard(seq.seq_id)
@@ -305,7 +313,10 @@ class Scheduler:
             token_budget -= n
 
         # 2) admit from the waiting queue, FIFO with head-of-line blocking
-        #    (matches the reference; no starvation of long prompts).
+        #    (matches the reference; no starvation of long prompts). Gate-B
+        #    blocked disagg seqs are deferred and re-queued in order
+        #    (reference scheduler.py:503) instead of blocking the line.
+        deferred_disagg = []
         while (self.waiting and token_budget > 0
                and len(self.running) < self.config.max_num_seqs
                and len(items) < max_seqs):
@@ -318,8 +329,15 @@ class Scheduler:
                 continue
             if seq.num_computed_tokens == 0 and not seq.page_table:
                 self.mm.match_prefix(seq)
-            n = self._ssm_align_chunk(
-                seq, min(seq.num_remaining_tokens, token_budget))
+            avail = seq.num_remaining_tokens
+            limit = seq.disagg_prefill_limit
+            if limit is not None:
+                if limit <= seq.num_computed_tokens:
+                    self.waiting.popleft()
+                    deferred_disagg.append(seq)
+                    continue
+                avail = min(avail, limit - seq.num_computed_tokens)
+            n = self._ssm_align_chunk(seq, min(avail, token_budget))
             # Adaptive admission: reserve room for the chunk plus
             # new_token_ratio of the expected decode output. When nothing is
             # running and nothing else got scheduled, drop the reservation —
@@ -341,6 +359,9 @@ class Scheduler:
             self.running.append(seq)
             items.append(ScheduledSeq(seq, n, seq.num_computed_tokens))
             token_budget -= n
+        # re-queue gate-B-blocked seqs at the front, preserving order
+        for seq in reversed(deferred_disagg):
+            self.waiting.appendleft(seq)
 
     def schedule_chained(self, prev: ScheduledBatch) -> \
             Optional[ScheduledBatch]:

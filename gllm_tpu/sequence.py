@@ -65,6 +65,9 @@ class Sequence:
         # Multimodal state (gllm_tpu/engine/mm.py MMState) or None for
         # text-only requests.
         self.mm = None
+        # Encoder-disaggregation gate state (gllm_tpu/disagg/lm_manager.py
+        # DisaggSeqState) or None for monolith seqs.
+        self.disagg = None
         # Logprob accumulators (filled by the engine when requested):
         # output_logprobs[i] = (chosen, top_ids, top_lps) for output token
         # i; prompt_logprobs[p] likewise per prompt position (0 → None).
@@ -101,6 +104,15 @@ class Sequence:
     @property
     def is_prefilling(self) -> bool:
         return self.num_computed_tokens < self.prompt_len
+
+    @property
+    def disagg_prefill_limit(self) -> Optional[int]:
+        """Gate B (reference scheduler.py:444-458): a disagg seq may only
+        prefill up to the first visual span whose embedding hasn't landed.
+        None → no cap (monolith seq or all embeddings ready)."""
+        if self.disagg is None:
+            return None
+        return self.disagg.prefill_limit()
 
     def append_token(self, token_id: int) -> None:
         self.token_ids.append(token_id)

@@ -1,0 +1,373 @@
+"""Encoder disaggregation: discovery, transfer, and the byte-identity
+oracle.
+
+The reference's correctness contract (docs/encoder_disaggregation_usage.md
+§11, SURVEY.md §4.3): the disagg stack must be BYTE-IDENTICAL to the
+monolith under greedy decoding, cold == warm. Plus failure-path coverage:
+watchdog redispatch to a second encoder, give-up → abort.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.disagg.config import DisaggConfig
+from gllm_tpu.disagg.discovery import (DiscoveryServer, NetworkDiscovery,
+                                       make_payload)
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.sampling_params import SamplingParams
+
+IMG, VID, VSTART, VEND = 150, 151, 152, 153
+
+TEXT = dict(
+    vocab_size=160, hidden_size=64, num_hidden_layers=2,
+    num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+    max_position_embeddings=512, rms_norm_eps=1e-6, rope_theta=10000.0,
+    tie_word_embeddings=False,
+    rope_scaling={"type": "mrope", "mrope_section": [2, 2, 4]},
+)
+VISION = dict(
+    depth=2, hidden_size=32, intermediate_size=48, num_heads=4,
+    patch_size=2, temporal_patch_size=2, in_channels=3,
+    spatial_merge_size=2, out_hidden_size=64, window_size=8,
+    fullatt_block_indexes=[1], hidden_act="silu",
+)
+
+CHAT_TEMPLATE = (
+    "{% for message in messages %}<im_start> "
+    "{% if message['content'] is string %}{{ message['content'] }} "
+    "{% else %}{% for content in message['content'] %}"
+    "{% if content['type'] == 'image' %}"
+    "<|vision_start|> <|image_pad|> <|vision_end|> "
+    "{% elif content['type'] == 'text' %}{{ content['text'] }} "
+    "{% endif %}{% endfor %}{% endif %}<im_end> {% endfor %}"
+    "{% if add_generation_prompt %}<im_start> {% endif %}")
+
+
+@pytest.fixture(scope="module")
+def vl_ckpt(tmp_path_factory):
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import (Qwen2_5_VLConfig,
+                              Qwen2_5_VLForConditionalGeneration,
+                              Qwen2TokenizerFast)
+    from transformers.models.qwen2_vl.image_processing_qwen2_vl import (
+        Qwen2VLImageProcessor)
+    torch.manual_seed(31)
+    cfg = Qwen2_5_VLConfig(
+        text_config=TEXT, vision_config=VISION,
+        image_token_id=IMG, video_token_id=VID,
+        vision_start_token_id=VSTART, vision_end_token_id=VEND,
+        eos_token_id=0, bos_token_id=1)
+    model = Qwen2_5_VLForConditionalGeneration(cfg)
+    model.eval()
+    d = str(tmp_path_factory.mktemp("tiny_vl_disagg"))
+    model.save_pretrained(d, safe_serialization=True)
+
+    vocab = {f"w{i}": i for i in range(150)}
+    vocab.update({"<|image_pad|>": IMG, "<|video_pad|>": VID,
+                  "<|vision_start|>": VSTART, "<|vision_end|>": VEND,
+                  "<unk>": 154, "<im_start>": 155, "<im_end>": 156})
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.WhitespaceSplit()
+    t = Qwen2TokenizerFast(tokenizer_object=tok, unk_token="<unk>",
+                           eos_token="w0", pad_token="w0",
+                           chat_template=CHAT_TEMPLATE)
+    t.save_pretrained(d)
+    Qwen2VLImageProcessor(patch_size=2, temporal_patch_size=2, merge_size=2,
+                          min_pixels=16, max_pixels=4096).save_pretrained(d)
+    return d
+
+
+def pil_image(seed=0, size=8):
+    from PIL import Image
+    arr = (np.random.default_rng(seed).random((size, size, 3))
+           * 255).astype(np.uint8)
+    return Image.fromarray(arr)
+
+
+def make_llm(model_dir, prefix=False, **sched):
+    cfg = EngineConfig(
+        model=model_dir, dtype="float32", max_model_len=256,
+        scheduler=SchedulerConfig(**sched) if sched else SchedulerConfig(),
+        cache=CacheConfig(page_size=4, num_pages=128,
+                          enable_prefix_caching=prefix))
+    return LLM(config=cfg)
+
+
+def drive(llm, seqs, timeout=60.0):
+    """Run the engine loop until the given seqs finish."""
+    deadline = time.monotonic() + timeout
+    while any(not s.is_finished for s in seqs):
+        assert time.monotonic() < deadline, "disagg drive timed out"
+        llm.step()
+    return [s.output_token_ids for s in seqs]
+
+
+# ---------------------------------------------------------------------------
+# Unit: discovery + transfer
+# ---------------------------------------------------------------------------
+
+def test_discovery_publish_expire_republish():
+    srv = DiscoveryServer("127.0.0.1", 0, default_ttl_ms=200).start()
+    try:
+        a = NetworkDiscovery(f"127.0.0.1:{srv.port}", ttl_ms=200)
+        b = NetworkDiscovery(f"127.0.0.1:{srv.port}", ttl_ms=200)
+        payload = make_payload(role="encoder", addr="127.0.0.1:1")
+        a.publish("enc0", payload)
+        evs = b.poll_events("encoder")
+        assert [(e.kind, e.identity) for e in evs] == [("ADD", "enc0")]
+        assert b.poll_events("encoder") == []      # no change
+        # lease renewal keeps it alive past the ttl
+        time.sleep(0.4)
+        assert b.poll_events("encoder") == []
+        assert "enc0" in b.list("encoder")
+        # close() revokes → REMOVE
+        a.close()
+        time.sleep(0.3)
+        evs = b.poll_events("encoder")
+        assert [(e.kind, e.identity) for e in evs] == [("REMOVE", "enc0")]
+        b.close()
+    finally:
+        srv.stop()
+
+
+def test_slot_pool_write_and_stale_guard():
+    from gllm_tpu.disagg.transfer import SlotPool, TransferClient
+    pool = SlotPool(num_slots=2, max_tokens=8, feat_dim=4,
+                    host="127.0.0.1")
+    try:
+        cli = TransferClient(f"127.0.0.1:{pool.port}")
+        slot = pool.alloc()
+        pool.expect(7, 0, slot)
+        emb = np.arange(12, dtype=np.float32).reshape(3, 4)
+        cli.write(7, 0, slot, emb)
+        landed = pool.drain_landed()
+        assert landed == {(7, 0): (slot, 3)}
+        np.testing.assert_array_equal(pool.clone(slot, 3), emb)
+        # un-reserved write is dropped (stale)
+        other = pool.alloc()
+        cli.write(9, 0, other, emb)          # no expect() → stale
+        assert pool.drain_landed() == {}
+        cli.close()
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# E2E: disagg == monolith byte identity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def disagg_stack(vl_ckpt):
+    """discovery + one encoder + one disagg LM engine, all in-process."""
+    from gllm_tpu.disagg.encoder_runtime import EncoderEngine, EncoderRuntime
+    srv = DiscoveryServer("127.0.0.1", 0).start()
+    endpoint = f"127.0.0.1:{srv.port}"
+    enc = EncoderRuntime(EncoderEngine(vl_ckpt, dtype="float32"),
+                         endpoint, encoder_id="enc0").start()
+    llm = make_llm(vl_ckpt)
+    llm.init_disagg(DisaggConfig(
+        is_lm=True, discovery_endpoint=endpoint, num_slots=4,
+        max_vis_tokens=64, overlap=True))
+    yield llm, srv, endpoint
+    llm.disagg_coordinator.close()
+    enc.stop()
+    srv.stop()
+
+
+MESSAGES = [{"role": "user", "content": [
+    {"type": "image", "image": pil_image(3)},
+    {"type": "text", "text": "w5 w9 w23"}]}]
+
+TWO_IMG_MESSAGES = [{"role": "user", "content": [
+    {"type": "image", "image": pil_image(3)},
+    {"type": "text", "text": "w5 w9"},
+    {"type": "image", "image": pil_image(4)},
+    {"type": "text", "text": "w23 w7"}]}]
+
+
+def monolith_tokens(vl_ckpt, messages, n=8):
+    llm = make_llm(vl_ckpt)
+    ids, mm_input = llm.process_mm_messages(messages)
+    out = llm.generate(prompt_token_ids=[ids], mm_inputs=[mm_input],
+                       sampling_params=SamplingParams(
+                           temperature=0.0, max_tokens=n, ignore_eos=True))
+    return out[0].output_token_ids
+
+
+def submit_disagg(llm, messages, n=8):
+    ids, items = llm.encode_skeleton(messages)
+    seq = llm._allocate_seq(ids, SamplingParams(
+        temperature=0.0, max_tokens=n, ignore_eos=True))
+    llm.submit_disagg(seq, items)
+    return seq
+
+
+def test_disagg_byte_identity(disagg_stack, vl_ckpt):
+    llm, _, _ = disagg_stack
+    want = monolith_tokens(vl_ckpt, MESSAGES)
+    seq = submit_disagg(llm, MESSAGES)
+    got = drive(llm, [seq])[0]
+    assert got == want, (got, want)
+    # warm (encoder-side embed cache + fresh slots) — identical again
+    seq2 = submit_disagg(llm, MESSAGES)
+    assert drive(llm, [seq2])[0] == want
+
+
+def test_disagg_two_images_chunked_prefill(vl_ckpt):
+    """Two images through chunked prefill on the disagg LM (gate B
+    exercises the per-span cap) — byte-identical to the monolith."""
+    from gllm_tpu.disagg.encoder_runtime import EncoderEngine, EncoderRuntime
+    want = monolith_tokens(vl_ckpt, TWO_IMG_MESSAGES, n=6)
+    srv = DiscoveryServer("127.0.0.1", 0).start()
+    endpoint = f"127.0.0.1:{srv.port}"
+    enc = EncoderRuntime(EncoderEngine(vl_ckpt, dtype="float32"),
+                         endpoint, encoder_id="enc0").start()
+    llm = make_llm(vl_ckpt, max_prefill_tokens=8, min_prefill_tokens=4)
+    llm.init_disagg(DisaggConfig(
+        is_lm=True, discovery_endpoint=endpoint, num_slots=4,
+        max_vis_tokens=64, overlap=True))
+    try:
+        seq = submit_disagg(llm, TWO_IMG_MESSAGES, n=6)
+        got = drive(llm, [seq])[0]
+        assert got == want, (got, want)
+    finally:
+        llm.disagg_coordinator.close()
+        enc.stop()
+        srv.stop()
+
+
+def test_disagg_gate_b_blocks_until_ready(disagg_stack, vl_ckpt):
+    """A slow encoder must not stall admission (gate A) — and prefill must
+    wait at the unready span (gate B), then complete correctly."""
+    llm, _, _ = disagg_stack
+    want = monolith_tokens(vl_ckpt, MESSAGES)
+    # slow the encoder's ViT path
+    coord = llm.disagg_coordinator
+    orig_clone = coord.pool.clone
+    delay = {"n": 0}
+
+    def slow_clone(slot_id, n):
+        delay["n"] += 1
+        return orig_clone(slot_id, n)
+
+    coord.pool.clone = slow_clone
+    seq = submit_disagg(llm, MESSAGES)
+    got = drive(llm, [seq])[0]
+    assert got == want
+    assert delay["n"] >= 1        # embeddings actually came from the pool
+
+
+def test_disagg_watchdog_redispatch(vl_ckpt, monkeypatch):
+    """Encoder A drops the first 2 jobs (fail injection); the watchdog
+    re-dispatches to encoder B and the request still completes
+    byte-identically. Two images → round-robin hits both encoders, so at
+    least one job lands on the dropper."""
+    from gllm_tpu.disagg.encoder_runtime import EncoderEngine, EncoderRuntime
+    want = monolith_tokens(vl_ckpt, TWO_IMG_MESSAGES, n=6)
+    monkeypatch.setenv("GLLM_TPU_DISAGG_REDISPATCH_TIMEOUT_S", "0.5")
+    monkeypatch.setenv("GLLM_TPU_DISAGG_MAX_REDISPATCH", "2")
+    srv = DiscoveryServer("127.0.0.1", 0).start()
+    endpoint = f"127.0.0.1:{srv.port}"
+    monkeypatch.setenv("GLLM_TPU_ENC_FAIL_FIRST_N", "2")
+    enc_a = EncoderRuntime(EncoderEngine(vl_ckpt, dtype="float32"),
+                           endpoint, encoder_id="encA").start()
+    monkeypatch.setenv("GLLM_TPU_ENC_FAIL_FIRST_N", "0")
+    enc_b = EncoderRuntime(EncoderEngine(vl_ckpt, dtype="float32"),
+                           endpoint, encoder_id="encB").start()
+    llm = make_llm(vl_ckpt)
+    llm.init_disagg(DisaggConfig(
+        is_lm=True, discovery_endpoint=endpoint, num_slots=4,
+        max_vis_tokens=64, overlap=True))
+    try:
+        seq = submit_disagg(llm, TWO_IMG_MESSAGES, n=6)
+        got = drive(llm, [seq], timeout=90.0)[0]
+        assert got == want, (got, want)
+    finally:
+        llm.disagg_coordinator.close()
+        enc_a.stop()
+        enc_b.stop()
+        srv.stop()
+
+
+def test_disagg_giveup_aborts(vl_ckpt, monkeypatch):
+    """A single always-failing encoder: the watchdog gives up after max
+    attempts and the seq is aborted (never hangs)."""
+    from gllm_tpu.disagg.encoder_runtime import EncoderEngine, EncoderRuntime
+    monkeypatch.setenv("GLLM_TPU_DISAGG_REDISPATCH_TIMEOUT_S", "0.3")
+    monkeypatch.setenv("GLLM_TPU_DISAGG_MAX_REDISPATCH", "1")
+    monkeypatch.setenv("GLLM_TPU_ENC_FAIL_FIRST_N", "100")
+    srv = DiscoveryServer("127.0.0.1", 0).start()
+    endpoint = f"127.0.0.1:{srv.port}"
+    enc = EncoderRuntime(EncoderEngine(vl_ckpt, dtype="float32"),
+                         endpoint, encoder_id="encA").start()
+    llm = make_llm(vl_ckpt)
+    llm.init_disagg(DisaggConfig(
+        is_lm=True, discovery_endpoint=endpoint, num_slots=4,
+        max_vis_tokens=64, overlap=True))
+    try:
+        seq = submit_disagg(llm, MESSAGES)
+        deadline = time.monotonic() + 30
+        while not seq.is_finished and time.monotonic() < deadline:
+            llm.step()
+        assert seq.is_finished
+        assert seq.finish_reason == "abort"
+        assert llm.disagg_coordinator.num_pending == 0
+        # all slots back in the pool
+        assert llm.disagg_coordinator.pool.num_free == 4
+    finally:
+        llm.disagg_coordinator.close()
+        enc.stop()
+        srv.stop()
+
+
+def test_disagg_api_server_end_to_end(vl_ckpt):
+    """OpenAI image request over HTTP against a disagg LM node."""
+    import base64
+    import http.client
+    import io
+    import json
+
+    from gllm_tpu.disagg.encoder_runtime import EncoderEngine, EncoderRuntime
+    from gllm_tpu.entrypoints.api_server import serve
+
+    srv = DiscoveryServer("127.0.0.1", 0).start()
+    endpoint = f"127.0.0.1:{srv.port}"
+    enc = EncoderRuntime(EncoderEngine(vl_ckpt, dtype="float32"),
+                         endpoint, encoder_id="enc0").start()
+    llm = make_llm(vl_ckpt)
+    llm.init_disagg(DisaggConfig(
+        is_lm=True, discovery_endpoint=endpoint, num_slots=4,
+        max_vis_tokens=64, overlap=True))
+    httpd = serve(llm, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        buf = io.BytesIO()
+        pil_image(7).save(buf, format="PNG")
+        url = ("data:image/png;base64,"
+               + base64.b64encode(buf.getvalue()).decode())
+        body = json.dumps({
+            "messages": [{"role": "user", "content": [
+                {"type": "image_url", "image_url": {"url": url}},
+                {"type": "text", "text": "w5 w9"}]}],
+            "max_tokens": 4, "temperature": 0, "ignore_eos": True})
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request("POST", "/v1/chat/completions", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        data = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200, data
+        assert data["usage"]["completion_tokens"] == 4
+    finally:
+        httpd.shutdown()
+        httpd.state.engine.shutdown()
+        llm.disagg_coordinator.close()
+        enc.stop()
+        srv.stop()
