@@ -70,12 +70,15 @@ class MMState:
 def build_mm_state(token_ids: Sequence[int], cfg: ModelConfig,
                    pixel_values=None, image_grid_thw=None,
                    video_pixel_values=None, video_grid_thw=None,
-                   second_per_grid_ts=None) -> MMState:
+                   second_per_grid_ts=None, grid_thws=None) -> MMState:
     """Build MMState from HF-processor outputs.
 
-    ``pixel_values`` is the processor's concatenation over image items;
+    ``pixel_values`` is the processor's concatenation over item rows;
     per-item slices are recovered from grid_thw (t*h*w rows each).
+    ``grid_thws`` is the Kimi processor's name for the image grids.
     """
+    if grid_thws is not None and image_grid_thw is None:
+        image_grid_thw = grid_thws
     if cfg.mm_per_frame_video and video_grid_thw is not None:
         # Qwen3-VL: each temporal frame is its own vision span (HF
         # get_rope_index splits video_grid_thw the same way, and frames
@@ -114,7 +117,21 @@ def finish_mm_state(token_ids: Sequence[int], cfg: ModelConfig,
     (pixels=None, hash from the encoder) go through the same logic so the
     disagg stack is byte-identical to the monolith (reference oracle,
     docs/encoder_disaggregation_usage.md §11)."""
-    positions, delta = get_mrope_input_positions(
+    if not cfg.mrope_section:
+        # 1-D position models (Kimi K2.5 — reference kimi_k25.py uses the
+        # DeepSeek backbone's plain positions): the mrope array is a
+        # degenerate 3×arange that the forward path ignores.
+        L = len(token_ids)
+        pos1d = np.tile(np.arange(L, dtype=np.int64), (3, 1))
+        positions, delta = pos1d, 0
+    else:
+        positions, delta = _mrope_positions(token_ids, cfg, items,
+                                            second_per_grid_ts)
+    return _index_and_hash(token_ids, cfg, items, positions, delta)
+
+
+def _mrope_positions(token_ids, cfg, items, second_per_grid_ts):
+    return get_mrope_input_positions(
         token_ids,
         [it.grid_thw for it in items if it.modality == "image"],
         [it.grid_thw for it in items if it.modality == "video"],
@@ -127,6 +144,8 @@ def finish_mm_state(token_ids: Sequence[int], cfg: ModelConfig,
         second_per_grid_ts=second_per_grid_ts,
     )
 
+
+def _index_and_hash(token_ids, cfg, items, positions, delta) -> MMState:
     ids = np.asarray(token_ids, np.int64)
     is_img = ids == cfg.image_token_id
     is_vid = ids == cfg.video_token_id

@@ -143,10 +143,51 @@ class DeepSeekToolParser(ToolParser):
         return content, calls
 
 
+class KimiToolParser(ToolParser):
+    """Kimi K2/K2.5 markup (reference tool_parsers.py:429-481):
+
+    ``<|tool_calls_section_begin|>`` wraps the calls; each call is
+    ``<|tool_call_begin|>functions.NAME:IDX<|tool_call_argument_begin|>
+    JSON<|tool_call_end|>``."""
+
+    _SECTION = "<|tool_calls_section_begin|>"
+    _CALL = re.compile(
+        r"<\|tool_call_begin\|>\s*([^\s<]+?)\s*"
+        r"<\|tool_call_argument_begin\|>\s*(.*?)\s*<\|tool_call_end\|>",
+        re.DOTALL)
+
+    @staticmethod
+    def _name_from_id(fid: str) -> str:
+        # ids look like "functions.get_weather:0"
+        fid = fid.split(":", 1)[0]
+        return fid[len("functions."):] if fid.startswith("functions.") \
+            else fid
+
+    def parse(self, text, schemas=None):
+        if self._SECTION not in text:
+            return text, []
+        calls: List[ToolCall] = []
+        for fid, payload in self._CALL.findall(text):
+            name = self._name_from_id(fid.strip())
+            if not name:
+                continue
+            try:
+                args = json.loads(payload) if payload.strip() else {}
+            except json.JSONDecodeError:
+                args = {}
+            if isinstance(args, dict) and schemas:
+                args = coerce_arguments(args, schemas.get(name))
+            calls.append(ToolCall(name=name, arguments=json.dumps(
+                args, ensure_ascii=False)))
+        content = text.split(self._SECTION, 1)[0].strip()
+        return content, calls
+
+
 _PARSERS = {
     "qwen": QwenToolParser,
     "hermes": QwenToolParser,
     "deepseek": DeepSeekToolParser,
+    "kimi": KimiToolParser,
     "none": ToolParser,
 }
 
@@ -165,8 +206,8 @@ def get_tool_parser(name: Optional[str] = None,
         return QwenToolParser()
     if "deepseek" in m:
         return DeepSeekToolParser()
-    # Kimi K2 uses its own <|tool_call_begin|> markup — parser TBD; fall
-    # through to the no-op parser rather than mis-parse.
+    if "kimi" in m:
+        return KimiToolParser()
     return ToolParser()
 
 

@@ -216,6 +216,46 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
         hf = {**text, "architectures": [arch],
               "eos_token_id": hf.get("eos_token_id",
                                      text.get("eos_token_id"))}
+    if arch in ("ChatGLMModel", "ChatGLMForConditionalGeneration"):
+        # ChatGLM3 legacy config layout (reference models/chatglm.py):
+        # kv_channels=head_dim, rotary over head_dim/2 interleaved
+        # (RotaryEmbedding(..., is_neox_style=False)), fused
+        # query_key_value / dense_h_to_4h handled by chatglm_rules.
+        n_heads = hf["num_attention_heads"]
+        hf = {
+            "architectures": [arch],
+            "vocab_size": hf["padded_vocab_size"],
+            "hidden_size": hf["hidden_size"],
+            "num_hidden_layers": hf["num_layers"],
+            "num_attention_heads": n_heads,
+            "num_key_value_heads": (hf.get("multi_query_group_num", n_heads)
+                                    if hf.get("multi_query_attention", False)
+                                    else n_heads),
+            "head_dim": hf.get("kv_channels",
+                               hf["hidden_size"] // n_heads),
+            "intermediate_size": hf["ffn_hidden_size"],
+            "rms_norm_eps": hf.get("layernorm_epsilon", 1e-5),
+            "rope_theta": 10000.0 * hf.get("rope_ratio", 1.0),
+            "max_position_embeddings": hf.get("seq_length", 8192),
+            "attention_bias": bool(hf.get("add_qkv_bias", False)
+                                   or hf.get("add_bias_linear", False)),
+            "partial_rotary_factor": 0.5,
+            "tie_word_embeddings": False,
+            "eos_token_id": hf.get("eos_token_id"),
+        }
+    if arch == "KimiK25ForConditionalGeneration":
+        # DeepSeek-V3 backbone under text_config; vision dict + the media
+        # placeholder (often OUTSIDE the LM vocab) at top level. Positions
+        # are plain 1-D — no mrope (reference kimi_k25.py).
+        vision = dict(hf.get("vision_config") or {})
+        text = dict(hf.get("text_config") or hf)
+        extra = dict(
+            image_token_id=hf.get("media_placeholder_token_id", -1),
+            vision_config=vision,
+        )
+        hf = {**text, "architectures": [arch],
+              "eos_token_id": hf.get("eos_token_id",
+                                     text.get("eos_token_id"))}
     if arch in ("Qwen2_5_VLForConditionalGeneration",
                 "Qwen2VLForConditionalGeneration"):
         # VL configs nest the LM under text_config (newer transformers) or
@@ -258,9 +298,10 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
                        "Qwen3VLForConditionalGeneration",
                        "Qwen3VLMoeForConditionalGeneration")
     is_glm4 = arch in ("Glm4ForCausalLM",)
-    # GLM-4 base (GlmForCausalLM): interleaved partial rotary like GLM4
-    # but WITHOUT the sandwich norms
-    is_glm = arch in ("GlmForCausalLM",)
+    # GLM-4 base / ChatGLM3: interleaved partial rotary like GLM4 but
+    # WITHOUT the sandwich norms
+    is_glm = arch in ("GlmForCausalLM", "ChatGLMModel",
+                      "ChatGLMForConditionalGeneration")
     attention_bias = hf.get("attention_bias",
                             arch in ("Qwen2ForCausalLM",
                                      "Qwen2MoeForCausalLM",

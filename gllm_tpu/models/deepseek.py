@@ -406,7 +406,14 @@ def forward(params, kv: LatentKVCache, batch: StepBatch, cfg: ModelConfig,
     scale = head_dim ** -0.5 * yarn_softmax_scale_mult(cfg.rope_scaling)
 
     if cfg.is_first_stage:
+        # Out-of-vocab placeholder ids (Kimi's media pad sits past the LM
+        # vocab) clamp in the gather; those rows are fully replaced by the
+        # visual splice below (reference kimi_k25.py embed_input_ids).
         hidden = params["embed"][batch.token_ids]
+        if batch.mm_embeds is not None:
+            mm_main = batch.mm_embeds[:, :cfg.hidden_size]
+            hidden = jnp.where(batch.mm_mask[:, None],
+                               mm_main.astype(hidden.dtype), hidden)
         residual = jnp.zeros_like(hidden)
     else:
         hidden, residual = hidden_in, residual_in

@@ -1,0 +1,124 @@
+"""Kimi K2.5: MoonViT3d vision tower + DeepSeek-V3 language backbone.
+
+Reference: /root/reference/gllm/models/kimi_k25.py (311 LoC) +
+kimi_k25_vision.py (475 LoC). The LM half IS our DeepSeek decoder
+(gllm_tpu/models/deepseek.py — MLA latent cache, noaux_tc routing);
+positions are plain 1-D (no mrope). The tower lives in
+gllm_tpu/models/kimi_vision.py; the media placeholder
+(``media_placeholder_token_id``, outside the LM vocab) marks visual rows
+that the embedding splice overwrites.
+
+Placeholder expansion contract: Kimi's chat template emits ONE
+``<|media_pad|>`` per image; the intake path expands it to the item's
+merged-token count (``(h//kh)·(w//kw)``, frame-independent — temporal
+pooling collapses t) before the engine sees the prompt, mirroring the
+reference ``build_kimi_input_ids``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gllm_tpu.models import deepseek, kimi_vision
+from gllm_tpu.models.config import ModelConfig
+
+init_kv_cache = deepseek.init_kv_cache
+compute_logits = deepseek.compute_logits
+forward = deepseek.forward
+make_rope_table = deepseek.make_rope_table
+
+
+def vision_cfg(cfg: ModelConfig) -> kimi_vision.KimiVisionConfig:
+    assert cfg.vision_config is not None
+    return kimi_vision.from_hf_vision_config(cfg.vision_config,
+                                             cfg.hidden_size)
+
+
+def init_params(cfg: ModelConfig, seed: int = 0,
+                dtype=jnp.bfloat16) -> dict:
+    params = deepseek.init_params(cfg, seed=seed, dtype=dtype)
+    params["visual"] = kimi_vision.init_vision_params(vision_cfg(cfg),
+                                                     seed=seed, dtype=dtype)
+    return params
+
+
+def embed_mm(params, cfg: ModelConfig, pixels, grid_thw) -> jnp.ndarray:
+    return kimi_vision.embed_single(params["visual"], vision_cfg(cfg),
+                                    pixels, grid_thw)
+
+
+def num_vis_tokens(cfg: ModelConfig, grid_thw) -> int:
+    """Merged tokens per item: spatial only (temporal pooling)."""
+    kh, kw = vision_cfg(cfg).merge_kernel
+    _, h, w = (int(v) for v in grid_thw)
+    return (h // kh) * (w // kw)
+
+
+def _kimi_rules(cfg: ModelConfig):
+    from gllm_tpu.models.loader import deepseek_rules
+    base = deepseek_rules(cfg)
+    vcfg = vision_cfg(cfg)
+
+    blk = {
+        "norm0.weight": ("norm0_w", None), "norm0.bias": ("norm0_b", None),
+        "norm1.weight": ("norm1_w", None), "norm1.bias": ("norm1_b", None),
+        "wqkv.weight": ("wqkv_w", "t"), "wqkv.bias": ("wqkv_b", None),
+        "wo.weight": ("wo_w", "t"), "wo.bias": ("wo_b", None),
+        "mlp.fc0.weight": ("fc0_w", "t"), "mlp.fc0.bias": ("fc0_b", None),
+        "mlp.fc1.weight": ("fc1_w", "t"), "mlp.fc1.bias": ("fc1_b", None),
+    }
+    merger = {
+        "pre_norm.weight": ("pre_norm_w", None),
+        "pre_norm.bias": ("pre_norm_b", None),
+        "proj.0.weight": ("fc1_w", "t"), "proj.0.bias": ("fc1_b", None),
+        "proj.2.weight": ("fc2_w", "t"), "proj.2.bias": ("fc2_b", None),
+    }
+
+    def patch_tf(t: np.ndarray) -> dict:
+        # Conv2d [C, 3, ps, ps] → flattened [3·ps², C] matmul
+        return {"patch_w": t.reshape(vcfg.hidden_size, -1).T}
+
+    def rule(name: str):
+        if name.startswith("language_model."):
+            return base(name[len("language_model."):])
+        if name.startswith("vision_tower."):
+            rest = name[len("vision_tower."):]
+            if rest == "patch_embed.proj.weight":
+                return (("visual", "__multi__"), None, patch_tf)
+            if rest == "patch_embed.proj.bias":
+                return (("visual", "patch_b"), None, None)
+            if rest == "patch_embed.pos_emb.weight":
+                return (("visual", "pos_emb"), None, None)
+            if rest == "encoder.final_layernorm.weight":
+                return (("visual", "final_ln_w"), None, None)
+            if rest == "encoder.final_layernorm.bias":
+                return (("visual", "final_ln_b"), None, None)
+            if rest.startswith("encoder.blocks."):
+                idx_s, _, leaf = \
+                    rest[len("encoder.blocks."):].partition(".")
+                if leaf in blk:
+                    target, tf = blk[leaf]
+                    return (("visual", "blocks", target), int(idx_s), tf)
+            return None
+        if name.startswith("mm_projector."):
+            leaf = name[len("mm_projector."):]
+            if leaf in merger:
+                target, tf = merger[leaf]
+                return (("visual", "merger", target), None, tf)
+            return None
+        return base(name)
+
+    return rule
+
+
+def load_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16,
+                progress_cb=None, skip_visual: bool = False) -> dict:
+    from gllm_tpu.models.loader import _load_params, skip_visual_rules
+    template = jax.eval_shape(lambda: init_params(cfg, dtype=dtype))
+    rules = _kimi_rules(cfg)
+    if skip_visual:
+        del template["visual"]
+        rules = skip_visual_rules(rules)
+    return _load_params(model_dir, template, rules, progress_cb)

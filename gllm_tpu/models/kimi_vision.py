@@ -1,0 +1,247 @@
+"""Kimi K2.5 vision tower (MoonViT3d) + patch-merger projector.
+
+TPU-native re-design of the reference tower
+(/root/reference/gllm/models/kimi_k25_vision.py): patch embed (conv as a
+flattened matmul), learnable 2-D spatial pos-emb bicubically interpolated
+to the live grid plus a fixed sincos temporal embedding, 27 pre-LN blocks
+with fused wqkv and an x/y-interleaved complex 2-D rotary, full attention
+within one item (each image / video chunk is a single varlen segment),
+then 2×2 spatial merge + temporal MEAN pooling and the PatchMergerMLP
+(LayerNorm → Linear(k·C → k·C) → GELU → Linear(k·C → text_hidden)).
+
+The tower runs replicated (no TP) like the reference — per-item batches
+are small and the 2-D rope / fused packing don't shard usefully.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class KimiVisionConfig:
+    hidden_size: int               # vt_hidden_size
+    num_layers: int                # vt_num_hidden_layers
+    num_heads: int                 # vt_num_attention_heads
+    intermediate_size: int         # vt_intermediate_size
+    patch_size: int
+    merge_kernel: Tuple[int, int]  # merge_kernel_size (kh, kw)
+    pos_emb_height: int            # init_pos_emb_height
+    pos_emb_width: int
+    pos_emb_time: int
+    mm_hidden_size: int
+    text_hidden_size: int
+    projector_ln_eps: float = 1e-5
+    in_channels: int = 3
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def merge_unit(self) -> int:
+        return self.merge_kernel[0] * self.merge_kernel[1]
+
+    @property
+    def patch_input_dim(self) -> int:
+        return self.in_channels * self.patch_size ** 2
+
+
+def from_hf_vision_config(d: Dict[str, Any],
+                          text_hidden: int) -> KimiVisionConfig:
+    mk = d.get("merge_kernel_size", (2, 2))
+    return KimiVisionConfig(
+        hidden_size=d.get("vt_hidden_size", 1152),
+        num_layers=d.get("vt_num_hidden_layers", 27),
+        num_heads=d.get("vt_num_attention_heads", 16),
+        intermediate_size=d.get("vt_intermediate_size", 4304),
+        patch_size=(d.get("patch_size", 14)
+                    if not isinstance(d.get("patch_size"), (list, tuple))
+                    else int(d["patch_size"][0])),
+        merge_kernel=(int(mk[0]), int(mk[1])),
+        pos_emb_height=d.get("init_pos_emb_height", 64),
+        pos_emb_width=d.get("init_pos_emb_width", 64),
+        pos_emb_time=d.get("init_pos_emb_time", 4),
+        mm_hidden_size=d.get("mm_hidden_size", d.get("vt_hidden_size",
+                                                     1152)),
+        text_hidden_size=d.get("text_hidden_size", text_hidden),
+        projector_ln_eps=d.get("projector_ln_eps", 1e-5),
+    )
+
+
+def init_vision_params(cfg: KimiVisionConfig, seed: int = 0,
+                       dtype=jnp.float32) -> Params:
+    L, C, I = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    k = cfg.merge_unit
+    mm, text = cfg.mm_hidden_size, cfg.text_hidden_size
+    key = jax.random.key(seed + 17)
+    ks = iter(jax.random.split(key, 12))
+
+    def w(kk, shape, scale):
+        return (jax.random.normal(kk, shape, jnp.float32)
+                * scale).astype(dtype)
+
+    s = C ** -0.5
+    return {
+        "patch_w": w(next(ks), (cfg.patch_input_dim, C),
+                     cfg.patch_input_dim ** -0.5),
+        "patch_b": jnp.zeros((C,), dtype),
+        "pos_emb": w(next(ks), (cfg.pos_emb_height, cfg.pos_emb_width, C),
+                     0.02),
+        "blocks": {
+            "norm0_w": jnp.ones((L, C), dtype),
+            "norm0_b": jnp.zeros((L, C), dtype),
+            "norm1_w": jnp.ones((L, C), dtype),
+            "norm1_b": jnp.zeros((L, C), dtype),
+            "wqkv_w": w(next(ks), (L, C, 3 * C), s),
+            "wqkv_b": jnp.zeros((L, 3 * C), dtype),
+            "wo_w": w(next(ks), (L, C, C), s),
+            "wo_b": jnp.zeros((L, C), dtype),
+            "fc0_w": w(next(ks), (L, C, I), s),
+            "fc0_b": jnp.zeros((L, I), dtype),
+            "fc1_w": w(next(ks), (L, I, C), I ** -0.5),
+            "fc1_b": jnp.zeros((L, C), dtype),
+        },
+        "final_ln_w": jnp.ones((C,), dtype),
+        "final_ln_b": jnp.zeros((C,), dtype),
+        "merger": {
+            "pre_norm_w": jnp.ones((mm,), dtype),
+            "pre_norm_b": jnp.zeros((mm,), dtype),
+            "fc1_w": w(next(ks), (k * mm, k * mm), (k * mm) ** -0.5),
+            "fc1_b": jnp.zeros((k * mm,), dtype),
+            "fc2_w": w(next(ks), (k * mm, text), (k * mm) ** -0.5),
+            "fc2_b": jnp.zeros((text,), dtype),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Host precompute per grid
+# ---------------------------------------------------------------------------
+
+def _sincos_1d(dim: int, t: int) -> np.ndarray:
+    """Fixed sincos temporal embedding (reference
+    _get_1d_sincos_pos_embed)."""
+    omega = np.arange(dim // 2, dtype=np.float32) / (dim / 2.0)
+    omega = 1.0 / 10000 ** omega
+    out = np.arange(t, dtype=np.float32)[:, None] * omega[None, :]
+    return np.concatenate([np.sin(out), np.cos(out)], axis=1)  # [t, dim]
+
+
+@functools.lru_cache(maxsize=512)
+def _rope2d_cos_sin(h: int, w: int, t: int, head_dim: int,
+                    theta: float = 10000.0):
+    """cos/sin [t*h*w, head_dim/2] for the x/y-interleaved complex rope
+    (reference Rope2DPosEmb): complex slot c rotates by
+    (c even → x_pos, c odd → y_pos) * freqs[c//2]."""
+    flat = np.arange(h * w)
+    x_pos = (flat % w).astype(np.float64)
+    y_pos = (flat // w).astype(np.float64)
+    nfreq = head_dim // 4
+    dim_range = np.arange(0, head_dim, 4, dtype=np.float64)[:nfreq]
+    freqs = 1.0 / theta ** (dim_range / head_dim)
+    x_ang = x_pos[:, None] * freqs[None, :]      # [hw, hd/4]
+    y_ang = y_pos[:, None] * freqs[None, :]
+    ang = np.stack([x_ang, y_ang], axis=-1).reshape(h * w, -1)  # [hw, hd/2]
+    ang = np.tile(ang, (t, 1))
+    return np.cos(ang).astype(np.float32), np.sin(ang).astype(np.float32)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _ln(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    return (((xf - mu) * jax.lax.rsqrt(var + eps)) * w + b).astype(x.dtype)
+
+
+def _rope2d(a, cos, sin):
+    """a: [L, nh, hd] — rotate real pairs (2c, 2c+1) by angle c."""
+    L, nh, hd = a.shape
+    af = a.astype(jnp.float32).reshape(L, nh, hd // 2, 2)
+    re, im = af[..., 0], af[..., 1]
+    c = cos[:, None, :]
+    s = sin[:, None, :]
+    out = jnp.stack([re * c - im * s, re * s + im * c], axis=-1)
+    return out.reshape(L, nh, hd).astype(a.dtype)
+
+
+def _vit_jit(params, pixels, pos, cos, sin, cfg: KimiVisionConfig,
+             t: int, h: int, w: int):
+    C, nh, hd = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+    kh, kw = cfg.merge_kernel
+    x = pixels @ params["patch_w"] + params["patch_b"]     # [t*h*w, C]
+    x = x + pos.astype(x.dtype)
+    L = x.shape[0]
+
+    for i in range(cfg.num_layers):
+        bp = jax.tree.map(lambda a: a[i], params["blocks"])
+        hst = _ln(x, bp["norm0_w"], bp["norm0_b"])
+        qkv = hst @ bp["wqkv_w"] + bp["wqkv_b"]
+        # reference packs [L, 3, nh, hd]
+        qkv = qkv.reshape(L, 3, nh, hd)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        q, k = _rope2d(q, cos, sin), _rope2d(k, cos, sin)
+        scores = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * hd ** -0.5
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum("hqk,khd->qhd", probs, v.astype(jnp.float32))
+        attn = attn.reshape(L, C).astype(x.dtype)
+        x = x + (attn @ bp["wo_w"] + bp["wo_b"])
+        hst = _ln(x, bp["norm1_w"], bp["norm1_b"])
+        hst = hst @ bp["fc0_w"] + bp["fc0_b"]
+        hst = jax.nn.gelu(hst.astype(jnp.float32),
+                          approximate=True).astype(x.dtype)
+        x = x + (hst @ bp["fc1_w"] + bp["fc1_b"])
+
+    x = _ln(x, params["final_ln_w"], params["final_ln_b"])
+
+    # 2x2 spatial merge + temporal mean pool (reference _tpool_patch_merger)
+    nhh, nww = h // kh, w // kw
+    x = x.reshape(t, nhh, kh, nww, kw, C).transpose(0, 1, 3, 2, 4, 5)
+    x = x.mean(axis=0).reshape(nhh * nww, kh * kw, C)
+
+    m = params["merger"]
+    x = _ln(x, m["pre_norm_w"], m["pre_norm_b"], cfg.projector_ln_eps)
+    x = x.reshape(nhh * nww, -1)
+    x = x @ m["fc1_w"] + m["fc1_b"]
+    x = jax.nn.gelu(x.astype(jnp.float32), approximate=False).astype(x.dtype)
+    return x @ m["fc2_w"] + m["fc2_b"]                 # [nhh*nww, text]
+
+
+_vit_jit = jax.jit(_vit_jit, static_argnames=("cfg", "t", "h", "w"))
+
+
+def _pos_embed(params, cfg: KimiVisionConfig, t: int, h: int, w: int):
+    """Spatial grid interpolated to (h, w) + sincos temporal for t > 1."""
+    pe = params["pos_emb"].astype(jnp.float32)           # [H0, W0, C]
+    if (h, w) != (cfg.pos_emb_height, cfg.pos_emb_width):
+        pe = jax.image.resize(pe, (h, w, pe.shape[-1]), method="bicubic")
+    pe = pe.reshape(h * w, -1)
+    if t == 1:
+        return pe
+    tw = jnp.asarray(_sincos_1d(cfg.hidden_size, t))     # [t, C]
+    return (pe[None, :, :] + tw[:, None, :]).reshape(t * h * w, -1)
+
+
+def embed_single(params: Params, cfg: KimiVisionConfig, pixels,
+                 grid_thw: Tuple[int, int, int]) -> jnp.ndarray:
+    """One image / video chunk: pixels [t*h*w, C·ps²] → projected
+    embeddings [(h/kh)·(w/kw), text_hidden] (temporal pooling collapses
+    the frame axis)."""
+    t, h, w = (int(v) for v in grid_thw)
+    cos, sin = _rope2d_cos_sin(h, w, t, cfg.head_dim)
+    pos = _pos_embed(params, cfg, t, h, w)
+    return _vit_jit(params, jnp.asarray(pixels), pos, jnp.asarray(cos),
+                    jnp.asarray(sin), cfg, t, h, w)

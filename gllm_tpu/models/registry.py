@@ -46,6 +46,8 @@ def _dense_def() -> ModelDef:
 
 
 _DENSE_ARCHS = (
+    "ChatGLMForConditionalGeneration",
+    "ChatGLMModel",
     "Glm4ForCausalLM",
     "GlmForCausalLM",
     "LlamaForCausalLM",
@@ -102,6 +104,22 @@ def get_model_def(cfg: ModelConfig) -> ModelDef:
         return _vl_def()
     if cfg.architecture in _VL3_ARCHS:
         return _vl3_def()
+    if cfg.architecture == "KimiK25ForConditionalGeneration":
+        from gllm_tpu.models import kimi
+        from gllm_tpu.parallel.shardings import (kimi_param_specs,
+                                                 latent_kv_specs)
+        return ModelDef(
+            family="kimi",
+            init_params=kimi.init_params,
+            forward=kimi.forward,
+            compute_logits=kimi.compute_logits,
+            make_rope_table=kimi.make_rope_table,
+            load_params=kimi.load_params,
+            init_kv_cache=kimi.init_kv_cache,
+            param_specs=kimi_param_specs,
+            kv_specs=latent_kv_specs,
+            embed_mm=kimi.embed_mm,
+        )
     if cfg.architecture in _HYBRID_ARCHS:
         from gllm_tpu.models import hybrid
         from gllm_tpu.parallel.shardings import (hybrid_kv_specs,
@@ -157,5 +175,6 @@ def supported_architectures() -> Dict[str, str]:
     out.update({a: "mla-moe" for a in _MLA_ARCHS})
     out.update({a: "vl" for a in _VL_ARCHS})
     out.update({a: "vl3" for a in _VL3_ARCHS})
+    out["KimiK25ForConditionalGeneration"] = "kimi"
     out.update({a: "hybrid" for a in _HYBRID_ARCHS})
     return out

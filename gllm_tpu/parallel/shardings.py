@@ -234,6 +234,19 @@ def vl3_param_specs(cfg: ModelConfig, tp: int) -> dict:
     return specs
 
 
+def kimi_param_specs(cfg: ModelConfig, tp: int) -> dict:
+    """Kimi K2.5: DeepSeek text specs + replicated MoonViT tower."""
+    import jax
+
+    from gllm_tpu.models import kimi, kimi_vision
+    specs = deepseek_param_specs(cfg, tp)
+    vtemplate = jax.eval_shape(
+        lambda: kimi_vision.init_vision_params(kimi.vision_cfg(cfg)))
+    specs["visual"] = jax.tree.map(lambda s: P(*([None] * len(s.shape))),
+                                   vtemplate)
+    return specs
+
+
 def hybrid_param_specs(cfg: ModelConfig, tp: int) -> dict:
     """Qwen3-Next hybrid shardings: attention halves shard like dense
     (head axis), GDN projections shard on their output/head axes, MoE

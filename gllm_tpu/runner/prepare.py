@@ -247,8 +247,10 @@ class BatchBuilder:
             for i, it in enumerate(batch.items):
                 sp = it.seq.sampling_params
                 if _uses_penalty(sp):
-                    np.add.at(tc[i], np.asarray(it.seq.token_ids,
-                                                np.int64), 1)
+                    ids = np.asarray(it.seq.token_ids, np.int64)
+                    # visual placeholder ids can sit past the LM vocab
+                    # (Kimi's media pad) — they never appear in logits
+                    np.add.at(tc[i], ids[ids < self.vocab_size], 1)
                     pres[i] = sp.presence_penalty
                     freq[i] = sp.frequency_penalty
             token_counts = jnp.asarray(tc)
