@@ -119,9 +119,13 @@ class EncoderEngine:
             grid = np.asarray(content["grid_thw"]).reshape(-1)
             assert grid.size == 3, \
                 f"one grid row per item, got shape {grid.shape}"
-            return {"pixels": np.asarray(content["pixel_values"],
-                                         np.float32),
-                    "grid_thw": tuple(int(v) for v in grid)}
+            out = {"pixels": np.asarray(content["pixel_values"],
+                                        np.float32),
+                   "grid_thw": tuple(int(v) for v in grid)}
+            if content.get("second_per_grid_ts") is not None:
+                out["second_per_grid_ts"] = float(
+                    content["second_per_grid_ts"])
+            return out
         if modality != "image":
             raise NotImplementedError(
                 "video jobs must ship pre-processed pixels")
@@ -228,7 +232,8 @@ class EncoderRuntime:
             modality=job.modality,
             num_tokens=self.engine.num_vis_tokens(grid),
             feat_dim=self.engine.feat_dim, grid_thw=grid,
-            content_hash=prep["hash"], slot_id=job.slot_id)
+            content_hash=prep["hash"], slot_id=job.slot_id,
+            second_per_grid_ts=prep.get("second_per_grid_ts"))
         self._send_meta(job.lm_meta_addr, meta)       # control plane first
         return prep
 

@@ -217,8 +217,11 @@ class DisaggCoordinator:
             1 for t in seq.token_ids
             if t in (self.model_cfg.image_token_id,
                      self.model_cfg.video_token_id))
-        assert n_sentinels == len(raw_items), \
-            f"{n_sentinels} sentinels != {len(raw_items)} items"
+        if n_sentinels != len(raw_items):
+            # ValueError → the serving intake rejects THIS request instead
+            # of the engine thread dying on an AssertionError
+            raise ValueError(f"{n_sentinels} vision sentinels in the "
+                             f"skeleton != {len(raw_items)} media items")
         if self.model_cfg.mm_per_frame_video and any(
                 m == "video" for m, _ in raw_items):
             # per-frame-video models (Qwen3-VL) need per-frame grid
@@ -283,9 +286,22 @@ class DisaggCoordinator:
             if ps is None:
                 continue
             if isinstance(msg, EncodeFailed):
-                logger.warning("encode failed for seq %d item %d: %s",
-                               msg.seq_id, msg.item_idx, msg.error)
-                self._fail_seq(ps, events)
+                it = ps.items[msg.item_idx]
+                if it.done:
+                    # stale failure from a redispatch-superseded encoder;
+                    # the item already completed elsewhere
+                    continue
+                _, max_redispatch = _watchdog_params()
+                if it.attempts > max_redispatch:
+                    logger.warning("encode failed for seq %d item %d: %s",
+                                   msg.seq_id, msg.item_idx, msg.error)
+                    self._fail_seq(ps, events)
+                else:
+                    # bounded retry: arm the watchdog to redispatch now
+                    logger.warning("encode attempt failed for seq %d item "
+                                   "%d (%s); will redispatch",
+                                   msg.seq_id, msg.item_idx, msg.error)
+                    it.dispatched_at = 0.0
                 continue
             assert isinstance(msg, MmItemMeta)
             it = ps.items[msg.item_idx]
@@ -398,7 +414,13 @@ class DisaggCoordinator:
                         tuple(int(v) for v in it.meta.grid_thw),
                         it.meta.content_hash)
                  for it in ps.items]
-        mm = finish_mm_state(expanded, cfg, items)
+        # temporal mrope scaling for video items (monolith parity; the
+        # builder consumes one entry per VIDEO item in order)
+        spg = [it.meta.second_per_grid_ts for it in ps.items
+               if it.modality == "video"]
+        mm = finish_mm_state(expanded, cfg, items,
+                             second_per_grid_ts=(spg if any(
+                                 v is not None for v in spg) else None))
         mm.vis_embeds = np.zeros((mm.num_vis_tokens, cfg.mm_embed_dim),
                                  np.float32)
 

@@ -122,7 +122,11 @@ def encode_mm_messages(llm, messages: List[dict], **kwargs):
         mm_input = {}
         if out.get("pixel_values") is not None:
             mm_input["pixel_values"] = out["pixel_values"]
-            mm_input["image_grid_thw"] = out.get("image_grid_thw")
+            # Kimi's processor names the grids "grid_thws"
+            if out.get("grid_thws") is not None:
+                mm_input["grid_thws"] = out["grid_thws"]
+            else:
+                mm_input["image_grid_thw"] = out.get("image_grid_thw")
         if out.get("pixel_values_videos") is not None:
             mm_input["video_pixel_values"] = out["pixel_values_videos"]
             mm_input["video_grid_thw"] = out.get("video_grid_thw")

@@ -58,8 +58,11 @@ class LazySafetensors:
 
     def __init__(self, model_dir: str):
         self.model_dir = model_dir
+        self._bin = False
         index_path = os.path.join(model_dir, "model.safetensors.index.json")
         single_path = os.path.join(model_dir, "model.safetensors")
+        bin_index = os.path.join(model_dir, "pytorch_model.bin.index.json")
+        bin_single = os.path.join(model_dir, "pytorch_model.bin")
         if os.path.exists(index_path):
             with open(index_path) as f:
                 self.weight_map: Dict[str, str] = json.load(f)["weight_map"]
@@ -68,9 +71,23 @@ class LazySafetensors:
             with safe_open(single_path, framework="np") as f:
                 names = list(f.keys())
             self.weight_map = {n: "model.safetensors" for n in names}
+        elif os.path.exists(bin_index) or os.path.exists(bin_single):
+            # torch .bin fallback (reference model_loader load_bin path):
+            # shards are torch.load-ed lazily (mmap) one at a time.
+            self._bin = True
+            if os.path.exists(bin_index):
+                with open(bin_index) as f:
+                    self.weight_map = json.load(f)["weight_map"]
+            else:
+                import torch
+                sd = torch.load(bin_single, map_location="cpu",
+                                weights_only=True, mmap=True)
+                self.weight_map = {n: "pytorch_model.bin" for n in sd}
+                self._open_files = {"pytorch_model.bin": sd}
+                return
         else:
             raise FileNotFoundError(
-                f"no safetensors checkpoint in {model_dir}")
+                f"no safetensors or .bin checkpoint in {model_dir}")
         self._open_files: Dict[str, object] = {}
 
     def names(self) -> Iterator[str]:
@@ -78,13 +95,26 @@ class LazySafetensors:
 
     def _file(self, fname: str):
         if fname not in self._open_files:
-            from safetensors import safe_open
-            self._open_files[fname] = safe_open(
-                os.path.join(self.model_dir, fname), framework="flax")
+            if self._bin:
+                import torch
+                self._open_files[fname] = torch.load(
+                    os.path.join(self.model_dir, fname),
+                    map_location="cpu", weights_only=True, mmap=True)
+            else:
+                from safetensors import safe_open
+                self._open_files[fname] = safe_open(
+                    os.path.join(self.model_dir, fname), framework="flax")
         return self._open_files[fname]
 
     def get(self, name: str) -> jnp.ndarray:
-        return self._file(self.weight_map[name]).get_tensor(name)
+        f = self._file(self.weight_map[name])
+        if self._bin:
+            import torch
+            t = f[name]
+            if t.dtype == torch.bfloat16:
+                return jnp.asarray(t.float().numpy()).astype(jnp.bfloat16)
+            return jnp.asarray(t.numpy())
+        return f.get_tensor(name)
 
     def __contains__(self, name: str) -> bool:
         return name in self.weight_map
@@ -149,6 +179,15 @@ def dense_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
     return rule
 
 
+def skip_visual_rules(rules):
+    """Drop every rule targeting the vision tower (disagg LM nodes never
+    read visual.* shards — the inverse of the encoder's filter)."""
+    def filtered(name):
+        r = rules(name)
+        return None if (r is not None and r[0][0] == "visual") else r
+    return filtered
+
+
 def _load_params(model_dir: str, template, rules,
                  progress_cb: Optional[Callable[[int, int], None]] = None,
                  ) -> dict:
@@ -190,6 +229,65 @@ def _load_params(model_dir: str, template, rules,
     return jax.tree.map(jnp.asarray, host)
 
 
+def chatglm_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
+    """ChatGLM3 legacy layout (reference models/chatglm.py): fused
+    ``query_key_value`` split by head geometry, fused ``dense_h_to_4h``
+    split into gate/up, ``transformer.*`` namespacing."""
+    first, last = cfg.stage_layers
+    q_rows = cfg.num_heads * cfg.head_dim
+    kv_rows = cfg.num_kv_heads * cfg.head_dim
+
+    def split_qkv_w(t: np.ndarray) -> dict:
+        q, k, v = np.split(t, [q_rows, q_rows + kv_rows], axis=0)
+        return {"q_proj": q.T, "k_proj": k.T, "v_proj": v.T}
+
+    def split_qkv_b(t: np.ndarray) -> dict:
+        q, k, v = np.split(t, [q_rows, q_rows + kv_rows], axis=0)
+        return {"q_bias": q, "k_bias": k, "v_bias": v}
+
+    def split_gate_up(t: np.ndarray) -> dict:
+        gate, up = np.split(t, 2, axis=0)
+        return {"gate_proj": gate.T, "up_proj": up.T}
+
+    leaves = {
+        "input_layernorm.weight": ("input_norm", None),
+        "post_attention_layernorm.weight": ("post_attn_norm", None),
+        "self_attention.dense.weight": ("o_proj", "t"),
+        "mlp.dense_4h_to_h.weight": ("down_proj", "t"),
+    }
+
+    def rule(name: str) -> Optional[Rule]:
+        if name == "transformer.embedding.word_embeddings.weight":
+            return (("embed",), None, None) if cfg.is_first_stage else None
+        if name == "transformer.encoder.final_layernorm.weight":
+            return (("final_norm",), None, None) if cfg.is_last_stage \
+                else None
+        if name == "transformer.output_layer.weight":
+            return (("lm_head",), None, "t") if cfg.is_last_stage else None
+        if name.startswith("transformer.encoder.layers."):
+            rest = name[len("transformer.encoder.layers."):]
+            idx_s, _, leaf = rest.partition(".")
+            i = int(idx_s)
+            if not (first <= i < last):
+                return None
+            li = i - first
+            if leaf == "self_attention.query_key_value.weight":
+                return (("layers", "__multi__"), li, split_qkv_w)
+            if leaf == "self_attention.query_key_value.bias":
+                return (("layers", "__multi__"), li, split_qkv_b)
+            if leaf == "mlp.dense_h_to_4h.weight":
+                return (("layers", "__multi__"), li, split_gate_up)
+            if leaf in leaves:
+                target, tf = leaves[leaf]
+                return (("layers", target), li, tf)
+        return None
+
+    return rule
+
+
+_CHATGLM_ARCHS = ("ChatGLMModel", "ChatGLMForConditionalGeneration")
+
+
 def load_dense_params(model_dir: str, cfg: ModelConfig,
                       dtype=jnp.bfloat16,
                       progress_cb: Optional[Callable[[int, int], None]] = None,
@@ -197,7 +295,9 @@ def load_dense_params(model_dir: str, cfg: ModelConfig,
     """Load a dense-family checkpoint into the stacked param layout."""
     from gllm_tpu.models import dense
     template = jax.eval_shape(lambda: dense.init_params(cfg, dtype=dtype))
-    return _load_params(model_dir, template, dense_rules(cfg), progress_cb)
+    rules = (chatglm_rules(cfg) if cfg.architecture in _CHATGLM_ARCHS
+             else dense_rules(cfg))
+    return _load_params(model_dir, template, rules, progress_cb)
 
 
 def moe_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:

@@ -170,7 +170,11 @@ def _vl3_rules(cfg: ModelConfig):
 
 
 def load_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16,
-                progress_cb=None) -> dict:
-    from gllm_tpu.models.loader import _load_params
+                progress_cb=None, skip_visual: bool = False) -> dict:
+    from gllm_tpu.models.loader import _load_params, skip_visual_rules
     template = jax.eval_shape(lambda: init_params(cfg, dtype=dtype))
-    return _load_params(model_dir, template, _vl3_rules(cfg), progress_cb)
+    rules = _vl3_rules(cfg)
+    if skip_visual:
+        del template["visual"]
+        rules = skip_visual_rules(rules)
+    return _load_params(model_dir, template, rules, progress_cb)

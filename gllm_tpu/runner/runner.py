@@ -112,8 +112,12 @@ class ModelRunner:
             ep_loaded = True
         else:
             logger.info("loading weights from %s", config.model)
+            kwargs = {}
+            if config.skip_visual_load and model_cfg.use_mm:
+                # disagg LM node: never read the visual.* shards
+                kwargs["skip_visual"] = True
             self.params = self.model_def.load_params(
-                config.model, model_cfg, dtype=self.dtype)
+                config.model, model_cfg, dtype=self.dtype, **kwargs)
         self.cos_sin = self.model_def.make_rope_table(model_cfg)
 
         if config.quantization:
@@ -126,8 +130,7 @@ class ModelRunner:
                         param_bytes(self.params) / 1e9)
 
         if config.skip_visual_load and "visual" in self.params:
-            # disagg LM node: the forward path never reads the tower
-            # (embeddings arrive pre-computed from the encoder fleet)
+            # dummy-init path (load skips the tower at the rules level)
             del self.params["visual"]
 
         if self.mesh is not None and not ep_loaded:
