@@ -169,11 +169,14 @@ def main():
             rope_theta=500000.0, tie_word_embeddings=True)
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="bfloat16", max_model_len=2048,
-            max_num_seqs=256, overlap_scheduling=True,
+            max_num_seqs=256, overlap_scheduling=True, overlap_depth=4,
+            multi_step_decode=8,
             scheduler=SchedulerConfig(max_prefill_tokens=1024,
-                                      max_decode_seqs=128),
-            cache=CacheConfig(page_size=16, memory_util=0.85))
-        n_requests = args.requests or 48
+                                      max_decode_seqs=256),
+            # explicit pool (4 GB KV): the axon-attached chip advertises
+            # no memory_stats and over-allocating hangs device init
+            cache=CacheConfig(page_size=16, num_pages=8192))
+        n_requests = args.requests or 160
 
     log(f"backend={jax.default_backend()} devices={jax.devices()}")
     t0 = time.monotonic()

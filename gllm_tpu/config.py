@@ -80,6 +80,15 @@ class EngineConfig:
     # chain decode steps on-device so the host round trip between decode
     # iterations disappears.
     overlap_scheduling: bool = False
+    # In-flight chained decode steps when overlap_scheduling is on. Depth
+    # 2 hides host batch-building; deeper pipelines also hide the
+    # dispatch round trip of remote-attached TPUs (axon tunnel).
+    overlap_depth: int = 2
+    # Fuse up to K chained decode steps into ONE device program
+    # (lax.scan over the step axis): one dispatch + one token fetch per K
+    # tokens/seq. The decisive lever when dispatch latency is high
+    # (remote-attached TPUs); trades up to K-1 wasted steps per EOS.
+    multi_step_decode: int = 1
     # Quantization: None | "int8" | "fp8" | "int4" (weight-only,
     # per-output-channel, XLA-fused dequant) | "w8a8" (int8 weights +
     # per-token int8 activations on the MXU) — reference quantization

@@ -243,17 +243,25 @@ class LLM:
         overlap = (self.config.overlap_scheduling
                    and self.config.parallel.pp == 1)
         if overlap:
-            depth = 2
+            depth = max(2, self.config.overlap_depth)
+        multi = self.config.multi_step_decode if overlap else 1
         while len(self._in_flight) < depth:
             if overlap and self._in_flight and not self.scheduler.waiting:
-                # chain the next decode step off the in-flight batch's
+                # chain the next decode step(s) off the in-flight batch's
                 # on-device tokens (overlap scheduling)
                 prev_batch, prev_handle = self._in_flight[-1]
-                chained = self.scheduler.schedule_chained(prev_batch)
-                if chained is None:
+                if isinstance(prev_batch, list):
+                    prev_batch = prev_batch[-1]
+                chain = self._schedule_multi(prev_batch, multi)
+                if not chain:
                     break
-                handle = self.runner.step_async_chained(chained, prev_handle)
-                self._in_flight.append((chained, handle))
+                if len(chain) > 1:
+                    handle = self.runner.step_multi(chain, prev_handle)
+                    self._in_flight.append((chain, handle))
+                else:
+                    handle = self.runner.step_async_chained(chain[0],
+                                                            prev_handle)
+                    self._in_flight.append((chain[0], handle))
                 continue
             batch = self.scheduler.schedule_once()
             if batch is None:
@@ -266,6 +274,14 @@ class LLM:
             return []
         batch, handle = self._in_flight.popleft()
         tokens, aux = self.runner.collect(handle)
+        if isinstance(batch, list):
+            # multi-step block: tokens [K, S]; advance K scheduler steps
+            outs = []
+            for b, row in zip(batch, tokens):
+                outs.extend(self.scheduler.process_output(
+                    b, row.tolist(), self.eos_token_ids))
+            self._check_stop_strings(outs)
+            return outs
         if aux:
             # before process_output: ScheduledSeq.samples reads the seq's
             # CURRENT token count, which process_output advances
@@ -274,6 +290,33 @@ class LLM:
                                              self.eos_token_ids)
         self._check_stop_strings(outs)
         return outs
+
+    def _schedule_multi(self, prev_batch, multi: int):
+        """Chain up to ``multi`` decode steps off ``prev_batch`` for one
+        fused dispatch (gated to plain decode: penalties / seeds /
+        logprobs / hybrid-SSM paths fall back to single chained steps)."""
+        first = self.scheduler.schedule_chained(prev_batch)
+        if first is None:
+            return []
+        if multi <= 1 or self.model_cfg.use_hybrid:
+            return [first]
+        from gllm_tpu.runner.prepare import BatchBuilder
+        if BatchBuilder.batch_extras(first):
+            return [first]          # seeded / penalized rows: step-by-step
+        if any(it.seq.sampling_params.logprobs is not None
+               or it.seq.sampling_params.stop
+               for it in first.items):
+            # stop STRINGS must be checked between steps (a fused block
+            # would stream past the match); logprobs aren't plumbed
+            # through the fused program
+            return [first]
+        chain = [first]
+        while len(chain) < multi:
+            nxt = self.scheduler.schedule_chained(chain[-1])
+            if nxt is None:
+                break
+            chain.append(nxt)
+        return chain
 
     def _step_dp(self) -> List[SeqOutput]:
         """One synchronous step over all DP replicas (single jit program;

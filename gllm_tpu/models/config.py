@@ -80,6 +80,15 @@ class ModelConfig:
     def use_mla(self) -> bool:
         return self.kv_lora_rank > 0
 
+    @property
+    def mla_cache_width(self) -> int:
+        """Latent-row width PADDED to the 128-lane Mosaic tile so the
+        Pallas kernels can DMA pages (576 → 640 for DeepSeek V3; the pad
+        lanes stay zero and q is zero-padded to match, so scores are
+        unchanged on every path)."""
+        width = self.kv_lora_rank + self.qk_rope_head_dim
+        return width + (-width) % 128
+
     # DeepSeek V3.2 sparse attention (DSA — reference deepseek_v32.py):
     # lightning indexer scoring + top-k physical-slot selection.
     index_n_heads: int = 0

@@ -52,9 +52,9 @@ class LatentKVCache(NamedTuple):
 
 def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
                   dtype=jnp.bfloat16) -> LatentKVCache:
-    width = cfg.kv_lora_rank + cfg.qk_rope_head_dim
     latent = jnp.zeros(
-        (cfg.num_stage_layers, num_pages, page_size, width), dtype)
+        (cfg.num_stage_layers, num_pages, page_size, cfg.mla_cache_width),
+        dtype)
     index_k = None
     if cfg.use_dsa:
         index_k = jnp.zeros((cfg.num_stage_layers, num_pages, page_size,
@@ -276,9 +276,14 @@ def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
     k_pe = kv_a[:, lora:][:, None, :]                 # [T, 1, rope]
     q_pe, k_pe = apply_rope_interleaved(q_pe, k_pe, batch.positions, cos_sin)
 
-    # Latent cache row = [c_kv | k_pe] — write via flat slot scatter.
+    # Latent cache row = [c_kv | k_pe | 0-pad] — the row is padded to the
+    # 128-lane tile (cfg.mla_cache_width) so Pallas can DMA pages; write
+    # via flat slot scatter.
     entry = jnp.concatenate([c_kv, k_pe[:, 0, :]], axis=-1)
     L_pages, page, width = latent_cache.shape
+    pad = width - entry.shape[-1]
+    if pad:
+        entry = jnp.pad(entry, ((0, 0), (0, pad)))
     flat = latent_cache.reshape(L_pages * page, width)
     latent_cache = flat.at[batch.slot_mapping].set(
         entry.astype(flat.dtype)).reshape(latent_cache.shape)
@@ -287,6 +292,9 @@ def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
     q_lat = jnp.einsum("thn,hnl->thl", q_nope.astype(jnp.float32),
                        lp["w_uk"].astype(jnp.float32)).astype(x.dtype)
     q_full = jnp.concatenate([q_lat, q_pe], axis=-1)  # [T, Hq, lora+rope]
+    if pad:
+        # zero q over the pad lanes — scores are unchanged
+        q_full = jnp.pad(q_full, ((0, 0), (0, 0), (0, pad)))
 
     if cfg.use_dsa:
         # DSA: indexer top-k physical slots, then sparse attention over

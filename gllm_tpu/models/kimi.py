@@ -115,10 +115,7 @@ def _kimi_rules(cfg: ModelConfig):
 
 def load_params(model_dir: str, cfg: ModelConfig, dtype=jnp.bfloat16,
                 progress_cb=None, skip_visual: bool = False) -> dict:
-    from gllm_tpu.models.loader import _load_params, skip_visual_rules
+    from gllm_tpu.models.loader import _load_params
     template = jax.eval_shape(lambda: init_params(cfg, dtype=dtype))
-    rules = _kimi_rules(cfg)
-    if skip_visual:
-        del template["visual"]
-        rules = skip_visual_rules(rules)
-    return _load_params(model_dir, template, rules, progress_cb)
+    return _load_params(model_dir, template, _kimi_rules(cfg),
+                        progress_cb, skip_visual=skip_visual)

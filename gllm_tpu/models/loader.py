@@ -190,9 +190,14 @@ def skip_visual_rules(rules):
 
 def _load_params(model_dir: str, template, rules,
                  progress_cb: Optional[Callable[[int, int], None]] = None,
-                 ) -> dict:
+                 skip_visual: bool = False) -> dict:
     """Shared load loop: stream tensors, apply first-match rules, fill the
-    stacked host buffers, ship to device once."""
+    stacked host buffers, ship to device once. ``skip_visual`` drops the
+    vision-tower subtree entirely (disagg LM nodes: never read or
+    allocate visual.* shards)."""
+    if skip_visual and "visual" in template:
+        template = {k: v for k, v in template.items() if k != "visual"}
+        rules = skip_visual_rules(rules)
     host: dict = jax.tree.map(
         lambda s: np.zeros(s.shape, jnp.dtype(s.dtype)), template)
     lazy = LazySafetensors(model_dir)

@@ -43,7 +43,7 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
             *refs,
             page_size: int, pages_per_block: int, scale: float,
             num_kv_heads: int, group: int, head_dim: int, v_dim: int,
-            shared_kv: bool):
+            shared_kv: bool, mqa: bool):
     if shared_kv:
         q_ref, k_hbm, o_ref, k_buf, sems = refs
         v_hbm = v_buf = None
@@ -63,7 +63,10 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
         start_fetch(0, s, 0)
 
     q = q_ref[0].astype(jnp.float32) * scale          # [Hq, D]
-    qh = q.reshape(num_kv_heads, group, head_dim)     # [Hkv, G, D]
+    # MQA (Hkv == 1): keep everything 2-D — scores [Hq, BK] from one
+    # q @ kᵀ MXU dot; the caches arrive 3-D with the head axis squeezed.
+    qh = q if mqa else q.reshape(num_kv_heads, group, head_dim)
+    kv_axis = 1 if mqa else 2
 
     def body(i, carry):
         m, l, acc = carry
@@ -75,33 +78,43 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
 
         wait_fetch(slot, s, i)
         k, v = block_kv(k_buf, v_buf, slot, bk, num_kv_heads, head_dim,
-                        v_dim, shared_kv)
-        kt = k.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, D]
-        vt = v.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, Dv]
-
-        # [Hkv, G, BK] = batch-dot over kv heads (MXU)
-        scores = jax.lax.dot_general(
-            qh, kt, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
+                        v_dim, shared_kv, mqa=mqa)
+        if mqa:
+            kt = k.astype(jnp.float32)                  # [BK, D]
+            vt = v.astype(jnp.float32)                  # [BK, Dv]
+            scores = jax.lax.dot_general(               # [Hq, BK]
+                qh, kt, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            kt = k.astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, BK, D]
+            vt = v.astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, BK, Dv]
+            scores = jax.lax.dot_general(               # [Hkv, G, BK]
+                qh, kt, (((2,), (2,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
         kv_pos = i * bk + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 2)
+            jnp.int32, scores.shape, kv_axis)
         scores = jnp.where(kv_pos < kv_len, scores, -jnp.inf)
 
-        m_blk = jnp.max(scores, axis=2, keepdims=True)   # [Hkv, G, 1]
+        m_blk = jnp.max(scores, axis=kv_axis, keepdims=True)
         m_new = jnp.maximum(m, m_blk)
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new)                      # [Hkv, G, BK]
-        l_new = l * alpha + jnp.sum(p, axis=2, keepdims=True)
-        # [Hkv, G, Dv] accumulation
-        pv = jax.lax.dot_general(
-            p, vt, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
+        p = jnp.exp(scores - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=kv_axis, keepdims=True)
+        if mqa:
+            pv = jax.lax.dot_general(                   # [Hq, Dv]
+                p, vt, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        else:
+            pv = jax.lax.dot_general(                   # [Hkv, G, Dv]
+                p, vt, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)
         acc_new = acc * alpha + pv
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((num_kv_heads, group, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((num_kv_heads, group, 1), jnp.float32)
-    acc0 = jnp.zeros((num_kv_heads, group, v_dim), jnp.float32)
+    lead = (num_kv_heads * group,) if mqa else (num_kv_heads, group)
+    m0 = jnp.full((*lead, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((*lead, 1), jnp.float32)
+    acc0 = jnp.zeros((*lead, v_dim), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-30)                   # padded seqs → 0
@@ -135,6 +148,15 @@ def paged_decode_attention(
     else:
         v_dim = v_cache.shape[-1]
 
+    # MQA (MLA's latent cache): squeeze the singleton head axis — Mosaic's
+    # sublane tiling rejects slicing a size-1 second-minor dim — and run
+    # the kernel's 2-D path.
+    mqa = num_kv_heads == 1
+    if mqa:
+        k_cache = k_cache.reshape(num_pages, page_size, head_dim)
+        if v_cache is not None:
+            v_cache = v_cache.reshape(num_pages, page_size, v_dim)
+
     pages_per_block = max(1, min(kv_block // page_size, max_pages))
     # page_table must cover whole blocks; pad with dummy page 0.
     rem = max_pages % pages_per_block
@@ -146,11 +168,11 @@ def paged_decode_attention(
     kernel = functools.partial(
         _kernel, page_size=page_size, pages_per_block=pages_per_block,
         scale=scale, num_kv_heads=num_kv_heads, group=group,
-        head_dim=head_dim, v_dim=v_dim, shared_kv=shared_kv)
+        head_dim=head_dim, v_dim=v_dim, shared_kv=shared_kv, mqa=mqa)
 
     kv_specs, scratch_shapes, kv_inputs = kv_stream_specs(
         k_cache, v_cache, pages_per_block, page_size, num_kv_heads,
-        head_dim, v_dim)
+        head_dim, v_dim, mqa=mqa)
     in_specs = [
         pl.BlockSpec((1, num_q_heads, head_dim), lambda s, *_: (s, 0, 0),
                      memory_space=pltpu.VMEM),

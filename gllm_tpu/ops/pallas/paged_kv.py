@@ -44,9 +44,17 @@ def make_fetch_fns(pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems,
 
 
 def block_kv(k_buf, v_buf, slot, bk: int, num_kv_heads: int,
-             head_dim: int, v_dim: int, shared_kv: bool):
+             head_dim: int, v_dim: int, shared_kv: bool,
+             mqa: bool = False):
     """The current VMEM block as ([BK, Hkv, D] keys, [BK, Hkv, Dv] values);
-    shared-kv mode slices values from the key block (latent prefix)."""
+    shared-kv mode slices values from the key block (latent prefix).
+    ``mqa`` mode (Hkv == 1, 3-D cache without the singleton head axis —
+    Mosaic's sublane tiling rejects slicing a size-1 second-minor dim)
+    returns 2-D [BK, D] / [BK, Dv]."""
+    if mqa:
+        k = k_buf[slot].reshape(bk, head_dim)
+        v = k[:, :v_dim] if shared_kv else v_buf[slot].reshape(bk, v_dim)
+        return k, v
     k = k_buf[slot].reshape(bk, num_kv_heads, head_dim)
     if shared_kv:
         v = k[..., :v_dim]
@@ -56,21 +64,24 @@ def block_kv(k_buf, v_buf, slot, bk: int, num_kv_heads: int,
 
 
 def kv_stream_specs(k_cache, v_cache, pages_per_block: int, page_size: int,
-                    num_kv_heads: int, head_dim: int, v_dim: int):
+                    num_kv_heads: int, head_dim: int, v_dim: int,
+                    mqa: bool = False):
     """(in_specs_tail, scratch_shapes, inputs_tail) for the KV streams.
 
     Appends the v stream only when a distinct v cache exists; the DMA
-    semaphore array always comes last in scratch.
+    semaphore array always comes last in scratch. ``mqa`` expects 3-D
+    caches [P, page, D] (head axis squeezed by the caller).
     """
     shared_kv = v_cache is None
+    head_shape = () if mqa else (num_kv_heads,)
     in_specs = [pl.BlockSpec(memory_space=pl.ANY)]
-    scratch = [pltpu.VMEM((2, pages_per_block, page_size, num_kv_heads,
+    scratch = [pltpu.VMEM((2, pages_per_block, page_size, *head_shape,
                            head_dim), k_cache.dtype)]
     inputs = [k_cache]
     if not shared_kv:
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
         scratch.append(pltpu.VMEM((2, pages_per_block, page_size,
-                                   num_kv_heads, v_dim), v_cache.dtype))
+                                   *head_shape, v_dim), v_cache.dtype))
         inputs.append(v_cache)
     scratch.append(pltpu.SemaphoreType.DMA((2, pages_per_block, 2)))
     return in_specs, scratch, inputs

@@ -47,7 +47,7 @@ def _kernel(cu_ref, kv_lens_ref, pt_ref, first_ref, last_ref,  # prefetch
             *refs,
             page_size: int, pages_per_block: int, scale: float,
             num_kv_heads: int, group: int, head_dim: int, v_dim: int,
-            q_blk: int, shared_kv: bool):
+            q_blk: int, shared_kv: bool, mqa: bool):
     if shared_kv:
         q_ref, k_hbm, o_ref, k_buf, sems = refs
         v_hbm = v_buf = None
@@ -59,14 +59,23 @@ def _kernel(cu_ref, kv_lens_ref, pt_ref, first_ref, last_ref,  # prefetch
     s1 = last_ref[b]
     bk = pages_per_block * page_size
     rows = q_blk * group
+    kv_axis = 1 if mqa else 2
 
     q = q_ref[...].astype(jnp.float32) * scale            # [BQ, Hq, D]
-    # [BQ, Hkv, G, D] → [Hkv, BQ, G, D] → [Hkv, BQ*G, D]
-    qh = q.reshape(q_blk, num_kv_heads, group, head_dim) \
-          .transpose(1, 0, 2, 3).reshape(num_kv_heads, rows, head_dim)
-    # token index of each score row: row r → t_start + r // G
-    row_tok = t_start + jax.lax.broadcasted_iota(
-        jnp.int32, (num_kv_heads, rows, 1), 1) // group
+    if mqa:
+        # Hkv == 1 (MLA latent): flat 2-D rows [BQ*Hq, D]; the caches
+        # arrive 3-D with the singleton head axis squeezed (Mosaic's
+        # sublane tiling rejects slicing a size-1 second-minor dim).
+        qh = q.reshape(rows, head_dim)
+        row_tok = t_start + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, 1), 0) // group
+    else:
+        # [BQ, Hkv, G, D] → [Hkv, BQ, G, D] → [Hkv, BQ*G, D]
+        qh = q.reshape(q_blk, num_kv_heads, group, head_dim) \
+              .transpose(1, 0, 2, 3).reshape(num_kv_heads, rows, head_dim)
+        # token index of each score row: row r → t_start + r // G
+        row_tok = t_start + jax.lax.broadcasted_iota(
+            jnp.int32, (num_kv_heads, rows, 1), 1) // group
 
     start_fetch, wait_fetch = make_fetch_fns(
         pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems, pages_per_block,
@@ -101,22 +110,27 @@ def _kernel(cu_ref, kv_lens_ref, pt_ref, first_ref, last_ref,  # prefetch
 
             wait_fetch(slot, s, i)
             k, v = block_kv(k_buf, v_buf, slot, bk, num_kv_heads,
-                            head_dim, v_dim, shared_kv)
-            kt = k.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, D]
-            vt = v.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, Dv]
-
-            # [Hkv, BQ*G, BK]
-            scores = jax.lax.dot_general(
-                qh, kt, (((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)
+                            head_dim, v_dim, shared_kv, mqa=mqa)
+            if mqa:
+                kt = k.astype(jnp.float32)              # [BK, D]
+                vt = v.astype(jnp.float32)              # [BK, Dv]
+                scores = jax.lax.dot_general(           # [R, BK]
+                    qh, kt, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            else:
+                kt = k.astype(jnp.float32).transpose(1, 0, 2)
+                vt = v.astype(jnp.float32).transpose(1, 0, 2)
+                scores = jax.lax.dot_general(           # [Hkv, R, BK]
+                    qh, kt, (((2,), (2,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
             kv_pos = i * bk + jax.lax.broadcasted_iota(
-                jnp.int32, scores.shape, 2)
+                jnp.int32, scores.shape, kv_axis)
             in_seq = (row_tok >= q_start) & (row_tok < q_end)
-            q_pos = kv_len - q_len + (row_tok - q_start)    # [Hkv, R, 1]
+            q_pos = kv_len - q_len + (row_tok - q_start)
             visible = in_seq & (kv_pos <= q_pos) & (kv_pos < kv_len)
             scores = jnp.where(visible, scores, NEG_INF)
 
-            m_blk = jnp.max(scores, axis=2, keepdims=True)
+            m_blk = jnp.max(scores, axis=kv_axis, keepdims=True)
             m_new = jnp.maximum(m, m_blk)
             # rows with nothing visible yet keep m == -inf; exp against a
             # zero stand-in keeps alpha/p at exactly 0 (no nan from
@@ -124,24 +138,33 @@ def _kernel(cu_ref, kv_lens_ref, pt_ref, first_ref, last_ref,  # prefetch
             safe_m = jnp.where(m_new == NEG_INF, 0.0, m_new)
             alpha = jnp.exp(m - safe_m)
             p = jnp.exp(scores - safe_m)
-            l_new = l * alpha + jnp.sum(p, axis=2, keepdims=True)
-            pv = jax.lax.dot_general(
-                p, vt, (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)
+            l_new = l * alpha + jnp.sum(p, axis=kv_axis, keepdims=True)
+            if mqa:
+                pv = jax.lax.dot_general(               # [R, Dv]
+                    p, vt, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            else:
+                pv = jax.lax.dot_general(               # [Hkv, R, Dv]
+                    p, vt, (((2,), (1,)), ((0,), (0,))),
+                    preferred_element_type=jnp.float32)
             return m_new, l_new, acc * alpha + pv
 
         return jax.lax.fori_loop(0, n_blocks, blk_body, (m, l, acc))
 
-    m0 = jnp.full((num_kv_heads, rows, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((num_kv_heads, rows, 1), jnp.float32)
-    acc0 = jnp.zeros((num_kv_heads, rows, v_dim), jnp.float32)
+    lead = (rows,) if mqa else (num_kv_heads, rows)
+    m0 = jnp.full((*lead, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((*lead, 1), jnp.float32)
+    acc0 = jnp.zeros((*lead, v_dim), jnp.float32)
     m, l, acc = jax.lax.fori_loop(s0, s1 + 1, seq_body, (m0, l0, acc0))
 
     out = acc / jnp.maximum(l, 1e-30)                   # empty rows → 0
-    # [Hkv, BQ*G, Dv] → [BQ, Hkv, G, Dv] → [BQ, Hq, Dv]
-    out = out.reshape(num_kv_heads, q_blk, group, v_dim) \
-             .transpose(1, 0, 2, 3) \
-             .reshape(q_blk, num_kv_heads * group, v_dim)
+    if mqa:
+        out = out.reshape(q_blk, group, v_dim)          # group == Hq
+    else:
+        # [Hkv, BQ*G, Dv] → [BQ, Hkv, G, Dv] → [BQ, Hq, Dv]
+        out = out.reshape(num_kv_heads, q_blk, group, v_dim) \
+                 .transpose(1, 0, 2, 3) \
+                 .reshape(q_blk, num_kv_heads * group, v_dim)
     o_ref[...] = out.astype(o_ref.dtype)
 
 
@@ -172,6 +195,15 @@ def ragged_paged_attention(
         v_dim = v_cache.shape[-1]
     S, max_pages = page_table.shape
     group = num_q_heads // num_kv_heads
+
+    # MQA (MLA latent cache): squeeze the singleton head axis — Mosaic's
+    # sublane tiling rejects slicing a size-1 second-minor dim.
+    num_pages = k_cache.shape[0]
+    mqa = num_kv_heads == 1
+    if mqa:
+        k_cache = k_cache.reshape(num_pages, page_size, head_dim)
+        if v_cache is not None:
+            v_cache = v_cache.reshape(num_pages, page_size, v_dim)
 
     # Honor the requested q block (tests use small ones to force blocks
     # that span sequences), but scale it down when the f32 score tile
@@ -205,11 +237,12 @@ def ragged_paged_attention(
     kernel = functools.partial(
         _kernel, page_size=page_size, pages_per_block=pages_per_block,
         scale=scale, num_kv_heads=num_kv_heads, group=group,
-        head_dim=head_dim, v_dim=v_dim, q_blk=bq, shared_kv=shared_kv)
+        head_dim=head_dim, v_dim=v_dim, q_blk=bq, shared_kv=shared_kv,
+        mqa=mqa)
 
     kv_specs, scratch_shapes, kv_inputs = kv_stream_specs(
         k_cache, v_cache, pages_per_block, page_size, num_kv_heads,
-        head_dim, v_dim)
+        head_dim, v_dim, mqa=mqa)
     in_specs = [
         pl.BlockSpec((bq, num_q_heads, head_dim),
                      lambda b, *_: (b, 0, 0),

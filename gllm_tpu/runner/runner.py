@@ -186,6 +186,7 @@ class ModelRunner:
         logger.info("KV cache: %d pages × %d tokens (%s)", self.num_pages,
                     config.cache.page_size, self._kv_dtype().__name__)
         self._step_fn = self._build_step_fn()
+        self._multi_step_fn = self._build_multi_step_fn()
 
     # ---- setup ------------------------------------------------------------
 
@@ -205,13 +206,15 @@ class ModelRunner:
             return impl
         if tp_sharded:
             return "xla"
-        # Mosaic tiles the lane (last) dimension at 128: a head_dim that
-        # isn't a multiple of 128 fails kernel compile ("Slice shape along
-        # dimension 3 must be aligned to tiling (128)") — real checkpoints
-        # use 64/128/192; tiny test configs fall back to the XLA path.
-        hd = (self.model_cfg.kv_lora_rank + self.model_cfg.qk_rope_head_dim
-              if self.model_cfg.use_mla else self.model_cfg.head_dim)
-        if hd % 128 != 0:
+        # Mosaic tiles the lane (last) dimension at 128: unaligned head
+        # dims fail kernel compile ("Slice shape along dimension 3 must be
+        # aligned to tiling (128)", verified on chip). MLA caches are
+        # tile-padded by construction, but the in-kernel value slice
+        # k[..., :lora] still needs lora % 128 == 0 (512 for DeepSeek).
+        if self.model_cfg.use_mla:
+            if self.model_cfg.kv_lora_rank % 128 != 0:
+                return "xla"
+        elif self.model_cfg.head_dim % 128 != 0:
             return "xla"
         return ("pallas" if jax.default_backend() in ("tpu", "axon")
                 else "xla")
@@ -227,9 +230,9 @@ class ModelRunner:
         cfg, page = self.model_cfg, self.config.cache.page_size
         itemsize = jnp.dtype(self._kv_dtype()).itemsize
         if cfg.use_mla:
-            # MLA latent cache: one [lora+rope] row per token, replicated
-            # over tp (MQA-shaped); DSA adds the parallel index-K cache.
-            width = cfg.kv_lora_rank + cfg.qk_rope_head_dim
+            # MLA latent cache: one tile-padded [lora+rope] row per token,
+            # replicated over tp (MQA-shaped); DSA adds the index-K cache.
+            width = cfg.mla_cache_width
             if cfg.use_dsa:
                 width += cfg.index_head_dim
             return (n_layers or cfg.num_stage_layers) * page * width \
@@ -262,8 +265,20 @@ class ModelRunner:
             limit = stats["bytes_limit"]
             in_use = stats["bytes_in_use"]
         except Exception:
-            # CPU / backends without memory_stats: modest default.
-            return 2048
+            if jax.default_backend() in ("tpu", "axon"):
+                # axon exposes no memory_stats; be conservative (8 GiB —
+                # over-allocating HANGS device init on the tunnel; set
+                # GLLM_TPU_HBM_BYTES to the chip's real HBM to use it
+                # all) and account for the weights ourselves — the old
+                # 2048-page fallback starved concurrency (32k KV tokens).
+                import os
+                from gllm_tpu.ops.quant import param_bytes
+                limit = int(os.environ.get("GLLM_TPU_HBM_BYTES",
+                                           8 * 1024 ** 3))
+                in_use = param_bytes(self.params)
+            else:
+                # CPU: modest default.
+                return 2048
         free = limit * self.config.cache.memory_util - in_use
         # Headroom for activations at peak batch shape (a full profile-run
         # pass would refine this; 512 MB covers the bucketed step buffers).
@@ -518,6 +533,8 @@ class ModelRunner:
         negative-id dance — the sampled-token array is simply spliced in as
         the next step's token_ids)."""
         prev_tokens, _, prev_n = prev_handle
+        if prev_tokens.ndim == 2:
+            prev_tokens = prev_tokens[-1]   # preceding multi-step block
         assert prev_n == sched_batch.num_seqs
         self._apply_ssm_intents()
         self._step_count += 1
@@ -536,14 +553,99 @@ class ModelRunner:
                 max_q_len=1, logprobs_k=lp_k)
         return tokens, aux, sched_batch.num_seqs
 
+    def step_multi(self, chain, prev_handle=None):
+        """Launch K chained decode steps as ONE device program (lax.scan
+        over the step axis): one dispatch, one token fetch for the whole
+        block. This is the high-dispatch-latency countermeasure the
+        per-step chain can't provide — remote-attached TPUs pay a full
+        host round trip per dispatch, so K steps per dispatch divides that
+        cost by K. ``chain`` is K ScheduledBatches produced by
+        schedule_once + (K-1)×schedule_chained over the SAME sequences.
+
+        Returns a handle whose collect() yields tokens [K, n]; chainable
+        (the last step's on-device tokens feed the next block)."""
+        K = len(chain)
+        # per-sub-step keys matching the single-step schedule exactly
+        # (fold_in of consecutive step counts) → byte-identical sampling
+        # across multi/single scheduling modes
+        keys = jnp.stack([
+            jax.random.fold_in(self.rng_key, self._step_count + 1 + i)
+            for i in range(K)])
+        self._step_count += K
+        # pages allocated by the chained schedules must fit the page
+        # bucket → size the signature from the LAST step's state
+        sig = self.builder.shape_signature(chain[-1])
+        batch, max_q, token_counts = self.builder.build(
+            chain[0], keys[0], force_signature=sig)
+        assert max_q == 1 and token_counts is None
+        if prev_handle is not None:
+            prev_tokens = prev_handle[0]
+            if prev_tokens.ndim == 2:       # previous multi block
+                prev_tokens = prev_tokens[-1]
+            batch = batch._replace(token_ids=prev_tokens)
+        from gllm_tpu.parallel.mesh import mesh_context
+        with mesh_context(self.mesh):
+            tokens, self.kv = self._multi_step_fn(
+                self.params, self.kv, batch, self.cos_sin, keys,
+                num_steps=K)
+        return tokens, {}, chain[0].num_seqs
+
+    def _build_multi_step_fn(self):
+        cfg = self.model_cfg
+        fwd = self.model_def.forward
+        logits_fn = self.model_def.compute_logits
+        attn_impl = self.attn_impl
+        page = self.config.cache.page_size
+
+        @functools.partial(jax.jit, static_argnames=("num_steps",),
+                           donate_argnums=(1,))
+        def step_multi(params, kv, batch: StepBatch, cos_sin, keys, *,
+                       num_steps: int):
+            def body(carry, xs):
+                k, key = xs
+                kv, tokens = carry
+                pos = batch.positions + k
+                # decode rows: one token per seq; recompute flat KV slots
+                # from the (pre-allocated) page table as positions advance
+                page_idx = jnp.take_along_axis(
+                    batch.attn.page_table, (pos // page)[:, None],
+                    axis=1)[:, 0]
+                slots = page_idx * page + pos % page
+                b = batch._replace(
+                    token_ids=tokens,
+                    positions=pos,
+                    slot_mapping=slots,
+                    attn=batch.attn._replace(
+                        kv_lens=batch.attn.kv_lens + k),
+                    sampling=batch.sampling._replace(step_key=key),
+                    mrope_positions=(batch.mrope_positions + k
+                                     if batch.mrope_positions is not None
+                                     else None),
+                )
+                hidden, residual, kv = fwd(params, kv, b, cfg,
+                                           cos_sin=cos_sin,
+                                           attn_impl=attn_impl,
+                                           max_q_len=1)
+                logits = logits_fn(params, hidden, residual, b, cfg)
+                toks = sample(logits, b.sampling, None)
+                return (kv, toks), toks
+
+            (kv, _), all_tokens = jax.lax.scan(
+                body, (kv, batch.token_ids),
+                (jnp.arange(num_steps, dtype=jnp.int32), keys))
+            return all_tokens, kv                        # [K, S]
+
+        return step_multi
+
     def collect(self, handle):
-        """(sampled tokens [n], aux dict of host arrays or {})."""
+        """(sampled tokens [n] or [K, n], aux dict of host arrays)."""
         tokens, aux, n = handle
         out_aux = {}
         if aux:
             out_aux = {k: tuple(_to_host(a) for a in v)
                        for k, v in aux.items()}
-        return _to_host(tokens)[:n], out_aux
+        host = _to_host(tokens)
+        return (host[..., :n] if host.ndim == 2 else host[:n]), out_aux
 
     def step(self, sched_batch: ScheduledBatch) -> np.ndarray:
         """Run one step; returns sampled token per batch item (host numpy)."""

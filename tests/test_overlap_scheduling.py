@@ -91,3 +91,44 @@ def test_overlap_single_seq_eos_midchain_no_leak(ckpt):
     assert not llm2._in_flight
     assert llm2.memory_manager.num_free_pages == \
         llm2.memory_manager.allocator.num_total
+
+
+def run_multi(model_dir, multi, prompts, sp, depth=2):
+    cfg = EngineConfig(
+        model=model_dir, dtype="float32", max_model_len=128,
+        overlap_scheduling=True, overlap_depth=depth,
+        multi_step_decode=multi,
+        scheduler=SchedulerConfig(max_prefill_tokens=64, max_decode_seqs=8),
+        cache=CacheConfig(page_size=4, num_pages=128))
+    llm = LLM(config=cfg)
+    outs = llm.generate(prompt_token_ids=prompts, sampling_params=sp)
+    assert llm.memory_manager.num_free_pages == \
+        llm.memory_manager.allocator.num_total
+    return [(o.output_token_ids, o.finish_reason) for o in outs]
+
+
+def test_multi_step_matches_sync_greedy(ckpt):
+    """K fused decode steps per dispatch == plain sync, byte for byte
+    (incl. page-boundary crossings inside the fused block)."""
+    sp = SamplingParams(temperature=0.0, max_tokens=23, ignore_eos=True)
+    prompts = [[3, 14, 15], [9, 2, 6, 5, 3], [58, 9]]
+    want = run(ckpt, False, prompts, sp)
+    assert run_multi(ckpt, 4, prompts, sp) == want
+    assert run_multi(ckpt, 8, prompts, sp, depth=3) == want
+
+
+def test_multi_step_matches_sync_with_eos(ckpt):
+    """EOS lands mid-block → the rest of the fused block's tokens for that
+    seq are discarded; frees happen exactly once."""
+    sp = SamplingParams(temperature=0.0, max_tokens=30)
+    prompts = [[i, i + 1, i + 2] for i in range(1, 12, 2)]
+    assert run_multi(ckpt, 6, prompts, sp) == run(ckpt, False, prompts, sp)
+
+
+def test_multi_step_sampling_key_schedule_identical(ckpt):
+    """Unseeded temp>0 sampling: the fused block folds the SAME per-step
+    keys as single-step chaining, so outputs stay byte-identical."""
+    sp = SamplingParams(temperature=0.8, top_p=0.9, max_tokens=12,
+                        ignore_eos=True)
+    prompts = [[3, 14, 15], [9, 2, 6]]
+    assert run_multi(ckpt, 4, prompts, sp) == run(ckpt, True, prompts, sp)
