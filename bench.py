@@ -127,6 +127,11 @@ def main():
         argv = [a for a in sys.argv[1:] if a != "--inner"]
         sys.exit(supervise(args, argv))
 
+    # Stall forensics: dump all thread stacks to stderr every 5 minutes so
+    # a wedged run (tunnel stall, compile hang, deadlock) leaves evidence.
+    import faulthandler
+    faulthandler.dump_traceback_later(300, repeat=True, file=sys.stderr)
+
     if args.tiny:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
 

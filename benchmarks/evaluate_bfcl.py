@@ -1,0 +1,191 @@
+"""BFCL-style function-calling accuracy against an OpenAI-compatible server
+(reference benchmarks/evaluate_bfcl.py — prompt-mode ``[func(arg=val)]``
+text plus native-mode ``tools``/``tool_calls``, scored by AST comparison).
+
+Zero-egress / dependency-free: the dataset is a LOCAL jsonl; the scorer is
+a self-contained AST checker (the reference borrows bfcl_eval's — not in
+this image) implementing the same contract: every expected function must be
+called with every required argument matching one of its accepted values;
+optional arguments, when present, must also match.
+
+Each line:
+  {"question": str,
+   "tools": [openai tool dicts],
+   "expect": [{"name": "f", "args": {"a": [accepted, values],
+                                      "b": ["opt1"]},
+               "required": ["a"]}],
+   "irrelevant": false}
+``irrelevant: true`` samples score correct when the model makes NO call.
+"""
+
+import argparse
+import ast
+import http.client
+import json
+import sys
+
+
+def _bracket_spans(text):
+    """Top-level balanced [...] spans, quote-aware."""
+    spans, stack = [], []
+    in_str, prev = None, ""
+    for i, ch in enumerate(text):
+        if in_str:
+            if ch == in_str and prev != "\\":
+                in_str = None
+        elif ch in "'\"" and stack:
+            # quotes only matter inside brackets — prose apostrophes
+            # ("I'll") must not swallow the rest of the reply
+            in_str = ch
+        elif ch == "[":
+            stack.append(i)
+        elif ch == "]" and stack:
+            start = stack.pop()
+            if not stack:
+                spans.append((start, i + 1))
+        prev = ch
+    return spans
+
+
+def parse_prompt_calls(text):
+    """``[f(a=1, b='x'), g()]`` → [(name, {args})]; [] when unparseable.
+    Scans balanced bracket spans from the END so prose like "[Note] ...
+    [get_weather(...)]" still parses the trailing call list."""
+    for start, end in reversed(_bracket_spans(text or "")):
+        try:
+            tree = ast.parse(text[start:end].strip(), mode="eval")
+        except SyntaxError:
+            continue
+        if not isinstance(tree.body, (ast.List, ast.Tuple)):
+            continue
+        calls = []
+        for node in tree.body.elts:
+            if not isinstance(node, ast.Call):
+                continue
+            name = ast.unparse(node.func)
+            args = {}
+            for kw in node.keywords:
+                if kw.arg is None:
+                    continue
+                try:
+                    args[kw.arg] = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    args[kw.arg] = ast.unparse(kw.value)
+            calls.append((name, args))
+        if calls:
+            return calls
+    return []
+
+
+def parse_native_calls(message):
+    calls = []
+    for tc in message.get("tool_calls") or []:
+        fn = tc.get("function", {})
+        try:
+            args = json.loads(fn.get("arguments") or "{}")
+        except json.JSONDecodeError:
+            args = {}
+        calls.append((fn.get("name", ""), args))
+    return calls
+
+
+def _matches(value, accepted):
+    """BFCL semantics: the emitted value must equal one accepted value
+    (with permissive numeric/string coercion; "" in accepted ⇒ the
+    argument may be omitted)."""
+    for acc in accepted:
+        if value == acc:
+            return True
+        try:
+            if isinstance(acc, (int, float)) and not isinstance(value, bool) \
+                    and float(value) == float(acc):
+                return True
+        except (TypeError, ValueError):
+            pass
+        if isinstance(acc, str) and isinstance(value, str) \
+                and value.strip().lower() == acc.strip().lower():
+            return True
+    return False
+
+
+def score(calls, expect, irrelevant):
+    if irrelevant:
+        return not calls
+    if len(calls) != len(expect):
+        return False
+    remaining = list(expect)
+    for name, args in calls:
+        hit = None
+        for i, exp in enumerate(remaining):
+            if exp["name"] != name and not name.endswith("." + exp["name"]):
+                continue
+            spec = exp.get("args", {})
+            required = exp.get("required", list(spec))
+            if any(r not in args and "" not in spec.get(r, [])
+                   for r in required):
+                continue
+            if any(k in spec and not _matches(v, spec[k])
+                   for k, v in args.items()):
+                continue
+            if any(k not in spec for k in args):
+                continue
+            hit = i
+            break
+        if hit is None:
+            return False
+        remaining.pop(hit)
+    return True
+
+
+def ask(host, port, q, native):
+    body = {"max_tokens": 512, "temperature": 0.0}
+    if native:
+        body["messages"] = [{"role": "user", "content": q["question"]}]
+        body["tools"] = q["tools"]
+    else:
+        # official BFCL prompting shape: tools embedded in a system prompt,
+        # answer as a python-call list
+        tool_text = json.dumps([t["function"] for t in q["tools"]],
+                               indent=1)
+        body["messages"] = [
+            {"role": "system", "content":
+             "You can invoke the following functions. Respond ONLY with "
+             "a list of calls in the format [func1(a=1), func2(b='x')] "
+             "or [] if none apply.\n" + tool_text},
+            {"role": "user", "content": q["question"]},
+        ]
+    conn = http.client.HTTPConnection(host, port, timeout=600)
+    conn.request("POST", "/v1/chat/completions", body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    d = json.loads(conn.getresponse().read())
+    conn.close()
+    msg = d["choices"][0]["message"]
+    return (parse_native_calls(msg) if native
+            else parse_prompt_calls(msg.get("content")))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-path", required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--mode", choices=("prompt", "native"),
+                    default="prompt")
+    ap.add_argument("--limit", type=int, default=None)
+    args = ap.parse_args()
+
+    with open(args.data_path) as f:
+        samples = [json.loads(line) for line in f if line.strip()]
+    if args.limit:
+        samples = samples[:args.limit]
+
+    ok = 0
+    for q in samples:
+        calls = ask(args.host, args.port, q, args.mode == "native")
+        ok += score(calls, q.get("expect", []), q.get("irrelevant", False))
+    print(f"accuracy: {ok}/{len(samples)} = {ok / max(len(samples), 1):.3f}")
+    return 0 if samples else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,7 +25,23 @@ def format_prompt(q):
 
 
 def extract_choice(text):
-    m = re.search(r"\b([A-J])\b", text.strip().upper())
+    """Same priority ladder as evaluate_mmmu.py: explicit "answer is X",
+    reply leading with the letter, then standalone capitals excluding the
+    English words "I"/"A"."""
+    t = (text or "").strip()
+    m = re.search(r"answer\s*(?:is|:)?\s*\*{0,2}\(?([A-Ja-j])\b", t,
+                  re.IGNORECASE)
+    if m:
+        return m.group(1).upper()
+    m = re.match(r"\(?([A-Ja-j])\)?(?:[.,:)]|$)", t)
+    if m:
+        return m.group(1).upper()
+    # leading letter + space: plausible for "B because ..." but not for
+    # the English words "I ..." / "A ..."
+    m = re.match(r"([B-HJb-hj])\s", t)
+    if m:
+        return m.group(1).upper()
+    m = re.search(r"\b([B-HJ])\b", t)
     return m.group(1) if m else None
 
 

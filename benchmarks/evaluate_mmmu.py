@@ -1,0 +1,129 @@
+"""MMMU-style multimodal multiple-choice eval against a running server
+(reference benchmarks/evaluate_mmmu.py — HF-dataset driver with inline
+base64 data URLs; per-subject + overall accuracy).
+
+Zero-egress environment: the dataset must be LOCAL — a jsonl where each
+line carries:
+  {"question": str, "options": [str, ...], "answer": "A" | 0,
+   "images": ["relative/or/abs.png", ...], "subject": "Art"}
+Image paths resolve relative to the jsonl's directory and are inlined as
+``data:`` URLs, exercising the server's full multimodal intake path
+(api_server _normalize_mm_messages → processor → ViT).
+"""
+
+import argparse
+import base64
+import concurrent.futures as cf
+import http.client
+import json
+import mimetypes
+import os
+import re
+import sys
+from collections import defaultdict
+
+LETTERS = "ABCDEFGHIJ"
+
+
+def data_url(path: str) -> str:
+    mime = mimetypes.guess_type(path)[0] or "image/png"
+    with open(path, "rb") as f:
+        return f"data:{mime};base64," + base64.b64encode(f.read()).decode()
+
+
+def format_content(q, base_dir):
+    opts = "\n".join(f"{LETTERS[i]}. {o}"
+                     for i, o in enumerate(q["options"]))
+    content = [{"type": "image_url", "image_url": {"url": data_url(
+        p if os.path.isabs(p) else os.path.join(base_dir, p))}}
+        for p in q.get("images", [])]
+    content.append({"type": "text", "text":
+                    f"Question: {q['question']}\nOptions:\n{opts}\n"
+                    "Answer with the option letter only.\nAnswer:"})
+    return content
+
+
+def extract_choice(text):
+    """First in priority order: an explicit "answer is X", a reply that
+    LEADS with the letter, then any standalone capital letter that isn't
+    the English word "I"/"A" (which the naive \\b[A-J]\\b match scores)."""
+    t = (text or "").strip()
+    m = re.search(r"answer\s*(?:is|:)?\s*\*{0,2}\(?([A-Ja-j])\b", t,
+                  re.IGNORECASE)
+    if m:
+        return m.group(1).upper()
+    m = re.match(r"\(?([A-Ja-j])\)?(?:[.,:)]|$)", t)
+    if m:
+        return m.group(1).upper()
+    # leading letter + space: plausible for "B because ..." but not for
+    # the English words "I ..." / "A ..."
+    m = re.match(r"([B-HJb-hj])\s", t)
+    if m:
+        return m.group(1).upper()
+    m = re.search(r"\b([B-HJ])\b", t)
+    return m.group(1) if m else None
+
+
+def ask(host, port, content, max_tokens=8):
+    body = {"messages": [{"role": "user", "content": content}],
+            "max_tokens": max_tokens, "temperature": 0.0}
+    conn = http.client.HTTPConnection(host, port, timeout=600)
+    conn.request("POST", "/v1/chat/completions", body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    d = json.loads(conn.getresponse().read())
+    conn.close()
+    return d["choices"][0]["message"]["content"]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data-path", required=True,
+                    help="local jsonl (question/options/answer/images"
+                         "/subject per line)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--limit", type=int, default=None)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--out", default=None, help="per-sample results jsonl")
+    args = ap.parse_args()
+
+    base_dir = os.path.dirname(os.path.abspath(args.data_path))
+    with open(args.data_path) as f:
+        questions = [json.loads(line) for line in f if line.strip()]
+    if args.limit:
+        questions = questions[:args.limit]
+
+    def run_one(q):
+        got = extract_choice(ask(args.host, args.port,
+                                 format_content(q, base_dir)))
+        want = q["answer"]
+        if isinstance(want, int):
+            want = LETTERS[want]
+        return q, got, got == want
+
+    per_subject = defaultdict(lambda: [0, 0])
+    results = []
+    with cf.ThreadPoolExecutor(args.concurrency) as ex:
+        for q, got, ok in ex.map(run_one, questions):
+            subj = q.get("subject", "all")
+            per_subject[subj][0] += ok
+            per_subject[subj][1] += 1
+            results.append({"subject": subj, "got": got,
+                            "answer": q["answer"], "correct": ok})
+
+    if args.out:
+        with open(args.out, "w") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+    total_ok = sum(v[0] for v in per_subject.values())
+    total = sum(v[1] for v in per_subject.values())
+    for subj in sorted(per_subject):
+        ok, n = per_subject[subj]
+        print(f"{subj:30s} {ok}/{n} = {ok / max(n, 1):.3f}")
+    print(f"{'OVERALL':30s} {total_ok}/{total} = "
+          f"{total_ok / max(total, 1):.3f}")
+    return 0 if total else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,7 +119,8 @@ class EngineConfig:
         ):
             raise ValueError(
                 f"unknown schedule_method {self.scheduler.schedule_method!r}")
-        if self.quantization not in (None, "int8", "fp8", "int4", "w8a8"):
+        if self.quantization not in (None, "int8", "fp8", "int4",
+                                     "w8a8", "fp8_block"):
             raise ValueError(
                 f"unknown quantization {self.quantization!r} "
-                "(choices: int8, fp8, int4, w8a8)")
+                "(choices: int8, fp8, int4, w8a8, fp8_block)")

@@ -296,29 +296,42 @@ class Handler(BaseHTTPRequestHandler):
                                   disagg_items=disagg_items)
         parse_tools = bool(req.tools) and req.tool_choice != "none"
         if req.stream and parse_tools:
-            # Tool markup can't be parsed incrementally with certainty —
-            # buffer, parse, then emit one delta carrying content and/or
-            # tool_calls (reference streams tool deltas; buffered round 1).
+            # Incremental tool streaming (reference streams tool deltas):
+            # text deltas flow through live; only potential-markup suffixes
+            # are held back; completed calls emit OpenAI tool_call deltas.
+            from gllm_tpu.entrypoints.tool_parsers import (
+                StreamingToolCalls, schemas_from_tools)
+            stream = StreamingToolCalls(st.tool_parser,
+                                        schemas_from_tools(req.tools))
             rid = proto.new_request_id(chat=True)
             self._sse_start()
             self._sse(proto.chat_completion_chunk(rid, req.model, None, None,
                                                   role=True))
-            r = self._collect(handle)
-            from gllm_tpu.entrypoints.tool_parsers import schemas_from_tools
-            text, calls = st.tool_parser.parse(
-                r["text"], schemas_from_tools(req.tools))
-            fin = r["finish"]
-            chunk = proto.chat_completion_chunk(rid, req.model, text or None,
-                                                None)
-            if calls:
-                chunk["choices"][0]["delta"]["tool_calls"] = [
-                    dict(c.to_openai(), index=i)
-                    for i, c in enumerate(calls)]
-                fin = "tool_calls"
-            self._sse(chunk)
-            self._sse(proto.chat_completion_chunk(rid, req.model, None, fin))
-            self.wfile.write(b"data: [DONE]\n\n")
-            self.wfile.flush()
+
+            def emit(text, deltas):
+                if text:
+                    self._sse(proto.chat_completion_chunk(rid, req.model,
+                                                          text, None))
+                for d in deltas:
+                    chunk = proto.chat_completion_chunk(rid, req.model,
+                                                        None, None)
+                    chunk["choices"][0]["delta"]["tool_calls"] = [d]
+                    self._sse(chunk)
+
+            fin = None
+            try:
+                for chunk_out in handle:
+                    emit(*stream.feed(chunk_out.text or ""))
+                    fin = chunk_out.finish_reason or fin
+                emit(*stream.finish())
+                if stream.saw_tool_calls:
+                    fin = "tool_calls"
+                self._sse(proto.chat_completion_chunk(rid, req.model, None,
+                                                      fin))
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                st.engine.abort(handle.seq_id)
         elif req.stream:
             rid = proto.new_request_id(chat=True)
             self._sse_start()
@@ -483,7 +496,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-pages", type=int, default=None)
     p.add_argument("--kv-cache-dtype", default="auto")
     p.add_argument("--quantization", default=None,
-                   choices=["int8", "fp8", "int4", "w8a8"],
+                   choices=["int8", "fp8", "int4", "w8a8", "fp8_block"],
                    help="weight-only quantization")
     p.add_argument("--enable-prefix-caching", action="store_true")
     p.add_argument("--overlap-scheduling", action="store_true",

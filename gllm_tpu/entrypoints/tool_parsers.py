@@ -66,14 +66,28 @@ def coerce_arguments(args: Dict[str, Any],
 class ToolParser:
     """Base: no tool support — everything is content."""
 
+    #: literal strings whose appearance means tool markup is starting;
+    #: the streaming adapter holds back only potential-marker suffixes.
+    STREAM_MARKERS: Tuple[str, ...] = ()
+
     def parse(self, text: str,
               schemas: Optional[Dict[str, dict]] = None
               ) -> Tuple[str, List[ToolCall]]:
         return text, []
 
+    def completed_calls(self, text: str,
+                        schemas: Optional[Dict[str, dict]] = None
+                        ) -> Tuple[List[ToolCall], int]:
+        """(calls, consumed) for the streaming adapter: calls whose markup
+        is COMPLETE in ``text`` (which may end mid-markup), plus the char
+        offset past the last complete unit so the caller never re-parses
+        emitted markup. Default: a full parse, nothing consumed."""
+        return self.parse(text, schemas)[1], 0
+
 
 class QwenToolParser(ToolParser):
     _RE = re.compile(r"<tool_call>\s*(\{.*?\})\s*</tool_call>", re.DOTALL)
+    STREAM_MARKERS = ("<tool_call>",)
 
     def parse(self, text, schemas=None):
         calls: List[ToolCall] = []
@@ -94,6 +108,22 @@ class QwenToolParser(ToolParser):
         content = self._RE.sub(repl, text).strip()
         return content, calls
 
+    def completed_calls(self, text, schemas=None):
+        calls, end = [], 0
+        for m in self._RE.finditer(text):
+            try:
+                obj = json.loads(m.group(1))
+            except json.JSONDecodeError:
+                continue                # malformed stays for finish()
+            args = obj.get("arguments", {})
+            name = obj.get("name", "")
+            if isinstance(args, dict) and schemas:
+                args = coerce_arguments(args, schemas.get(name))
+            calls.append(ToolCall(name=name, arguments=json.dumps(
+                args, ensure_ascii=False)))
+            end = m.end()
+        return calls, end
+
 
 class DeepSeekToolParser(ToolParser):
     _BLOCK = re.compile(
@@ -101,6 +131,7 @@ class DeepSeekToolParser(ToolParser):
     _CALL = re.compile(
         r"<｜tool▁call▁begin｜>(.*?)<｜tool▁sep｜>(.*?)<｜tool▁call▁end｜>",
         re.DOTALL)
+    STREAM_MARKERS = ("<｜tool▁calls▁begin｜>", "<｜tool▁call▁begin｜>")
 
     @staticmethod
     def _strip_fence(payload: str) -> str:
@@ -111,36 +142,47 @@ class DeepSeekToolParser(ToolParser):
             payload = payload[3:]
         return payload.strip().rstrip("`").strip()
 
+    def _parse_call(self, head: str, body: str, schemas) -> ToolCall:
+        head = head.strip()
+        body = body.strip()
+        # Two layouts in the wild:
+        #   stock V3/R1 template: head == "function",
+        #     body == "NAME\n```json\nARGS\n```"
+        #   simplified:           head == NAME, body == ARGS-json
+        if head == "function" or "```" in body:
+            name, _, fenced = body.partition("\n")
+            name = name.strip()
+            payload = self._strip_fence(fenced)
+        else:
+            name = head
+            payload = self._strip_fence(body)
+        try:
+            args = json.loads(payload) if payload else {}
+        except json.JSONDecodeError:
+            args = {}
+        if schemas:
+            args = coerce_arguments(args, schemas.get(name))
+        return ToolCall(name=name,
+                        arguments=json.dumps(args, ensure_ascii=False))
+
     def parse(self, text, schemas=None):
         calls: List[ToolCall] = []
 
         def repl(match):
             for head, body in self._CALL.findall(match.group(1)):
-                head = head.strip()
-                body = body.strip()
-                # Two layouts in the wild:
-                #   stock V3/R1 template: head == "function",
-                #     body == "NAME\n```json\nARGS\n```"
-                #   simplified:           head == NAME, body == ARGS-json
-                if head == "function" or "```" in body:
-                    name, _, fenced = body.partition("\n")
-                    name = name.strip()
-                    payload = self._strip_fence(fenced)
-                else:
-                    name = head
-                    payload = self._strip_fence(body)
-                try:
-                    args = json.loads(payload) if payload else {}
-                except json.JSONDecodeError:
-                    args = {}
-                if schemas:
-                    args = coerce_arguments(args, schemas.get(name))
-                calls.append(ToolCall(name=name, arguments=json.dumps(
-                    args, ensure_ascii=False)))
+                calls.append(self._parse_call(head, body, schemas))
             return ""
 
         content = self._BLOCK.sub(repl, text).strip()
         return content, calls
+
+    def completed_calls(self, text, schemas=None):
+        # Per-call units complete before the section end marker arrives.
+        calls, end = [], 0
+        for m in self._CALL.finditer(text):
+            calls.append(self._parse_call(m.group(1), m.group(2), schemas))
+            end = m.end()
+        return calls, end
 
 
 class KimiToolParser(ToolParser):
@@ -155,6 +197,7 @@ class KimiToolParser(ToolParser):
         r"<\|tool_call_begin\|>\s*([^\s<]+?)\s*"
         r"<\|tool_call_argument_begin\|>\s*(.*?)\s*<\|tool_call_end\|>",
         re.DOTALL)
+    STREAM_MARKERS = (_SECTION,)
 
     @staticmethod
     def _name_from_id(fid: str) -> str:
@@ -181,6 +224,115 @@ class KimiToolParser(ToolParser):
                 args, ensure_ascii=False)))
         content = text.split(self._SECTION, 1)[0].strip()
         return content, calls
+
+    def completed_calls(self, text, schemas=None):
+        calls, end = [], 0
+        for m in self._CALL.finditer(text):
+            name = self._name_from_id(m.group(1).strip())
+            if not name:
+                continue
+            payload = m.group(2)
+            try:
+                args = json.loads(payload) if payload.strip() else {}
+            except json.JSONDecodeError:
+                args = {}
+            if isinstance(args, dict) and schemas:
+                args = coerce_arguments(args, schemas.get(name))
+            calls.append(ToolCall(name=name, arguments=json.dumps(
+                args, ensure_ascii=False)))
+            end = m.end()
+        return calls, end
+
+
+class StreamingToolCalls:
+    """Incremental SSE adapter over a ToolParser (role of the reference's
+    streaming tool parsers, tool_parsers.py — ours completes per call-unit
+    rather than per argument token). Text deltas pass through immediately;
+    only a trailing fragment that could begin tool markup is held back.
+    Once markup starts, each completed call is emitted as the standard
+    OpenAI delta pair (id+name, then the full arguments string)."""
+
+    def __init__(self, parser: ToolParser,
+                 schemas: Optional[Dict[str, dict]] = None):
+        self.parser = parser
+        self.schemas = schemas or {}
+        self.buf = ""
+        self.in_tool = False
+        self.n_emitted = 0
+        self._done = 0    # buf offset past already-emitted call units
+
+    def _held_suffix_len(self) -> int:
+        """Longest buffer suffix that is a proper prefix of a marker."""
+        best = 0
+        for m in self.parser.STREAM_MARKERS:
+            for k in range(min(len(m) - 1, len(self.buf)), 0, -1):
+                if self.buf.endswith(m[:k]):
+                    best = max(best, k)
+                    break
+        return best
+
+    def _emit_new(self, calls: List[ToolCall]) -> List[dict]:
+        """OpenAI streamed tool_call delta pair per NEW call (indices
+        continue from what was already emitted)."""
+        deltas = []
+        for call in calls:
+            i, c = self.n_emitted, call.to_openai()
+            deltas.append({"index": i, "id": c["id"], "type": "function",
+                           "function": {"name": c["function"]["name"],
+                                        "arguments": ""}})
+            deltas.append({"index": i, "function": {
+                "arguments": c["function"]["arguments"]}})
+            self.n_emitted += 1
+        return deltas
+
+    def feed(self, delta: str) -> Tuple[str, List[dict]]:
+        """Returns (text_delta_to_emit, tool_call_deltas)."""
+        self.buf += delta
+        if not self.parser.STREAM_MARKERS:
+            out, self.buf = self.buf, ""
+            return out, []
+        text = ""
+        if not self.in_tool:
+            hits = [i for i in (self.buf.find(m)
+                                for m in self.parser.STREAM_MARKERS)
+                    if i >= 0]
+            if hits:
+                cut = min(hits)
+                text, self.buf = self.buf[:cut], self.buf[cut:]
+                self.in_tool = True
+            else:
+                keep = self._held_suffix_len()
+                cut = len(self.buf) - keep
+                text, self.buf = self.buf[:cut], self.buf[cut:]
+        deltas = []
+        if self.in_tool:
+            # incremental: only the unconsumed tail is re-parsed
+            calls, end = self.parser.completed_calls(self.buf[self._done:],
+                                                     self.schemas)
+            deltas = self._emit_new(calls)
+            self._done += end
+        return text, deltas
+
+    def finish(self) -> Tuple[str, List[dict]]:
+        """Flush: full parse of the held buffer. Content surviving the
+        parse (trailing / interleaved assistant text, malformed markup) is
+        returned as a final text delta; not-yet-emitted calls as deltas."""
+        content, calls = self.parser.parse(self.buf, self.schemas)
+        if self.in_tool:
+            # A stream can end mid-section (e.g. length-capped before the
+            # section-end marker): recover the complete per-unit calls and
+            # drop the raw markup remnant instead of leaking it as content.
+            unit_calls, _ = self.parser.completed_calls(self.buf,
+                                                        self.schemas)
+            if len(unit_calls) > len(calls):
+                calls = unit_calls
+                content = ""
+        self.buf = ""
+        return content, self._emit_new(calls[self.n_emitted:])
+
+    @property
+    def saw_tool_calls(self) -> bool:
+        return self.n_emitted > 0
 
 
 _PARSERS = {

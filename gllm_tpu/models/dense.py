@@ -45,9 +45,13 @@ class KVCache(NamedTuple):
 
 
 def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
-                  dtype=jnp.bfloat16) -> KVCache:
-    shape = (cfg.num_stage_layers, num_pages, page_size, cfg.num_kv_heads,
-             cfg.head_dim)
+                  dtype=jnp.bfloat16, kv_pack: int = 1) -> KVCache:
+    """kv_pack > 1 packs that many adjacent kv heads into the lane dim
+    ([.., Hkv/pack, D*pack]) so head_dim < 128 models meet Mosaic's
+    128-lane tiling on the Pallas path (ops/attention.py pack handling)."""
+    assert cfg.num_kv_heads % kv_pack == 0
+    shape = (cfg.num_stage_layers, num_pages, page_size,
+             cfg.num_kv_heads // kv_pack, cfg.head_dim * kv_pack)
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 

@@ -43,10 +43,98 @@ class AttentionMetadata(NamedTuple):
 
 NEG_INF = float("-inf")
 
+# TP shard context: (mesh, axis_name), set by the runner when the Pallas
+# path must run per-TP-shard under shard_map (q and KV are head-sharded, so
+# the kernels partition cleanly — each shard streams only its own heads'
+# pages). Read at trace time of the runner's step fn; one active
+# pallas+tp runner per process (every ModelRunner.__init__ resets it).
+_SHARD_CTX = None
+
+
+def set_shard_context(mesh, axis_name: str = "tp") -> None:
+    global _SHARD_CTX
+    _SHARD_CTX = None if mesh is None else (mesh, axis_name)
+
+
+def pallas_tp_compatible(num_q_heads: int, num_kv_heads: int,
+                         tp: int) -> bool:
+    """Can the Pallas kernels run per-TP-shard?
+
+    Heads-sharded case (Hkv % tp == 0): per-shard GQA group is unchanged.
+    KV-replicated case (small Hkv / MLA MQA — matches kv_cache_specs /
+    latent_kv_specs): tp % Hkv == 0 means each shard's contiguous q-head
+    slice belongs to exactly ONE kv head (heads are grouped kv-head-major),
+    which the shard slices out and runs in MQA mode."""
+    if num_q_heads % tp:
+        return False
+    return num_kv_heads % tp == 0 or tp % num_kv_heads == 0
+
+
+def paged_attention(q, k_cache, v_cache, metadata, *, scale, max_q_len,
+                    impl="xla", v_dim=None):
+    """Public entry: dispatch to the (jitted) single-shard implementation,
+    wrapping the Pallas path in shard_map when a TP shard context is set."""
+    if impl == "pallas" and _SHARD_CTX is not None:
+        mesh, axis = _SHARD_CTX
+        tp = mesh.shape[axis]
+        if tp > 1:
+            return _pallas_sharded(q, k_cache, v_cache, metadata,
+                                   scale=scale, max_q_len=max_q_len,
+                                   v_dim=v_dim, mesh=mesh, axis=axis)
+    return _paged_attention(q, k_cache, v_cache, metadata, scale=scale,
+                            max_q_len=max_q_len, impl=impl, v_dim=v_dim)
+
+
+def _pallas_sharded(q, k_cache, v_cache, metadata, *, scale, max_q_len,
+                    v_dim, mesh, axis):
+    """Run the Pallas kernels per TP shard: q sharded on its head axis, KV
+    sharded on the kv-head axis when divisible (else replicated — small-Hkv
+    and MLA-MQA caches are replicated by kv_cache_specs), metadata
+    replicated. The per-shard call sees plain smaller arrays, so the
+    kernels run untouched; GSPMD moves nothing (shardings already match
+    the layer's activation/cache placement)."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    tp = mesh.shape[axis]
+    num_q_heads = q.shape[1]
+    num_kv_heads = k_cache.shape[2]
+    if not pallas_tp_compatible(num_q_heads, num_kv_heads, tp):
+        raise ValueError(
+            f"pallas tp={tp} incompatible with Hq={num_q_heads} "
+            f"Hkv={num_kv_heads}")
+    kv_sharded = num_kv_heads % tp == 0
+    qs = P(None, axis, None)
+    ks = P(None, None, axis, None) if kv_sharded else P(None, None, None,
+                                                        None)
+    md_specs = AttentionMetadata(P(None), P(None), P(None, None), P())
+
+    def inner(q, k, v, md):
+        if not kv_sharded and num_kv_heads > 1:
+            # KV replicated with tp % Hkv == 0: this shard's contiguous
+            # q-head slice belongs to exactly one kv head (kv-head-major
+            # grouping) — slice it out and run the kernels in MQA mode.
+            head = jax.lax.axis_index(axis) // (tp // num_kv_heads)
+            k = jax.lax.dynamic_slice_in_dim(k, head, 1, axis=2)
+            if v is not None:
+                v = jax.lax.dynamic_slice_in_dim(v, head, 1, axis=2)
+        return _paged_attention(q, k, v, md, scale=scale,
+                                max_q_len=max_q_len, impl="pallas",
+                                v_dim=v_dim)
+
+    if v_cache is None:
+        fn = shard_map(lambda q, k, md: inner(q, k, None, md), mesh=mesh,
+                       in_specs=(qs, ks, md_specs), out_specs=qs,
+                       check_vma=False)
+        return fn(q, k_cache, metadata)
+    fn = shard_map(inner, mesh=mesh, in_specs=(qs, ks, ks, md_specs),
+                   out_specs=qs, check_vma=False)
+    return fn(q, k_cache, v_cache, metadata)
+
 
 @functools.partial(jax.jit, static_argnames=("max_q_len", "scale", "impl",
                                              "v_dim"))
-def paged_attention(
+def _paged_attention(
     q: jnp.ndarray,            # [T, Hq, D]
     k_cache: jnp.ndarray,      # [num_pages, page_size, Hkv, D]
     v_cache,                   # [P, page, Hkv, Dv] or None → v = k[:, :Dv]
@@ -62,9 +150,21 @@ def paged_attention(
 ) -> jnp.ndarray:
     if v_cache is None and v_dim is None:
         raise ValueError("v_dim required when v_cache is None")
+    # Packed lane layout (kv_pack > 1): the cache stores ``pack`` adjacent
+    # kv heads per row — [P, ps, Hkv/pack, D*pack] — so head_dim < 128
+    # models still meet Mosaic's 128-lane tiling. Detected structurally:
+    # non-MLA caches otherwise always have last dim == head_dim.
+    pack = (k_cache.shape[-1] // q.shape[-1]
+            if v_cache is not None and k_cache.shape[-1] != q.shape[-1]
+            else 1)
     if impl == "xla":
         if v_cache is None:
             v_cache = k_cache[..., :v_dim]
+        elif pack > 1:
+            P_, ps = k_cache.shape[:2]
+            hkv = k_cache.shape[2] * pack
+            k_cache = k_cache.reshape(P_, ps, hkv, q.shape[-1])
+            v_cache = v_cache.reshape(P_, ps, hkv, q.shape[-1])
         return _xla_paged_attention(q, k_cache, v_cache, metadata,
                                     scale=scale, max_q_len=max_q_len)
     if impl == "pallas":
@@ -77,6 +177,21 @@ def paged_attention(
             raise NotImplementedError(
                 f"pallas attention unsupported on backend {backend!r}; "
                 "use impl='xla'")
+        slot = None
+        if pack > 1:
+            # Expand q into block-diagonal 128-lane rows: head h's values
+            # occupy the lane block its kv head holds inside the packed
+            # row; the other pack-1 blocks are zero, so the kernel's
+            # q·k_packed dot contracts to exactly the head's own scores
+            # (2× MAC waste — irrelevant in the bandwidth-bound regime).
+            T, num_q_heads, D = q.shape
+            group = num_q_heads // (k_cache.shape[2] * pack)
+            slot = (jnp.arange(num_q_heads, dtype=jnp.int32)
+                    // group) % pack
+            onehot = jax.nn.one_hot(slot, pack, dtype=q.dtype)
+            q = (q[:, :, None, :] * onehot[None, :, :, None]
+                 ).reshape(T, num_q_heads, pack * D)
+
         if max_q_len == 1:
             # Pure-decode batch: T == S, one query row per sequence (the
             # layout prepare.py emits for max_q_len == 1). The per-seq
@@ -88,15 +203,25 @@ def paged_attention(
                     f"S={metadata.kv_lens.shape[0]}")
             from gllm_tpu.ops.pallas.decode_attention import (
                 paged_decode_attention)
-            return paged_decode_attention(
+            out = paged_decode_attention(
                 q, k_cache, v_cache, metadata.kv_lens, metadata.page_table,
                 scale=scale, interpret=interpret, v_dim=v_dim)
-        from gllm_tpu.ops.pallas.ragged_attention import (
-            ragged_paged_attention)
-        return ragged_paged_attention(
-            q, k_cache, v_cache, metadata.cu_q_lens, metadata.kv_lens,
-            metadata.page_table, scale=scale, interpret=interpret,
-            v_dim=v_dim)
+        else:
+            from gllm_tpu.ops.pallas.ragged_attention import (
+                ragged_paged_attention)
+            out = ragged_paged_attention(
+                q, k_cache, v_cache, metadata.cu_q_lens, metadata.kv_lens,
+                metadata.page_table, scale=scale, interpret=interpret,
+                v_dim=v_dim)
+        if pack > 1:
+            # The packed p·v_packed dot produced every lane block; keep
+            # each head's own block (the rest mixed other heads' values).
+            T, num_q_heads = out.shape[:2]
+            D = out.shape[-1] // pack
+            out = out.reshape(T, num_q_heads, pack, D)
+            out = jnp.take_along_axis(
+                out, slot[None, :, None, None], axis=2)[:, :, 0]
+        return out
     raise ValueError(f"unknown attention impl {impl!r}")
 
 

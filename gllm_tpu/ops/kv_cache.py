@@ -26,8 +26,15 @@ def write_kv(k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     slot_mapping:    [T] int32 flat slots (padding → dummy-page slots)
     """
     num_pages, page_size, hkv, d = k_cache.shape
+    # Packed lane layout (runner kv_pack>1: cache is [P, ps, Hkv/pack,
+    # D*pack] so Mosaic's 128-lane tiling holds for head_dim<128): the new
+    # rows fold into the cache's trailing shape — row-major contiguity
+    # makes the reshape exact.
+    T = k.shape[0]
     flat_k = k_cache.reshape(num_pages * page_size, hkv, d)
     flat_v = v_cache.reshape(num_pages * page_size, hkv, d)
-    flat_k = flat_k.at[slot_mapping].set(k.astype(flat_k.dtype))
-    flat_v = flat_v.at[slot_mapping].set(v.astype(flat_v.dtype))
+    flat_k = flat_k.at[slot_mapping].set(
+        k.reshape(T, hkv, d).astype(flat_k.dtype))
+    flat_v = flat_v.at[slot_mapping].set(
+        v.reshape(T, hkv, d).astype(flat_v.dtype))
     return (flat_k.reshape(k_cache.shape), flat_v.reshape(v_cache.shape))

@@ -46,6 +46,17 @@ class QuantizedW8A8(NamedTuple):
     scale: jnp.ndarray    # [..., 1, out] f32
 
 
+BLOCK = 128   # block-scale tile edge (reference fp8.py weight_block_size)
+
+
+class QuantizedBlock(NamedTuple):
+    """Block-wise fp8: one f32 scale per 128×128 weight tile (the
+    reference's W8A8 block-fp8 checkpoint layout, fp8.py:370-453 — DeepSeek
+    V3-class fp8 checkpoints ship exactly these scales)."""
+    q: jnp.ndarray        # [..., in, out] float8
+    scale: jnp.ndarray    # [..., ceil(in/128), ceil(out/128)] f32
+
+
 def quantize_weight(w: jnp.ndarray, dtype=jnp.int8) -> Quantized:
     """Quantize a [..., in, out] matmul weight per output channel."""
     wf = w.astype(jnp.float32)
@@ -78,6 +89,25 @@ def quantize_weight_int4(w: jnp.ndarray) -> Quantized4:
     return Quantized4(packed, scale)
 
 
+def quantize_weight_block(w: jnp.ndarray,
+                          dtype=jnp.float8_e4m3fn) -> QuantizedBlock:
+    """Quantize a [..., in, out] weight with per-128×128-tile scales.
+    Ragged tails pad with zeros for the absmax; the stored payload keeps
+    the original shape."""
+    wf = w.astype(jnp.float32)
+    *lead, K, N = wf.shape
+    kb, nb = -(-K // BLOCK), -(-N // BLOCK)
+    wp = jnp.pad(wf, [(0, 0)] * len(lead)
+                 + [(0, kb * BLOCK - K), (0, nb * BLOCK - N)])
+    tiles = wp.reshape(*lead, kb, BLOCK, nb, BLOCK)
+    absmax = jnp.max(jnp.abs(tiles), axis=(-3, -1))          # [..., kb, nb]
+    fmax = float(jnp.finfo(dtype).max)
+    scale = jnp.maximum(absmax / fmax, 1e-9)
+    q = (tiles / scale[..., :, None, :, None]).reshape(
+        *lead, kb * BLOCK, nb * BLOCK)[..., :K, :N].astype(dtype)
+    return QuantizedBlock(q, scale)
+
+
 def _unpack_int4(q: jnp.ndarray) -> jnp.ndarray:
     """[..., in/2, out] packed → [..., in, out] int8 in [-8, 7]."""
     lo = (q << 4).astype(jnp.int8) >> 4          # sign-extend low nibble
@@ -92,6 +122,11 @@ def deq(w, dtype=jnp.bfloat16) -> jnp.ndarray:
     if isinstance(w, Quantized4):
         return (_unpack_int4(w.q).astype(dtype)
                 * w.scale.astype(dtype))
+    if isinstance(w, QuantizedBlock):
+        K, N = w.q.shape[-2:]
+        s = jnp.repeat(jnp.repeat(w.scale, BLOCK, axis=-2), BLOCK,
+                       axis=-1)[..., :K, :N]
+        return w.q.astype(dtype) * s.astype(dtype)
     if isinstance(w, (Quantized, QuantizedW8A8)):
         return w.q.astype(dtype) * w.scale.astype(dtype)
     return w
@@ -111,7 +146,7 @@ def qmm(x: jnp.ndarray, w) -> jnp.ndarray:
             preferred_element_type=jnp.int32).astype(jnp.float32)
         return (acc * x_scale * w.scale.astype(jnp.float32)
                 ).astype(x.dtype)
-    if isinstance(w, (Quantized, Quantized4)):
+    if isinstance(w, (Quantized, Quantized4, QuantizedBlock)):
         return x @ deq(w, x.dtype)
     return x @ w
 
@@ -140,6 +175,8 @@ def quantize_params(params: dict, dtype=jnp.int8, mode: str = None) -> dict:
     def make(v):
         if mode == "int4":
             return quantize_weight_int4(v)
+        if mode == "fp8_block":
+            return quantize_weight_block(v)
         if mode == "w8a8":
             qz = quantize_weight(v, jnp.int8)
             return QuantizedW8A8(qz.q, qz.scale)

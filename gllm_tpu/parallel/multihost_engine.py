@@ -223,8 +223,9 @@ class MultihostServingEngine:
                                         daemon=True, name="gllm-mh-engine")
         self._thread.start()
 
-    def submit(self, token_ids, sampling_params, mm_input=None):
-        if mm_input:
+    def submit(self, token_ids, sampling_params, mm_input=None,
+               disagg_items=None):
+        if mm_input or disagg_items:
             raise NotImplementedError(
                 "multimodal requests over multi-host are not wired up yet")
         sampling_params.validate()

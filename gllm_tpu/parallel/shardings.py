@@ -120,14 +120,20 @@ def shard_params(params, specs, mesh: Optional[Mesh]):
     axis on size-1 dims (a sharded singleton is impossible)."""
     if mesh is None:
         return params
-    from gllm_tpu.ops.quant import Quantized, Quantized4, QuantizedW8A8
-    qtypes = (Quantized, Quantized4, QuantizedW8A8)
+    from gllm_tpu.ops.quant import (Quantized, Quantized4, QuantizedBlock,
+                                    QuantizedW8A8)
+    qtypes = (Quantized, Quantized4, QuantizedW8A8, QuantizedBlock)
 
     def place(x, s):
         if isinstance(x, qtypes):
             dims = list(s) + [None] * (x.q.ndim - len(s))
-            scale_spec = P(*[None if x.scale.shape[i] == 1 else dims[i]
-                             for i in range(x.scale.ndim)])
+            if isinstance(x, QuantizedBlock):
+                # tiny per-tile scale grids replicate (a 128-tile grid
+                # rarely divides over tp; deq broadcasts them fine)
+                scale_spec = P(*[None] * x.scale.ndim)
+            else:
+                scale_spec = P(*[None if x.scale.shape[i] == 1 else dims[i]
+                                 for i in range(x.scale.ndim)])
             return type(x)(
                 jax.device_put(x.q, NamedSharding(mesh, s)),
                 jax.device_put(x.scale, NamedSharding(mesh, scale_spec)))

@@ -39,8 +39,9 @@ import numpy as np
 from gllm_tpu.config import EngineConfig
 from gllm_tpu.models import ModelConfig, get_model_def
 from gllm_tpu.ops.sampling import sample
-from gllm_tpu.runner.runner import ModelRunner, _DTYPES
-from gllm_tpu.utils import cdiv
+from gllm_tpu.runner.runner import (ModelRunner, _DTYPES,
+                                    pick_kv_pack)
+from gllm_tpu.utils import cdiv, tpu_compiler_options
 
 logger = logging.getLogger(__name__)
 
@@ -106,13 +107,25 @@ class PPModelRunner(ModelRunner):
         if len(devices) < pp * tp:
             raise ValueError(f"pp={pp} tp={tp} needs {pp * tp} devices, "
                              f"have {len(devices)}")
+        # PP builds per-stage meshes, which don't fit the single TP shard
+        # context — clear any stale one a prior runner left behind.
+        from gllm_tpu.ops.attention import set_shard_context
+        set_shard_context(None)
         impl = config.attention_impl
+        pack = pick_kv_pack(model_cfg, tp_sharded=tp > 1)
         if impl == "auto":
-            impl = ("pallas" if tp == 1
+            impl = ("pallas" if tp == 1 and pack
                     and jax.default_backend() in ("tpu", "axon") else "xla")
-        elif impl == "pallas" and tp > 1:
-            raise NotImplementedError(
-                "attention_impl='pallas' with tp>1 is not wired up yet")
+        elif impl == "pallas":
+            if tp > 1:
+                raise NotImplementedError(
+                    "attention_impl='pallas' with pp×tp is not wired up "
+                    "yet; use attention_impl='xla'")
+            if not pack:
+                raise NotImplementedError(
+                    "attention_impl='pallas' needs a 128-lane-aligned KV "
+                    "layout (head_dim ×pack % 128 == 0)")
+        self.kv_pack = pack if impl == "pallas" else 1
         self.attn_impl = impl
         from gllm_tpu.runner.prepare import BatchBuilder
         self.builder = BatchBuilder(config, config.cache.page_size,
@@ -165,7 +178,8 @@ class PPModelRunner(ModelRunner):
             skv = self.model_def.init_kv_cache(
                 scfg, self.num_pages, config.cache.page_size,
                 self.dtype if config.cache.kv_cache_dtype == "auto"
-                else _DTYPES[config.cache.kv_cache_dtype])
+                else _DTYPES[config.cache.kv_cache_dtype],
+                **({"kv_pack": self.kv_pack} if self.kv_pack > 1 else {}))
             if smesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
                 from gllm_tpu.parallel.shardings import shard_params
@@ -223,6 +237,7 @@ class PPModelRunner(ModelRunner):
         attn_impl = self.attn_impl
 
         @functools.partial(jax.jit, static_argnames=("max_q_len",),
+                           compiler_options=tpu_compiler_options(),
                            donate_argnums=(1,))
         def stage(params, kv, batch, cos_sin, hidden, residual,
                   token_counts, *, max_q_len: int):

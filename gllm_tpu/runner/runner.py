@@ -32,7 +32,8 @@ from gllm_tpu.models import ModelConfig, get_model_def
 from gllm_tpu.ops.sampling import sample
 from gllm_tpu.runner.prepare import BatchBuilder
 from gllm_tpu.scheduler import ScheduledBatch
-from gllm_tpu.utils import bucket_size, cdiv, next_pow2
+from gllm_tpu.utils import (bucket_size, cdiv, next_pow2,
+                            tpu_compiler_options)
 
 logger = logging.getLogger(__name__)
 
@@ -65,6 +66,28 @@ def _ssm_apply(conv, rec, snap_src, snap_dst, zero_slots, rest_src,
     return conv, rec
 
 
+def pick_kv_pack(cfg: ModelConfig, tp_sharded: bool) -> int:
+    """Mosaic lane-packing policy, shared by ModelRunner and PPModelRunner.
+
+    Returns 0 when the Pallas kernels cannot compile for this model
+    (caller falls back to XLA or raises), 1 when no packing is needed, or
+    the pack factor (2/4 adjacent kv heads per 128-lane cache row) for
+    head_dim < 128 models. Packing is a single-replica layout: tp/dp
+    shard the unpacked specs, so sharded meshes need native alignment."""
+    if cfg.use_mla:
+        # latent cache is tile-padded by construction; the in-kernel value
+        # slice k[..., :lora] still needs lane alignment (512 for DeepSeek)
+        return 1 if cfg.kv_lora_rank % 128 == 0 else 0
+    if cfg.head_dim % 128 == 0:
+        return 1
+    if tp_sharded or cfg.use_hybrid:
+        return 0
+    for p in (2, 4):
+        if cfg.head_dim * p % 128 == 0 and cfg.num_kv_heads % p == 0:
+            return p
+    return 0
+
+
 class ModelRunner:
     def __init__(self, config: EngineConfig, model_cfg: ModelConfig,
                  params=None, mesh=None):
@@ -76,7 +99,16 @@ class ModelRunner:
         self.mesh = mesh
         self.dtype = _DTYPES[config.dtype]
         self.model_def = get_model_def(model_cfg)
+        self.kv_pack = 1   # may be raised by _pick_attn_impl (lane packing)
         self.attn_impl = self._pick_attn_impl()
+        # (Re)set the module-level TP shard context the attention dispatch
+        # reads at trace time — cleared when this runner doesn't need it so
+        # a later runner in the same process never sees a stale mesh.
+        from gllm_tpu.ops.attention import set_shard_context
+        from gllm_tpu.parallel.mesh import AXIS_TP
+        set_shard_context(
+            self.mesh if (self.attn_impl == "pallas" and mesh is not None
+                          and config.parallel.tp > 1) else None, AXIS_TP)
         self.builder = BatchBuilder(config, config.cache.page_size,
                                     vocab_size=model_cfg.vocab_size,
                                     hidden_size=model_cfg.hidden_size,
@@ -161,9 +193,10 @@ class ModelRunner:
                 num_slots=(1 + self.ssm_working_slots
                            + self.ssm_snapshot_slots))
         else:
+            kw = {"kv_pack": self.kv_pack} if self.kv_pack > 1 else {}
             self.kv = self.model_def.init_kv_cache(
                 model_cfg, self.num_pages, config.cache.page_size,
-                self._kv_dtype())
+                self._kv_dtype(), **kw)
         if self.dp > 1:
             # One KV pool per DP replica, stacked on a leading axis that
             # shards over the mesh's dp axis (the reference's per-replica
@@ -192,32 +225,42 @@ class ModelRunner:
 
     def _pick_attn_impl(self) -> str:
         impl = self.config.attention_impl
+        cfg = self.model_cfg
+        tp = self.config.parallel.tp
         tp_sharded = self.mesh is not None and (
-            self.config.parallel.tp > 1 or self.config.parallel.dp > 1)
+            tp > 1 or self.config.parallel.dp > 1)
+
+        def tp_ok() -> bool:
+            # dp steps vmap the forward over stacked replicas; shard_map
+            # inside that vmap is not wired up — keep dp on XLA.
+            if self.config.parallel.dp > 1:
+                return False
+            from gllm_tpu.ops.attention import pallas_tp_compatible
+            hkv = 1 if cfg.use_mla else cfg.num_kv_heads
+            return pallas_tp_compatible(cfg.num_heads, hkv, tp)
+
+        pack = pick_kv_pack(cfg, tp_sharded)
         if impl != "auto":
-            if impl == "pallas" and tp_sharded:
-                # TODO: shard_map wrapper so the decode kernel runs
-                # per-TP-shard (q and KV are both head-sharded, so it
-                # partitions cleanly); reject rather than silently
-                # all-gathering the KV cache every layer.
-                raise NotImplementedError(
-                    "attention_impl='pallas' with tp>1 is not wired up yet; "
-                    "use attention_impl='xla' (or 'auto')")
+            if impl == "pallas":
+                if tp_sharded and not tp_ok():
+                    raise NotImplementedError(
+                        "attention_impl='pallas' needs head counts "
+                        "divisible over tp (and dp==1); use "
+                        "attention_impl='xla'")
+                if not pack:
+                    raise NotImplementedError(
+                        "attention_impl='pallas' needs a 128-lane-aligned "
+                        "KV layout: head_dim (×pack 2/4) % 128 == 0, or "
+                        "kv_lora_rank % 128 == 0 for MLA; use "
+                        "attention_impl='xla'")
+                self.kv_pack = pack
             return impl
-        if tp_sharded:
+        if not pack or (tp_sharded and not tp_ok()):
             return "xla"
-        # Mosaic tiles the lane (last) dimension at 128: unaligned head
-        # dims fail kernel compile ("Slice shape along dimension 3 must be
-        # aligned to tiling (128)", verified on chip). MLA caches are
-        # tile-padded by construction, but the in-kernel value slice
-        # k[..., :lora] still needs lora % 128 == 0 (512 for DeepSeek).
-        if self.model_cfg.use_mla:
-            if self.model_cfg.kv_lora_rank % 128 != 0:
-                return "xla"
-        elif self.model_cfg.head_dim % 128 != 0:
-            return "xla"
-        return ("pallas" if jax.default_backend() in ("tpu", "axon")
-                else "xla")
+        if jax.default_backend() in ("tpu", "axon"):
+            self.kv_pack = pack
+            return "pallas"
+        return "xla"
 
     def _kv_dtype(self):
         kd = self.config.cache.kv_cache_dtype
@@ -302,7 +345,8 @@ class ModelRunner:
         @functools.partial(jax.jit,
                            static_argnames=("max_q_len", "logprobs_k",
                                             "prompt_lp"),
-                           donate_argnums=(1,))
+                           donate_argnums=(1,),
+                           compiler_options=tpu_compiler_options())
         def step(params, kv, batch: StepBatch, cos_sin, token_counts,
                  *, max_q_len: int, logprobs_k: int = -1,
                  prompt_lp: bool = False):
@@ -339,7 +383,8 @@ class ModelRunner:
             cfg_dp = _dc.replace(cfg, moe_force_dense=True)
 
             @functools.partial(jax.jit, static_argnames=("max_q_len",),
-                               donate_argnums=(1,))
+                               donate_argnums=(1,),
+                               compiler_options=tpu_compiler_options())
             def step_dp(params, kv, batch, cos_sin, token_counts, *,
                         max_q_len: int):
                 def one(kv_r, batch_r, counts_r):
@@ -598,6 +643,7 @@ class ModelRunner:
         page = self.config.cache.page_size
 
         @functools.partial(jax.jit, static_argnames=("num_steps",),
+                           compiler_options=tpu_compiler_options(),
                            donate_argnums=(1,))
         def step_multi(params, kv, batch: StepBatch, cos_sin, keys, *,
                        num_steps: int):

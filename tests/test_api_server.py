@@ -162,3 +162,34 @@ def test_bad_requests(server):
     assert status == 400
     status, _ = request(server, "POST", "/v1/unknown", {})
     assert status == 404
+
+
+def test_chat_streaming_with_tools(server):
+    """Streamed chat WITH tools rides the incremental StreamingToolCalls
+    path: text deltas arrive live (multiple SSE events) even when no tool
+    markup is generated."""
+    conn = http.client.HTTPConnection("127.0.0.1", server, timeout=60)
+    conn.request("POST", "/v1/chat/completions", body=json.dumps({
+        "messages": [{"role": "user", "content": "call a tool"}],
+        "max_tokens": 6, "temperature": 0, "stream": True,
+        "ignore_eos": True,
+        "tools": [{"type": "function", "function": {
+            "name": "noop", "parameters": {"type": "object",
+                                           "properties": {}}}}]}),
+        headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.getheader("Content-Type").startswith("text/event-stream")
+    events = []
+    for line in resp.read().decode().splitlines():
+        if line.startswith("data: ") and line != "data: [DONE]":
+            events.append(json.loads(line[6:]))
+    conn.close()
+    deltas = [e["choices"][0]["delta"] for e in events]
+    # role preamble + per-token content deltas + finish chunk
+    assert deltas[0].get("role") == "assistant"
+    content = "".join(d.get("content") or "" for d in deltas)
+    assert len(content) > 0
+    assert sum(1 for d in deltas if d.get("content")) >= 2, \
+        "content must stream incrementally, not as one buffered delta"
+    fins = [e["choices"][0].get("finish_reason") for e in events]
+    assert fins[-1] == "length"

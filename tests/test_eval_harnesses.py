@@ -1,0 +1,78 @@
+"""Scorer/parser units of the offline eval harnesses (reference
+benchmarks/evaluate_bfcl.py + evaluate_mmmu.py drivers)."""
+
+import importlib.util
+import os
+
+import pytest
+
+
+def _load(name):
+    path = os.path.join(os.path.dirname(__file__), "..", "benchmarks",
+                        name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bfcl = _load("evaluate_bfcl")
+mmmu = _load("evaluate_mmmu")
+
+
+def test_parse_prompt_calls():
+    calls = bfcl.parse_prompt_calls(
+        "Sure: [get_weather(city='Paris', days=3), noop()]")
+    assert calls == [("get_weather", {"city": "Paris", "days": 3}),
+                     ("noop", {})]
+    assert bfcl.parse_prompt_calls("no calls here") == []
+    assert bfcl.parse_prompt_calls("[broken(") == []
+
+
+def test_parse_native_calls():
+    msg = {"tool_calls": [{"function": {
+        "name": "f", "arguments": "{\"x\": 1}"}}]}
+    assert bfcl.parse_native_calls(msg) == [("f", {"x": 1})]
+
+
+@pytest.mark.parametrize("calls,expect,irr,want", [
+    ([("f", {"a": 1})],
+     [{"name": "f", "args": {"a": [1, 2]}, "required": ["a"]}], False, True),
+    ([("f", {"a": 3})],
+     [{"name": "f", "args": {"a": [1, 2]}, "required": ["a"]}], False, False),
+    ([("f", {})],                                   # missing required
+     [{"name": "f", "args": {"a": [1]}, "required": ["a"]}], False, False),
+    ([("f", {})],                                   # "" ⇒ omittable
+     [{"name": "f", "args": {"a": [1, ""]}, "required": ["a"]}], False, True),
+    ([("f", {"a": 1, "z": 9})],                     # undeclared arg
+     [{"name": "f", "args": {"a": [1]}, "required": ["a"]}], False, False),
+    ([], [], True, True),                           # irrelevance detection
+    ([("f", {})], [], True, False),
+    ([("f", {"a": "PARIS"})],                       # case-folded strings
+     [{"name": "f", "args": {"a": ["Paris"]}, "required": ["a"]}],
+     False, True),
+    ([("g", {"b": 2}), ("f", {"a": 1})],            # order-free parallel
+     [{"name": "f", "args": {"a": [1]}, "required": ["a"]},
+      {"name": "g", "args": {"b": [2]}, "required": ["b"]}], False, True),
+])
+def test_bfcl_score(calls, expect, irr, want):
+    assert bfcl.score(calls, expect, irr) is want
+
+
+def test_mmmu_choice_extraction():
+    assert mmmu.extract_choice("The answer is B.") == "B"
+    assert mmmu.extract_choice(" c") == "C"
+    assert mmmu.extract_choice("unclear") is None
+
+
+def test_parse_prompt_calls_with_leading_prose_brackets():
+    calls = bfcl.parse_prompt_calls(
+        "[Note] I'll call it now: [get_weather(city='Paris')]")
+    assert calls == [("get_weather", {"city": "Paris"})]
+
+
+def test_extract_choice_ignores_english_words():
+    assert mmmu.extract_choice("I think the answer is B") == "B"
+    assert mmmu.extract_choice("I cannot see the image") is None
+    assert mmmu.extract_choice("A") == "A"
+    assert mmmu.extract_choice("(C) because ...") == "C"

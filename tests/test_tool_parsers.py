@@ -94,3 +94,82 @@ def test_deepseek_v3_stock_template_format():
     assert content == ""
     assert calls[0].name == "get_weather"
     assert json.loads(calls[0].arguments) == {"city": "Paris"}
+
+
+# ---- incremental streaming adapter ----------------------------------------
+
+def _feed_chunks(stream, text, n=7):
+    """Feed in n-char chunks; collect (text, deltas)."""
+    out_text, out_deltas = "", []
+    for i in range(0, len(text), n):
+        t, ds = stream.feed(text[i:i + n])
+        out_text += t
+        out_deltas += ds
+    t, ds = stream.finish()
+    return out_text + t, out_deltas
+
+
+def test_streaming_qwen_text_then_calls():
+    from gllm_tpu.entrypoints.tool_parsers import StreamingToolCalls
+    text = ('Checking now.\n<tool_call>\n'
+            '{"name": "a", "arguments": {"x": 1}}\n</tool_call>'
+            '<tool_call>\n{"name": "b", "arguments": {}}\n</tool_call>')
+    s = StreamingToolCalls(QwenToolParser())
+    got_text, deltas = _feed_chunks(s, text, n=5)
+    assert got_text.strip() == "Checking now."
+    # two calls × (header delta + arguments delta), indices 0 and 1
+    assert [d["index"] for d in deltas] == [0, 0, 1, 1]
+    assert deltas[0]["function"]["name"] == "a"
+    assert json.loads(deltas[1]["function"]["arguments"]) == {"x": 1}
+    assert deltas[2]["function"]["name"] == "b"
+    assert s.saw_tool_calls
+
+
+def test_streaming_text_passthrough_is_incremental():
+    """Plain text streams through immediately — nothing held except a
+    potential marker prefix."""
+    from gllm_tpu.entrypoints.tool_parsers import StreamingToolCalls
+    s = StreamingToolCalls(QwenToolParser())
+    t1, d1 = s.feed("hello wor")
+    assert t1 == "hello wor" and d1 == []
+    t2, _ = s.feed("ld <tool")          # "<tool" could start a marker
+    assert t2 == "ld "
+    t3, _ = s.feed("box> done")         # not a marker after all
+    assert t3 == "<toolbox> done"
+    t4, _ = s.finish()
+    assert t4 == ""
+
+
+def test_streaming_deepseek_unterminated_section():
+    """Length-capped mid-section: completed call units still come back."""
+    from gllm_tpu.entrypoints.tool_parsers import StreamingToolCalls
+    text = ("<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>get_weather"
+            "<｜tool▁sep｜>{\"city\": \"Paris\"}<｜tool▁call▁end｜>")
+    s = StreamingToolCalls(DeepSeekToolParser())
+    got_text, deltas = _feed_chunks(s, text, n=9)
+    assert got_text == ""
+    assert [d["index"] for d in deltas] == [0, 0]
+    assert deltas[0]["function"]["name"] == "get_weather"
+    assert json.loads(deltas[1]["function"]["arguments"]) == \
+        {"city": "Paris"}
+
+
+def test_streaming_malformed_markup_returns_as_content():
+    from gllm_tpu.entrypoints.tool_parsers import StreamingToolCalls
+    text = "a <tool_call>{not json}</tool_call>"
+    s = StreamingToolCalls(QwenToolParser())
+    got_text, deltas = _feed_chunks(s, text, n=4)
+    assert deltas == []
+    assert "not json" in got_text and got_text.startswith("a ")
+
+
+def test_streaming_trailing_content_after_calls_survives():
+    """Content following well-formed tool markup must still reach the
+    client (regression: finish() used to drop it)."""
+    from gllm_tpu.entrypoints.tool_parsers import StreamingToolCalls
+    text = ('<tool_call>\n{"name": "a", "arguments": {}}\n</tool_call>\n'
+            'I called the tool for you.')
+    s = StreamingToolCalls(QwenToolParser())
+    got_text, deltas = _feed_chunks(s, text, n=6)
+    assert [d["index"] for d in deltas] == [0, 0]
+    assert "I called the tool for you." in got_text
