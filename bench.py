@@ -51,7 +51,9 @@ def supervise(args, argv):
     deadline = time.monotonic() + (900 if not args.tiny else 420)
     last_tail = ""
     for attempt in range(1, attempts + 1):
-        budget = max(60, deadline - time.monotonic())
+        # per-attempt cap so a mid-run hang (wedged tunnel) still leaves
+        # any later attempt a real budget
+        budget = max(60, min(deadline - time.monotonic(), 620))
         log(f"[bench supervisor] attempt {attempt}/{attempts}, "
             f"budget {budget:.0f}s")
         try:

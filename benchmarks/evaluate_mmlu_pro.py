@@ -11,8 +11,10 @@ first choice letter.
 import argparse
 import http.client
 import json
-import re
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 LETTERS = "ABCDEFGHIJ"
 
@@ -25,24 +27,8 @@ def format_prompt(q):
 
 
 def extract_choice(text):
-    """Same priority ladder as evaluate_mmmu.py: explicit "answer is X",
-    reply leading with the letter, then standalone capitals excluding the
-    English words "I"/"A"."""
-    t = (text or "").strip()
-    m = re.search(r"answer\s*(?:is|:)?\s*\*{0,2}\(?([A-Ja-j])\b", t,
-                  re.IGNORECASE)
-    if m:
-        return m.group(1).upper()
-    m = re.match(r"\(?([A-Ja-j])\)?(?:[.,:)]|$)", t)
-    if m:
-        return m.group(1).upper()
-    # leading letter + space: plausible for "B because ..." but not for
-    # the English words "I ..." / "A ..."
-    m = re.match(r"([B-HJb-hj])\s", t)
-    if m:
-        return m.group(1).upper()
-    m = re.search(r"\b([B-HJ])\b", t)
-    return m.group(1) if m else None
+    from mcq_common import extract_choice as _ec
+    return _ec(text)
 
 
 def main():

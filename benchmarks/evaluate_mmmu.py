@@ -18,8 +18,9 @@ import http.client
 import json
 import mimetypes
 import os
-import re
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from collections import defaultdict
 
 LETTERS = "ABCDEFGHIJ"
@@ -44,24 +45,8 @@ def format_content(q, base_dir):
 
 
 def extract_choice(text):
-    """First in priority order: an explicit "answer is X", a reply that
-    LEADS with the letter, then any standalone capital letter that isn't
-    the English word "I"/"A" (which the naive \\b[A-J]\\b match scores)."""
-    t = (text or "").strip()
-    m = re.search(r"answer\s*(?:is|:)?\s*\*{0,2}\(?([A-Ja-j])\b", t,
-                  re.IGNORECASE)
-    if m:
-        return m.group(1).upper()
-    m = re.match(r"\(?([A-Ja-j])\)?(?:[.,:)]|$)", t)
-    if m:
-        return m.group(1).upper()
-    # leading letter + space: plausible for "B because ..." but not for
-    # the English words "I ..." / "A ..."
-    m = re.match(r"([B-HJb-hj])\s", t)
-    if m:
-        return m.group(1).upper()
-    m = re.search(r"\b([B-HJ])\b", t)
-    return m.group(1) if m else None
+    from mcq_common import extract_choice as _ec
+    return _ec(text)
 
 
 def ask(host, port, content, max_tokens=8):

@@ -1,0 +1,38 @@
+"""Shared multiple-choice answer extraction for the MMLU-Pro / MMMU
+harnesses (one implementation so the scorers cannot drift)."""
+
+import re
+
+
+def extract_choice(text):
+    """Priority ladder:
+    1. explicit "answer is X" / "Answer: X"
+    2. "option X" / "choice X"
+    3. reply leading with the letter then punctuation/EOL ("B.", "(C)")
+    4. leading letter + copula ("A is correct") — accepts A/I here
+       because the verb disambiguates from English prose
+    5. leading letter + space for the unambiguous letters B-H, J
+    6. first standalone B-H/J anywhere (A/I excluded: they are common
+       English words and would be scored as choices)
+    """
+    t = (text or "").strip()
+    m = re.search(r"answer\s*(?:is|:)?\s*\*{0,2}\(?([A-Ja-j])\b", t,
+                  re.IGNORECASE)
+    if m:
+        return m.group(1).upper()
+    m = re.search(r"(?:option|choice)\s*\(?([A-Ja-j])\b", t, re.IGNORECASE)
+    if m:
+        return m.group(1).upper()
+    m = re.match(r"\(?([A-Ja-j])\)?(?:[.,:)]|$)", t)
+    if m:
+        return m.group(1).upper()
+    # "would/should/could" belong to first-person prose ("I would say B"),
+    # so only the copulas disambiguate a leading A/I as an answer
+    m = re.match(r"([A-Ja-j])\s+(?:is|was|seems)\b", t)
+    if m:
+        return m.group(1).upper()
+    m = re.match(r"([B-HJb-hj])\s", t)
+    if m:
+        return m.group(1).upper()
+    m = re.search(r"\b([B-HJ])\b", t)
+    return m.group(1) if m else None

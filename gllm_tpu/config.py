@@ -110,6 +110,13 @@ class EngineConfig:
         return cdiv(self.max_model_len, self.cache.page_size)
 
     def validate(self) -> None:
+        if self.enforce_eager:
+            # The reference's enforce_eager drops CUDA-graph capture; the
+            # analogues here are the async-execution tricks — chained
+            # overlap decode and the fused multi-step loop. Plain
+            # one-dispatch-per-step execution remains.
+            self.overlap_scheduling = False
+            self.multi_step_decode = 1
         if self.cache.page_size <= 0:
             raise ValueError("page_size must be positive")
         if self.scheduler.max_prefill_tokens < self.cache.page_size:

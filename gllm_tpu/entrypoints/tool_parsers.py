@@ -69,6 +69,10 @@ class ToolParser:
     #: literal strings whose appearance means tool markup is starting;
     #: the streaming adapter holds back only potential-marker suffixes.
     STREAM_MARKERS: Tuple[str, ...] = ()
+    #: literal strings that terminate one call unit; the streaming adapter
+    #: only re-parses when a NEW end marker arrives, so per-call work is
+    #: O(unit) once instead of O(unit) per token.
+    END_MARKERS: Tuple[str, ...] = ()
 
     def parse(self, text: str,
               schemas: Optional[Dict[str, dict]] = None
@@ -88,6 +92,7 @@ class ToolParser:
 class QwenToolParser(ToolParser):
     _RE = re.compile(r"<tool_call>\s*(\{.*?\})\s*</tool_call>", re.DOTALL)
     STREAM_MARKERS = ("<tool_call>",)
+    END_MARKERS = ("</tool_call>",)
 
     def parse(self, text, schemas=None):
         calls: List[ToolCall] = []
@@ -132,6 +137,7 @@ class DeepSeekToolParser(ToolParser):
         r"<｜tool▁call▁begin｜>(.*?)<｜tool▁sep｜>(.*?)<｜tool▁call▁end｜>",
         re.DOTALL)
     STREAM_MARKERS = ("<｜tool▁calls▁begin｜>", "<｜tool▁call▁begin｜>")
+    END_MARKERS = ("<｜tool▁call▁end｜>",)
 
     @staticmethod
     def _strip_fence(payload: str) -> str:
@@ -198,6 +204,7 @@ class KimiToolParser(ToolParser):
         r"<\|tool_call_argument_begin\|>\s*(.*?)\s*<\|tool_call_end\|>",
         re.DOTALL)
     STREAM_MARKERS = (_SECTION,)
+    END_MARKERS = ("<|tool_call_end|>",)
 
     @staticmethod
     def _name_from_id(fid: str) -> str:
@@ -259,7 +266,8 @@ class StreamingToolCalls:
         self.buf = ""
         self.in_tool = False
         self.n_emitted = 0
-        self._done = 0    # buf offset past already-emitted call units
+        self._done = 0       # buf offset past already-emitted call units
+        self._scanned = 0    # buf offset end-marker search has covered
 
     def _held_suffix_len(self) -> int:
         """Longest buffer suffix that is a proper prefix of a marker."""
@@ -305,13 +313,24 @@ class StreamingToolCalls:
                 cut = len(self.buf) - keep
                 text, self.buf = self.buf[:cut], self.buf[cut:]
         deltas = []
-        if self.in_tool:
-            # incremental: only the unconsumed tail is re-parsed
+        if self.in_tool and self._new_unit_ended():
+            # only the unconsumed tail is re-parsed, and only when a NEW
+            # end marker arrived — O(unit) per completed call, not per token
             calls, end = self.parser.completed_calls(self.buf[self._done:],
                                                      self.schemas)
             deltas = self._emit_new(calls)
             self._done += end
         return text, deltas
+
+    def _new_unit_ended(self) -> bool:
+        ends = self.parser.END_MARKERS
+        if not ends:
+            return True     # no marker info → parse every feed
+        overlap = max(len(m) for m in ends) - 1
+        start = max(self._done, self._scanned - overlap)
+        window = self.buf[start:]
+        self._scanned = len(self.buf)
+        return any(m in window for m in ends)
 
     def finish(self) -> Tuple[str, List[dict]]:
         """Flush: full parse of the held buffer. Content surviving the

@@ -182,7 +182,8 @@ def _gated_attention(lp, x, batch: StepBatch, k_cache, v_cache,
 # ---------------------------------------------------------------------------
 
 def _gdn_layer(lp, x, batch: StepBatch, conv_state, rec_state,
-               cfg: ModelConfig, *, max_q_len: int):
+               cfg: ModelConfig, *, max_q_len: int,
+               gdn_impl: str = "xla"):
     """One Gated-DeltaNet layer over the flat ragged batch.
 
     conv_state/rec_state: full slot pools for this layer
@@ -257,7 +258,8 @@ def _gdn_layer(lp, x, batch: StepBatch, conv_state, rec_state,
         qh, kh, vh = unpack(out_c)
         rstate = rec_state[slots]
         core, new_rstate = chunk_gated_delta_rule(
-            qh, kh, vh, g_s, beta_s, initial_state=rstate)
+            qh, kh, vh, g_s, beta_s, initial_state=rstate,
+            impl=gdn_impl)
         conv_state = conv_state.at[slots].set(new_cstate)
         rec_state = rec_state.at[slots].set(new_rstate)
         # scatter valid rows back to the flat layout
@@ -340,7 +342,10 @@ def forward(params: Params, kv: HybridKV, batch: StepBatch,
                                                      keepdims=False)
                 mix_out, conv_l, rec_l = _gdn_layer(
                     lp, normed, batch, conv_l, rec_l, cfg,
-                    max_q_len=max_q_len)
+                    max_q_len=max_q_len,
+                    # the runner's attn impl doubles as the GDN kernel
+                    # switch (gdn_scan falls back itself on unaligned dims)
+                    gdn_impl=attn_impl)
                 conv_all = jax.lax.dynamic_update_index_in_dim(
                     conv_all, conv_l, gi + g_j, 0)
                 rec_all = jax.lax.dynamic_update_index_in_dim(

@@ -82,7 +82,7 @@ def recurrent_gated_delta_step(
     return out, state
 
 
-@functools.partial(jax.jit, static_argnames=("chunk_size",))
+@functools.partial(jax.jit, static_argnames=("chunk_size", "impl"))
 def chunk_gated_delta_rule(
     q: jnp.ndarray,          # [S, T, H, Dk]
     k: jnp.ndarray,          # [S, T, H, Dk]
@@ -91,11 +91,16 @@ def chunk_gated_delta_rule(
     beta: jnp.ndarray,       # [S, T, H] (0 on padded tokens)
     initial_state: Optional[jnp.ndarray] = None,   # [S, H, Dk, Dv]
     chunk_size: int = 64,
+    impl: str = "xla",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Chunked gated delta rule (HF torch_chunk_gated_delta_rule, batched).
 
     Returns (out [S, T, H, Dv] f32, final_state [S, H, Dk, Dv] f32).
     Padded tokens must carry g = 0 and beta = 0 (identity on the state).
+
+    ``impl="pallas"`` runs the sequential inter-chunk scan in the fused
+    VMEM-resident kernel (ops/pallas/gdn_scan.py); the in-chunk triangular
+    math stays on XLA's native batched TriangularSolve either way.
     """
     S, T, H, Dk = q.shape
     Dv = v.shape[-1]
@@ -145,6 +150,24 @@ def chunk_gated_delta_rule(
     state0 = (jnp.zeros((S, H, Dk, Dv), jnp.float32)
               if initial_state is None
               else initial_state.astype(jnp.float32))
+
+    if impl == "pallas":
+        backend = jax.default_backend()
+        interpret = backend == "cpu"
+        if interpret or (Dk % 128 == 0 and Dv % 128 == 0):
+            from gllm_tpu.ops.pallas.gdn_scan import gdn_chunk_scan
+            B = S * H
+            out_p, final_p = gdn_chunk_scan(
+                qc.reshape(B, N, C, Dk), kc.reshape(B, N, C, Dk),
+                v2.reshape(B, N, C, Dv), k_cumdecay.reshape(B, N, C, Dk),
+                attn_local.reshape(B, N, C, C),
+                gcum.reshape(B, N, C, 1),
+                state0.reshape(B, Dk, Dv), interpret=interpret)
+            out = out_p.reshape(S, H, N, C, Dv)
+            out = out.transpose(0, 2, 3, 1, 4).reshape(
+                S, T + pad, H, Dv)[:, :T]
+            return out, final_p.reshape(S, H, Dk, Dv)
+        # fall through to XLA when lane alignment rules out Mosaic
 
     def chunk_step(state, inputs):
         q_i, k_i, v_i, kcd_i, attn_i, g_i = inputs

@@ -38,15 +38,37 @@ class SamplingMetadata(NamedTuple):
     out_step: Optional[jnp.ndarray] = None   # [S] i32 output-token index
 
 
+class PenaltyTokens(NamedTuple):
+    """Padded per-seq token-id lists for penalty application.
+
+    The reference keeps a persistent [seqs, vocab] mask pool on device
+    (memory_manager.py:723-828) with slot lifecycle management; here the
+    [S, V] count matrix is regenerated ON DEVICE each step from the padded
+    id lists — a [S, L] int32 transfer (a few MB) and a fused scatter-add
+    replace the pool, its alloc/free/preemption bookkeeping, and the
+    hundred-MB host-built matrix the first version shipped per step."""
+    ids: jnp.ndarray      # [S, L] int32 (padding clipped to id 0)
+    mask: jnp.ndarray     # [S, L] bool — False on padding
+
+
+def _counts_from_tokens(pt: PenaltyTokens, vocab: int) -> jnp.ndarray:
+    S = pt.ids.shape[0]
+    rows = jnp.arange(S, dtype=jnp.int32)[:, None]
+    return jnp.zeros((S, vocab), jnp.int32).at[
+        rows, pt.ids].add(pt.mask.astype(jnp.int32))
+
+
 def apply_penalties(logits: jnp.ndarray,
-                    token_counts: Optional[jnp.ndarray],
+                    token_counts,
                     md: "SamplingMetadata") -> jnp.ndarray:
-    """token_counts: [S, V] — occurrence count of each token in the
-    sequence so far. Applies the scaling repetition penalty (reference
+    """token_counts: [S, V] occurrence counts, or a PenaltyTokens bundle
+    expanded on device. Applies the scaling repetition penalty (reference
     repetition_penalty.py:40-80) and the OpenAI presence/frequency
     penalties in one pass."""
     if token_counts is None:
         return logits
+    if isinstance(token_counts, PenaltyTokens):
+        token_counts = _counts_from_tokens(token_counts, logits.shape[-1])
     counts = token_counts.astype(jnp.float32)
     seen = counts > 0
     p = md.repetition_penalty[:, None]

@@ -123,7 +123,8 @@ class BatchBuilder:
         return frozenset(extras)
 
     def build(self, batch: ScheduledBatch, step_key,
-              force_signature=None, force_extras=frozenset()):
+              force_signature=None, force_extras=frozenset(),
+              force_penalty_len=None):
         """Returns (StepBatch, max_q_len, token_counts_or_None).
 
         ``force_signature`` overrides the computed shape buckets and
@@ -241,19 +242,31 @@ class BatchBuilder:
         if self.vocab_size and (force_penalties or any(
                 _uses_penalty(it.seq.sampling_params)
                 for it in batch.items)):
-            tc = np.zeros((s_pad, self.vocab_size), np.int32)
+            from gllm_tpu.ops.sampling import PenaltyTokens
+            from gllm_tpu.utils import next_pow2
+            lens = [len(it.seq.token_ids) for it in batch.items
+                    if _uses_penalty(it.seq.sampling_params)]
+            # DP replicas must agree on L (the stacked pytrees share one
+            # jit signature) — the dp wrapper passes the cross-replica max
+            L = force_penalty_len or (max(16, next_pow2(max(lens)))
+                                      if lens else 16)
+            ids = np.zeros((s_pad, L), np.int32)
+            mask = np.zeros((s_pad, L), bool)
             pres = np.zeros(s_pad, np.float32)
             freq = np.zeros(s_pad, np.float32)
             for i, it in enumerate(batch.items):
                 sp = it.seq.sampling_params
                 if _uses_penalty(sp):
-                    ids = np.asarray(it.seq.token_ids, np.int64)
+                    row = np.asarray(it.seq.token_ids, np.int64)
                     # visual placeholder ids can sit past the LM vocab
                     # (Kimi's media pad) — they never appear in logits
-                    np.add.at(tc[i], ids[ids < self.vocab_size], 1)
+                    row = row[row < self.vocab_size][:L]
+                    ids[i, :len(row)] = row
+                    mask[i, :len(row)] = True
                     pres[i] = sp.presence_penalty
                     freq[i] = sp.frequency_penalty
-            token_counts = jnp.asarray(tc)
+            token_counts = PenaltyTokens(jnp.asarray(ids),
+                                         jnp.asarray(mask))
 
         step_batch = StepBatch(
             token_ids=jnp.asarray(tokens),

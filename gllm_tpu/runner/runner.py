@@ -510,6 +510,14 @@ class ModelRunner:
         extras = frozenset().union(
             *[self.builder.batch_extras(b) for b in live])
 
+        # Penalty id lists are length-bucketed per batch — replicas must
+        # share one L so the stacked PenaltyTokens match structurally.
+        pen_len = None
+        if "penalties" in extras:
+            from gllm_tpu.utils import next_pow2
+            lens = [len(it.seq.token_ids) for b in live for it in b.items]
+            pen_len = max(16, next_pow2(max(lens))) if lens else 16
+
         parts = []
         counts_any = False
         for r, b in enumerate(sched_batches):
@@ -518,15 +526,18 @@ class ModelRunner:
                 parts.append((self.builder.empty(sig, key, extras), None))
             else:
                 batch, _, counts = self.builder.build(
-                    b, key, force_signature=sig, force_extras=extras)
+                    b, key, force_signature=sig, force_extras=extras,
+                    force_penalty_len=pen_len)
                 counts_any = counts_any or counts is not None
                 parts.append((batch, counts))
         token_counts = None
         if counts_any:
-            t_shape = (sig[1], self.model_cfg.vocab_size)
-            token_counts = jnp.stack(
-                [c if c is not None else jnp.zeros(t_shape, jnp.int32)
-                 for _, c in parts])
+            from gllm_tpu.ops.sampling import PenaltyTokens
+            blank = PenaltyTokens(jnp.zeros((sig[1], pen_len), jnp.int32),
+                                  jnp.zeros((sig[1], pen_len), bool))
+            token_counts = jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[c if c is not None else blank for _, c in parts])
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs),
                                *[p[0] for p in parts])
         if self.mesh is not None:

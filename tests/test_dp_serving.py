@@ -109,3 +109,26 @@ def test_dp2_moe_ep(ckpt, tmp_path):
             prompt_token_ids=prompts, sampling_params=sp)]
 
     assert run(2) == run(1)
+
+
+def test_dp2_penalties_match_dp1(ckpt):
+    """Penalty requests under dp (stacked PenaltyTokens with a shared
+    length bucket, one replica penalized + one idle/plain)."""
+    rng = np.random.default_rng(1)
+    prompts = [[int(x) for x in rng.integers(2, 120, size=int(n))]
+               for n in rng.integers(4, 40, size=4)]
+    sps = [SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True,
+                          repetition_penalty=1.5, presence_penalty=0.4,
+                          frequency_penalty=0.2),
+           SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True),
+           SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True,
+                          repetition_penalty=2.0),
+           SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)]
+
+    base = [o.output_token_ids
+            for o in make_llm(ckpt).generate(prompt_token_ids=prompts,
+                                             sampling_params=sps)]
+    dp2 = [o.output_token_ids
+           for o in make_llm(ckpt, dp=2).generate(prompt_token_ids=prompts,
+                                                  sampling_params=sps)]
+    assert base == dp2

@@ -76,3 +76,11 @@ def test_extract_choice_ignores_english_words():
     assert mmmu.extract_choice("I cannot see the image") is None
     assert mmmu.extract_choice("A") == "A"
     assert mmmu.extract_choice("(C) because ...") == "C"
+
+
+def test_extract_choice_a_and_i_phrasings():
+    assert mmmu.extract_choice("Option A.") == "A"
+    assert mmmu.extract_choice("A is correct") == "A"
+    assert mmmu.extract_choice("I would say B") == "B"  # answer-ish verb,
+    # but B is the standalone choice mentioned
+    assert mmmu.extract_choice("choice (I)") == "I"

@@ -261,3 +261,33 @@ def test_top_k_minus_one_means_disabled():
     seen = {int(sample(logits, md._replace(step_key=jax.random.key(s)))[0])
             for s in range(40)}
     assert len(seen) > 1  # uniform logits → multiple tokens reachable
+
+
+def test_penalty_tokens_equals_dense_counts():
+    """PenaltyTokens (on-device count regeneration) is byte-identical to
+    dense [S,V] counts through apply_penalties, incl. duplicate ids."""
+    import numpy as np
+    from gllm_tpu.ops.sampling import (PenaltyTokens, SamplingMetadata,
+                                       _counts_from_tokens, apply_penalties)
+    rng = np.random.default_rng(0)
+    V, S, L = 97, 3, 16
+    ids = rng.integers(0, V, size=(S, L)).astype(np.int32)
+    mask = rng.random((S, L)) < 0.7
+    dense = np.zeros((S, V), np.int32)
+    for s in range(S):
+        for j in range(L):
+            if mask[s, j]:
+                dense[s, ids[s, j]] += 1
+    pt = PenaltyTokens(jnp.asarray(ids), jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(_counts_from_tokens(pt, V)),
+                                  dense)
+    logits = jnp.asarray(rng.standard_normal((S, V)), jnp.float32)
+    md = SamplingMetadata(temperature=jnp.zeros(S), top_p=jnp.ones(S),
+                          top_k=jnp.full(S, -1, jnp.int32),
+                          repetition_penalty=jnp.full(S, 1.7),
+                          step_key=jax.random.key(0),
+                          presence_penalty=jnp.full(S, 0.5),
+                          frequency_penalty=jnp.full(S, 0.25))
+    np.testing.assert_array_equal(
+        np.asarray(apply_penalties(logits, jnp.asarray(dense), md)),
+        np.asarray(apply_penalties(logits, pt, md)))

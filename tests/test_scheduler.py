@@ -198,3 +198,12 @@ def test_prefix_cache_via_scheduler():
     assert batch.items[0].num_new_tokens == 4
     assert batch.items[0].computed_before == 12
     assert b.num_cached_tokens == 12
+
+
+def test_enforce_eager_disables_async_tricks():
+    from gllm_tpu.config import EngineConfig
+    cfg = EngineConfig(enforce_eager=True, overlap_scheduling=True,
+                       multi_step_decode=8)
+    cfg.validate()
+    assert cfg.overlap_scheduling is False
+    assert cfg.multi_step_decode == 1
