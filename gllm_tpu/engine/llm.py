@@ -115,6 +115,9 @@ class LLM:
             for _ in range(self.dp)]
         self.memory_manager = self.memory_managers[0]
         self.runner.memory_manager = self.memory_manager
+        if self.dp > 1:
+            # per-replica SSM intents apply to the stacked pools by index
+            self.runner.memory_managers = self.memory_managers
         self.schedulers = [Scheduler(config, mm,
                                      pp_size=config.parallel.pp)
                            for mm in self.memory_managers]

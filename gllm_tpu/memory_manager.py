@@ -160,6 +160,7 @@ class MemoryManager:
         for page in seq.page_table:
             self._release_page(page)
         seq.page_table = []
+        seq._pt_np = None      # see Sequence.preempt: shrink ⇒ drop cache
         self._free_ssm(seq)
 
     def _release_page(self, page: int) -> None:
@@ -257,6 +258,7 @@ class PrefixMemoryManager(MemoryManager):
             for page in seq.page_table[keep:]:
                 self._release_page(page)
             del seq.page_table[keep:]
+            seq._pt_np = None  # see Sequence.preempt: shrink ⇒ drop cache
             if keep:
                 matched_digest = digests[keep - 1]
                 seq._ssm_restore_snap = self.page2snap[

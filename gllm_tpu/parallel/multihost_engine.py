@@ -58,6 +58,9 @@ class RequestDesc:
     seq_id: int
     token_ids: List[int]
     sampling: dict                       # dataclasses.asdict(SamplingParams)
+    mm: Optional[dict] = None            # raw mm_input (pixel arrays ride
+                                         # the pickle broadcast; every host
+                                         # rebuilds the same MM state)
 
 
 @dataclasses.dataclass
@@ -92,18 +95,24 @@ class MultihostEngine:
     # ---- host-0 frontend side ---------------------------------------------
 
     def submit(self, token_ids: List[int], sampling_params,
-               on_register=None) -> int:
+               on_register=None, mm_input: Optional[dict] = None) -> int:
         """``on_register(seq_id)`` runs under the intake lock BEFORE the
         request becomes visible to the engine loop — callers register
         their output handles there so no chunk can be dropped."""
         assert self.is_host0
+        mm_state = None
+        if mm_input:
+            from gllm_tpu.engine.mm import build_mm_state
+            mm_state = build_mm_state(token_ids, self.llm.model_cfg,
+                                      **mm_input)
         with self._lock:
             seq = self.llm._allocate_seq(list(token_ids), sampling_params)
+            seq.mm = mm_state
             if on_register is not None:
                 on_register(seq.seq_id)
             self._pending.append(RequestDesc(
                 seq.seq_id, list(token_ids),
-                dataclasses.asdict(sampling_params)))
+                dataclasses.asdict(sampling_params), mm=mm_input))
             self._seqs[seq.seq_id] = seq
         return seq.seq_id
 
@@ -127,6 +136,10 @@ class MultihostEngine:
                 seq = llm._allocate_seq(rd.token_ids, sp)
                 # keep seq-id allocation identical across hosts
                 seq.seq_id = rd.seq_id
+                if rd.mm:
+                    from gllm_tpu.engine.mm import build_mm_state
+                    seq.mm = build_mm_state(rd.token_ids, llm.model_cfg,
+                                            **rd.mm)
             try:
                 llm.add_seq(seq)
             except ValueError as e:
@@ -225,9 +238,10 @@ class MultihostServingEngine:
 
     def submit(self, token_ids, sampling_params, mm_input=None,
                disagg_items=None):
-        if mm_input or disagg_items:
+        if disagg_items:
             raise NotImplementedError(
-                "multimodal requests over multi-host are not wired up yet")
+                "encoder disaggregation over multi-host is not wired up "
+                "yet (run the disagg coordinator single-host)")
         sampling_params.validate()
         box = {}
 
@@ -238,7 +252,7 @@ class MultihostServingEngine:
             self._handles[sid] = box["handle"]
 
         self.engine.submit(token_ids, sampling_params,
-                           on_register=on_register)
+                           on_register=on_register, mm_input=mm_input)
         return box["handle"]
 
     def abort(self, seq_id: int) -> None:

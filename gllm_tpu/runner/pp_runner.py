@@ -95,11 +95,6 @@ class PPModelRunner(ModelRunner):
         if config.parallel.dp > 1:
             raise NotImplementedError("dp with pp pending multi-replica "
                                       "engine")
-        if model_cfg.use_mm:
-            # Reject honestly rather than silently dropping images (the
-            # per-stage builder has no vision tower / mrope plumbing yet).
-            raise NotImplementedError(
-                "multimodal models with pp > 1 are not wired up yet")
         if model_cfg.use_hybrid:
             raise NotImplementedError(
                 "hybrid (GDN) models with pp > 1 are not wired up yet")
@@ -129,7 +124,13 @@ class PPModelRunner(ModelRunner):
         self.attn_impl = impl
         from gllm_tpu.runner.prepare import BatchBuilder
         self.builder = BatchBuilder(config, config.cache.page_size,
-                                    vocab_size=model_cfg.vocab_size)
+                                    vocab_size=model_cfg.vocab_size,
+                                    hidden_size=model_cfg.hidden_size,
+                                    use_mm=model_cfg.use_mm,
+                                    mm_embed_dim=model_cfg.mm_embed_dim)
+        if model_cfg.use_mm:
+            from gllm_tpu.utils import LRUBytesCache
+            self._mm_cache = LRUBytesCache()
         self.rng_key = jax.random.key(config.seed)
         self._step_count = 0
 
@@ -153,6 +154,13 @@ class PPModelRunner(ModelRunner):
                 sparams = self.model_def.init_params(scfg,
                                                      seed=config.seed,
                                                      dtype=self.dtype)
+                if model_cfg.use_mm and first > 0:
+                    sparams.pop("visual", None)
+            elif model_cfg.use_mm and first > 0:
+                # only stage 0 embeds visual rows — later stages never
+                # read the tower (disagg-LM skip_visual rule filtering)
+                sparams = self.model_def.load_params(
+                    config.model, scfg, dtype=self.dtype, skip_visual=True)
             else:
                 sparams = self.model_def.load_params(config.model, scfg,
                                                      dtype=self.dtype)
@@ -198,6 +206,9 @@ class PPModelRunner(ModelRunner):
             fn = self._make_stage_fn(scfg)
             self.stages.append(_Stage(scfg, sparams, skv, place, smesh, fn))
         self.cos_sin = self.model_def.make_rope_table(model_cfg)
+        if model_cfg.use_mm:
+            # the inherited _prepare_mm embeds on stage 0 (visual tower)
+            self.params = self.stages[0].params
         logger.info("pipeline: %d stages %s × tp=%d, %d KV pages/stage",
                     pp, bounds, tp, self.num_pages)
 
@@ -260,6 +271,9 @@ class PPModelRunner(ModelRunner):
     def step_async(self, sched_batch):
         from gllm_tpu.parallel.mesh import mesh_context
         self._step_count += 1
+        if self.model_cfg.use_mm:
+            # ViT embedding on stage 0's params (visual tower lives there)
+            self._prepare_mm(sched_batch)
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
         batch, max_q, presence = self.builder.build(sched_batch, step_key)
         hidden = residual = None

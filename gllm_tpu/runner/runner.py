@@ -52,18 +52,35 @@ def _to_host(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def _ssm_update(conv, rec, idx, snap_src, snap_dst, zero_slots, rest_src,
+                rest_dst):
+    """Shared SSM slot maintenance body (snapshot → zero → restore).
+    ``idx``: index prefix — () for a single pool ([Lg, slots, ...]),
+    (r,) for one replica of dp-stacked pools ([dp, Lg, slots, ...]).
+    Padding entries are (0, 0) / slot 0 — the dummy slot, where
+    self-copies and zeroing are harmless."""
+    a = (*idx, slice(None))
+    conv = conv.at[(*a, snap_dst)].set(conv[(*a, snap_src)])
+    rec = rec.at[(*a, snap_dst)].set(rec[(*a, snap_src)])
+    conv = conv.at[(*a, zero_slots)].set(0.0)
+    rec = rec.at[(*a, zero_slots)].set(0.0)
+    conv = conv.at[(*a, rest_dst)].set(conv[(*a, rest_src)])
+    rec = rec.at[(*a, rest_dst)].set(rec[(*a, rest_src)])
+    return conv, rec
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _ssm_apply(conv, rec, snap_src, snap_dst, zero_slots, rest_src,
                rest_dst):
-    """Batched SSM slot maintenance. Padding entries are (0, 0) / slot 0 —
-    the dummy slot, where self-copies and zeroing are harmless."""
-    conv = conv.at[:, snap_dst].set(conv[:, snap_src])
-    rec = rec.at[:, snap_dst].set(rec[:, snap_src])
-    conv = conv.at[:, zero_slots].set(0.0)
-    rec = rec.at[:, zero_slots].set(0.0)
-    conv = conv.at[:, rest_dst].set(conv[:, rest_src])
-    rec = rec.at[:, rest_dst].set(rec[:, rest_src])
-    return conv, rec
+    return _ssm_update(conv, rec, (), snap_src, snap_dst, zero_slots,
+                       rest_src, rest_dst)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _ssm_apply_replica(conv, rec, r, snap_src, snap_dst, zero_slots,
+                       rest_src, rest_dst):
+    return _ssm_update(conv, rec, (r,), snap_src, snap_dst, zero_slots,
+                       rest_src, rest_dst)
 
 
 def pick_kv_pack(cfg: ModelConfig, tp_sharded: bool) -> int:
@@ -173,9 +190,6 @@ class ModelRunner:
             self.params = shard_params(self.params, specs, self.mesh)
 
         self.dp = config.parallel.dp
-        if self.dp > 1 and (model_cfg.use_hybrid or model_cfg.use_mm):
-            raise NotImplementedError(
-                "dp > 1 with hybrid/multimodal models is not wired up yet")
         if model_cfg.use_hybrid:
             # slot 0 dummy + one working slot per live seq + snapshot range
             self.ssm_working_slots = config.max_num_seqs
@@ -440,31 +454,38 @@ class ModelRunner:
         from completed steps, zeros clear freed slots, restores fill fresh
         slots from snapshots — all before the next step reads them
         (reference SSMSegment.copy_state / free_working zeroing)."""
-        mm = self.memory_manager
-        if mm is None or not getattr(mm, "use_ssm", False):
-            return
-        intents = mm.drain_ssm_intents()
-        if not intents:
-            return
-        snap = [(a, b) for k, a, b in intents if k == "snapshot"]
-        zero = [a for k, a, _ in intents if k == "zero"]
-        rest = [(a, b) for k, a, b in intents if k == "restore"]
+        mms = (self.memory_managers if getattr(self, "memory_managers",
+                                               None)
+               else [self.memory_manager])
 
         def pad_pairs(pairs, n):
             pairs = pairs + [(0, 0)] * (n - len(pairs))
             return (jnp.asarray([p[0] for p in pairs], jnp.int32),
                     jnp.asarray([p[1] for p in pairs], jnp.int32))
 
-        # pow2 padding keeps the jit-shape count logarithmic
-        n_s = next_pow2(len(snap), 1)
-        n_z = next_pow2(len(zero), 1)
-        n_r = next_pow2(len(rest), 1)
-        s_src, s_dst = pad_pairs(snap, n_s)
-        z = jnp.asarray(zero + [0] * (n_z - len(zero)), jnp.int32)
-        r_src, r_dst = pad_pairs(rest, n_r)
-        conv, rec = _ssm_apply(self.kv.conv, self.kv.rec, s_src, s_dst, z,
-                               r_src, r_dst)
-        self.kv = self.kv._replace(conv=conv, rec=rec)
+        for r, mm in enumerate(mms):
+            if mm is None or not getattr(mm, "use_ssm", False):
+                continue
+            intents = mm.drain_ssm_intents()
+            if not intents:
+                continue
+            snap = [(a, b) for k, a, b in intents if k == "snapshot"]
+            zero = [a for k, a, _ in intents if k == "zero"]
+            rest = [(a, b) for k, a, b in intents if k == "restore"]
+
+            # pow2 padding keeps the jit-shape count logarithmic
+            s_src, s_dst = pad_pairs(snap, next_pow2(len(snap), 1))
+            z = jnp.asarray(zero + [0] * (next_pow2(len(zero), 1)
+                                          - len(zero)), jnp.int32)
+            r_src, r_dst = pad_pairs(rest, next_pow2(len(rest), 1))
+            if self.dp > 1:
+                conv, rec = _ssm_apply_replica(
+                    self.kv.conv, self.kv.rec, jnp.int32(r), s_src, s_dst,
+                    z, r_src, r_dst)
+            else:
+                conv, rec = _ssm_apply(self.kv.conv, self.kv.rec, s_src,
+                                       s_dst, z, r_src, r_dst)
+            self.kv = self.kv._replace(conv=conv, rec=rec)
 
     @staticmethod
     def _lp_flags(sched_batch: ScheduledBatch):
@@ -501,6 +522,9 @@ class ModelRunner:
 
         live = [b for b in sched_batches if b is not None]
         assert live, "step_async_dp needs at least one non-empty batch"
+        if self.model_cfg.use_mm:
+            for b in live:
+                self._prepare_mm(b)   # ViT per replica (shared LRU cache)
         sigs = [self.builder.shape_signature(b) for b in live]
         sig = tuple(max(s[i] for s in sigs) for i in range(4))
         max_q = sig[2]
@@ -514,9 +538,8 @@ class ModelRunner:
         # share one L so the stacked PenaltyTokens match structurally.
         pen_len = None
         if "penalties" in extras:
-            from gllm_tpu.utils import next_pow2
-            lens = [len(it.seq.token_ids) for b in live for it in b.items]
-            pen_len = max(16, next_pow2(max(lens))) if lens else 16
+            pen_len = self.builder.penalty_len_bucket(
+                [len(it.seq.token_ids) for b in live for it in b.items])
 
         parts = []
         counts_any = False
@@ -755,4 +778,29 @@ class ModelRunner:
                 items.append(ScheduledSeq(seq, 1, ctx))
             if items:
                 self.step(ScheduledBatch(items))
-        logger.info("warmed %d decode shape buckets", len(combos))
+
+        # Mixed prefill+decode signatures — the shapes a newly admitted
+        # request hits mid-serving (chunked prefill riding with the decode
+        # wave); round 1 left these to first-hit compiles.
+        chunk = min(self.config.scheduler.max_prefill_tokens,
+                    self.config.max_model_len)
+        mixed = 0
+        for nseq in decode_buckets:
+            items = []
+            seq = Sequence(0, [1] * chunk, SamplingParams(max_tokens=4))
+            seq.page_table = [1 + (j % max(1, self.num_pages - 1))
+                              for j in range(cdiv(chunk, page))]
+            seq.num_computed_tokens = 0
+            items.append(ScheduledSeq(seq, chunk, 0))
+            for i in range(1, nseq):
+                ctx = page_buckets[-1] * page - 1
+                s2 = Sequence(i, [1] * (ctx + 1),
+                              SamplingParams(max_tokens=4))
+                s2.page_table = [1 + (j % max(1, self.num_pages - 1))
+                                 for j in range(page_buckets[-1])]
+                s2.num_computed_tokens = ctx
+                items.append(ScheduledSeq(s2, 1, ctx))
+            self.step(ScheduledBatch(items))
+            mixed += 1
+        logger.info("warmed %d decode + %d mixed shape buckets",
+                    len(combos), mixed)

@@ -51,6 +51,7 @@ class Sequence:
         # preempted, or have its pages freed until its steps land.
         self.num_in_flight = 0
         self.page_table: List[int] = []
+        self._pt_np = None   # np cache of page_table (builder fast path)
         # Pages whose contents came from the prefix cache (KV already valid).
         self.num_cached_tokens = 0
         self.finish_reason: Optional[str] = None
@@ -128,6 +129,10 @@ class Sequence:
         self.num_computed_tokens = 0
         self.num_cached_tokens = 0
         self.page_table = []
+        # the batch builder caches the np form of the page table with
+        # length-only invalidation (append-only growth); every shrink
+        # site must drop it or a same-length regrow serves stale page ids
+        self._pt_np = None
 
     def check_finish(self, eos_token_ids) -> Optional[str]:
         """EOS / stop-token / length check after a token was appended.

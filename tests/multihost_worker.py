@@ -45,6 +45,11 @@ def main():
         jax.distributed.shutdown()
         return
 
+    if mode == "mm":
+        _run_mm(jax, llm, result_path)
+        jax.distributed.shutdown()
+        return
+
     if jax.process_index() == 0:
         results = {}
 
@@ -117,6 +122,49 @@ def _run_http(jax, llm, result_path):
     engine.shutdown()
     with open(result_path, "w") as f:
         json.dump({"status": resp.status, "body": body}, f)
+
+
+
+
+def _run_mm(jax, llm, result_path):
+    """Host 0 submits one image request; pixels ride the intake broadcast
+    and every host rebuilds identical MM state."""
+    import numpy as np
+
+    from gllm_tpu.parallel.multihost_engine import MultihostEngine
+    from gllm_tpu.sampling_params import SamplingParams
+
+    rng = np.random.default_rng(0)
+    pix = rng.standard_normal((16, 24)).astype(np.float32)
+    grid = np.asarray([[1, 4, 4]])
+    ids = [5, 9, 23, 152] + [150] * 4 + [153, 7, 30]
+
+    if jax.process_index() == 0:
+        results = {}
+
+        def on_output(evt):
+            if evt[0] == "out" and evt[1].finish_reason is not None:
+                results[evt[1].seq.seq_id] = evt[1].seq.output_token_ids
+
+        eng = MultihostEngine(llm, on_output=on_output)
+        import threading
+        import time
+        t = threading.Thread(target=eng.run_host0, daemon=True)
+        t.start()
+        sid = eng.submit(ids, SamplingParams(temperature=0.0, max_tokens=4,
+                                             ignore_eos=True),
+                         mm_input={"pixel_values": pix,
+                                   "image_grid_thw": grid})
+        deadline = time.monotonic() + 150
+        while sid not in results and time.monotonic() < deadline:
+            time.sleep(0.05)
+        eng.shutdown()
+        t.join(timeout=30)
+        with open(result_path, "w") as f:
+            json.dump({"output": results.get(sid),
+                       "procs": jax.process_count()}, f)
+    else:
+        MultihostEngine(llm).run_follower()
 
 
 if __name__ == "__main__":

@@ -181,3 +181,25 @@ def test_hybrid_overlap_scheduling_matches(tmp_path):
                                            ignore_eos=True))]
 
     assert run(True) == run(False)
+
+
+def test_hybrid_dp2_matches_dp1(tmp_path):
+    """Hybrid GDN under dp: per-replica SSM pools (stacked leading axis,
+    per-replica intent application) — greedy byte-identity vs dp=1."""
+    from gllm_tpu.config import ParallelConfig
+    make_ckpt(tmp_path)
+    prompts = [[7, 3, 56, 21], [99, 14, 2], [5, 6, 7, 8, 9, 10, 11],
+               [42, 13]]
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    def run(dp):
+        cfg = EngineConfig(
+            model=str(tmp_path), dtype="float32", max_model_len=256,
+            cache=CacheConfig(page_size=4, num_pages=128),
+            parallel=ParallelConfig(dp=dp))
+        llm = LLM(config=cfg)
+        return [o.output_token_ids
+                for o in llm.generate(prompt_token_ids=prompts,
+                                      sampling_params=sp)]
+
+    assert run(2) == run(1)

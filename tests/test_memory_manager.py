@@ -131,3 +131,36 @@ class TestPrefixCache:
         mm.register_computed_pages(a)
         b = Sequence(1, list(range(6)) + [100, 101, 102], SamplingParams())
         assert mm.match_prefix(b) == 8
+
+
+def test_pt_cache_invalidated_on_preempt_and_rollback():
+    """The builder's cached np page-table row must never survive a shrink:
+    a same-length regrow with different page ids (preempt → re-admit)
+    would otherwise write KV into pages owned by other sequences."""
+    import jax
+    import numpy as np
+
+    from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+    from gllm_tpu.runner.prepare import BatchBuilder
+    from gllm_tpu.sampling_params import SamplingParams
+    from gllm_tpu.scheduler import ScheduledBatch, ScheduledSeq
+    from gllm_tpu.sequence import Sequence
+
+    cfg = EngineConfig(max_model_len=64, max_num_seqs=8,
+                       scheduler=SchedulerConfig(max_prefill_tokens=32,
+                                                 max_decode_seqs=8),
+                       cache=CacheConfig(page_size=4, num_pages=32))
+    b = BatchBuilder(cfg, 4, vocab_size=128)
+    seq = Sequence(0, [1, 2, 3, 4, 5, 6, 7], SamplingParams(max_tokens=4))
+    seq.page_table = [3, 4]
+    seq.num_computed_tokens = 0
+    key = jax.random.key(0)
+    sb = ScheduledBatch([ScheduledSeq(seq, 7, 0)])
+    batch, _, _ = b.build(sb, key)
+    assert list(np.asarray(batch.attn.page_table)[0][:2]) == [3, 4]
+
+    seq.preempt()
+    seq.page_table = [9, 10]          # same length, different pages
+    seq.num_computed_tokens = 0
+    batch, _, _ = b.build(ScheduledBatch([ScheduledSeq(seq, 7, 0)]), key)
+    assert list(np.asarray(batch.attn.page_table)[0][:2]) == [9, 10]

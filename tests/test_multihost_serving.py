@@ -116,3 +116,76 @@ def test_two_process_http_serving(tmp_path):
     assert d["status"] == 200, d
     assert d["body"]["choices"][0]["finish_reason"] == "length"
     assert d["body"]["usage"]["completion_tokens"] == 4
+
+
+def test_two_process_mm_serving(tmp_path):
+    """Image request over multi-host: pixels ride the intake broadcast;
+    output matches a single-process run."""
+    import numpy as np
+    from transformers import (Qwen2_5_VLConfig,
+                              Qwen2_5_VLForConditionalGeneration)
+    torch.manual_seed(11)
+    text = dict(vocab_size=160, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=2,
+                intermediate_size=96, max_position_embeddings=512,
+                rms_norm_eps=1e-6, rope_theta=10000.0,
+                tie_word_embeddings=False,
+                rope_scaling={"type": "mrope", "mrope_section": [2, 2, 4]})
+    vision = dict(depth=2, hidden_size=32, intermediate_size=48,
+                  num_heads=4, patch_size=2, temporal_patch_size=2,
+                  in_channels=3, spatial_merge_size=2, out_hidden_size=64,
+                  window_size=8, fullatt_block_indexes=[1],
+                  hidden_act="silu")
+    model_dir = tmp_path / "vl"
+    Qwen2_5_VLForConditionalGeneration(Qwen2_5_VLConfig(
+        text_config=text, vision_config=vision, image_token_id=150,
+        video_token_id=151, vision_start_token_id=152,
+        vision_end_token_id=153, eos_token_id=0,
+        bos_token_id=1)).save_pretrained(model_dir,
+                                         safe_serialization=True)
+
+    result = tmp_path / "result.json"
+    port = free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(port), "2", str(i), str(model_dir),
+         str(result), "mm"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out.decode(errors="replace"))
+            assert p.returncode == 0, outs[-1][-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    d = json.loads(result.read_text())
+    assert d["procs"] == 2 and d["output"], (d, [o[-800:] for o in outs])
+
+    # oracle: single-process run of the same request
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from gllm_tpu.config import CacheConfig, EngineConfig
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.sampling_params import SamplingParams
+    rng = np.random.default_rng(0)
+    pix = rng.standard_normal((16, 24)).astype(np.float32)
+    grid = np.asarray([[1, 4, 4]])
+    ids = [5, 9, 23, 152] + [150] * 4 + [153, 7, 30]
+    llm = LLM(config=EngineConfig(
+        model=str(model_dir), dtype="float32", max_model_len=64,
+        cache=CacheConfig(page_size=4, num_pages=64)))
+    want = llm.generate(
+        prompt_token_ids=[ids],
+        mm_inputs=[{"pixel_values": pix, "image_grid_thw": grid}],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=4,
+                                       ignore_eos=True))[0]
+    assert d["output"] == want.output_token_ids, (d, want.output_token_ids)

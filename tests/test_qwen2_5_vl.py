@@ -342,3 +342,55 @@ def test_build_mm_state_video_only_and_mixed_order():
     hash_arr = np.asarray(st2.hash_token_ids)
     assert set(hash_arr[arr == VID]) == {mm_pad_id(vid_item.hash)}
     assert set(hash_arr[arr == IMG]) == {mm_pad_id(img_item.hash)}
+
+
+def test_vl_dp2_matches_dp1(vl_ckpt):
+    """Multimodal under dp: per-replica ViT embedding + forced mm-buffer
+    structure on the image-less replica — byte-identity vs dp=1."""
+    from gllm_tpu.config import ParallelConfig
+    model_dir, _ = vl_ckpt
+    rng = np.random.default_rng(5)
+    pix, grid, n_tok = make_image(rng)
+    prompts = [vl_prompt([5, 9, 23], n_tok, [7, 30, 41]),
+               [12, 44, 9, 8, 7],       # text-only lands on replica 1
+               vl_prompt([81], n_tok, [3, 3])]
+    mm = [{"pixel_values": pix, "image_grid_thw": grid}, None,
+          {"pixel_values": pix, "image_grid_thw": grid}]
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+
+    def run(dp):
+        cfg = EngineConfig(
+            model=model_dir, dtype="float32", max_model_len=256,
+            cache=CacheConfig(page_size=4, num_pages=128),
+            parallel=ParallelConfig(dp=dp))
+        llm = LLM(config=cfg)
+        return [o.output_token_ids
+                for o in llm.generate(prompt_token_ids=prompts,
+                                      mm_inputs=mm, sampling_params=sp)]
+
+    assert run(2) == run(1)
+
+
+def test_vl_pp2_matches_pp1(vl_ckpt):
+    """Multimodal under pipeline parallelism: stage 0 owns the vision
+    tower (later stages skip_visual); byte-identity vs pp=1."""
+    from gllm_tpu.config import ParallelConfig
+    model_dir, _ = vl_ckpt
+    rng = np.random.default_rng(6)
+    pix, grid, n_tok = make_image(rng)
+    prompts = [vl_prompt([5, 9, 23], n_tok, [7, 30, 41]),
+               [12, 44, 9, 8, 7]]
+    mm = [{"pixel_values": pix, "image_grid_thw": grid}, None]
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+
+    def run(pp):
+        cfg = EngineConfig(
+            model=model_dir, dtype="float32", max_model_len=256,
+            cache=CacheConfig(page_size=4, num_pages=128),
+            parallel=ParallelConfig(pp=pp))
+        llm = LLM(config=cfg)
+        return [o.output_token_ids
+                for o in llm.generate(prompt_token_ids=prompts,
+                                      mm_inputs=mm, sampling_params=sp)]
+
+    assert run(2) == run(1)
