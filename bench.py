@@ -15,11 +15,21 @@ vs_baseline denominator: BASELINE.json's flagship target (2000 output tok/s
 for Llama-3-70B PP=8 on v5e-8 — i.e. ~250 tok/s/chip × 8; a 1B model on one
 chip should beat it by a wide margin; it is the round-over-round yardstick).
 
-Robustness: the default invocation is a supervisor that runs the actual
-benchmark in a child process under a hard deadline, retries once on
-backend-init failure/hang (round 1 died with "Unable to initialize backend
-'axon'" and produced no number), and on unrecoverable failure still prints
-one parseable JSON line with an "error" field.
+Robustness (the rounds 1-2 history: one backend-init crash, one device-side
+stall that wedged the single-tenant tunnel for >40 min):
+ - the default invocation is a supervisor; the measurement runs in a child
+   process under a hard deadline;
+ - before EVERY chip-touching attempt the supervisor probes the tunnel with
+   a fresh short-lived subprocess (``timeout``-bounded ``jax.devices()``)
+   and polls until it answers — a wedged tunnel burns probe time, not
+   measurement time;
+ - attempts run a DEGRADE LADDER: the first profile is the simplest serving
+   loop (multi_step_decode=1, no overlap) to get ANY number; only if that
+   succeeds and budget remains is the full-featured profile tried, and the
+   best successful number wins;
+ - the inner process emits ``[bench phase] <name>`` markers so a timeout's
+   error JSON says *where* it died, and faulthandler dumps stacks every
+   300 s for device-side stalls.
 
 Usage: python bench.py            # real chip (axon/tpu)
        python bench.py --tiny     # CPU smoke (small model, small workload)
@@ -36,37 +46,83 @@ import sys
 import time
 
 METRIC = "sharegpt_output_tok_s_per_chip"
+PHASE_TAG = "[bench phase] "
+
+# Degrade ladder, simplest first (VERDICT r02: the device-side stall is
+# suspected in the multi-step fused decode path — measure without it, then
+# with it, and report the best successful run).
+PROFILES = ("conservative", "full")
 
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def probe_tunnel(deadline, interval=30):
+    """Poll the axon tunnel with fresh bounded subprocesses until
+    ``jax.devices()`` answers. Single-tenant relay: a probe is the only
+    safe way to learn whether the lease is free without wedging a real
+    attempt. Returns True when the tunnel answered."""
+    while time.monotonic() < deadline:
+        t0 = time.monotonic()
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices(); "
+                 "print(jax.default_backend(), len(d))"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, timeout=120)
+            if r.returncode == 0:
+                log(f"[bench supervisor] tunnel probe ok "
+                    f"({time.monotonic()-t0:.0f}s): {r.stdout.strip()!r}")
+                return True
+            log(f"[bench supervisor] tunnel probe rc={r.returncode}: "
+                f"{r.stdout[-300:]!r}")
+        except subprocess.TimeoutExpired:
+            log("[bench supervisor] tunnel probe timed out (120s); "
+                "tunnel busy/wedged, polling again")
+        time.sleep(max(0, interval - (time.monotonic() - t0)))
+    return False
+
+
+def last_phase(text):
+    ph = "start"
+    for line in text.splitlines():
+        if line.startswith(PHASE_TAG):
+            ph = line[len(PHASE_TAG):].strip()
+    return ph
+
+
 def supervise(args, argv):
-    """Run the real benchmark in a child process; retry once; always print
-    one JSON line."""
-    attempts = 2
-    # First attempt gets the full budget (TPU backend init via the tunnel
-    # can take minutes); the retry gets the remainder.
-    deadline = time.monotonic() + (900 if not args.tiny else 420)
-    last_tail = ""
-    for attempt in range(1, attempts + 1):
-        # per-attempt cap so a mid-run hang (wedged tunnel) still leaves
-        # any later attempt a real budget
-        budget = max(60, min(deadline - time.monotonic(), 620))
-        log(f"[bench supervisor] attempt {attempt}/{attempts}, "
-            f"budget {budget:.0f}s")
+    """Degrade-ladder supervisor; always prints one JSON line."""
+    deadline = time.monotonic() + (1020 if not args.tiny else 420)
+    best = None          # best successful (value, profile, extra)
+    last_tail, phase = "", "start"
+    on_chip = not args.tiny
+    for profile in PROFILES:
+        remaining = deadline - time.monotonic()
+        if remaining < 120:
+            break
+        if best is not None and remaining < 360:
+            # don't risk a wedge chasing the full profile on a thin budget
+            break
+        if on_chip and not probe_tunnel(
+                min(deadline - 60, time.monotonic() + remaining / 2)):
+            log("[bench supervisor] tunnel never answered; stopping")
+            break
+        budget = max(60, min(deadline - time.monotonic(), 640))
+        log(f"[bench supervisor] profile={profile}, budget {budget:.0f}s")
         try:
             proc = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--inner"]
-                + argv,
+                [sys.executable, os.path.abspath(__file__), "--inner",
+                 "--profile", profile] + argv,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True, timeout=budget)
             tail = proc.stdout[-8000:]
             sys.stderr.write(tail)
             sys.stderr.flush()
+            phase = last_phase(proc.stdout)
             if proc.returncode == 0:
-                # The inner run prints the JSON line last.
                 for line in reversed(proc.stdout.strip().splitlines()):
                     line = line.strip()
                     if line.startswith("{"):
@@ -75,21 +131,34 @@ def supervise(args, argv):
                         except json.JSONDecodeError:
                             continue
                         if parsed.get("metric") == METRIC:
-                            print(line)
-                            return 0
-            last_tail = tail[-1500:]
+                            if best is None or parsed["value"] > best[0]:
+                                best = (parsed["value"], profile, parsed)
+                            break
+                if best is None:
+                    last_tail = tail[-1500:]
+            else:
+                last_tail = tail[-1500:]
         except subprocess.TimeoutExpired as e:
             out = (e.stdout or b"")
             if isinstance(out, bytes):
                 out = out.decode(errors="replace")
-            last_tail = (out[-1500:] + f"\n[timeout after {budget:.0f}s]")
-            log(f"[bench supervisor] attempt {attempt} timed out")
-        if time.monotonic() >= deadline - 60:
-            break
+            phase = last_phase(out)
+            last_tail = (out[-1500:]
+                         + f"\n[timeout after {budget:.0f}s in phase "
+                           f"'{phase}' profile={profile}]")
+            log(f"[bench supervisor] profile={profile} timed out in "
+                f"phase '{phase}'")
+            # a timeout on chip very likely wedged the tunnel; the next
+            # loop iteration's probe will wait it out
+    if best is not None:
+        value, profile, parsed = best
+        parsed["profile"] = profile
+        print(json.dumps(parsed))
+        return 0
     print(json.dumps({
         "metric": METRIC, "value": 0.0, "unit": "tok/s",
-        "vs_baseline": 0.0,
-        "error": f"benchmark failed after {attempts} attempts: "
+        "vs_baseline": 0.0, "phase": phase,
+        "error": f"no profile produced a number; last phase '{phase}': "
                  + last_tail[-900:],
     }))
     return 0
@@ -113,20 +182,39 @@ def build_workload(rng, n_requests, max_model_len, tiny=False):
     return prompts, params
 
 
+def phase(name):
+    print(PHASE_TAG + name, flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true",
                     help="CPU smoke test (small model/workload)")
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--profile", choices=PROFILES, default="full",
+                    help="serving-loop feature level (degrade ladder)")
     ap.add_argument("--inner", action="store_true",
                     help="(internal) run the measurement directly; without"
                          " this flag a supervisor child-process wrapper"
-                         " with deadline+retry is used")
+                         " with tunnel probe + deadline + degrade ladder"
+                         " is used")
     args = ap.parse_args()
 
     if not args.inner:
-        argv = [a for a in sys.argv[1:] if a != "--inner"]
+        # forward argv minus --inner and any user --profile: the degrade
+        # ladder owns the child's profile flag (last-wins in argparse)
+        argv, skip = [], False
+        for a in sys.argv[1:]:
+            if skip:
+                skip = False
+                continue
+            if a == "--inner" or a.startswith("--profile="):
+                continue
+            if a == "--profile":
+                skip = True
+                continue
+            argv.append(a)
         sys.exit(supervise(args, argv))
 
     # Stall forensics: dump all thread stacks to stderr every 5 minutes so
@@ -140,6 +228,7 @@ def main():
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           os.path.join(os.path.dirname(__file__) or ".",
                                        ".jax_cache"))
+    phase("import_jax")
     import numpy as np
     import jax
     if args.tiny:
@@ -155,6 +244,7 @@ def main():
     from gllm_tpu.engine.llm import LLM
     from gllm_tpu.models.config import ModelConfig
 
+    full = args.profile == "full"
     if args.tiny:
         model_cfg = ModelConfig(
             architecture="LlamaForCausalLM", vocab_size=2048,
@@ -163,6 +253,7 @@ def main():
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="float32", max_model_len=512,
             max_num_seqs=32,
+            overlap_scheduling=full, multi_step_decode=8 if full else 1,
             scheduler=SchedulerConfig(max_prefill_tokens=128,
                                       max_decode_seqs=16),
             cache=CacheConfig(page_size=4, num_pages=512))
@@ -176,8 +267,10 @@ def main():
             rope_theta=500000.0, tie_word_embeddings=True)
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="bfloat16", max_model_len=2048,
-            max_num_seqs=256, overlap_scheduling=True, overlap_depth=4,
-            multi_step_decode=8,
+            max_num_seqs=256,
+            overlap_scheduling=full,
+            overlap_depth=4 if full else 1,
+            multi_step_decode=8 if full else 1,
             scheduler=SchedulerConfig(max_prefill_tokens=1024,
                                       max_decode_seqs=256),
             # explicit pool (4 GB KV): the axon-attached chip advertises
@@ -185,7 +278,10 @@ def main():
             cache=CacheConfig(page_size=16, num_pages=8192))
         n_requests = args.requests or 160
 
-    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    phase("backend_init")
+    log(f"backend={jax.default_backend()} devices={jax.devices()} "
+        f"profile={args.profile}")
+    phase("engine_build")
     t0 = time.monotonic()
     llm = LLM(config=engine_cfg, model_cfg=model_cfg)
     log(f"engine up in {time.monotonic() - t0:.1f}s "
@@ -202,14 +298,17 @@ def main():
 
     # Warmup pass: same workload → compiles every bucket the measured pass
     # will hit (the reference warms its CUDA graphs the same way).
+    phase("warmup_pass")
     t0 = time.monotonic()
     llm.generate(prompt_token_ids=prompts, sampling_params=params)
     log(f"warmup pass: {time.monotonic() - t0:.1f}s")
 
+    phase("measured_pass")
     t0 = time.monotonic()
     outs = llm.generate(prompt_token_ids=prompts, sampling_params=params)
     dt = time.monotonic() - t0
 
+    phase("report")
     out_tokens = sum(o.num_output_tokens for o in outs)
     assert out_tokens == total_out, (out_tokens, total_out)
     value = out_tokens / dt

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Interactive streaming chat REPL against a gllm_tpu api_server.
+
+Role parity with the reference's examples/chat_client.py (OpenAI-client
+REPL with thinking/tool toggles), stdlib-only: SSE parsed straight off
+the chunked HTTP response.
+
+  python -m gllm_tpu.entrypoints.api_server --model <ckpt> &
+  python examples/chat_client.py --port 8000 --thinking
+
+Runtime commands: \\think, \\nothink, \\tools, \\notools, \\reset, \\quit
+"""
+
+import argparse
+import json
+import urllib.request
+
+DEMO_TOOLS = [
+    {"type": "function", "function": {
+        "name": "get_weather",
+        "description": "Get the current weather for a city",
+        "parameters": {"type": "object",
+                       "properties": {"city": {"type": "string"}},
+                       "required": ["city"]}}},
+    {"type": "function", "function": {
+        "name": "calculate",
+        "description": "Evaluate an arithmetic expression",
+        "parameters": {"type": "object",
+                       "properties": {"expression": {"type": "string"}},
+                       "required": ["expression"]}}},
+]
+
+
+def stream_chat(base, body):
+    """POST /v1/chat/completions with stream=true; yields delta dicts."""
+    req = urllib.request.Request(
+        base + "/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        for raw in r:
+            line = raw.decode("utf-8").strip()
+            if not line.startswith("data:"):
+                continue
+            payload = line[len("data:"):].strip()
+            if payload == "[DONE]":
+                return
+            yield json.loads(payload)
+
+
+def main():
+    ap = argparse.ArgumentParser(description="gllm_tpu chat client")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-tokens", type=int, default=1024)
+    ap.add_argument("--thinking", action="store_true",
+                    help="request the model's reasoning block")
+    ap.add_argument("--tools", action="store_true",
+                    help="expose the demo toolset")
+    args = ap.parse_args()
+    base = f"http://{args.host}:{args.port}/v1"
+
+    thinking, tools = args.thinking, args.tools
+    history = []
+    print("chat ready — \\think \\nothink \\tools \\notools \\reset \\quit")
+    while True:
+        try:
+            user = input("> ").strip()
+        except (EOFError, KeyboardInterrupt):
+            break
+        if not user:
+            continue
+        if user == "\\quit":
+            break
+        if user == "\\reset":
+            history = []
+            continue
+        if user in ("\\think", "\\nothink"):
+            thinking = user == "\\think"
+            print(f"[thinking={'on' if thinking else 'off'}]")
+            continue
+        if user in ("\\tools", "\\notools"):
+            tools = user == "\\tools"
+            print(f"[tools={'on' if tools else 'off'}]")
+            continue
+
+        history.append({"role": "user", "content": user})
+        body = {"model": "default", "messages": history, "stream": True,
+                "max_tokens": args.max_tokens,
+                "chat_template_kwargs": {"enable_thinking": thinking}}
+        if tools:
+            body["tools"] = DEMO_TOOLS
+        text, calls = "", {}
+        try:
+            for chunk in stream_chat(base, body):
+                delta = chunk["choices"][0].get("delta", {})
+                if delta.get("content"):
+                    text += delta["content"]
+                    print(delta["content"], end="", flush=True)
+                for tc in delta.get("tool_calls") or []:
+                    slot = calls.setdefault(
+                        tc.get("index", 0),
+                        {"name": "", "arguments": ""})
+                    fn = tc.get("function") or {}
+                    slot["name"] = fn.get("name") or slot["name"]
+                    slot["arguments"] += fn.get("arguments") or ""
+        except KeyboardInterrupt:
+            print("\n[interrupted]")
+        print()
+        msg = {"role": "assistant", "content": text}
+        if calls:
+            msg["tool_calls"] = [
+                {"id": f"call_{i}", "type": "function",
+                 "function": {"name": c["name"],
+                              "arguments": c["arguments"]}}
+                for i, c in sorted(calls.items())]
+            for i, c in sorted(calls.items()):
+                print(f"[tool_call {c['name']}({c['arguments']})]")
+        history.append(msg)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Multimodal chat against a running gllm_tpu api_server.
+
+Role parity with the reference's examples/mm_chat.py (OpenAI client +
+base64 image chat), stdlib-only: images are inlined as ``data:`` URLs in
+OpenAI image_url content parts, which the server decodes through its MM
+pipeline (ViT + token splicing).
+
+  python -m gllm_tpu.entrypoints.api_server --model <qwen-vl-ckpt> &
+  python examples/mm_chat.py --image cat.png "What is in this image?"
+
+Without --image a tiny synthetic RGB gradient is sent (smoke mode — no
+files needed)."""
+
+import argparse
+import base64
+import io
+import json
+import struct
+import urllib.request
+import zlib
+
+
+def synth_png(w=64, h=64):
+    """Minimal in-process PNG writer (RGB gradient) — keeps the example
+    runnable with zero assets."""
+    raw = b""
+    for y in range(h):
+        row = b"\x00"
+        for x in range(w):
+            row += bytes((int(255 * x / w), int(255 * y / h), 128))
+        raw += row
+
+    def chunk(tag, data):
+        c = struct.pack(">I", len(data)) + tag + data
+        return c + struct.pack(">I", zlib.crc32(tag + data) & 0xFFFFFFFF)
+
+    ihdr = struct.pack(">IIBBBBB", w, h, 8, 2, 0, 0, 0)
+    return (b"\x89PNG\r\n\x1a\n" + chunk(b"IHDR", ihdr)
+            + chunk(b"IDAT", zlib.compress(raw)) + chunk(b"IEND", b""))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("prompt", nargs="?",
+                    default="Describe this image in one sentence.")
+    ap.add_argument("--image", help="image file (png/jpeg); synthetic "
+                                    "gradient when omitted")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--max-tokens", type=int, default=128)
+    args = ap.parse_args()
+
+    if args.image:
+        data = open(args.image, "rb").read()
+        mime = ("image/jpeg" if args.image.lower().endswith((".jpg",
+                                                             ".jpeg"))
+                else "image/png")
+    else:
+        data, mime = synth_png(), "image/png"
+    url = f"data:{mime};base64,{base64.b64encode(data).decode()}"
+
+    body = {
+        "model": "default",
+        "max_tokens": args.max_tokens,
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "image_url", "image_url": {"url": url}},
+                {"type": "text", "text": args.prompt},
+            ],
+        }],
+    }
+    req = urllib.request.Request(
+        f"http://{args.host}:{args.port}/v1/chat/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req) as r:
+        out = json.load(io.TextIOWrapper(r, "utf-8"))
+    msg = out["choices"][0]["message"]
+    print(msg.get("content", ""))
+    print(f"[usage] {out.get('usage')}")
+
+
+if __name__ == "__main__":
+    main()

@@ -89,12 +89,20 @@ class EngineConfig:
     # tokens/seq. The decisive lever when dispatch latency is high
     # (remote-attached TPUs); trades up to K-1 wasted steps per EOS.
     multi_step_decode: int = 1
+    # In-flight microbatches for pp>1 (None → pp, the reference's depth:
+    # pp_size batches running, scheduler.py:358-364). 1 forces serialized
+    # launch-collect — the control arm for measuring pipeline overlap.
+    pp_pipeline_depth: Optional[int] = None
     # Quantization: None | "int8" | "fp8" | "int4" (weight-only,
     # per-output-channel, XLA-fused dequant) | "w8a8" (int8 weights +
     # per-token int8 activations on the MXU) — reference quantization
     # stack SURVEY §2.6
     quantization: Optional[str] = None
     enforce_eager: bool = False           # disable donation/async tricks (debug)
+    # Resolve a non-local model id via HF-hub snapshot download (file-lock
+    # serialized, reference model_loader.py hub path). Off by default:
+    # loads are local-path-only unless explicitly opted in.
+    allow_hub_download: bool = False
     attention_impl: str = "auto"          # auto | pallas | xla
     # Disagg LM nodes: drop the vision tower from params after load —
     # visual embeddings arrive from the encoder fleet (reference
@@ -115,6 +123,13 @@ class EngineConfig:
             # analogues here are the async-execution tricks — chained
             # overlap decode and the fused multi-step loop. Plain
             # one-dispatch-per-step execution remains.
+            if self.overlap_scheduling or self.multi_step_decode > 1:
+                import logging
+                logging.getLogger(__name__).warning(
+                    "enforce_eager overrides overlap_scheduling/"
+                    "multi_step_decode (were %s/%d) — plain per-step "
+                    "execution", self.overlap_scheduling,
+                    self.multi_step_decode)
             self.overlap_scheduling = False
             self.multi_step_decode = 1
         if self.cache.page_size <= 0:

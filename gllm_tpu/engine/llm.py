@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 from typing import Callable, List, Optional, Sequence as Seq, Union
 
@@ -78,6 +79,10 @@ class LLM:
         config.validate()
         self.config = config
 
+        if config.model and not os.path.isdir(config.model):
+            from gllm_tpu.models.loader import resolve_model_path
+            config.model = resolve_model_path(
+                config.model, allow_download=config.allow_hub_download)
         if model_cfg is None:
             from gllm_tpu.models.loader import load_hf_config
             model_cfg = from_hf_config(load_hf_config(config.model))
@@ -205,11 +210,6 @@ class LLM:
     def add_seq(self, seq: Sequence) -> None:
         """Admit a sequence, round-robining over DP replicas."""
         sp = seq.sampling_params
-        if (self.dp > 1 or self.config.parallel.pp > 1) \
-                and (sp.logprobs is not None
-                     or sp.prompt_logprobs is not None):
-            raise ValueError(
-                "logprobs are not supported with dp/pp > 1 yet")
         r = self._rr % self.dp
         self._rr += 1
         self._seq_replica[seq.seq_id] = r
@@ -242,7 +242,8 @@ class LLM:
                 return []
         if self.dp > 1:
             return self._step_dp()
-        depth = max(1, self.config.parallel.pp)
+        depth = max(1, self.config.pp_pipeline_depth
+                    or self.config.parallel.pp)
         overlap = (self.config.overlap_scheduling
                    and self.config.parallel.pp == 1)
         if overlap:
@@ -328,10 +329,13 @@ class LLM:
         if all(b is None for b in batches):
             return []
         handle = self.runner.step_async_dp(batches)
-        rows = self.runner.collect_dp(handle)
+        rows, auxes = self.runner.collect_dp(handle)
         outs: List[SeqOutput] = []
-        for sched, b, row in zip(self.schedulers, batches, rows):
+        for sched, b, row, aux in zip(self.schedulers, batches, rows,
+                                      auxes):
             if b is not None:
+                if aux:
+                    self._record_logprobs(b, aux)
                 outs.extend(sched.process_output(b, row.tolist(),
                                                  self.eos_token_ids))
         self._check_stop_strings(outs)
@@ -470,10 +474,35 @@ class LLM:
                                  mm_inputs=[mm_input])[0]
         if self.tokenizer is None:
             raise ValueError("chat() requires a tokenizer")
-        ids = self.tokenizer.apply_chat_template(
-            messages, add_generation_prompt=True, **kwargs)
+        ids = self.render_chat_ids(messages, **kwargs)
         return self.generate(prompt_token_ids=[ids],
                              sampling_params=sampling_params)[0]
+
+    @property
+    def dsv32_encoder(self):
+        """The DeepSeek-V3.2 checkpoint-bundled message encoder, or None
+        (lazy; cached by model path in gllm_tpu.tokenizers)."""
+        if (self.model_cfg.architecture != "DeepseekV32ForCausalLM"
+                or not self.config.model):
+            return None
+        from gllm_tpu.tokenizers.deepseek_v32 import load_encoder
+        return load_encoder(self.config.model)
+
+    def render_chat_ids(self, messages, **kwargs) -> List[int]:
+        """Chat messages → prompt token ids: the model-native DSv3.2
+        encoder when the checkpoint bundles one, else the tokenizer's
+        chat template (reference api_server.py:554-567)."""
+        enc = self.dsv32_encoder
+        if enc is not None:
+            from gllm_tpu.tokenizers.deepseek_v32 import render_chat
+            tools = kwargs.pop("tools", None)
+            return render_chat(enc, messages, self.tokenizer,
+                               tools=tools, **kwargs)
+        ids = self.tokenizer.apply_chat_template(
+            messages, add_generation_prompt=True, **kwargs)
+        if ids and isinstance(ids[0], list):
+            ids = ids[0]
+        return [int(t) for t in ids]
 
     @property
     def processor(self):

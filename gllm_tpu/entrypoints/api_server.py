@@ -70,9 +70,9 @@ class ServerState:
         tok = self.llm.tokenizer
         if tok is None:
             raise proto.ProtocolError("server has no tokenizer loaded")
-        return tok.apply_chat_template(req.messages,
-                                       add_generation_prompt=True,
-                                       **kwargs), None
+        # render_chat_ids prefers the checkpoint's bundled DSv3.2 message
+        # encoder (model-native DSML markup) over the generic template
+        return self.llm.render_chat_ids(req.messages, **kwargs), None
 
     def encode_completion(self, req: proto.CompletionRequest):
         if isinstance(req.prompt, list):
@@ -225,27 +225,106 @@ class Handler(BaseHTTPRequestHandler):
 
     # ---- chat / completions ----------------------------------------------
 
+    def _submit_choices(self, req, ids, mm_input, disagg_items,
+                        count=None, rank_logprobs=False):
+        """Submit ``count`` (default ``n``) independent sequences for one
+        request (explicit seeds step per choice so seeded requests still
+        differ); ``rank_logprobs`` forces chosen-logprob collection for
+        best_of ranking."""
+        import dataclasses as dc
+        st = self.state
+        handles = []
+        try:
+            for i in range(count if count is not None else req.n):
+                sp = dc.replace(req.sampling)
+                if sp.seed is not None:
+                    sp.seed = sp.seed + i
+                if rank_logprobs and sp.logprobs is None:
+                    sp.logprobs = 0      # chosen-logprob only, for ranking
+                handles.append(st.engine.submit(list(ids), sp,
+                                                mm_input=mm_input,
+                                                disagg_items=disagg_items))
+        except Exception:
+            # partial submit must not leak running sequences: abort the
+            # choices already admitted before re-raising
+            for h in handles:
+                st.engine.abort(h.seq_id)
+            raise
+        return handles
+
+    def _sse_open(self, handles, *chunks) -> bool:
+        """Send the SSE preamble (headers + any role chunks). A client
+        that disconnected in the submit→stream window otherwise escapes
+        every downstream abort handler and leaves the admitted sequences
+        generating with no consumer — abort them here instead."""
+        try:
+            self._sse_start()
+            for c in chunks:
+                self._sse(c)
+            return True
+        except (BrokenPipeError, ConnectionResetError):
+            for h in handles:
+                self.state.engine.abort(h.seq_id)
+            return False
+
+    def _stream_many(self, handles, make_chunk):
+        """Interleave n request streams into one SSE stream with
+        per-choice indices (OpenAI ``stream`` + ``n > 1`` semantics —
+        VERDICT r2 parity closure; each handle drains on its own thread
+        into a merged queue, so a slow choice never stalls the others)."""
+        import queue as _q
+        import threading
+        merged: "_q.Queue" = _q.Queue()
+
+        def pump(i, h):
+            # the sentinel MUST go up even if the handle iterator raises,
+            # or the merge loop below waits forever on a dead choice; the
+            # error rides along so the consumer can abort the siblings
+            err = None
+            try:
+                for c in h:
+                    merged.put((i, c))
+            except Exception as e:       # noqa: BLE001 — surfaced below
+                err = e
+            merged.put((i, (None, err)))
+
+        for i, h in enumerate(handles):
+            threading.Thread(target=pump, args=(i, h),
+                             daemon=True).start()
+        done, first_err = 0, None
+        try:
+            while done < len(handles):
+                i, c = merged.get()
+                if isinstance(c, tuple):
+                    done += 1
+                    first_err = first_err or c[1]
+                    continue
+                self._sse(make_chunk(c.text or "", c.finish_reason, i))
+            if first_err is not None:
+                # a choice died mid-stream: abort the rest and close the
+                # connection without [DONE] so the client sees a broken
+                # stream, matching the single-choice path's behavior
+                raise first_err
+            self.wfile.write(b"data: [DONE]\n\n")
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            for h in handles:
+                self.state.engine.abort(h.seq_id)
+        except Exception:
+            for h in handles:
+                self.state.engine.abort(h.seq_id)
+            raise
+
     def _run_choices(self, req, ids, mm_input=None):
         """Submit best_of sequences, collect all, rank by mean logprob when
         best_of > n, return the top n collected dicts (reference n/best_of
-        semantics, protocol.py:170-203)."""
-        import dataclasses as dc
-        st = self.state
-        par = st.llm.config.parallel
-        # Ranking needs per-token logprobs, which dp/pp don't support yet —
-        # degrade to first-n there rather than failing the request.
-        rank = req.best_of > req.n and par.dp == 1 and par.pp == 1
+        semantics, protocol.py:170-203). Logprobs now flow under dp/pp
+        too, so ranking works under every parallel mode."""
+        rank = req.best_of > req.n
         mm_input, disagg_items = _split_disagg(mm_input)
-        handles = []
-        for i in range(req.best_of):
-            sp = dc.replace(req.sampling)
-            if sp.seed is not None:
-                sp.seed = sp.seed + i
-            if rank and sp.logprobs is None:
-                sp.logprobs = 0      # chosen-logprob only, for ranking
-            handles.append(st.engine.submit(list(ids), sp,
-                                            mm_input=mm_input,
-                                            disagg_items=disagg_items))
+        handles = self._submit_choices(req, ids, mm_input, disagg_items,
+                                       count=req.best_of,
+                                       rank_logprobs=rank)
         results = [self._collect(h) for h in handles]
         if rank:
             def score(r):
@@ -267,8 +346,6 @@ class Handler(BaseHTTPRequestHandler):
         req = proto.ChatCompletionRequest.from_dict(
             self._read_json(), default_max_tokens=256)
         ids, mm_input = st.encode_chat(req)
-        if req.stream and req.n > 1:
-            raise proto.ProtocolError("stream with n > 1 is not supported")
         if not req.stream:
             results, usage = self._run_choices(req, ids, mm_input)
             choices = []
@@ -291,10 +368,29 @@ class Handler(BaseHTTPRequestHandler):
                                                       usage))
             return
         mm_input, disagg_items = _split_disagg(mm_input)
+        parse_tools = bool(req.tools) and req.tool_choice != "none"
+        if req.n > 1:
+            if parse_tools:
+                raise proto.ProtocolError(
+                    "stream with n > 1 and tool parsing is not supported")
+            rid = proto.new_request_id(chat=True)
+            # submit BEFORE the SSE headers go out: a submit-time
+            # validation error (e.g. prompt > max_model_len) must still
+            # surface as a clean JSON error, not a dead 200 stream
+            handles = self._submit_choices(req, ids, mm_input,
+                                           disagg_items)
+            if not self._sse_open(handles, *[
+                    proto.chat_completion_chunk(rid, req.model, None, None,
+                                                role=True, index=i)
+                    for i in range(req.n)]):
+                return
+            self._stream_many(handles, lambda text, fin, i: proto.
+                              chat_completion_chunk(rid, req.model, text,
+                                                    fin, index=i))
+            return
         handle = st.engine.submit(list(ids), req.sampling,
                                   mm_input=mm_input,
                                   disagg_items=disagg_items)
-        parse_tools = bool(req.tools) and req.tool_choice != "none"
         if req.stream and parse_tools:
             # Incremental tool streaming (reference streams tool deltas):
             # text deltas flow through live; only potential-markup suffixes
@@ -304,9 +400,10 @@ class Handler(BaseHTTPRequestHandler):
             stream = StreamingToolCalls(st.tool_parser,
                                         schemas_from_tools(req.tools))
             rid = proto.new_request_id(chat=True)
-            self._sse_start()
-            self._sse(proto.chat_completion_chunk(rid, req.model, None, None,
-                                                  role=True))
+            if not self._sse_open(
+                    [handle], proto.chat_completion_chunk(
+                        rid, req.model, None, None, role=True)):
+                return
 
             def emit(text, deltas):
                 if text:
@@ -334,9 +431,10 @@ class Handler(BaseHTTPRequestHandler):
                 st.engine.abort(handle.seq_id)
         elif req.stream:
             rid = proto.new_request_id(chat=True)
-            self._sse_start()
-            self._sse(proto.chat_completion_chunk(rid, req.model, None, None,
-                                                  role=True))
+            if not self._sse_open(
+                    [handle], proto.chat_completion_chunk(
+                        rid, req.model, None, None, role=True)):
+                return
             self._stream(handle, lambda text, fin: proto.
                          chat_completion_chunk(rid, req.model, text, fin))
 
@@ -345,12 +443,22 @@ class Handler(BaseHTTPRequestHandler):
         req = proto.CompletionRequest.from_dict(
             self._read_json(), default_max_tokens=256)
         ids = st.encode_completion(req)
-        if req.stream and req.n > 1:
-            raise proto.ProtocolError("stream with n > 1 is not supported")
         if req.stream:
-            handle = st.engine.submit(ids, req.sampling)
             rid = proto.new_request_id(chat=False)
-            self._sse_start()
+            # submit before the SSE headers (see _chat): submit errors
+            # still get a JSON error response
+            if req.n > 1:
+                handles = self._submit_choices(req, ids, None, None)
+                if not self._sse_open(handles):
+                    return
+                self._stream_many(handles, lambda text, fin, i: proto.
+                                  completion_chunk(rid, req.model,
+                                                   text or "", fin,
+                                                   index=i))
+                return
+            handle = st.engine.submit(ids, req.sampling)
+            if not self._sse_open([handle]):
+                return
             self._stream(handle, lambda text, fin: proto.completion_chunk(
                 rid, req.model, text or "", fin))
             return
@@ -443,6 +551,7 @@ def build_engine_config(args) -> EngineConfig:
         max_model_len=args.max_model_len,
         max_num_seqs=args.max_num_seqs,
         load_format=args.load_format,
+        allow_hub_download=args.allow_hub_download,
         attention_impl=args.attention_impl,
         overlap_scheduling=args.overlap_scheduling,
         quantization=args.quantization,
@@ -499,6 +608,10 @@ def make_parser() -> argparse.ArgumentParser:
                    choices=["int8", "fp8", "int4", "w8a8", "fp8_block"],
                    help="weight-only quantization")
     p.add_argument("--enable-prefix-caching", action="store_true")
+    p.add_argument("--allow-hub-download", action="store_true",
+                   help="resolve a non-local model id via HF-hub snapshot "
+                        "download (file-lock serialized); default is "
+                        "local-path-only")
     p.add_argument("--overlap-scheduling", action="store_true",
                    help="chain decode steps on-device (no host round trip "
                         "between decode iterations)")

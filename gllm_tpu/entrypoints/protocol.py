@@ -227,7 +227,7 @@ def chat_completion_response(model: str, choices: list,
 
 def chat_completion_chunk(rid: str, model: str, delta: Optional[str],
                           finish_reason: Optional[str],
-                          role: bool = False) -> dict:
+                          role: bool = False, index: int = 0) -> dict:
     d: Dict[str, Any] = {}
     if role:
         d["role"] = "assistant"
@@ -238,7 +238,7 @@ def chat_completion_chunk(rid: str, model: str, delta: Optional[str],
         "object": "chat.completion.chunk",
         "created": int(time.time()),
         "model": model,
-        "choices": [{"index": 0, "delta": d,
+        "choices": [{"index": index, "delta": d,
                      "finish_reason": finish_reason}],
     }
 
@@ -259,13 +259,14 @@ def completion_response(model: str, choices: list, usage: dict) -> dict:
 
 
 def completion_chunk(rid: str, model: str, delta: str,
-                     finish_reason: Optional[str]) -> dict:
+                     finish_reason: Optional[str],
+                     index: int = 0) -> dict:
     return {
         "id": rid,
         "object": "text_completion",
         "created": int(time.time()),
         "model": model,
-        "choices": [{"index": 0, "text": delta,
+        "choices": [{"index": index, "text": delta,
                      "finish_reason": finish_reason, "logprobs": None}],
     }
 

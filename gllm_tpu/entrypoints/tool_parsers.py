@@ -119,7 +119,12 @@ class QwenToolParser(ToolParser):
             try:
                 obj = json.loads(m.group(1))
             except json.JSONDecodeError:
-                continue                # malformed stays for finish()
+                # Malformed unit: stop consuming HERE so `end` never
+                # advances past it — the markup stays in the buffer for
+                # finish() to surface as content, matching the
+                # non-streaming parse() (a later valid unit must not
+                # swallow it).
+                break
             args = obj.get("arguments", {})
             name = obj.get("name", "")
             if isinstance(args, dict) and schemas:

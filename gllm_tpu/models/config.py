@@ -143,14 +143,24 @@ class ModelConfig:
         return "linear_attention" in self.layer_types
 
     @property
+    def stage_layer_types(self) -> Tuple[str, ...]:
+        """layer_types restricted to this PP stage's layer range."""
+        a, b = self.stage_layers
+        return self.layer_types[a:b]
+
+    @property
     def num_attn_layers(self) -> int:
+        """Full-attention layers OWNED BY THIS STAGE (= the whole model
+        when un-staged) — sizes the stage's paged-KV stack."""
         if not self.layer_types:
-            return self.num_layers
-        return sum(1 for t in self.layer_types if t == "full_attention")
+            return self.num_stage_layers
+        return sum(1 for t in self.stage_layer_types
+                   if t == "full_attention")
 
     @property
     def num_linear_layers(self) -> int:
-        return sum(1 for t in self.layer_types if t == "linear_attention")
+        return sum(1 for t in self.stage_layer_types
+                   if t == "linear_attention")
 
     @property
     def gdn_conv_dim(self) -> int:
@@ -289,15 +299,23 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
               "eos_token_id": hf.get("eos_token_id",
                                      text.get("eos_token_id"))}
     if arch in ("Qwen3NextForCausalLM", "Qwen3_5ForCausalLM",
-                "Qwen3_5MoeForCausalLM"):
+                "Qwen3_5MoeForCausalLM", "Qwen3_5ForConditionalGeneration",
+                "Qwen3_5MoeForConditionalGeneration"):
+        # Real Qwen3.5 checkpoints use the *ForConditionalGeneration arch
+        # string and may nest the LM under text_config (reference reads
+        # attrs with a text_config fallback, model_loader.py:180-201).
+        text = dict(hf.get("text_config") or hf)
         extra = dict(
-            layer_types=tuple(hf.get("layer_types", ())),
-            linear_num_value_heads=hf.get("linear_num_value_heads", 0),
-            linear_num_key_heads=hf.get("linear_num_key_heads", 0),
-            linear_key_head_dim=hf.get("linear_key_head_dim", 0),
-            linear_value_head_dim=hf.get("linear_value_head_dim", 0),
-            linear_conv_kernel_dim=hf.get("linear_conv_kernel_dim", 4),
+            layer_types=tuple(text.get("layer_types", ())),
+            linear_num_value_heads=text.get("linear_num_value_heads", 0),
+            linear_num_key_heads=text.get("linear_num_key_heads", 0),
+            linear_key_head_dim=text.get("linear_key_head_dim", 0),
+            linear_value_head_dim=text.get("linear_value_head_dim", 0),
+            linear_conv_kernel_dim=text.get("linear_conv_kernel_dim", 4),
         )
+        hf = {**text, "architectures": [arch],
+              "eos_token_id": hf.get("eos_token_id",
+                                     text.get("eos_token_id"))}
     num_heads = hf["num_attention_heads"]
     hidden = hf["hidden_size"]
     head_dim = hf.get("head_dim") or hidden // num_heads
@@ -311,11 +329,21 @@ def from_hf_config(hf: Dict[str, Any]) -> ModelConfig:
     # WITHOUT the sandwich norms
     is_glm = arch in ("GlmForCausalLM", "ChatGLMModel",
                       "ChatGLMForConditionalGeneration")
-    attention_bias = hf.get("attention_bias",
-                            arch in ("Qwen2ForCausalLM",
-                                     "Qwen2MoeForCausalLM",
-                                     "Qwen2_5_VLForConditionalGeneration",
-                                     "Qwen2VLForConditionalGeneration"))
+    # HF's Qwen2-family attention is bias=True UNCONDITIONALLY
+    # (modeling_qwen2.py nn.Linear(..., bias=True)): the checkpoint
+    # always carries q/k/v biases even when config.json says
+    # attention_bias=false, so the config key must not be trusted for
+    # these archs (a false value would shrink our param template and the
+    # loader would reject the checkpoint's bias tensors). The reverse
+    # direction is safe: if a nonstandard bias-free export ever omits the
+    # tensors, the loader leaves the template's zero biases in place —
+    # mathematically identical to no bias.
+    if arch in ("Qwen2ForCausalLM", "Qwen2MoeForCausalLM",
+                "Qwen2_5_VLForConditionalGeneration",
+                "Qwen2VLForConditionalGeneration"):
+        attention_bias = True
+    else:
+        attention_bias = hf.get("attention_bias", False)
     return ModelConfig(
         architecture=arch,
         vocab_size=hf["vocab_size"],

@@ -35,7 +35,7 @@ from gllm_tpu.models.moe import select_experts
 from gllm_tpu.ops import (fused_add_rms_norm, paged_attention, rms_norm,
                           silu_and_mul)
 from gllm_tpu.ops.attention import AttentionMetadata
-from gllm_tpu.ops.quant import deq, qmm
+from gllm_tpu.ops.quant import deq, qmm, qragged_dot
 from gllm_tpu.ops.rope import (apply_rope_interleaved, compute_rope_cos_sin,
                                yarn_softmax_scale_mult)
 
@@ -113,12 +113,12 @@ def _moe_block(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
     weights, ids = deepseek_route(logits, lp.get("e_bias"), cfg)
 
-    w_gate = deq(lp["w_gate"], x.dtype)
-    w_up = deq(lp["w_up"], x.dtype)
-    w_down = deq(lp["w_down"], x.dtype)
     if cfg.moe_force_dense:
         # DP vmap path — ragged grouped GEMM has no usable batch rule
         # (see gllm_tpu/models/moe.py dense fallback).
+        w_gate = deq(lp["w_gate"], x.dtype)
+        w_up = deq(lp["w_up"], x.dtype)
+        w_down = deq(lp["w_down"], x.dtype)
         combined = jnp.zeros((T, H), jnp.float32)
         wf = weights.astype(jnp.float32)
         for e in range(E):
@@ -133,11 +133,12 @@ def _moe_block(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
         sort_idx = jnp.argsort(flat_ids)
         token_of = sort_idx // K
         xs = x[token_of]
+        sorted_eids = flat_ids[sort_idx]
         group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
-        gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
-        up = jax.lax.ragged_dot(xs, w_up, group_sizes)
+        gate = qragged_dot(xs, lp["w_gate"], group_sizes, sorted_eids)
+        up = qragged_dot(xs, lp["w_up"], group_sizes, sorted_eids)
         act = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
-        out = jax.lax.ragged_dot(act, w_down, group_sizes)
+        out = qragged_dot(act, lp["w_down"], group_sizes, sorted_eids)
         w_sorted = weights.reshape(-1)[sort_idx][:, None].astype(out.dtype)
         combined = jnp.zeros((T, H), out.dtype).at[token_of].add(
             out * w_sorted)

@@ -47,8 +47,10 @@ class HybridKV(NamedTuple):
 
 
 def period_pattern(cfg: ModelConfig) -> Tuple[str, ...]:
-    """Smallest repeating layer-type pattern; raises if non-periodic."""
-    lt = cfg.layer_types
+    """Smallest repeating layer-type pattern of THIS STAGE's layers;
+    raises if non-periodic (PP stage bounds must align to the period —
+    pp_runner.split_layers rounds hybrid stages to period multiples)."""
+    lt = cfg.stage_layer_types
     assert lt, "hybrid model needs layer_types"
     L = len(lt)
     for p in range(1, L + 1):
@@ -87,7 +89,8 @@ def init_params(cfg: ModelConfig, seed: int = 0,
                 dtype=jnp.bfloat16) -> Params:
     H, D = cfg.hidden_size, cfg.head_dim
     Hq, Hkv = cfg.num_heads, cfg.num_kv_heads
-    La, Lg, L = cfg.num_attn_layers, cfg.num_linear_layers, cfg.num_layers
+    La, Lg = cfg.num_attn_layers, cfg.num_linear_layers
+    L = cfg.num_stage_layers
     Nk, Nv = cfg.linear_num_key_heads, cfg.linear_num_value_heads
     Dk, Dv = cfg.linear_key_head_dim, cfg.linear_value_head_dim
     K = cfg.linear_conv_kernel_dim
@@ -296,7 +299,7 @@ def forward(params: Params, kv: HybridKV, batch: StepBatch,
     p = len(pattern)
     n_lin = sum(1 for t in pattern if t == "linear_attention")
     n_att = p - n_lin
-    n_periods = cfg.num_layers // p
+    n_periods = cfg.num_stage_layers // p
 
     if cfg.is_first_stage:
         hidden = params["embed"][batch.token_ids]
@@ -373,10 +376,14 @@ compute_logits = dense.compute_logits
 
 def hybrid_rules(cfg: ModelConfig):
     """Qwen3-Next checkpoint → our stacked layout. Layer index i maps to
-    a per-kind index (i-th attention layer / i-th linear layer)."""
+    a per-kind index (i-th attention layer / i-th linear layer of THIS
+    STAGE); out-of-stage layers are skipped (PP-pruned loading)."""
+    first, last = cfg.stage_layers
     attn_index = {}
     lin_index = {}
     for i, t in enumerate(cfg.layer_types):
+        if not (first <= i < last):
+            continue
         if t == "full_attention":
             attn_index[i] = len(attn_index)
         else:
@@ -429,11 +436,12 @@ def hybrid_rules(cfg: ModelConfig):
 
     def rule(name: str):
         if name == "model.embed_tokens.weight":
-            return (("embed",), None, None)
+            return (("embed",), None, None) if cfg.is_first_stage else None
         if name == "model.norm.weight":
-            return (("__multi__",), None, plus1("final_norm"))
+            return ((("__multi__",), None, plus1("final_norm"))
+                    if cfg.is_last_stage else None)
         if name == "lm_head.weight":
-            if not cfg.tie_word_embeddings:
+            if cfg.is_last_stage and not cfg.tie_word_embeddings:
                 return (("lm_head",), None, "t")
             return None
         if not name.startswith("model.layers."):
@@ -441,6 +449,8 @@ def hybrid_rules(cfg: ModelConfig):
         rest = name[len("model.layers."):]
         idx_s, _, leaf = rest.partition(".")
         i = int(idx_s)
+        if not (first <= i < last):
+            return None   # other PP stage's layer
         if leaf == "linear_attn.conv1d.weight":
             return (("gdn_layers", "__multi__"), lin_index[i], conv_tf)
         if leaf in attn_leaves:
@@ -451,13 +461,13 @@ def hybrid_rules(cfg: ModelConfig):
             return (("gdn_layers", target), lin_index[i], tf)
         if leaf in mlp_leaves:
             target, tf = mlp_leaves[leaf]
-            return (("mlp_layers", target), i, tf)
+            return (("mlp_layers", target), i - first, tf)
         if leaf.startswith("mlp.experts."):
             rest2 = leaf[len("mlp.experts."):]
             e_s, _, el = rest2.partition(".")
             if el in expert_leaves:
                 target, tf = expert_leaves[el]
-                return (("mlp_layers", target), (i, int(e_s)), tf)
+                return (("mlp_layers", target), (i - first, int(e_s)), tf)
         return None
 
     return rule

@@ -26,6 +26,39 @@ import numpy as np
 from gllm_tpu.models.config import ModelConfig, from_hf_config
 
 
+def resolve_model_path(model: str, allow_download: bool = False,
+                       cache_dir: str = None) -> str:
+    """Local dir → as-is; HF-hub id → snapshot download behind a flag.
+
+    The reference resolves hub ids with snapshot_download under a file
+    lock so concurrent workers don't race the same download
+    (model_loader.py hub path). Same here: an fcntl lock per model id in
+    the cache dir serializes the fetch; loads stay local-path-only unless
+    ``allow_download`` (CLI --allow-hub-download) — this image is
+    zero-egress, so downloads must be an explicit opt-in."""
+    if os.path.isdir(model):
+        return model
+    if not allow_download:
+        raise ValueError(
+            f"model path {model!r} is not a local directory; pass "
+            "--allow-hub-download to fetch it from the HF hub")
+    import fcntl
+    import hashlib
+    cache_dir = cache_dir or os.path.join(
+        os.path.expanduser("~"), ".cache", "gllm_tpu")
+    lock_dir = os.path.join(cache_dir, "locks")
+    os.makedirs(lock_dir, exist_ok=True)
+    lock_path = os.path.join(
+        lock_dir, hashlib.sha256(model.encode()).hexdigest()[:24] + ".lock")
+    with open(lock_path, "w") as lf:
+        fcntl.flock(lf, fcntl.LOCK_EX)
+        try:
+            from huggingface_hub import snapshot_download
+            return snapshot_download(model, cache_dir=cache_dir)
+        finally:
+            fcntl.flock(lf, fcntl.LOCK_UN)
+
+
 def load_hf_config(model_dir: str) -> dict:
     with open(os.path.join(model_dir, "config.json")) as f:
         hf = json.load(f)

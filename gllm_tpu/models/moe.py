@@ -31,7 +31,7 @@ from gllm_tpu.models import dense
 from gllm_tpu.models.config import ModelConfig
 from gllm_tpu.models.dense import KVCache
 from gllm_tpu.ops import silu_and_mul
-from gllm_tpu.ops.quant import deq, qmm
+from gllm_tpu.ops.quant import deq, qmm, qragged_dot
 
 Params = dict
 
@@ -57,16 +57,14 @@ def moe_mlp(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     router_logits = x.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
     weights, ids = select_experts(router_logits, K, cfg.norm_topk_prob)
 
-    # Quantized expert stacks dequantize once per call (XLA keeps the
-    # narrow copy in HBM; the dense copy is a fused transient).
-    w_gate = deq(lp["w_gate"], x.dtype)
-    w_up = deq(lp["w_up"], x.dtype)
-    w_down = deq(lp["w_down"], x.dtype)
     if cfg.moe_force_dense:
         # Under vmap (DP replicas in one program) lax.ragged_dot's batch
         # rule can't handle the carried-weight layout — fall back to a
-        # masked dense loop over experts. TODO: shard_map over the dp axis
-        # so each replica runs the ragged grouped GEMM natively.
+        # masked dense loop over experts. (The dp Pallas path runs under
+        # shard_map manual over dp, where the ragged GEMM works natively.)
+        w_gate = deq(lp["w_gate"], x.dtype)
+        w_up = deq(lp["w_up"], x.dtype)
+        w_down = deq(lp["w_down"], x.dtype)
         combined = jnp.zeros((T, H), jnp.float32)
         wf = weights.astype(jnp.float32)
         for e in range(E):
@@ -78,17 +76,21 @@ def moe_mlp(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
         combined = combined.astype(x.dtype)
     else:
         # Sort token-replicas by expert id → contiguous per-expert groups.
+        # Quantized stacks go through qragged_dot: W8A8 experts run the
+        # int8 MXU grouped GEMM with epilogue scales (no dequantized
+        # stack materialized); weight-only stacks cast in the transient.
         flat_ids = ids.reshape(-1)                      # [T*K]
         sort_idx = jnp.argsort(flat_ids)                # [T*K]
         token_of = sort_idx // K                        # source token rows
         xs = x[token_of]                                # [T*K, H]
+        sorted_eids = flat_ids[sort_idx]                # [T*K]
         group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
 
-        gate = jax.lax.ragged_dot(xs, w_gate, group_sizes)
-        up = jax.lax.ragged_dot(xs, w_up, group_sizes)
+        gate = qragged_dot(xs, lp["w_gate"], group_sizes, sorted_eids)
+        up = qragged_dot(xs, lp["w_up"], group_sizes, sorted_eids)
         act = silu_and_mul(jnp.concatenate([gate, up], axis=-1))
-        out = jax.lax.ragged_dot(act, w_down,
-                                 group_sizes)           # [T*K, H]
+        out = qragged_dot(act, lp["w_down"], group_sizes,
+                          sorted_eids)                  # [T*K, H]
 
         # Weight by routing prob and scatter-add back to token rows.
         w_sorted = weights.reshape(-1)[sort_idx][:, None].astype(out.dtype)

@@ -166,6 +166,10 @@ _HYBRID_ARCHS = (
     "Qwen3NextForCausalLM",
     "Qwen3_5ForCausalLM",
     "Qwen3_5MoeForCausalLM",
+    # Real Qwen3.5 checkpoints ship the ConditionalGeneration arch string
+    # (reference model_loader.py:527-531); same hybrid GDN stack.
+    "Qwen3_5ForConditionalGeneration",
+    "Qwen3_5MoeForConditionalGeneration",
 )
 
 

@@ -122,13 +122,25 @@ def _pallas_sharded(q, k_cache, v_cache, metadata, *, scale, max_q_len,
                                 max_q_len=max_q_len, impl="pallas",
                                 v_dim=v_dim)
 
+    # Inside an already-set mesh context (the runner's step trace, or the
+    # dp-manual shard_map region where the dp axis is Manual) the inner
+    # shard_map must bind the CONTEXT abstract mesh with only the tp axis
+    # going manual (mesh=None infers it). Standalone (unit tests, no
+    # context) the concrete mesh is bound fully-manual — partial-manual
+    # over a concrete multi-axis mesh trips spec normalization on
+    # replicated in_specs.
+    am = jax.sharding.get_abstract_mesh()
+    if am is not None and am.shape_tuple:
+        kw = dict(mesh=None, axis_names={axis})
+    else:
+        kw = dict(mesh=mesh)
     if v_cache is None:
-        fn = shard_map(lambda q, k, md: inner(q, k, None, md), mesh=mesh,
+        fn = shard_map(lambda q, k, md: inner(q, k, None, md),
                        in_specs=(qs, ks, md_specs), out_specs=qs,
-                       check_vma=False)
+                       check_vma=False, **kw)
         return fn(q, k_cache, metadata)
-    fn = shard_map(inner, mesh=mesh, in_specs=(qs, ks, ks, md_specs),
-                   out_specs=qs, check_vma=False)
+    fn = shard_map(inner, in_specs=(qs, ks, ks, md_specs),
+                   out_specs=qs, check_vma=False, **kw)
     return fn(q, k_cache, v_cache, metadata)
 
 

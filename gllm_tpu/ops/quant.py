@@ -132,6 +132,38 @@ def deq(w, dtype=jnp.bfloat16) -> jnp.ndarray:
     return w
 
 
+def qragged_dot(xs: jnp.ndarray, w, group_sizes: jnp.ndarray,
+                expert_ids: jnp.ndarray = None) -> jnp.ndarray:
+    """Grouped (ragged) GEMM against a plain or quantized expert stack
+    ([E, in, out]); rows of ``xs`` are expert-sorted.
+
+    ``QuantizedW8A8`` stacks run the int8×int8 MXU grouped GEMM with int32
+    accumulation and rescale in the epilogue — per-token activation scale
+    × per-(expert, output-channel) weight scale gathered by
+    ``expert_ids`` ([R] i32, the row's expert). This is the compute-win
+    analogue of the reference's fused quantized MoE GEMMs
+    (layers/moe/fused_moe_triton/layer.py:229-552, quantization/fp8.py) —
+    no dense dequantized copy of the expert stack exists anywhere.
+
+    Weight-only stacks (int8/fp8/int4/fp8_block) dequantize into the GEMM
+    transient by design: their contract is bf16 activations × narrow
+    storage (the reference W4A16 Marlin semantics); TPU has no mixed
+    int×bf16 MXU mode, so the cast rides the GEMM epilogue fusion."""
+    if isinstance(w, QuantizedW8A8):
+        assert expert_ids is not None, "W8A8 ragged GEMM needs expert ids"
+        xf = xs.astype(jnp.float32)
+        x_absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+        x_scale = jnp.maximum(x_absmax / 127.0, 1e-9)
+        xq = jnp.clip(jnp.round(xf / x_scale), -127, 127).astype(jnp.int8)
+        acc = jax.lax.ragged_dot(
+            xq, w.q, group_sizes,
+            preferred_element_type=jnp.int32).astype(jnp.float32)
+        w_scale = jnp.squeeze(w.scale.astype(jnp.float32),
+                              axis=-2)[expert_ids]       # [R, out]
+        return (acc * x_scale * w_scale).astype(xs.dtype)
+    return jax.lax.ragged_dot(xs, deq(w, xs.dtype), group_sizes)
+
+
 def qmm(x: jnp.ndarray, w) -> jnp.ndarray:
     """Matmul against a plain or quantized weight."""
     if isinstance(w, QuantizedW8A8):

@@ -9,7 +9,10 @@ owns every stage:
 - layers split into ``pp`` contiguous stages (even split, or
   ``--assigned-layers``; reference get_pp_layers dist_utils.py:494-528);
   each stage's params + its layers' KV cache live on a disjoint device
-  group (optionally TP-sharded within the stage).
+  group (optionally TP-sharded within the stage). Hybrid (GDN) stages are
+  rounded to the model's layer-type period so each stage is itself
+  periodic (reference builds per-stage layer lists the same way,
+  qwen3_5.py via get_pp_layers).
 - one jit program per stage; hidden/residual move between stages with
   ``jax.device_put`` (ICI transfer on real hardware).
 - **pipelining comes from async dispatch**: the engine keeps up to
@@ -18,8 +21,13 @@ owns every stage:
   different device groups, XLA's per-device queues overlap them — no
   explicit microbatch scheduler needed. Token throttling balances the
   token count across those in-flight microbatches (scheduler policy).
+- **dp × pp**: each DP replica owns a full private pipeline on its own
+  ``pp × tp`` device block (the reference's dp-grouped rank grid,
+  dist_utils.py:149-263). Replicas are independent programs — no
+  lockstep dummy batches needed; host-side launch order + async
+  dispatch overlaps them.
 - the follower-mirror/delta-payload machinery disappears: there is one
-  scheduler and one page table, shared by construction.
+  scheduler and one page table per replica, shared by construction.
 
 The sampled-token array returned by ``step_async`` is an uncommitted device
 future; ``collect`` blocks on it one pipeline depth later.
@@ -47,18 +55,32 @@ logger = logging.getLogger(__name__)
 
 
 def split_layers(num_layers: int, pp: int,
-                 assigned: Optional[List[int]] = None):
+                 assigned: Optional[List[int]] = None,
+                 multiple: int = 1):
     """[(first, last)] per stage: even split with remainder spread from the
-    front, or an explicit per-stage layer-count list."""
+    front, or an explicit per-stage layer-count list. ``multiple`` forces
+    each stage's layer count to a multiple (hybrid layer-type period)."""
     if assigned is not None:
         if sum(assigned) != num_layers or len(assigned) != pp:
             raise ValueError(
                 f"assigned_layers {assigned} must sum to {num_layers} "
                 f"over {pp} stages")
+        if any(c % multiple for c in assigned):
+            raise ValueError(
+                f"assigned_layers {assigned} must each be a multiple of "
+                f"the hybrid layer-type period {multiple}")
         counts = assigned
     else:
-        base, rem = divmod(num_layers, pp)
-        counts = [base + (1 if i < rem else 0) for i in range(pp)]
+        if num_layers % multiple:
+            raise ValueError(f"{num_layers} layers not divisible by the "
+                             f"hybrid layer-type period {multiple}")
+        units = num_layers // multiple
+        if units < pp:
+            raise ValueError(f"pp={pp} needs at least {pp} period-units, "
+                             f"model has {units}")
+        base, rem = divmod(units, pp)
+        counts = [(base + (1 if i < rem else 0)) * multiple
+                  for i in range(pp)]
     bounds, first = [], 0
     for c in counts:
         bounds.append((first, first + c))
@@ -77,7 +99,8 @@ class _Stage:
 
 
 class PPModelRunner(ModelRunner):
-    """Same interface as ModelRunner; executes a multi-stage pipeline."""
+    """Same interface as ModelRunner; executes one multi-stage pipeline
+    per DP replica."""
 
     def __init__(self, config: EngineConfig, model_cfg: ModelConfig,
                  params=None, mesh=None):
@@ -92,34 +115,35 @@ class PPModelRunner(ModelRunner):
         self.dtype = _DTYPES[config.dtype]
         self.model_def = get_model_def(model_cfg)
         pp, tp = config.parallel.pp, config.parallel.tp
-        if config.parallel.dp > 1:
-            raise NotImplementedError("dp with pp pending multi-replica "
-                                      "engine")
-        if model_cfg.use_hybrid:
+        dp = self.dp = config.parallel.dp
+        if model_cfg.use_hybrid and tp > 1:
             raise NotImplementedError(
-                "hybrid (GDN) models with pp > 1 are not wired up yet")
+                "hybrid (GDN) models with tp > 1 are not wired up yet")
         devices = jax.devices()
-        if len(devices) < pp * tp:
-            raise ValueError(f"pp={pp} tp={tp} needs {pp * tp} devices, "
-                             f"have {len(devices)}")
-        # PP builds per-stage meshes, which don't fit the single TP shard
-        # context — clear any stale one a prior runner left behind.
+        if len(devices) < dp * pp * tp:
+            raise ValueError(f"dp={dp} pp={pp} tp={tp} needs "
+                             f"{dp * pp * tp} devices, have {len(devices)}")
         from gllm_tpu.ops.attention import set_shard_context
+        from gllm_tpu.runner.runner import pallas_tp_ok
+        # PP builds per-stage meshes; the shard context (if any) is set
+        # below once those exist — clear a prior runner's first.
         set_shard_context(None)
+
         impl = config.attention_impl
         pack = pick_kv_pack(model_cfg, tp_sharded=tp > 1)
         if impl == "auto":
-            impl = ("pallas" if tp == 1 and pack
+            impl = ("pallas" if pack
+                    and (tp == 1 or pallas_tp_ok(model_cfg, tp))
                     and jax.default_backend() in ("tpu", "axon") else "xla")
         elif impl == "pallas":
-            if tp > 1:
+            if tp > 1 and not pallas_tp_ok(model_cfg, tp):
                 raise NotImplementedError(
-                    "attention_impl='pallas' with pp×tp is not wired up "
-                    "yet; use attention_impl='xla'")
+                    "attention_impl='pallas' needs head counts divisible "
+                    "over tp; use attention_impl='xla'")
             if not pack:
                 raise NotImplementedError(
-                    "attention_impl='pallas' needs a 128-lane-aligned KV "
-                    "layout (head_dim ×pack % 128 == 0)")
+                    "attention_impl='pallas' needs a 128-lane-aligned "
+                    "KV layout (head_dim ×pack % 128 == 0)")
         self.kv_pack = pack if impl == "pallas" else 1
         self.attn_impl = impl
         from gllm_tpu.runner.prepare import BatchBuilder
@@ -127,6 +151,7 @@ class PPModelRunner(ModelRunner):
                                     vocab_size=model_cfg.vocab_size,
                                     hidden_size=model_cfg.hidden_size,
                                     use_mm=model_cfg.use_mm,
+                                    use_ssm=model_cfg.use_hybrid,
                                     mm_embed_dim=model_cfg.mm_embed_dim)
         if model_cfg.use_mm:
             from gllm_tpu.utils import LRUBytesCache
@@ -134,22 +159,40 @@ class PPModelRunner(ModelRunner):
         self.rng_key = jax.random.key(config.seed)
         self._step_count = 0
 
+        if model_cfg.use_hybrid:
+            from gllm_tpu.models.hybrid import period_pattern
+            period = len(period_pattern(model_cfg))
+            self.ssm_working_slots = config.max_num_seqs
+            self.ssm_snapshot_slots = (
+                config.cache.ssm_snapshot_slots
+                if config.cache.enable_prefix_caching else 0)
+        else:
+            period = 1
+            self.ssm_working_slots = self.ssm_snapshot_slots = 0
         bounds = split_layers(model_cfg.num_layers, pp,
-                              config.parallel.assigned_layers)
+                              config.parallel.assigned_layers,
+                              multiple=period)
 
-        # Phase 1: load (and optionally quantize) every stage's weights so
-        # page sizing sees the real post-load memory on each stage device.
+        # Per-(replica, stage) device groups: replica r owns the
+        # contiguous block devices[r*pp*tp : (r+1)*pp*tp], stage i the
+        # tp-slice within it.
+        def stage_devices(r, i):
+            base = (r * pp + i) * tp
+            return devices[base:base + tp]
+
+        def stage_mesh(devs):
+            if tp <= 1:
+                return None
+            from jax.sharding import Mesh
+            return Mesh(np.asarray(devs).reshape(1, tp), ("dp", "tp"))
+
+        # Phase 1: load (and optionally quantize) every stage's weights and
+        # place them on REPLICA 0's device block as we go (peak host memory
+        # is one stage; page sizing then reads live device stats).
         staged = []
         for i, (first, last) in enumerate(bounds):
             scfg = dataclasses.replace(model_cfg, first_layer=first,
                                        last_layer=last)
-            stage_devs = devices[i * tp:(i + 1) * tp]
-            if tp > 1:
-                from jax.sharding import Mesh
-                smesh = Mesh(np.asarray(stage_devs).reshape(1, tp),
-                             ("dp", "tp"))
-            else:
-                smesh = None
             if config.load_format == "dummy" or not config.model:
                 sparams = self.model_def.init_params(scfg,
                                                      seed=config.seed,
@@ -174,63 +217,114 @@ class PPModelRunner(ModelRunner):
                     "stage %d quantized (%s): %.2f GB -> %.2f GB", i,
                     config.quantization, before / 1e9,
                     param_bytes(sparams) / 1e9)
-            staged.append((scfg, stage_devs, smesh, sparams))
-
-        # Phase 2: one shared page count from the TIGHTEST stage device
-        # (page tables are global; honors cache.memory_util).
-        self.num_pages = (config.cache.num_pages
-                          or self._determine_num_pages(bounds, staged))
-
-        self.stages: List[_Stage] = []
-        for i, (scfg, stage_devs, smesh, sparams) in enumerate(staged):
-            skv = self.model_def.init_kv_cache(
-                scfg, self.num_pages, config.cache.page_size,
-                self.dtype if config.cache.kv_cache_dtype == "auto"
-                else _DTYPES[config.cache.kv_cache_dtype],
-                **({"kv_pack": self.kv_pack} if self.kv_pack > 1 else {}))
+            sdevs = stage_devices(0, i)
+            smesh = stage_mesh(sdevs)
             if smesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
                 from gllm_tpu.parallel.shardings import shard_params
                 sparams = shard_params(
                     sparams, self.model_def.param_specs(scfg, tp), smesh)
-                kspecs = self.model_def.kv_specs(scfg, tp)
-                skv = jax.tree.map(
-                    lambda x, s: jax.device_put(x, NamedSharding(smesh, s)),
-                    skv, kspecs)
-                # Activations/batch enter the stage replicated over its mesh.
                 place = NamedSharding(smesh, PartitionSpec())
             else:
-                place = stage_devs[0]
+                place = sdevs[0]
                 sparams = jax.device_put(sparams, place)
-                skv = jax.device_put(skv, place)
-            fn = self._make_stage_fn(scfg)
-            self.stages.append(_Stage(scfg, sparams, skv, place, smesh, fn))
+            # one jit wrapper per stage, shared by all replicas (their
+            # calls differ only in arg placement → per-sharding compiles
+            # dedupe through the jit cache)
+            staged.append((scfg, sparams, self._make_stage_fn(scfg)))
+
+        # Phase 2: one shared page count from the TIGHTEST stage device
+        # (page tables are global; honors cache.memory_util). Replicas are
+        # identical, so replica 0 prices all of them.
+        self.num_pages = (config.cache.num_pages
+                          or self._determine_num_pages(bounds, staged,
+                                                       stage_devices))
+
+        # Phase 3: init per-stage KV everywhere; replicas r>0 copy their
+        # params device-to-device from replica 0 (ICI, no host re-load).
+        kv_dtype = self._kv_dtype()
+        num_slots = (1 + self.ssm_working_slots + self.ssm_snapshot_slots)
+        self.replicas: List[List[_Stage]] = []
+        for r in range(dp):
+            stages: List[_Stage] = []
+            for i, (scfg, sparams, fn) in enumerate(staged):
+                sdevs = stage_devices(r, i)
+                smesh = stage_mesh(sdevs)
+                if model_cfg.use_hybrid:
+                    skv = self.model_def.init_kv_cache(
+                        scfg, self.num_pages, config.cache.page_size,
+                        kv_dtype, num_slots=num_slots)
+                else:
+                    skv = self.model_def.init_kv_cache(
+                        scfg, self.num_pages, config.cache.page_size,
+                        kv_dtype,
+                        **({"kv_pack": self.kv_pack}
+                           if self.kv_pack > 1 else {}))
+                if smesh is not None:
+                    from jax.sharding import NamedSharding, PartitionSpec
+                    if r == 0:
+                        rparams = sparams
+                    else:
+                        pspecs = self.model_def.param_specs(scfg, tp)
+                        rparams = jax.tree.map(
+                            lambda x, s: jax.device_put(
+                                x, NamedSharding(smesh, s)),
+                            sparams, pspecs)
+                    kspecs = self.model_def.kv_specs(scfg, tp)
+                    skv = jax.tree.map(
+                        lambda x, s: jax.device_put(
+                            x, NamedSharding(smesh, s)), skv, kspecs)
+                    # Activations/batch enter the stage replicated over
+                    # its mesh.
+                    place = NamedSharding(smesh, PartitionSpec())
+                else:
+                    place = sdevs[0]
+                    rparams = (sparams if r == 0
+                               else jax.device_put(sparams, place))
+                    skv = jax.device_put(skv, place)
+                stages.append(_Stage(scfg, rparams, skv, place, smesh, fn))
+            self.replicas.append(stages)
+        self.stages = self.replicas[0]
+        if impl == "pallas" and tp > 1:
+            # Any mesh with the tp axis works for the dispatch decision;
+            # each stage's trace runs under mesh_context(stage.mesh), so
+            # the nested tp shard_map binds the CONTEXT mesh — i.e. that
+            # stage's own device group (ops/attention.py).
+            set_shard_context(self.stages[0].mesh, "tp")
         self.cos_sin = self.model_def.make_rope_table(model_cfg)
         if model_cfg.use_mm:
             # the inherited _prepare_mm embeds on stage 0 (visual tower)
             self.params = self.stages[0].params
-        logger.info("pipeline: %d stages %s × tp=%d, %d KV pages/stage",
-                    pp, bounds, tp, self.num_pages)
+        self.memory_manager = None     # attached by the engine
+        logger.info("pipeline: dp=%d × %d stages %s × tp=%d, "
+                    "%d KV pages/stage", dp, pp, bounds, tp,
+                    self.num_pages)
 
-    def _determine_num_pages(self, bounds, staged) -> int:
+    def _determine_num_pages(self, bounds, staged, stage_devices) -> int:
         """Size the shared KV page count from the TIGHTEST stage: every
-        stage's weights are already resident (phase 1), so each stage
-        device's free memory divided by that stage's per-page KV bytes
-        (via the shared _kv_bytes_per_page, with the stage's layer count)
-        bounds its page budget; take the minimum (reference
-        profile-then-size discipline, memory_manager.py:476-526)."""
+        stage's weights are already resident on replica 0 (phase 1), so
+        each stage device's free memory divided by that stage's per-page
+        KV bytes (via the shared _kv_bytes_per_page, with the stage's
+        attention-layer count) bounds its page budget; take the minimum
+        (reference profile-then-size discipline,
+        memory_manager.py:476-526)."""
         best = None
-        for (scfg, stage_devs, _, _), (first, last) in zip(staged, bounds):
+        for i, ((first, last), (scfg, _, _)) in enumerate(
+                zip(bounds, staged)):
+            dev = stage_devices(0, i)[0]
             try:
-                stats = stage_devs[0].memory_stats()
+                stats = dev.memory_stats()
                 limit = stats["bytes_limit"]
                 in_use = stats["bytes_in_use"]
             except Exception:
                 return 2048        # CPU / no memory_stats
             free = limit * self.config.cache.memory_util - in_use
             free -= 512 * 1024 * 1024      # activation headroom
-            per_page = self._kv_bytes_per_page(n_layers=last - first)
-            num = int(free // per_page)
+            free -= self._ssm_pool_bytes(scfg)
+            n_kv = (scfg.num_attn_layers if scfg.use_hybrid
+                    else last - first)
+            per_page = self._kv_bytes_per_page(n_layers=n_kv)
+            num = int(free // per_page) if per_page else 1 << 30
             best = num if best is None else min(best, num)
         min_pages = cdiv(self.config.max_model_len,
                          self.config.cache.page_size) + 2
@@ -240,6 +334,17 @@ class PPModelRunner(ModelRunner):
                 f"(need >= {min_pages})")
         return best
 
+    def _ssm_pool_bytes(self, cfg: Optional[ModelConfig] = None) -> int:
+        cfg = cfg or self.model_cfg
+        if not cfg.use_hybrid:
+            return 0
+        slots = 1 + self.ssm_working_slots + self.ssm_snapshot_slots
+        K = cfg.linear_conv_kernel_dim
+        per_slot = (cfg.gdn_conv_dim * (K - 1)
+                    + cfg.linear_num_value_heads * cfg.linear_key_head_dim
+                    * cfg.linear_value_head_dim) * 4
+        return cfg.num_linear_layers * slots * per_slot
+
     # ---- stage programs ---------------------------------------------------
 
     def _make_stage_fn(self, scfg: ModelConfig):
@@ -247,11 +352,14 @@ class PPModelRunner(ModelRunner):
         logits_fn = self.model_def.compute_logits
         attn_impl = self.attn_impl
 
-        @functools.partial(jax.jit, static_argnames=("max_q_len",),
+        @functools.partial(jax.jit,
+                           static_argnames=("max_q_len", "logprobs_k",
+                                            "prompt_lp"),
                            compiler_options=tpu_compiler_options(),
                            donate_argnums=(1,))
         def stage(params, kv, batch, cos_sin, hidden, residual,
-                  token_counts, *, max_q_len: int):
+                  token_counts, *, max_q_len: int, logprobs_k: int = -1,
+                  prompt_lp: bool = False):
             hidden, residual, kv = fwd(params, kv, batch, scfg,
                                        cos_sin=cos_sin,
                                        attn_impl=attn_impl,
@@ -261,24 +369,54 @@ class PPModelRunner(ModelRunner):
             if scfg.is_last_stage:
                 logits = logits_fn(params, hidden, residual, batch, scfg)
                 tokens = sample(logits, batch.sampling, token_counts)
-                return tokens, kv
+                aux = {}
+                if logprobs_k >= 0:
+                    # same shapes as the single-runner step (reference
+                    # computes logprobs on the last rank too,
+                    # sampler.py:71-91)
+                    from gllm_tpu.ops.sampling import (apply_penalties,
+                                                       compute_logprobs)
+                    lp_logits = apply_penalties(logits, token_counts,
+                                                batch.sampling)
+                    aux["lp"] = compute_logprobs(lp_logits, tokens,
+                                                 max(logprobs_k, 1))
+                if prompt_lp:
+                    from gllm_tpu.models.dense import compute_full_logits
+                    from gllm_tpu.ops.sampling import compute_logprobs
+                    full_logits = compute_full_logits(params, hidden,
+                                                      residual, scfg)
+                    aux["plp"] = compute_logprobs(full_logits,
+                                                  batch.plp_targets,
+                                                  max(logprobs_k, 1))
+                return (tokens, aux), kv
             return (hidden, residual), kv
 
         return stage
 
     # ---- execution --------------------------------------------------------
 
-    def step_async(self, sched_batch):
+    def _apply_ssm_intents(self) -> None:
+        """PP version: each replica's drained+padded intents (shared
+        helper) apply to every hybrid stage's slot pools — slot indices
+        are global; each stage holds its own layers' pools."""
+        from gllm_tpu.runner.runner import _ssm_apply
+        for r, (s_src, s_dst, z, r_src, r_dst) in self._drained_ssm_ops():
+            for stage in self.replicas[r]:
+                if stage.cfg.num_linear_layers == 0:
+                    continue
+                conv, rec = _ssm_apply(stage.kv.conv, stage.kv.rec,
+                                       s_src, s_dst, z, r_src, r_dst)
+                stage.kv = stage.kv._replace(conv=conv, rec=rec)
+
+    def _run_pipeline(self, stages, sched_batch, step_key):
+        """Launch one microbatch through one replica's stage chain; all
+        dispatch is async — returns (tokens_future, aux, num_seqs)."""
         from gllm_tpu.parallel.mesh import mesh_context
-        self._step_count += 1
-        if self.model_cfg.use_mm:
-            # ViT embedding on stage 0's params (visual tower lives there)
-            self._prepare_mm(sched_batch)
-        step_key = jax.random.fold_in(self.rng_key, self._step_count)
         batch, max_q, presence = self.builder.build(sched_batch, step_key)
+        lp_k, want_plp = self._lp_flags(sched_batch)
         hidden = residual = None
         out = None
-        for stage in self.stages:
+        for stage in stages:
             sb = jax.device_put(batch, stage.device)
             if hidden is not None:
                 hidden = jax.device_put(hidden, stage.device)
@@ -286,19 +424,70 @@ class PPModelRunner(ModelRunner):
             pm = presence if stage.cfg.is_last_stage else None
             if pm is not None:
                 pm = jax.device_put(pm, stage.device)
+            # lp flags are static jit args — only the last stage reads
+            # them, so earlier stages keep their (-1, False) cache entry
+            # for every logprobs pattern (no pipeline-wide recompiles)
+            lp_kw = (dict(logprobs_k=lp_k, prompt_lp=want_plp)
+                     if stage.cfg.is_last_stage else {})
             with mesh_context(stage.mesh):
                 out, stage.kv = stage.fn(stage.params, stage.kv, sb,
                                          self.cos_sin, hidden, residual,
-                                         pm, max_q_len=max_q)
+                                         pm, max_q_len=max_q, **lp_kw)
             if not stage.cfg.is_last_stage:
                 hidden, residual = out
-        # aux slot kept empty: per-token logprobs are a single-runner
-        # feature for now (last PP stage could compute them the same way).
-        return out, {}, sched_batch.num_seqs
+        tokens, aux = out
+        return tokens, aux, sched_batch.num_seqs
+
+    def step_async(self, sched_batch):
+        self._step_count += 1
+        if self.model_cfg.use_mm:
+            # ViT embedding on stage 0's params (visual tower lives there)
+            self._prepare_mm(sched_batch)
+        self._apply_ssm_intents()
+        step_key = jax.random.fold_in(self.rng_key, self._step_count)
+        return self._run_pipeline(self.stages, sched_batch, step_key)
 
     def collect(self, handle):
         tokens, aux, n = handle
+        if aux:
+            aux = jax.tree.map(np.asarray, aux)
         return np.asarray(tokens)[:n], aux
 
     def step(self, sched_batch) -> np.ndarray:
         return self.collect(self.step_async(sched_batch))[0]
+
+    # ---- dp × pp ----------------------------------------------------------
+
+    def step_async_dp(self, sched_batches):
+        """One step over all DP replicas: each replica's private pipeline
+        is launched back-to-back (async dispatch overlaps them on their
+        disjoint device blocks); idle replicas simply don't run — no
+        lockstep dummy batches, unlike the single-program dp runner."""
+        assert len(sched_batches) == self.dp
+        self._step_count += 1
+        if self.model_cfg.use_mm:
+            for b in sched_batches:
+                if b is not None:
+                    self._prepare_mm(b)
+        self._apply_ssm_intents()
+        base_key = jax.random.fold_in(self.rng_key, self._step_count)
+        handles = []
+        for r, b in enumerate(sched_batches):
+            if b is None:
+                handles.append(None)
+                continue
+            key = jax.random.fold_in(base_key, r)
+            handles.append(self._run_pipeline(self.replicas[r], b, key))
+        return handles
+
+    def collect_dp(self, handles):
+        rows, auxes = [], []
+        for h in handles:
+            if h is None:
+                rows.append(np.zeros((0,), np.int32))
+                auxes.append({})
+                continue
+            tokens, aux, n = h
+            rows.append(np.asarray(tokens)[:n])
+            auxes.append(jax.tree.map(np.asarray, aux) if aux else {})
+        return rows, auxes

@@ -83,6 +83,16 @@ def _ssm_apply_replica(conv, rec, r, snap_src, snap_dst, zero_slots,
                        rest_src, rest_dst)
 
 
+def pallas_tp_ok(cfg: ModelConfig, tp: int) -> bool:
+    """Can the Pallas attention run tp-sharded for this model? Only the
+    head-count split over tp must divide (dp>1 runs the kernels per
+    replica under manual shard_map and adds no constraint). Shared by
+    ModelRunner and PPModelRunner."""
+    from gllm_tpu.ops.attention import pallas_tp_compatible
+    hkv = 1 if cfg.use_mla else cfg.num_kv_heads
+    return pallas_tp_compatible(cfg.num_heads, hkv, tp)
+
+
 def pick_kv_pack(cfg: ModelConfig, tp_sharded: bool) -> int:
     """Mosaic lane-packing policy, shared by ModelRunner and PPModelRunner.
 
@@ -90,19 +100,31 @@ def pick_kv_pack(cfg: ModelConfig, tp_sharded: bool) -> int:
     (caller falls back to XLA or raises), 1 when no packing is needed, or
     the pack factor (2/4 adjacent kv heads per 128-lane cache row) for
     head_dim < 128 models. Packing is a single-replica layout: tp/dp
-    shard the unpacked specs, so sharded meshes need native alignment."""
-    if cfg.use_mla:
-        # latent cache is tile-padded by construction; the in-kernel value
-        # slice k[..., :lora] still needs lane alignment (512 for DeepSeek)
-        return 1 if cfg.kv_lora_rank % 128 == 0 else 0
-    if cfg.head_dim % 128 == 0:
-        return 1
-    if tp_sharded or cfg.use_hybrid:
+    shard the unpacked specs, so sharded meshes need native alignment.
+
+    On the CPU backend the kernels run in interpret mode, which has no
+    Mosaic lane constraints (same escape as ops/gdn.py) — any layout is
+    viable, keeping CPU e2e coverage of the Pallas engine path alive for
+    arbitrary head_dim."""
+    def native() -> int:
+        if cfg.use_mla:
+            # latent cache is tile-padded by construction; the in-kernel
+            # value slice k[..., :lora] still needs lane alignment (512
+            # for DeepSeek)
+            return 1 if cfg.kv_lora_rank % 128 == 0 else 0
+        if cfg.head_dim % 128 == 0:
+            return 1
+        if tp_sharded or cfg.use_hybrid:
+            return 0
+        for p in (2, 4):
+            if cfg.head_dim * p % 128 == 0 and cfg.num_kv_heads % p == 0:
+                return p
         return 0
-    for p in (2, 4):
-        if cfg.head_dim * p % 128 == 0 and cfg.num_kv_heads % p == 0:
-            return p
-    return 0
+
+    pack = native()
+    if pack == 0 and jax.default_backend() == "cpu":
+        return 1
+    return pack
 
 
 class ModelRunner:
@@ -244,32 +266,25 @@ class ModelRunner:
         tp_sharded = self.mesh is not None and (
             tp > 1 or self.config.parallel.dp > 1)
 
-        def tp_ok() -> bool:
-            # dp steps vmap the forward over stacked replicas; shard_map
-            # inside that vmap is not wired up — keep dp on XLA.
-            if self.config.parallel.dp > 1:
-                return False
-            from gllm_tpu.ops.attention import pallas_tp_compatible
-            hkv = 1 if cfg.use_mla else cfg.num_kv_heads
-            return pallas_tp_compatible(cfg.num_heads, hkv, tp)
-
-        pack = pick_kv_pack(cfg, tp_sharded)
+        # Lane packing is a per-replica layout: the dp axis stacks whole
+        # replicas (manual shard_map), so only a tp kv-head split forces
+        # native alignment.
+        pack = pick_kv_pack(cfg, self.mesh is not None and tp > 1)
         if impl != "auto":
             if impl == "pallas":
-                if tp_sharded and not tp_ok():
+                if tp_sharded and not pallas_tp_ok(cfg, tp):
                     raise NotImplementedError(
                         "attention_impl='pallas' needs head counts "
-                        "divisible over tp (and dp==1); use "
-                        "attention_impl='xla'")
+                        "divisible over tp; use attention_impl='xla'")
                 if not pack:
                     raise NotImplementedError(
-                        "attention_impl='pallas' needs a 128-lane-aligned "
-                        "KV layout: head_dim (×pack 2/4) % 128 == 0, or "
-                        "kv_lora_rank % 128 == 0 for MLA; use "
+                        "attention_impl='pallas' needs a 128-lane-"
+                        "aligned KV layout: head_dim (×pack 2/4) % 128 "
+                        "== 0, or kv_lora_rank % 128 == 0 for MLA; use "
                         "attention_impl='xla'")
                 self.kv_pack = pack
             return impl
-        if not pack or (tp_sharded and not tp_ok()):
+        if not pack or (tp_sharded and not pallas_tp_ok(cfg, tp)):
             return "xla"
         if jax.default_backend() in ("tpu", "axon"):
             self.kv_pack = pack
@@ -356,20 +371,8 @@ class ModelRunner:
         logits_fn = self.model_def.compute_logits
         attn_impl = self.attn_impl
 
-        @functools.partial(jax.jit,
-                           static_argnames=("max_q_len", "logprobs_k",
-                                            "prompt_lp"),
-                           donate_argnums=(1,),
-                           compiler_options=tpu_compiler_options())
-        def step(params, kv, batch: StepBatch, cos_sin, token_counts,
-                 *, max_q_len: int, logprobs_k: int = -1,
-                 prompt_lp: bool = False):
-            hidden, residual, kv = fwd(params, kv, batch, cfg,
-                                       cos_sin=cos_sin,
-                                       attn_impl=attn_impl,
-                                       max_q_len=max_q_len)
-            logits = logits_fn(params, hidden, residual, batch, cfg)
-            tokens = sample(logits, batch.sampling, token_counts)
+        def lp_aux(params, cfg_, logits, tokens, hidden, residual, batch,
+                   token_counts, logprobs_k, prompt_lp):
             aux = {}
             if logprobs_k >= 0:
                 # Output logprobs of the SAMPLED tokens over the
@@ -386,36 +389,116 @@ class ModelRunner:
                 from gllm_tpu.models.dense import compute_full_logits
                 from gllm_tpu.ops.sampling import compute_logprobs
                 full_logits = compute_full_logits(params, hidden,
-                                                  residual, cfg)
+                                                  residual, cfg_)
                 aux["plp"] = compute_logprobs(full_logits,
                                               batch.plp_targets,
                                               max(logprobs_k, 1))
+            return aux
+
+        @functools.partial(jax.jit,
+                           static_argnames=("max_q_len", "logprobs_k",
+                                            "prompt_lp"),
+                           donate_argnums=(1,),
+                           compiler_options=tpu_compiler_options())
+        def step(params, kv, batch: StepBatch, cos_sin, token_counts,
+                 *, max_q_len: int, logprobs_k: int = -1,
+                 prompt_lp: bool = False):
+            hidden, residual, kv = fwd(params, kv, batch, cfg,
+                                       cos_sin=cos_sin,
+                                       attn_impl=attn_impl,
+                                       max_q_len=max_q_len)
+            logits = logits_fn(params, hidden, residual, batch, cfg)
+            tokens = sample(logits, batch.sampling, token_counts)
+            aux = lp_aux(params, cfg, logits, tokens, hidden, residual,
+                         batch, token_counts, logprobs_k, prompt_lp)
             return tokens, kv, aux
 
         if self.dp > 1:
             import dataclasses as _dc
             cfg_dp = _dc.replace(cfg, moe_force_dense=True)
+            mesh = self.mesh
+            from jax.sharding import PartitionSpec as P
+            from gllm_tpu.parallel.mesh import AXIS_DP
 
-            @functools.partial(jax.jit, static_argnames=("max_q_len",),
+            def one(kv_r, batch_r, counts_r, params, cos_sin, *,
+                    max_q_len, logprobs_k, prompt_lp):
+                hidden, residual, kv_r = fwd(params, kv_r, batch_r,
+                                             cfg_dp, cos_sin=cos_sin,
+                                             attn_impl=attn_impl,
+                                             max_q_len=max_q_len)
+                logits = logits_fn(params, hidden, residual, batch_r,
+                                   cfg_dp)
+                tokens = sample(logits, batch_r.sampling, counts_r)
+                aux = lp_aux(params, cfg_dp, logits, tokens, hidden,
+                             residual, batch_r, counts_r, logprobs_k,
+                             prompt_lp)
+                return tokens, kv_r, aux
+
+            @functools.partial(jax.jit,
+                               static_argnames=("max_q_len", "logprobs_k",
+                                                "prompt_lp"),
                                donate_argnums=(1,),
                                compiler_options=tpu_compiler_options())
             def step_dp(params, kv, batch, cos_sin, token_counts, *,
-                        max_q_len: int):
-                def one(kv_r, batch_r, counts_r):
-                    hidden, residual, kv_r = fwd(params, kv_r, batch_r,
-                                                 cfg_dp, cos_sin=cos_sin,
-                                                 attn_impl=attn_impl,
-                                                 max_q_len=max_q_len)
-                    logits = logits_fn(params, hidden, residual, batch_r,
-                                       cfg_dp)
-                    return sample(logits, batch_r.sampling, counts_r), kv_r
+                        max_q_len: int, logprobs_k: int = -1,
+                        prompt_lp: bool = False):
+                kw = dict(max_q_len=max_q_len, logprobs_k=logprobs_k,
+                          prompt_lp=prompt_lp)
+                if attn_impl != "pallas" or mesh is None:
+                    # XLA attention: plain vmap over stacked replicas —
+                    # GSPMD partitions the batched program over the
+                    # dp-sharded leading axis on its own.
+                    if token_counts is None:
+                        return jax.vmap(lambda k, b: one(
+                            k, b, None, params, cos_sin, **kw))(kv, batch)
+                    return jax.vmap(lambda k, b, c: one(
+                        k, b, c, params, cos_sin, **kw))(kv, batch,
+                                                         token_counts)
 
+                # Pallas attention: GSPMD cannot partition a custom call
+                # over the dp axis, so the replica loop runs MANUAL over
+                # dp via shard_map — each device sees its own replica
+                # slice ([1, ...]) and invokes the kernels locally; tp
+                # stays an auto axis inside (the attention dispatch nests
+                # its tp shard_map over the context mesh). This is the
+                # TPU answer to the reference's per-replica worker
+                # processes each calling FA3 (worker.py:750-829,
+                # layers/attention.py:92-140).
+                from jax import shard_map
+                dp_s = lambda t: jax.tree.map(lambda _: P(AXIS_DP), t)
+                rep = lambda t: jax.tree.map(lambda _: P(), t)
+                aux_spec = {}
+                if logprobs_k >= 0:
+                    aux_spec["lp"] = (P(AXIS_DP),) * 3
+                if prompt_lp:
+                    aux_spec["plp"] = (P(AXIS_DP),) * 3
+
+                def body(kv_s, batch_s, counts_s, params_s, cos_s):
+                    sq = lambda t: jax.tree.map(lambda x: x[0], t)
+                    tokens, kv_r, aux = one(
+                        sq(kv_s), sq(batch_s),
+                        None if counts_s is None else sq(counts_s),
+                        params_s, cos_s, **kw)
+                    ex = lambda t: jax.tree.map(lambda x: x[None], t)
+                    return ex(tokens), ex(kv_r), ex(aux)
+
+                out_specs = (P(AXIS_DP), dp_s(kv), aux_spec)
                 if token_counts is None:
-                    tokens, kv = jax.vmap(
-                        lambda k, b: one(k, b, None))(kv, batch)
-                else:
-                    tokens, kv = jax.vmap(one)(kv, batch, token_counts)
-                return tokens, kv, {}
+                    fn = shard_map(
+                        lambda k, b, p, c: body(k, b, None, p, c),
+                        mesh=mesh,
+                        in_specs=(dp_s(kv), dp_s(batch), rep(params),
+                                  rep(cos_sin)),
+                        out_specs=out_specs,
+                        axis_names={AXIS_DP}, check_vma=False)
+                    return fn(kv, batch, params, cos_sin)
+                fn = shard_map(
+                    body, mesh=mesh,
+                    in_specs=(dp_s(kv), dp_s(batch), dp_s(token_counts),
+                              rep(params), rep(cos_sin)),
+                    out_specs=out_specs,
+                    axis_names={AXIS_DP}, check_vma=False)
+                return fn(kv, batch, token_counts, params, cos_sin)
 
             self._step_fn_dp = step_dp
         return step
@@ -448,12 +531,11 @@ class ModelRunner:
             assert mm.vis_embeds.shape[0] == mm.num_vis_tokens, \
                 (mm.vis_embeds.shape, mm.num_vis_tokens)
 
-    def _apply_ssm_intents(self) -> None:
-        """Apply pending SSM slot ops (snapshot / zero / restore) recorded
-        by the memory manager, in class order: snapshots capture states
-        from completed steps, zeros clear freed slots, restores fill fresh
-        slots from snapshots — all before the next step reads them
-        (reference SSMSegment.copy_state / free_working zeroing)."""
+    def _drained_ssm_ops(self):
+        """Per replica: drain the memory manager's pending SSM intents and
+        pow2-pad them into device index arrays. Yields
+        (replica, (s_src, s_dst, zero, r_src, r_dst)) for replicas with
+        work (shared by the single-program and PP runners)."""
         mms = (self.memory_managers if getattr(self, "memory_managers",
                                                None)
                else [self.memory_manager])
@@ -472,12 +554,20 @@ class ModelRunner:
             snap = [(a, b) for k, a, b in intents if k == "snapshot"]
             zero = [a for k, a, _ in intents if k == "zero"]
             rest = [(a, b) for k, a, b in intents if k == "restore"]
-
             # pow2 padding keeps the jit-shape count logarithmic
             s_src, s_dst = pad_pairs(snap, next_pow2(len(snap), 1))
             z = jnp.asarray(zero + [0] * (next_pow2(len(zero), 1)
                                           - len(zero)), jnp.int32)
             r_src, r_dst = pad_pairs(rest, next_pow2(len(rest), 1))
+            yield r, (s_src, s_dst, z, r_src, r_dst)
+
+    def _apply_ssm_intents(self) -> None:
+        """Apply pending SSM slot ops (snapshot / zero / restore) recorded
+        by the memory manager, in class order: snapshots capture states
+        from completed steps, zeros clear freed slots, restores fill fresh
+        slots from snapshots — all before the next step reads them
+        (reference SSMSegment.copy_state / free_working zeroing)."""
+        for r, (s_src, s_dst, z, r_src, r_dst) in self._drained_ssm_ops():
             if self.dp > 1:
                 conv, rec = _ssm_apply_replica(
                     self.kv.conv, self.kv.rec, jnp.int32(r), s_src, s_dst,
@@ -572,19 +662,28 @@ class ModelRunner:
                 token_counts = jax.device_put(
                     token_counts, NamedSharding(self.mesh, P("dp")))
 
+        lp_k, want_plp = -1, False
+        for b in live:
+            k, plp = self._lp_flags(b)
+            lp_k, want_plp = max(lp_k, k), want_plp or plp
+
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
             tokens, self.kv, aux = self._step_fn_dp(
                 self.params, self.kv, stacked, self.cos_sin, token_counts,
-                max_q_len=max_q)
+                max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp)
         return tokens, aux, [b.num_seqs if b is not None else 0
                              for b in sched_batches]
 
     def collect_dp(self, handle):
-        """Per-replica sampled-token rows: List[np [n_r]]."""
-        tokens, _aux, ns = handle
+        """Per-replica sampled-token rows + per-replica aux slices:
+        (List[np [n_r]], List[aux dict])."""
+        tokens, aux, ns = handle
         host = np.asarray(tokens)
-        return [host[r, :n] for r, n in enumerate(ns)]
+        aux_host = jax.tree.map(np.asarray, aux)
+        auxes = [jax.tree.map(lambda a: a[r], aux_host)
+                 for r in range(len(ns))]
+        return [host[r, :n] for r, n in enumerate(ns)], auxes
 
     def step_async(self, sched_batch: ScheduledBatch):
         """Launch one step; returns an opaque handle whose tokens are an

@@ -1,0 +1,1 @@
+"""Model-bundled tokenizer/encoder adapters (reference gllm/tokenizers/)."""

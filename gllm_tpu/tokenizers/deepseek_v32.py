@@ -1,0 +1,103 @@
+"""DeepSeek-V3.2 chat rendering via the checkpoint's bundled encoder.
+
+V3.2 checkpoints ship no usable Jinja ``chat_template``; the model-native
+DSML prompt markup (user/assistant sentinels, ``<think>`` gating, DSML tool
+invocations) is produced by a Python encoder the checkpoint bundles at
+``<model_path>/encoding/encoding_dsv32.py``. The reference loads that file
+at runtime and adapts its OpenAI-style call sites to it
+(/root/reference/gllm/tokenizers/deepseek_v32.py); we do the same so chat
+requests render exactly the markup the model was trained on. When the file
+is absent (or fails to import) callers fall back to
+``apply_chat_template``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+# model_path → imported encoder module, or None when unavailable (negative
+# results are cached too: the common non-DSv32 case must stay zero-cost).
+_CACHE: Dict[str, Optional[Any]] = {}
+
+
+def load_encoder(model_path: str) -> Optional[Any]:
+    """Import ``<model_path>/encoding/encoding_dsv32.py`` once per path.
+
+    The module must expose ``encode_messages``; ``None`` means "use the
+    generic chat template instead"."""
+    if model_path in _CACHE:
+        return _CACHE[model_path]
+    mod: Optional[Any] = None
+    path = os.path.join(model_path, "encoding", "encoding_dsv32.py")
+    if os.path.isfile(path):
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "gllm_tpu_dsv32_encoding", path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            if not callable(getattr(mod, "encode_messages", None)):
+                mod = None
+        except Exception:
+            mod = None
+    _CACHE[model_path] = mod
+    return mod
+
+
+def _normalize(messages: List[Any]) -> List[dict]:
+    """Realize request messages as plain JSON dicts: pydantic models and
+    lazy iterators (e.g. tool_calls validators) break the encoder's
+    ``len``/iteration, so round-trip through JSON."""
+    out = []
+    for m in messages:
+        if hasattr(m, "model_dump"):
+            out.append(m.model_dump(mode="json", exclude_none=True))
+        else:
+            out.append(json.loads(json.dumps(m, default=list)))
+    return out
+
+
+def render_chat(encoder: Any, messages: List[Any], tokenizer: Any = None,
+                *, tools: Optional[List[dict]] = None, tokenize: bool = True,
+                **kwargs: Any):
+    """Render a chat request with the bundled encoder.
+
+    - ``thinking`` / ``enable_thinking`` request kwargs select the
+      encoder's thinking mode (default plain chat).
+    - ``tools`` ride on a leading system message, which is how the
+      encoder expects tool declarations.
+    - a trailing user turn drops prior-turn reasoning (the model's
+      convention: reasoning only persists mid-assistant-turn).
+    - the encoder emits BOS itself → tokenize without special tokens.
+
+    Returns token ids when ``tokenize`` (requires ``tokenizer``), else the
+    prompt string."""
+    thinking = bool(kwargs.get("thinking")
+                    or kwargs.get("enable_thinking"))
+    messages = _normalize(messages)
+    if tools:
+        messages.insert(0, {"role": "system",
+                            "tools": _normalize(tools)})
+    drop_thinking = bool(messages) and messages[-1].get("role") == "user"
+    prompt = encoder.encode_messages(messages,
+                                     thinking_mode=("thinking" if thinking
+                                                    else "chat"),
+                                     drop_thinking=drop_thinking)
+    if not tokenize:
+        return prompt
+    return tokenizer.encode(prompt, add_special_tokens=False)
+
+
+def parse_completion(encoder: Any, text: str):
+    """Parse a completion back into message structure via the encoder's
+    own parser when it ships one; ``None`` → caller keeps its generic
+    tool/content parsing."""
+    fn = getattr(encoder, "parse_message_from_completion_text", None)
+    if not callable(fn):
+        return None
+    try:
+        return fn(text)
+    except Exception:
+        return None

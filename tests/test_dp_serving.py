@@ -28,12 +28,13 @@ def ckpt(tmp_path_factory):
     return str(d)
 
 
-def make_llm(ckpt, dp=1, **sched):
+def make_llm(ckpt, dp=1, tp=1, attention_impl="auto", **sched):
     cfg = EngineConfig(
         model=ckpt, dtype="float32", max_model_len=128,
+        attention_impl=attention_impl,
         scheduler=SchedulerConfig(**sched) if sched else SchedulerConfig(),
         cache=CacheConfig(page_size=4, num_pages=64),
-        parallel=ParallelConfig(dp=dp))
+        parallel=ParallelConfig(dp=dp, tp=tp))
     return LLM(config=cfg)
 
 
@@ -109,6 +110,82 @@ def test_dp2_moe_ep(ckpt, tmp_path):
             prompt_token_ids=prompts, sampling_params=sp)]
 
     assert run(2) == run(1)
+
+
+def test_dp2_pallas_matches_dp1_xla(ckpt):
+    """dp=2 with attention_impl='pallas' (shard_map manual over the dp
+    axis, kernels in interpret mode on CPU) is byte-identical to dp=1
+    XLA — the reference runs FA3 in every DP replica
+    (worker.py:750-829)."""
+    rng = np.random.default_rng(7)
+    prompts = [[int(x) for x in rng.integers(2, 120, size=int(n))]
+               for n in rng.integers(2, 30, size=5)]
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    base = [o.output_token_ids
+            for o in make_llm(ckpt, attention_impl="xla").generate(
+                prompt_token_ids=prompts, sampling_params=sp)]
+    dp2 = [o.output_token_ids
+           for o in make_llm(ckpt, dp=2, attention_impl="pallas").generate(
+               prompt_token_ids=prompts, sampling_params=sp)]
+    assert base == dp2
+
+
+def test_dp2_tp2_pallas_matches_dp1_xla(ckpt):
+    """dp=2 × tp=2 with Pallas attention: the dp axis is manual
+    (shard_map), tp stays auto inside and the attention dispatch nests
+    its tp shard_map over the context mesh."""
+    rng = np.random.default_rng(9)
+    prompts = [[int(x) for x in rng.integers(2, 120, size=int(n))]
+               for n in rng.integers(2, 30, size=4)]
+    sp = SamplingParams(temperature=0.0, max_tokens=7, ignore_eos=True)
+
+    base = [o.output_token_ids
+            for o in make_llm(ckpt, attention_impl="xla").generate(
+                prompt_token_ids=prompts, sampling_params=sp)]
+    dp2 = [o.output_token_ids
+           for o in make_llm(ckpt, dp=2, tp=2,
+                             attention_impl="pallas").generate(
+               prompt_token_ids=prompts, sampling_params=sp)]
+    assert base == dp2
+
+
+def test_dp2_logprobs_match_dp1(ckpt):
+    """Output + prompt logprobs under dp=2 (reference computes logprobs
+    from every worker, sampler.py:71-91) match dp=1 numerically."""
+    rng = np.random.default_rng(5)
+    prompts = [[int(x) for x in rng.integers(2, 120, size=int(n))]
+               for n in rng.integers(4, 24, size=4)]
+    sps = [SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True,
+                          logprobs=3, prompt_logprobs=2),
+           SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True,
+                          logprobs=2),
+           SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True),
+           SamplingParams(temperature=0.0, max_tokens=5, ignore_eos=True,
+                          prompt_logprobs=1)]
+
+    def run(dp):
+        return make_llm(ckpt, dp=dp).generate(prompt_token_ids=prompts,
+                                              sampling_params=sps)
+
+    base, dp2 = run(1), run(2)
+    for a, b in zip(base, dp2):
+        assert a.output_token_ids == b.output_token_ids
+        assert (a.logprobs is None) == (b.logprobs is None)
+        if a.logprobs is not None:
+            for (ca, ia, la), (cb, ib, lb) in zip(a.logprobs, b.logprobs):
+                assert ia == ib
+                np.testing.assert_allclose([ca] + la, [cb] + lb,
+                                           rtol=1e-5, atol=1e-6)
+        assert (a.prompt_logprobs is None) == (b.prompt_logprobs is None)
+        if a.prompt_logprobs is not None:
+            for pa, pb in zip(a.prompt_logprobs, b.prompt_logprobs):
+                assert (pa is None) == (pb is None)
+                if pa is not None:
+                    assert pa[1] == pb[1]
+                    np.testing.assert_allclose(
+                        [pa[0]] + pa[2], [pb[0]] + pb[2],
+                        rtol=1e-5, atol=1e-6)
 
 
 def test_dp2_penalties_match_dp1(ckpt):

@@ -31,16 +31,18 @@ def ckpt(tmp_path_factory):
     return str(d)
 
 
-def run(model_dir, pp=1, tp=1, method="chunked_prefill", assigned=None,
-        n_prompts=4):
+def run(model_dir, pp=1, tp=1, dp=1, method="chunked_prefill",
+        assigned=None, n_prompts=4, attention_impl="auto"):
     cfg = EngineConfig(
         model=model_dir, dtype="float32", max_model_len=128,
+        attention_impl=attention_impl,
         scheduler=SchedulerConfig(schedule_method=method,
                                   max_prefill_tokens=32,
                                   min_prefill_tokens=8,
                                   max_decode_seqs=8),
         cache=CacheConfig(page_size=4, num_pages=256),
-        parallel=ParallelConfig(pp=pp, tp=tp, assigned_layers=assigned),
+        parallel=ParallelConfig(pp=pp, tp=tp, dp=dp,
+                                assigned_layers=assigned),
     )
     llm = LLM(config=cfg)
     prompts = [[3, 14, 15, 92, 6], [53, 58], [9, 7, 9, 3, 2, 3, 8, 4],
@@ -109,6 +111,109 @@ def test_pp_pipeline_keeps_batches_in_flight(ckpt):
     # pp=2 must actually keep TWO microbatches in flight at some moment —
     # the pipelining claim, not just "a batch existed" (VERDICT r1 weak 7)
     assert max_depth >= 2, max_depth
+
+
+def test_pp2_pallas_matches_single(ckpt):
+    """pp=2 with the Pallas engine path (interpret kernels on CPU)."""
+    assert run(ckpt, pp=2, attention_impl="pallas") == run(ckpt, pp=1)
+
+
+def test_pp2_tp2_pallas_matches_single(ckpt):
+    """pp×tp with Pallas attention: each stage's trace nests the tp
+    shard_map over that stage's own mesh (the context mesh) — the
+    reference bar is FA3 under every parallel mode
+    (layers/attention.py:92-140)."""
+    assert run(ckpt, pp=2, tp=2, attention_impl="pallas") == run(ckpt,
+                                                                 pp=1)
+
+
+def test_pp2_dp2_matches_single(ckpt):
+    """dp×pp grid: two private pipelines on disjoint device blocks
+    (reference worker.py:831-889 runs the full pp×dp×tp grid)."""
+    assert run(ckpt, pp=2, dp=2) == run(ckpt, pp=1)
+
+
+def test_pp2_dp2_tp2_matches_single(ckpt):
+    assert run(ckpt, pp=2, dp=2, tp=2) == run(ckpt, pp=1)
+
+
+def test_pp2_logprobs_match_pp1():
+    """Output + prompt logprobs computed on the last PP stage match the
+    single-runner values (reference sampler runs on every last-stage
+    rank, sampler.py:71-91)."""
+    import numpy as np
+    import tempfile
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(22)
+    with tempfile.TemporaryDirectory() as d:
+        LlamaForCausalLM(LlamaConfig(**TINY, attention_bias=False)
+                         ).save_pretrained(d, safe_serialization=True)
+
+        def go(pp):
+            cfg = EngineConfig(
+                model=d, dtype="float32", max_model_len=128,
+                cache=CacheConfig(page_size=4, num_pages=256),
+                parallel=ParallelConfig(pp=pp))
+            sps = [SamplingParams(temperature=0.0, max_tokens=5,
+                                  ignore_eos=True, logprobs=3,
+                                  prompt_logprobs=2),
+                   SamplingParams(temperature=0.0, max_tokens=5,
+                                  ignore_eos=True, logprobs=2)]
+            return LLM(config=cfg).generate(
+                prompt_token_ids=[[3, 14, 15, 92, 6], [53, 58, 9, 21]],
+                sampling_params=sps)
+
+        base, pp2 = go(1), go(2)
+        for a, b in zip(base, pp2):
+            assert a.output_token_ids == b.output_token_ids
+            for (ca, ia, la), (cb, ib, lb) in zip(a.logprobs, b.logprobs):
+                assert ia == ib
+                np.testing.assert_allclose([ca] + la, [cb] + lb,
+                                           rtol=1e-5, atol=1e-6)
+            assert (a.prompt_logprobs is None) == (b.prompt_logprobs
+                                                  is None)
+            if a.prompt_logprobs is not None:
+                for pa, pb in zip(a.prompt_logprobs, b.prompt_logprobs):
+                    assert (pa is None) == (pb is None)
+                    if pa is not None:
+                        assert pa[1] == pb[1]
+                        np.testing.assert_allclose(
+                            [pa[0]] + pa[2], [pb[0]] + pb[2],
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_pp2_hybrid_gdn_matches_pp1(tmp_path):
+    """Hybrid (GDN) model over pp=2: stage bounds align to the layer-type
+    period; each stage owns its layers' paged KV + GDN slot pools
+    (reference builds per-stage qwen3_5 layers via get_pp_layers,
+    dist_utils.py:494-528)."""
+    import sys
+    sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent))
+    from test_hybrid_qwen3next import BASE, make_ckpt
+    make_ckpt(tmp_path, num_hidden_layers=8,
+              layer_types=list(BASE["layer_types"]) * 2)
+
+    def go(pp):
+        cfg = EngineConfig(
+            model=str(tmp_path), dtype="float32", max_model_len=128,
+            cache=CacheConfig(page_size=4, num_pages=128),
+            parallel=ParallelConfig(pp=pp))
+        return [o.output_token_ids for o in LLM(config=cfg).generate(
+            prompt_token_ids=[[3, 14, 15, 92, 6], [53, 58, 9],
+                              [9, 7, 9, 3, 2, 3, 8, 4]],
+            sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
+                                           ignore_eos=True))]
+
+    assert go(2) == go(1)
+
+
+def test_pp_hybrid_stage_bounds_respect_period():
+    assert split_layers(8, 2, multiple=4) == [(0, 4), (4, 8)]
+    assert split_layers(12, 2, multiple=4) == [(0, 8), (8, 12)]
+    with pytest.raises(ValueError):
+        split_layers(4, 2, multiple=4)     # fewer period-units than pp
+    with pytest.raises(ValueError):
+        split_layers(8, 2, [2, 6], multiple=4)
 
 
 def test_pp_quantized_matches_pp1_quantized(ckpt):

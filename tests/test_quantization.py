@@ -186,6 +186,72 @@ def test_moe_experts_are_quantized_and_close(tmp_path):
     assert a.output_token_ids[:2] == b.output_token_ids[:2]
 
 
+def test_qragged_dot_w8a8_matches_per_expert_qmm():
+    """The int8 MXU grouped GEMM (epilogue scales, no dequantized stack)
+    must agree with running each expert's QuantizedW8A8 qmm separately —
+    same activation-quant semantics, grouped in one ragged call
+    (reference fused quantized MoE GEMM, fused_moe_triton/layer.py)."""
+    import jax.numpy as jnp
+
+    from gllm_tpu.ops.quant import (QuantizedW8A8, qmm, qragged_dot,
+                                    quantize_weight)
+    rng = np.random.default_rng(0)
+    E, K, N, R = 3, 32, 16, 10
+    w = jnp.asarray(rng.normal(size=(E, K, N)), jnp.float32)
+    qz = quantize_weight(w, jnp.int8)
+    wq = QuantizedW8A8(qz.q, qz.scale)
+    xs = jnp.asarray(rng.normal(size=(R, K)), jnp.float32)
+    sizes = [4, 0, 6]
+    group_sizes = jnp.asarray(sizes, jnp.int32)
+    eids = jnp.asarray(sum(([e] * n for e, n in enumerate(sizes)), []),
+                       jnp.int32)
+
+    out = qragged_dot(xs, wq, group_sizes, eids)
+    start = 0
+    for e, n in enumerate(sizes):
+        if n == 0:
+            continue
+        ref = qmm(xs[start:start + n],
+                  QuantizedW8A8(wq.q[e], wq.scale[e]))
+        np.testing.assert_allclose(np.asarray(out[start:start + n]),
+                                   np.asarray(ref), rtol=1e-5, atol=1e-5)
+        start += n
+
+
+def test_moe_w8a8_no_dequantized_stack_and_close(tmp_path):
+    """W8A8 MoE: the expert hot path runs the int8 grouped GEMM (no deq)
+    and engine outputs stay close to full precision."""
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    from gllm_tpu.ops.quant import QuantizedW8A8
+    torch.manual_seed(11)
+    Qwen2MoeForCausalLM(Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        moe_intermediate_size=32, shared_expert_intermediate_size=48,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=128, eos_token_id=0)).save_pretrained(
+        tmp_path, safe_serialization=True)
+
+    def make(q):
+        cfg = EngineConfig(model=str(tmp_path), dtype="float32",
+                           max_model_len=64, quantization=q,
+                           cache=CacheConfig(page_size=4, num_pages=64))
+        return LLM(config=cfg)
+
+    llm_q = make("w8a8")
+    assert isinstance(llm_q.runner.params["layers"]["w_gate"],
+                      QuantizedW8A8)
+    sp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+    a = llm_q.generate(prompt_token_ids=[[5, 9, 23], [7, 12, 2, 44]],
+                       sampling_params=sp)
+    b = make(None).generate(prompt_token_ids=[[5, 9, 23], [7, 12, 2, 44]],
+                            sampling_params=sp)
+    for qa, qb in zip(a, b):
+        assert qa.output_token_ids[:2] == qb.output_token_ids[:2]
+
+
 def test_hybrid_gdn_int8_quantized_runs(tmp_path):
     """Hybrid GDN projections (in_qkvz/out_proj) route through qmm."""
     from tests.test_hybrid_qwen3next import make_ckpt
