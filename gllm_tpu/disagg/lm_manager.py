@@ -222,13 +222,6 @@ class DisaggCoordinator:
             # of the engine thread dying on an AssertionError
             raise ValueError(f"{n_sentinels} vision sentinels in the "
                              f"skeleton != {len(raw_items)} media items")
-        if self.model_cfg.mm_per_frame_video and any(
-                m == "video" for m, _ in raw_items):
-            # per-frame-video models (Qwen3-VL) need per-frame grid
-            # normalization that disagg metas don't carry yet; reject
-            # cleanly instead of silently diverging from the monolith
-            raise ValueError("video items over disagg are not supported "
-                             "for per-frame-video models yet")
         now = time.monotonic()
         ps = _PendingSeq(seq=seq, items=[
             _PendingItem(item_idx=i, modality=m, content=c, queued_at=now)
@@ -409,11 +402,33 @@ class DisaggCoordinator:
         assert cursor == len(ps.items)
 
         # 2) MMState through the monolith's own path (pixels=None items;
-        #    positions / hash ids / vis_index identical by construction)
-        items = [MMItem(it.modality, None,
-                        tuple(int(v) for v in it.meta.grid_thw),
-                        it.meta.content_hash)
-                 for it in ps.items]
+        #    positions / hash ids / vis_index identical by construction).
+        #    Per-frame-video models (Qwen3-VL): the monolith normalizes a
+        #    (t,h,w) video grid to t per-frame (1,h,w) items BEFORE
+        #    position/index building (engine/mm.py build_mm_state) — the
+        #    disagg meta carries the raw grid, so the same normalization
+        #    happens here. Row counts are unchanged (t·h·w total), so the
+        #    slot transfer below stays one span per RAW item; per-frame
+        #    hashes REHASH (item hash, frame index) so the leading bytes
+        #    mm_pad_id reads differ per frame (prefix-cache keys stay
+        #    deterministic and frame-distinct — appending the index would
+        #    leave the pad-id prefix identical across frames).
+        import hashlib as _hl
+        items = []
+        for it in ps.items:
+            g = tuple(int(v) for v in it.meta.grid_thw)
+            if (it.modality == "video" and cfg.mm_per_frame_video
+                    and g[0] > 1):
+                items.extend(
+                    MMItem("video", None, (1, g[1], g[2]),
+                           _hl.blake2b(
+                               it.meta.content_hash
+                               + f.to_bytes(4, "little"),
+                               digest_size=16).digest())
+                    for f in range(g[0]))
+            else:
+                items.append(MMItem(it.modality, None, g,
+                                    it.meta.content_hash))
         # temporal mrope scaling for video items (monolith parity; the
         # builder consumes one entry per VIDEO item in order)
         spg = [it.meta.second_per_grid_ts for it in ps.items

@@ -146,8 +146,11 @@ class LLM:
         from gllm_tpu.disagg.lm_manager import DisaggCoordinator
         if not self.model_cfg.use_mm:
             raise ValueError("disagg LM mode needs a VL checkpoint")
-        if self.dp > 1 or self.config.parallel.pp > 1:
-            raise NotImplementedError("disagg with dp/pp > 1")
+        # Any LM topology can front a disagg encoder fleet (reference
+        # dispatches from every dp/pp grid, disagg/lm_manager.py:256-900):
+        # admits route through add_seq (dp round-robin over per-replica
+        # schedulers) and the coordinator poll runs before either step
+        # path, so no parallelism guard is needed.
         self.disagg_coordinator = DisaggCoordinator(self.model_cfg,
                                                     disagg_cfg)
 

@@ -160,28 +160,54 @@ def _index_and_hash(token_ids, cfg, items, positions, delta) -> MMState:
     vis_index[is_img] = np.arange(int(is_img.sum()))
     vis_index[is_vid] = n_img_tokens + np.arange(int(is_vid.sum()))
 
-    # Splice per-item pad ids over each item's placeholder run, pairing
-    # each run (in prompt order) with the next unused item of the run's
-    # modality — runs never merge across items because the chat template
-    # separates them with vision_start/end tokens.
+    # Splice per-item pad ids over the placeholder runs, pairing runs (in
+    # prompt order) with the next unused item(s) of the run's modality.
+    # One run may cover SEVERAL consecutive items: per-frame-video models
+    # lay the frames of one video back-to-back in a single span (and the
+    # disagg skeleton expansion emits one contiguous span per raw item),
+    # so each item consumes its own grid-worth of tokens within the run —
+    # the same contract as get_mrope_input_positions, which also walks
+    # back-to-back grids through one span.
     hash_ids = list(int(t) for t in token_ids)
-    run_starts = []
+    run_bounds = []
     prev = False
     for i, v in enumerate(is_vis):
         if v and not prev:
-            run_starts.append(i)
+            run_bounds.append([i, i + 1])
+        elif v:
+            run_bounds[-1][1] = i + 1
         prev = bool(v)
-    assert len(run_starts) == len(items), (len(run_starts), len(items))
     by_modality = {"image": [it for it in items if it.modality == "image"],
                    "video": [it for it in items if it.modality == "video"]}
-    for start in run_starts:
-        modality = "image" if is_img[start] else "video"
-        item = by_modality[modality].pop(0)
-        pad = mm_pad_id(item.hash)
-        i = start
-        while i < len(hash_ids) and is_vis[i]:
-            hash_ids[i] = pad
-            i += 1
+    if len(run_bounds) == len(items):
+        # 1:1 — each run is one whole item (token count per item is then
+        # model-defined: e.g. Kimi's temporal pooling shrinks video runs
+        # below the grid formula, which is fine because the run length IS
+        # the item's token count here)
+        for start, end in run_bounds:
+            modality = "image" if is_img[start] else "video"
+            item = by_modality[modality].pop(0)
+            hash_ids[start:end] = [mm_pad_id(item.hash)] * (end - start)
+    else:
+        # fewer runs than items: back-to-back items share a span, so each
+        # item's extent comes from its grid (exact for the per-frame
+        # models whose normalization creates this layout)
+        merge = (cfg.vision_config or {}).get("spatial_merge_size", 2)
+        unit = merge * merge
+        for start, end in run_bounds:
+            modality = "image" if is_img[start] else "video"
+            i = start
+            while i < end:
+                assert by_modality[modality], \
+                    f"{modality} placeholder run at {start} has no item left"
+                item = by_modality[modality].pop(0)
+                t, h, w = item.grid_thw
+                n = t * h * w // unit
+                hash_ids[i:i + n] = [mm_pad_id(item.hash)] * n
+                i += n
+            assert i == end, (start, end, i)
+    assert not by_modality["image"] and not by_modality["video"], \
+        "items left over after all placeholder runs were filled"
 
     return MMState(items=items, mrope_positions=positions,
                    mrope_delta=delta, vis_index=vis_index,

@@ -626,6 +626,11 @@ def make_parser() -> argparse.ArgumentParser:
     # jax.distributed coordinator/worker)
     p.add_argument("--coordinator-address", default=None,
                    help="host:port of host 0 for multi-host serving")
+    p.add_argument("--blob-advertise-host", default=None,
+                   help="address followers use to reach host 0's bulk-"
+                        "payload (MM pixel) server; default resolves "
+                        "gethostname(), which is wrong on hosts whose "
+                        "/etc/hosts maps the hostname to loopback")
     p.add_argument("--num-hosts", type=int, default=1)
     p.add_argument("--host-id", type=int, default=None)
     p.add_argument("--pp", type=int, default=1)
@@ -675,7 +680,9 @@ def main(argv=None):
             return
         state = ServerState(llm, args.served_model_name or args.model,
                             tool_parser=args.tool_call_parser,
-                            engine=MultihostServingEngine(llm))
+                            engine=MultihostServingEngine(
+                                llm,
+                                advertise_host=args.blob_advertise_host))
         handler = type("BoundHandler", (Handler,), {"state": state})
         httpd = ThreadingHTTPServer((args.host, args.port), handler)
         httpd.state = state

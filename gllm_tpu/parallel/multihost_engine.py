@@ -53,14 +53,58 @@ def broadcast_payload(obj) -> object:
 
 
 @dataclasses.dataclass
+class BlobRef:
+    """Placeholder for a bulk ndarray lifted out of the tick broadcast."""
+    key: str                             # content hash (hex)
+    shape: tuple
+    dtype: str
+
+
+# Arrays below this ride the pickle broadcast directly; above it they move
+# through the host-0 blob server instead, so one big video can't serialize
+# the whole intake collective (the concern the reference answers with
+# per-DP zmq endpoints, comm.py:436-524). Env-overridable for tests.
+import os as _os
+
+BLOB_MIN_BYTES = int(_os.environ.get("GLLM_TPU_BLOB_MIN_BYTES", 1 << 16))
+
+
+def _lift_blobs(mm: Optional[dict]):
+    """(mm with BlobRefs, {key: bytes}) — large ndarrays only."""
+    if not mm:
+        return mm, {}
+    import hashlib
+    out, blobs = {}, {}
+    for k, v in mm.items():
+        arr = np.asarray(v) if v is not None else None
+        if arr is not None and arr.nbytes >= BLOB_MIN_BYTES:
+            raw = np.ascontiguousarray(arr).tobytes()
+            key = hashlib.blake2b(raw, digest_size=16).hexdigest()
+            blobs[key] = raw
+            out[k] = BlobRef(key, tuple(arr.shape), str(arr.dtype))
+        else:
+            out[k] = v
+    return out, blobs
+
+
+def _resolve_blobs(mm: Optional[dict], fetch):
+    if not mm:
+        return mm
+    return {k: (np.frombuffer(fetch(v.key), dtype=v.dtype)
+                .reshape(v.shape) if isinstance(v, BlobRef) else v)
+            for k, v in mm.items()}
+
+
+@dataclasses.dataclass
 class RequestDesc:
     """Wire form of one request (frontend → every host)."""
     seq_id: int
     token_ids: List[int]
     sampling: dict                       # dataclasses.asdict(SamplingParams)
-    mm: Optional[dict] = None            # raw mm_input (pixel arrays ride
-                                         # the pickle broadcast; every host
-                                         # rebuilds the same MM state)
+    mm: Optional[dict] = None            # mm_input; arrays >= BLOB_MIN_BYTES
+                                         # are BlobRefs served by host 0's
+                                         # blob server (content-addressed),
+                                         # the rest rides the broadcast
 
 
 @dataclasses.dataclass
@@ -71,6 +115,66 @@ class Tick:
     shutdown: bool = False
 
 
+class BlobStore:
+    """Host-0 side: content-addressed bytes + a TCP server for followers.
+
+    Lifecycle: blobs published with tick T are guaranteed fetched once the
+    tick T+1 broadcast completes (every follower fully applies T — fetches
+    included — before entering the next collective), so host 0 retires
+    them then. No acks needed; the collective IS the barrier."""
+
+    def __init__(self, host: str = "0.0.0.0"):
+        from gllm_tpu.disagg.wire import MsgServer, send_msg
+        self._data = {}
+        self._send = send_msg
+        self._srv = MsgServer(host, 0, self._on_req).start()
+        self.port = self._srv.port
+
+    def _on_req(self, msg, sock):
+        raw = self._data.get(msg)
+        # empty bytes = unknown key (follower treats as fatal; it means
+        # the retire barrier was violated)
+        self._send(sock, None, raw=raw if raw is not None else b"")
+
+    def put(self, blobs: dict) -> None:
+        self._data.update(blobs)
+
+    def retire(self, keys) -> None:
+        for k in keys:
+            self._data.pop(k, None)
+
+    def close(self) -> None:
+        self._srv.stop()
+
+
+class BlobClient:
+    """Follower side: fetch-by-key with a content-addressed LRU, so a
+    media item repeated across requests crosses the wire once per host."""
+
+    def __init__(self, addr: str):
+        from gllm_tpu.utils import LRUBytesCache
+        self._addr = addr
+        self._sock = None
+        self._cache = LRUBytesCache(max_entries=128, max_mb=512.0)
+
+    def fetch(self, key: str) -> bytes:
+        cached = self._cache.get(key)
+        if cached is not None:
+            return cached
+        from gllm_tpu.disagg.wire import connect, recv_msg, recv_raw, \
+            send_msg
+        if self._sock is None:
+            host, _, port = self._addr.rpartition(":")
+            self._sock = connect((host, int(port)))
+        send_msg(self._sock, key)
+        recv_msg(self._sock)                  # header (None)
+        raw = recv_raw(self._sock)
+        if not raw:
+            raise RuntimeError(f"blob {key} unavailable on host 0")
+        self._cache.put(key, raw)             # bytes on both paths
+        return raw
+
+
 class MultihostEngine:
     """Runs the engine loop on every host; host 0 feeds it requests.
 
@@ -79,7 +183,8 @@ class MultihostEngine:
     Outputs surface only on host 0 (``on_output`` callback).
     """
 
-    def __init__(self, llm, on_output=None, tick_interval: float = 0.002):
+    def __init__(self, llm, on_output=None, tick_interval: float = 0.002,
+                 advertise_host: Optional[str] = None):
         import jax
         self.llm = llm
         self.on_output = on_output or (lambda out: None)
@@ -91,6 +196,21 @@ class MultihostEngine:
         self._shutdown = False
         import threading
         self._lock = threading.Lock()
+        # bulk-payload side channel (host 0 serves, followers fetch)
+        self._blob_store: Optional[BlobStore] = None
+        self._blob_client: Optional[BlobClient] = None
+        self._inflight_keys: List[str] = []    # published with last tick
+        if self.is_host0 and jax.process_count() > 1:
+            self._blob_store = BlobStore()
+            if advertise_host is None:
+                import socket as _s
+                try:
+                    advertise_host = _s.gethostbyname(_s.gethostname())
+                except OSError:
+                    advertise_host = "127.0.0.1"
+            self._blob_addr = f"{advertise_host}:{self._blob_store.port}"
+        else:
+            self._blob_addr = None
 
     # ---- host-0 frontend side ---------------------------------------------
 
@@ -105,14 +225,17 @@ class MultihostEngine:
             from gllm_tpu.engine.mm import build_mm_state
             mm_state = build_mm_state(token_ids, self.llm.model_cfg,
                                       **mm_input)
+        mm_wire, blobs = _lift_blobs(mm_input)
         with self._lock:
+            if blobs and self._blob_store is not None:
+                self._blob_store.put(blobs)
             seq = self.llm._allocate_seq(list(token_ids), sampling_params)
             seq.mm = mm_state
             if on_register is not None:
                 on_register(seq.seq_id)
             self._pending.append(RequestDesc(
                 seq.seq_id, list(token_ids),
-                dataclasses.asdict(sampling_params), mm=mm_input))
+                dataclasses.asdict(sampling_params), mm=mm_wire))
             self._seqs[seq.seq_id] = seq
         return seq.seq_id
 
@@ -138,8 +261,9 @@ class MultihostEngine:
                 seq.seq_id = rd.seq_id
                 if rd.mm:
                     from gllm_tpu.engine.mm import build_mm_state
+                    mm = _resolve_blobs(rd.mm, self._blob_client.fetch)
                     seq.mm = build_mm_state(rd.token_ids, llm.model_cfg,
-                                            **rd.mm)
+                                            **mm)
             try:
                 llm.add_seq(seq)
             except ValueError as e:
@@ -152,6 +276,10 @@ class MultihostEngine:
 
     def _loop(self) -> None:
         llm = self.llm
+        # startup handshake: followers learn the blob-server address
+        addr = broadcast_payload(self._blob_addr)
+        if not self.is_host0 and addr:
+            self._blob_client = BlobClient(addr)
         while True:
             if self.is_host0:
                 with self._lock:
@@ -162,7 +290,28 @@ class MultihostEngine:
             else:
                 tick = None
             tick = broadcast_payload(tick)
+            if self._blob_store is not None:
+                # this broadcast completing means every follower fully
+                # applied the PREVIOUS tick (blob fetches included) —
+                # its blobs can retire now
+                def keys_of(rds):
+                    return {v.key for rd in rds if rd.mm
+                            for v in rd.mm.values()
+                            if isinstance(v, BlobRef)}
+
+                new_keys = keys_of(tick.requests)
+                with self._lock:
+                    # keep alive: this tick's keys AND keys of requests
+                    # already submitted for the next tick (same content
+                    # re-submitted must not lose its bytes to the retire
+                    # of an older tick)
+                    live = new_keys | keys_of(self._pending)
+                    self._blob_store.retire(
+                        set(self._inflight_keys) - live)
+                self._inflight_keys = list(new_keys)
             if tick.shutdown:
+                if self._blob_store is not None:
+                    self._blob_store.close()
                 return
             self._apply_tick(tick)
             if llm.has_unfinished:
@@ -198,7 +347,7 @@ class MultihostServingEngine:
     per-request chunk queues as the single-host ServingEngine.
     """
 
-    def __init__(self, llm):
+    def __init__(self, llm, advertise_host: Optional[str] = None):
         import threading
 
         from gllm_tpu.engine.serving_engine import (RequestHandle,
@@ -231,7 +380,8 @@ class MultihostServingEngine:
             if out.finish_reason is not None:
                 self._handles.pop(out.seq.seq_id, None)
 
-        self.engine = MultihostEngine(llm, on_output=on_output)
+        self.engine = MultihostEngine(llm, on_output=on_output,
+                                      advertise_host=advertise_host)
         self._thread = threading.Thread(target=self.engine.run_host0,
                                         daemon=True, name="gllm-mh-engine")
         self._thread.start()

@@ -52,7 +52,11 @@ class LRUBytesCache:
     @staticmethod
     def _size_of(value) -> int:
         nbytes = getattr(value, "nbytes", None)
-        return int(nbytes) if nbytes is not None else 0
+        if nbytes is not None:
+            return int(nbytes)
+        if isinstance(value, (bytes, bytearray, memoryview)):
+            return len(value)
+        return 0
 
     def get(self, key):
         v = self._cache.get(key)

@@ -118,9 +118,14 @@ def test_two_process_http_serving(tmp_path):
     assert d["body"]["usage"]["completion_tokens"] == 4
 
 
-def test_two_process_mm_serving(tmp_path):
-    """Image request over multi-host: pixels ride the intake broadcast;
-    output matches a single-process run."""
+@pytest.mark.parametrize("blob_min", [None, "1"],
+                         ids=["broadcast-pixels", "blob-channel"])
+def test_two_process_mm_serving(tmp_path, blob_min):
+    """Image request over multi-host: small pixels ride the intake
+    broadcast; with GLLM_TPU_BLOB_MIN_BYTES=1 they are lifted onto the
+    host-0 blob server and the follower fetches them out-of-band (the
+    reference's pixels-off-the-schedule-plane property, comm.py:436-524).
+    Output matches a single-process run either way."""
     import numpy as np
     from transformers import (Qwen2_5_VLConfig,
                               Qwen2_5_VLForConditionalGeneration)
@@ -149,6 +154,8 @@ def test_two_process_mm_serving(tmp_path):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
+    if blob_min is not None:
+        env["GLLM_TPU_BLOB_MIN_BYTES"] = blob_min
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
     worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
@@ -189,3 +196,37 @@ def test_two_process_mm_serving(tmp_path):
         sampling_params=SamplingParams(temperature=0.0, max_tokens=4,
                                        ignore_eos=True))[0]
     assert d["output"] == want.output_token_ids, (d, want.output_token_ids)
+
+
+def test_blob_lift_resolve_roundtrip():
+    """Unit: _lift_blobs / BlobStore / BlobClient / _resolve_blobs."""
+    import numpy as np
+    from gllm_tpu.parallel import multihost_engine as me
+
+    rng = np.random.default_rng(1)
+    big = rng.standard_normal((me.BLOB_MIN_BYTES // 4 + 16,)) \
+        .astype(np.float32)                      # > threshold
+    small = np.arange(4, dtype=np.int64)
+    mm = {"pixel_values": big, "image_grid_thw": small, "none": None}
+    wire, blobs = me._lift_blobs(mm)
+    assert isinstance(wire["pixel_values"], me.BlobRef)
+    assert isinstance(wire["image_grid_thw"], np.ndarray)
+    assert len(blobs) == 1
+
+    store = me.BlobStore(host="127.0.0.1")
+    try:
+        store.put(blobs)
+        cli = me.BlobClient(f"127.0.0.1:{store.port}")
+        out = me._resolve_blobs(wire, cli.fetch)
+        np.testing.assert_array_equal(out["pixel_values"], big)
+        np.testing.assert_array_equal(out["image_grid_thw"], small)
+        # cache hit path (after retire the bytes only live in the cache)
+        store.retire(blobs.keys())
+        out2 = me._resolve_blobs(wire, cli.fetch)
+        np.testing.assert_array_equal(out2["pixel_values"], big)
+        # a truly unknown key is fatal
+        import pytest as _pytest
+        with _pytest.raises(RuntimeError):
+            cli.fetch("deadbeef")
+    finally:
+        store.close()
