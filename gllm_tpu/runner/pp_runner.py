@@ -96,6 +96,9 @@ class _Stage:
     device: object          # placement target (Device or NamedSharding mesh)
     mesh: object
     fn: object              # jit'd stage program
+    cos_sin: object = None  # rope table pre-placed on this stage's devices
+                            # (re-transferring it every call costs a
+                            # host→device copy per stage per step)
 
 
 class PPModelRunner(ModelRunner):
@@ -292,6 +295,9 @@ class PPModelRunner(ModelRunner):
             # stage's own device group (ops/attention.py).
             set_shard_context(self.stages[0].mesh, "tp")
         self.cos_sin = self.model_def.make_rope_table(model_cfg)
+        for stages in self.replicas:
+            for stage in stages:
+                stage.cos_sin = jax.device_put(self.cos_sin, stage.device)
         if model_cfg.use_mm:
             # the inherited _prepare_mm embeds on stage 0 (visual tower)
             self.params = self.stages[0].params
@@ -416,14 +422,23 @@ class PPModelRunner(ModelRunner):
         lp_k, want_plp = self._lp_flags(sched_batch)
         hidden = residual = None
         out = None
-        for stage in stages:
-            sb = jax.device_put(batch, stage.device)
+        # one batched host→device transfer fans the step batch out to
+        # every stage (and presence to the last) — one dispatch call
+        # instead of per-stage puts
+        last = stages[-1]
+        targets = [batch] * len(stages)
+        devices = [s.device for s in stages]
+        if presence is not None:
+            targets.append(presence)
+            devices.append(last.device)
+        placed = jax.device_put(targets, devices)
+        sbs = placed[:len(stages)]
+        presence = placed[len(stages)] if presence is not None else None
+        for stage, sb in zip(stages, sbs):
             if hidden is not None:
                 hidden = jax.device_put(hidden, stage.device)
                 residual = jax.device_put(residual, stage.device)
             pm = presence if stage.cfg.is_last_stage else None
-            if pm is not None:
-                pm = jax.device_put(pm, stage.device)
             # lp flags are static jit args — only the last stage reads
             # them, so earlier stages keep their (-1, False) cache entry
             # for every logprobs pattern (no pipeline-wide recompiles)
@@ -431,7 +446,7 @@ class PPModelRunner(ModelRunner):
                      if stage.cfg.is_last_stage else {})
             with mesh_context(stage.mesh):
                 out, stage.kv = stage.fn(stage.params, stage.kv, sb,
-                                         self.cos_sin, hidden, residual,
+                                         stage.cos_sin, hidden, residual,
                                          pm, max_q_len=max_q, **lp_kw)
             if not stage.cfg.is_last_stage:
                 hidden, residual = out
