@@ -237,7 +237,11 @@ class LLM:
         behind the device step.
         """
         if self.disagg_coordinator is not None:
-            self._poll_disagg()
+            # multihost: the MultihostEngine polls the coordinator itself
+            # (events must ride the tick broadcast) — skip the local poll
+            # but keep the don't-spin-hot sleep
+            if not getattr(self, "disagg_external_poll", False):
+                self._poll_disagg()
             if not any(s.has_unfinished for s in self.schedulers) \
                     and not self._in_flight:
                 # only disagg-pending work: don't spin the poll loop hot

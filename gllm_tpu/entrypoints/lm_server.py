@@ -42,11 +42,59 @@ def add_disagg_args(p):
 def main(argv=None):
     logging.basicConfig(level=logging.INFO)
     args = add_disagg_args(make_parser()).parse_args(argv)
+    multihost = False
+    if args.num_hosts > 1 or args.coordinator_address:
+        from gllm_tpu.parallel.multihost import init_multihost
+        init_multihost(args.coordinator_address, args.num_hosts,
+                       args.host_id)
+        import jax
+        multihost = jax.process_count() > 1
     cfg = build_engine_config(args)
     cfg.skip_visual_load = True
     llm = LLM(config=cfg)
     if not args.skip_warmup:
         llm.runner.warmup()
+    if multihost:
+        # followers mirror the engine loop only; the disagg coordinator
+        # (encoder fleet, slot pool) lives on host 0 and its events ride
+        # the tick broadcast (parallel/multihost_engine.py)
+        import jax
+
+        from gllm_tpu.entrypoints.api_server import (Handler, ServerState,
+                                                     ThreadingHTTPServer)
+        from gllm_tpu.parallel.multihost_engine import (
+            MultihostEngine, MultihostServingEngine)
+        if jax.process_index() != 0:
+            logger.info("follower %d joined; mirroring engine loop",
+                        jax.process_index())
+            MultihostEngine(llm).run_follower()
+            return
+        _init_disagg(llm, args)
+        state = ServerState(llm, args.served_model_name or args.model,
+                            tool_parser=args.tool_call_parser,
+                            engine=MultihostServingEngine(
+                                llm,
+                                advertise_host=args.blob_advertise_host))
+        handler = type("BoundHandler", (Handler,), {"state": state})
+        httpd = ThreadingHTTPServer((args.host, args.port), handler)
+        httpd.state = state
+    else:
+        _init_disagg(llm, args)
+        httpd = serve(llm, args.host, args.port,
+                      args.served_model_name or args.model,
+                      tool_parser=args.tool_call_parser)
+    logger.info("disagg LM serving %s on %s:%d", args.model, args.host,
+                args.port)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.state.engine.shutdown()
+        llm.disagg_coordinator.close()
+
+
+def _init_disagg(llm, args) -> None:
     from gllm_tpu.engine.mm_processing import processor_config_hash
     llm.init_disagg(DisaggConfig(
         is_lm=True, skip_visual=True,
@@ -57,18 +105,6 @@ def main(argv=None):
         num_slots=args.num_slots,
         max_vis_tokens=args.max_vis_tokens,
         overlap=not args.no_disagg_overlap))
-    httpd = serve(llm, args.host, args.port,
-                  args.served_model_name or args.model,
-                  tool_parser=args.tool_call_parser)
-    logger.info("disagg LM serving %s on %s:%d", args.model, args.host,
-                args.port)
-    try:
-        httpd.serve_forever()
-    except KeyboardInterrupt:
-        pass
-    finally:
-        httpd.state.engine.shutdown()
-        llm.disagg_coordinator.close()
 
 
 if __name__ == "__main__":

@@ -69,30 +69,38 @@ import os as _os
 BLOB_MIN_BYTES = int(_os.environ.get("GLLM_TPU_BLOB_MIN_BYTES", 1 << 16))
 
 
+def _lift_array(arr, blobs: dict):
+    """BlobRef (bytes added to ``blobs``) if large, else the array."""
+    import hashlib
+    if arr is None or arr.nbytes < BLOB_MIN_BYTES:
+        return arr
+    raw = np.ascontiguousarray(arr).tobytes()
+    key = hashlib.blake2b(raw, digest_size=16).hexdigest()
+    blobs[key] = raw
+    return BlobRef(key, tuple(arr.shape), str(arr.dtype))
+
+
 def _lift_blobs(mm: Optional[dict]):
     """(mm with BlobRefs, {key: bytes}) — large ndarrays only."""
     if not mm:
         return mm, {}
-    import hashlib
     out, blobs = {}, {}
     for k, v in mm.items():
-        arr = np.asarray(v) if v is not None else None
-        if arr is not None and arr.nbytes >= BLOB_MIN_BYTES:
-            raw = np.ascontiguousarray(arr).tobytes()
-            key = hashlib.blake2b(raw, digest_size=16).hexdigest()
-            blobs[key] = raw
-            out[k] = BlobRef(key, tuple(arr.shape), str(arr.dtype))
-        else:
-            out[k] = v
+        out[k] = _lift_array(np.asarray(v) if v is not None else None,
+                             blobs)
     return out, blobs
+
+
+def _resolve_array(v, fetch):
+    if isinstance(v, BlobRef):
+        return np.frombuffer(fetch(v.key), dtype=v.dtype).reshape(v.shape)
+    return v
 
 
 def _resolve_blobs(mm: Optional[dict], fetch):
     if not mm:
         return mm
-    return {k: (np.frombuffer(fetch(v.key), dtype=v.dtype)
-                .reshape(v.shape) if isinstance(v, BlobRef) else v)
-            for k, v in mm.items()}
+    return {k: _resolve_array(v, fetch) for k, v in mm.items()}
 
 
 @dataclasses.dataclass
@@ -108,11 +116,47 @@ class RequestDesc:
 
 
 @dataclasses.dataclass
+class DisaggAdmit:
+    """Coordinator admit (gate A) replicated to every host: the fully
+    expanded sequence state, by value (followers run NO coordinator —
+    the reference's LM-side disagg state machine stays rank-0-only and
+    workers receive derived state, lm_manager admit path)."""
+    seq_id: int
+    token_ids: List[int]                 # expanded (sentinels → runs)
+    sampling: dict
+    mrope_positions: object              # [3, L] np / BlobRef
+    mrope_delta: int
+    vis_index: object                    # [L] np / BlobRef
+    num_vis_tokens: int
+    hash_token_ids: List[int]
+    item_span: List[tuple]
+    vis_span: List[tuple]
+
+
+@dataclasses.dataclass
+class DisaggReady:
+    """Gate-B flip for one item: its embedding rows, by value."""
+    seq_id: int
+    k: int                               # ordered-item index
+    lo: int                              # vis-row span
+    hi: int
+    rows: object                         # np [n, H] / BlobRef
+
+
+@dataclasses.dataclass
+class DisaggAbort:
+    seq_id: int
+
+
+@dataclasses.dataclass
 class Tick:
     """One intake broadcast: requests + aborts + shutdown flag."""
     requests: List[RequestDesc]
     aborts: List[int]
     shutdown: bool = False
+    # coordinator events (host 0's disagg state machine), applied in
+    # order on every host
+    disagg: List[object] = dataclasses.field(default_factory=list)
 
 
 class BlobStore:
@@ -196,6 +240,25 @@ class MultihostEngine:
         self._shutdown = False
         import threading
         self._lock = threading.Lock()
+        # Encoder disaggregation: the coordinator (encoder fleet, slot
+        # pool, two-gate state machine) runs on HOST 0 ONLY — this engine
+        # polls it itself (events must ride the tick broadcast), so
+        # llm.step() skips its local poll via the flag; the coordinator
+        # stays attached (api_server's disagg detection and lm_server's
+        # close read llm.disagg_coordinator).
+        self.coord = getattr(llm, "disagg_coordinator", None)
+        if self.coord is not None:
+            llm.disagg_external_poll = True
+        # seq_id → (Sequence, shadow-ready list) for in-flight disagg seqs
+        self._disagg_seqs: dict = {}
+        # host 0: registry entries whose events are fully emitted — popped
+        # at the NEXT drain, never before the admit tick was applied (a
+        # fully-ready-at-admit seq would otherwise vanish from the
+        # registry before _apply_tick reads it)
+        self._disagg_done: List[int] = []
+        # host 0: user aborts to surface as DisaggAbort events (the
+        # coordinator's own abort path frees state without emitting)
+        self._disagg_aborts: List[int] = []
         # bulk-payload side channel (host 0 serves, followers fetch)
         self._blob_store: Optional[BlobStore] = None
         self._blob_client: Optional[BlobClient] = None
@@ -239,9 +302,137 @@ class MultihostEngine:
             self._seqs[seq.seq_id] = seq
         return seq.seq_id
 
+    def submit_disagg(self, seq, raw_items) -> None:
+        """Host 0: hand a skeleton-tokenized MM request to the
+        coordinator; the admit reaches every host as a tick event."""
+        assert self.is_host0 and self.coord is not None
+        self.coord.submit(seq, raw_items)
+
+    def _drain_disagg_host0(self, blobs: dict) -> List[object]:
+        """Run one coordinator poll and serialize its effects: new admits
+        (expanded state by value), gate-B ready flips since the last poll
+        (diffed against a shadow — the coordinator mutates seq.mm in
+        place), failures. Embedding rows >= BLOB_MIN_BYTES ride the blob
+        channel."""
+        evts: List[object] = []
+        # retire fully-emitted entries from the PREVIOUS drain (their
+        # admit tick has been applied by now)
+        for sid in self._disagg_done:
+            self._disagg_seqs.pop(sid, None)
+        self._disagg_done = []
+        devents = self.coord.poll()
+        # user aborts recorded by abort(): the coordinator has processed
+        # them in the poll above (slot frees); emit the events so every
+        # host drops registry + scheduler state
+        with self._lock:
+            user_aborts, self._disagg_aborts = self._disagg_aborts, []
+        for seq in devents.admits:
+            st = seq.disagg
+            self._disagg_seqs[seq.seq_id] = (seq, [False] * len(st.ready))
+            mm = seq.mm
+            evts.append(DisaggAdmit(
+                seq_id=seq.seq_id, token_ids=list(seq.token_ids),
+                sampling=dataclasses.asdict(seq.sampling_params),
+                mrope_positions=_lift_array(
+                    np.asarray(mm.mrope_positions), blobs),
+                mrope_delta=mm.mrope_delta,
+                vis_index=_lift_array(np.asarray(mm.vis_index), blobs),
+                num_vis_tokens=mm.num_vis_tokens,
+                hash_token_ids=list(mm.hash_token_ids),
+                item_span=list(st.item_span), vis_span=list(st.vis_span)))
+        abort_sids = {seq.seq_id for seq in devents.aborts} | \
+            set(user_aborts)
+        for sid in abort_sids:
+            evts.append(DisaggAbort(sid))
+            self._disagg_seqs.pop(sid, None)
+        # ready diffs (including items already ready at admit time);
+        # fully-emitted entries retire at the NEXT drain (see above)
+        for sid, (seq, shadow) in self._disagg_seqs.items():
+            st = seq.disagg
+            for k, r in enumerate(st.ready):
+                if r and not shadow[k]:
+                    lo, hi = st.vis_span[k]
+                    evts.append(DisaggReady(
+                        sid, k, lo, hi,
+                        _lift_array(seq.mm.vis_embeds[lo:hi].copy(),
+                                    blobs)))
+                    shadow[k] = True
+            if all(shadow):
+                self._disagg_done.append(sid)
+        return evts
+
+    def _apply_disagg_event(self, ev) -> None:
+        from gllm_tpu.sequence import SequenceStatus
+        llm = self.llm
+        if isinstance(ev, DisaggAdmit):
+            if self.is_host0:
+                seq = self._disagg_seqs[ev.seq_id][0]
+            else:
+                from gllm_tpu.disagg.lm_manager import DisaggSeqState
+                from gllm_tpu.engine.mm import MMState
+                from gllm_tpu.sampling_params import SamplingParams
+                fetch = self._blob_client.fetch
+                # Sequence.__init__ derives prompt_len / raw_prompt_len /
+                # detok offsets from the (already expanded) token list —
+                # no re-assignment needed here
+                seq = llm._allocate_seq(list(ev.token_ids),
+                                        SamplingParams(**ev.sampling))
+                seq.seq_id = ev.seq_id
+                seq.mm = MMState(
+                    items=[],
+                    mrope_positions=_resolve_array(ev.mrope_positions,
+                                                   fetch),
+                    mrope_delta=ev.mrope_delta,
+                    vis_index=_resolve_array(ev.vis_index, fetch),
+                    num_vis_tokens=ev.num_vis_tokens,
+                    hash_token_ids=list(ev.hash_token_ids),
+                    vis_embeds=np.zeros(
+                        (ev.num_vis_tokens, llm.model_cfg.mm_embed_dim),
+                        np.float32))
+                seq.disagg = DisaggSeqState(
+                    item_span=list(ev.item_span),
+                    vis_span=list(ev.vis_span),
+                    ready=[False] * len(ev.vis_span))
+                self._disagg_seqs[seq.seq_id] = (seq, None)
+            try:
+                llm.add_seq(seq)
+            except ValueError as e:
+                # deterministic on every host (same validation); host 0
+                # additionally releases coordinator state + reports
+                self._disagg_seqs.pop(ev.seq_id, None)
+                seq.status = SequenceStatus.ABORTED
+                seq.finish_reason = "abort"
+                if self.is_host0:
+                    self.coord.abort([ev.seq_id])
+                    self.on_output(("error", ev.seq_id, str(e)))
+            return
+        if isinstance(ev, DisaggReady):
+            if self.is_host0:
+                return                      # coordinator already applied
+            entry = self._disagg_seqs.get(ev.seq_id)
+            if entry is None:
+                return                      # admit failed / aborted
+            seq = entry[0]
+            seq.mm.vis_embeds[ev.lo:ev.hi] = _resolve_array(
+                ev.rows, self._blob_client.fetch)
+            seq.disagg.ready[ev.k] = True
+            if seq.disagg.all_ready:
+                self._disagg_seqs.pop(ev.seq_id, None)
+            return
+        if isinstance(ev, DisaggAbort):
+            self._disagg_seqs.pop(ev.seq_id, None)
+            if ev.seq_id in llm._seq_replica:    # reached a scheduler
+                llm.abort(ev.seq_id)
+            if self.is_host0:
+                self.on_output(("error", ev.seq_id, "abort"))
+
     def abort(self, seq_id: int) -> None:
         with self._lock:
             self._pending_aborts.append(seq_id)
+            if self.is_host0 and self.coord is not None:
+                self._disagg_aborts.append(seq_id)
+        if self.is_host0 and self.coord is not None:
+            self.coord.abort([seq_id])
 
     def shutdown(self) -> None:
         self._shutdown = True
@@ -273,6 +464,8 @@ class MultihostEngine:
                     self.on_output(("error", rd.seq_id, str(e)))
         for sid in tick.aborts:
             llm.abort(sid)
+        for ev in tick.disagg:
+            self._apply_disagg_event(ev)
 
     def _loop(self) -> None:
         llm = self.llm
@@ -282,9 +475,14 @@ class MultihostEngine:
             self._blob_client = BlobClient(addr)
         while True:
             if self.is_host0:
+                dblobs: dict = {}
+                devts = (self._drain_disagg_host0(dblobs)
+                         if self.coord is not None else [])
+                if dblobs and self._blob_store is not None:
+                    self._blob_store.put(dblobs)
                 with self._lock:
                     tick = Tick(self._pending, self._pending_aborts,
-                                self._shutdown)
+                                self._shutdown, disagg=devts)
                     self._pending = []
                     self._pending_aborts = []
             else:
@@ -294,18 +492,25 @@ class MultihostEngine:
                 # this broadcast completing means every follower fully
                 # applied the PREVIOUS tick (blob fetches included) —
                 # its blobs can retire now
-                def keys_of(rds):
-                    return {v.key for rd in rds if rd.mm
-                            for v in rd.mm.values()
-                            if isinstance(v, BlobRef)}
+                def keys_of(tick_):
+                    ks = {v.key for rd in tick_.requests if rd.mm
+                          for v in rd.mm.values()
+                          if isinstance(v, BlobRef)}
+                    for ev in tick_.disagg:
+                        for v in vars(ev).values():
+                            if isinstance(v, BlobRef):
+                                ks.add(v.key)
+                    return ks
 
-                new_keys = keys_of(tick.requests)
+                new_keys = keys_of(tick)
                 with self._lock:
                     # keep alive: this tick's keys AND keys of requests
                     # already submitted for the next tick (same content
                     # re-submitted must not lose its bytes to the retire
                     # of an older tick)
-                    live = new_keys | keys_of(self._pending)
+                    live = new_keys | {
+                        v.key for rd in self._pending if rd.mm
+                        for v in rd.mm.values() if isinstance(v, BlobRef)}
                     self._blob_store.retire(
                         set(self._inflight_keys) - live)
                 self._inflight_keys = list(new_keys)
@@ -389,9 +594,23 @@ class MultihostServingEngine:
     def submit(self, token_ids, sampling_params, mm_input=None,
                disagg_items=None):
         if disagg_items:
-            raise NotImplementedError(
-                "encoder disaggregation over multi-host is not wired up "
-                "yet (run the disagg coordinator single-host)")
+            # coordinator runs on host 0; the admit reaches every host as
+            # a tick event (gate-B flips ride the blob channel)
+            if self.engine.coord is None:
+                raise ValueError("this engine is not a disagg LM node "
+                                 "(no coordinator initialized)")
+            sampling_params.validate()
+            with self.engine._lock:      # seq-id allocation is shared
+                seq = self.llm._allocate_seq(list(token_ids),
+                                             sampling_params)
+                handle = self._make_handle(seq.seq_id, len(token_ids))
+                self._handles[seq.seq_id] = handle
+            try:
+                self.engine.submit_disagg(seq, disagg_items)
+            except Exception:
+                self._handles.pop(seq.seq_id, None)
+                raise
+            return handle
         sampling_params.validate()
         box = {}
 

@@ -50,6 +50,11 @@ def main():
         jax.distributed.shutdown()
         return
 
+    if mode == "disagg":
+        _run_disagg(jax, llm, result_path, model_dir)
+        jax.distributed.shutdown()
+        return
+
     if jax.process_index() == 0:
         results = {}
 
@@ -163,6 +168,69 @@ def _run_mm(jax, llm, result_path):
         with open(result_path, "w") as f:
             json.dump({"output": results.get(sid),
                        "procs": jax.process_count()}, f)
+    else:
+        MultihostEngine(llm).run_follower()
+
+
+def disagg_image():
+    import numpy as np
+    from PIL import Image
+    arr = (np.random.default_rng(5).random((8, 8, 3)) * 255).astype(
+        np.uint8)
+    return Image.fromarray(arr)
+
+
+DISAGG_IDS = [5, 9, 23, 152, 150, 153, 7, 30]     # one image sentinel
+
+
+def _run_disagg(jax, llm, result_path, model_dir):
+    """Host 0 runs the disagg coordinator (+ an in-process encoder +
+    discovery); the admit and gate-B embedding rows replicate to the
+    follower as tick events. Output written for the test's single-host
+    disagg oracle."""
+    import threading
+    import time
+
+    from gllm_tpu.parallel.multihost_engine import MultihostEngine
+    from gllm_tpu.sampling_params import SamplingParams
+
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    if jax.process_index() == 0:
+        from gllm_tpu.disagg.config import DisaggConfig
+        from gllm_tpu.disagg.discovery import DiscoveryServer
+        from gllm_tpu.disagg.encoder_runtime import (EncoderEngine,
+                                                     EncoderRuntime)
+        srv = DiscoveryServer("127.0.0.1", 0).start()
+        endpoint = f"127.0.0.1:{srv.port}"
+        enc = EncoderRuntime(EncoderEngine(model_dir, dtype="float32"),
+                             endpoint, encoder_id="enc0").start()
+        llm.init_disagg(DisaggConfig(
+            is_lm=True, discovery_endpoint=endpoint, num_slots=4,
+            max_vis_tokens=64, overlap=True))
+        done = {}
+
+        def on_output(evt):
+            if evt[0] == "out" and evt[1].finish_reason is not None:
+                done[evt[1].seq.seq_id] = evt[1].seq.output_token_ids
+            elif evt[0] == "error":
+                done[evt[1]] = ["ERROR", evt[2]]
+
+        eng = MultihostEngine(llm, on_output=on_output)
+        t = threading.Thread(target=eng.run_host0, daemon=True)
+        t.start()
+        seq = llm._allocate_seq(DISAGG_IDS, sp)
+        eng.submit_disagg(seq, [("image", disagg_image())])
+        deadline = time.monotonic() + 150
+        while seq.seq_id not in done and time.monotonic() < deadline:
+            time.sleep(0.05)
+        eng.shutdown()
+        t.join(timeout=30)
+        with open(result_path, "w") as f:
+            json.dump({"output": done.get(seq.seq_id),
+                       "procs": jax.process_count()}, f)
+        eng.coord.close()
+        enc.stop()
+        srv.stop()
     else:
         MultihostEngine(llm).run_follower()
 

@@ -230,3 +230,107 @@ def test_blob_lift_resolve_roundtrip():
             cli.fetch("deadbeef")
     finally:
         store.close()
+
+
+def test_two_process_disagg_serving(tmp_path):
+    """Encoder disaggregation over multi-host: the coordinator runs on
+    host 0 only; admits and gate-B embedding rows replicate to the
+    follower as tick events (blob channel for bulk rows). Output must be
+    byte-identical to a single-host disagg run of the same request."""
+    import numpy as np
+    from transformers import (Qwen2_5_VLConfig,
+                              Qwen2_5_VLForConditionalGeneration)
+    torch.manual_seed(11)
+    text = dict(vocab_size=160, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, num_key_value_heads=2,
+                intermediate_size=96, max_position_embeddings=512,
+                rms_norm_eps=1e-6, rope_theta=10000.0,
+                tie_word_embeddings=False,
+                rope_scaling={"type": "mrope", "mrope_section": [2, 2, 4]})
+    vision = dict(depth=2, hidden_size=32, intermediate_size=48,
+                  num_heads=4, patch_size=2, temporal_patch_size=2,
+                  in_channels=3, spatial_merge_size=2, out_hidden_size=64,
+                  window_size=8, fullatt_block_indexes=[1],
+                  hidden_act="silu")
+    model_dir = tmp_path / "vl"
+    Qwen2_5_VLForConditionalGeneration(Qwen2_5_VLConfig(
+        text_config=text, vision_config=vision, image_token_id=150,
+        video_token_id=151, vision_start_token_id=152,
+        vision_end_token_id=153, eos_token_id=0,
+        bos_token_id=1)).save_pretrained(model_dir,
+                                         safe_serialization=True)
+    # the encoder loads the checkpoint's image processor; without the
+    # pixel bounds the default upscales the tiny test image past the
+    # slot capacity
+    from transformers.models.qwen2_vl.image_processing_qwen2_vl import (
+        Qwen2VLImageProcessor)
+    Qwen2VLImageProcessor(patch_size=2, temporal_patch_size=2,
+                          merge_size=2, min_pixels=16,
+                          max_pixels=4096).save_pretrained(model_dir)
+
+    result = tmp_path / "result.json"
+    port = free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["GLLM_TPU_BLOB_MIN_BYTES"] = "1"      # force rows over the blob channel
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(port), "2", str(i), str(model_dir),
+         str(result), "disagg"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out.decode(errors="replace"))
+            assert p.returncode == 0, outs[-1][-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    d = json.loads(result.read_text())
+    assert d["procs"] == 2 and d["output"], (d, [o[-800:] for o in outs])
+    assert d["output"][0] != "ERROR", d
+
+    # oracle: SINGLE-host disagg run of the same request (single-host
+    # disagg == monolith is covered by test_disagg)
+    import time as _time
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from multihost_worker import DISAGG_IDS, disagg_image
+    from gllm_tpu.config import CacheConfig, EngineConfig
+    from gllm_tpu.disagg.config import DisaggConfig
+    from gllm_tpu.disagg.discovery import DiscoveryServer
+    from gllm_tpu.disagg.encoder_runtime import (EncoderEngine,
+                                                 EncoderRuntime)
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.sampling_params import SamplingParams
+    srv = DiscoveryServer("127.0.0.1", 0).start()
+    endpoint = f"127.0.0.1:{srv.port}"
+    enc = EncoderRuntime(EncoderEngine(str(model_dir), dtype="float32"),
+                         endpoint, encoder_id="enc0").start()
+    llm = LLM(config=EngineConfig(
+        model=str(model_dir), dtype="float32", max_model_len=64,
+        cache=CacheConfig(page_size=4, num_pages=64)))
+    llm.init_disagg(DisaggConfig(
+        is_lm=True, discovery_endpoint=endpoint, num_slots=4,
+        max_vis_tokens=64, overlap=True))
+    try:
+        seq = llm._allocate_seq(list(DISAGG_IDS), SamplingParams(
+            temperature=0.0, max_tokens=4, ignore_eos=True))
+        llm.submit_disagg(seq, [("image", disagg_image())])
+        deadline = _time.monotonic() + 90
+        while not seq.is_finished:
+            assert _time.monotonic() < deadline
+            llm.step()
+        want = seq.output_token_ids
+    finally:
+        llm.disagg_coordinator.close()
+        enc.stop()
+        srv.stop()
+    assert d["output"] == want, (d["output"], want)

@@ -337,3 +337,38 @@ def test_engine_fp8_block_close_to_full_precision(tmp_path):
     quantized = run("fp8_block")
     assert quantized.output_token_ids[:2] == full.output_token_ids[:2]
     assert len(quantized.output_token_ids) == 8
+
+
+def test_moe_w8a8_under_ep_matches_ep1(tmp_path):
+    """Quantized (W8A8) experts under expert-parallel sharding: the int8
+    grouped GEMM partitions over the EP axis (GSPMD shards w.q/w.scale on
+    the expert dim) and outputs match the unsharded quantized run."""
+    from gllm_tpu.config import ParallelConfig
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    from gllm_tpu.ops.quant import QuantizedW8A8
+    torch.manual_seed(13)
+    Qwen2MoeForCausalLM(Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, intermediate_size=96,
+        moe_intermediate_size=32, shared_expert_intermediate_size=64,
+        num_experts=8, num_experts_per_tok=2, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[],
+        max_position_embeddings=128, eos_token_id=0)).save_pretrained(
+        tmp_path, safe_serialization=True)
+
+    def run(tp):
+        cfg = EngineConfig(model=str(tmp_path), dtype="float32",
+                           max_model_len=64, quantization="w8a8",
+                           cache=CacheConfig(page_size=4, num_pages=64),
+                           parallel=ParallelConfig(tp=tp,
+                                                   enable_ep=tp > 1))
+        llm = LLM(config=cfg)
+        assert isinstance(llm.runner.params["layers"]["w_gate"],
+                          QuantizedW8A8)
+        return [o.output_token_ids for o in llm.generate(
+            prompt_token_ids=[[5, 9, 23], [7, 12, 2, 44]],
+            sampling_params=SamplingParams(temperature=0.0, max_tokens=6,
+                                           ignore_eos=True))]
+
+    assert run(4) == run(1)
