@@ -267,12 +267,16 @@ def main():
             rope_theta=500000.0, tie_word_embeddings=True)
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="bfloat16", max_model_len=2048,
-            max_num_seqs=256,
+            # conservative halves the decode width: fewer/smaller decode
+            # buckets to compile, so the first (budget-bounded) attempt
+            # spends its time measuring, not compiling
+            max_num_seqs=256 if full else 128,
             overlap_scheduling=full,
             overlap_depth=4 if full else 1,
             multi_step_decode=8 if full else 1,
             scheduler=SchedulerConfig(max_prefill_tokens=1024,
-                                      max_decode_seqs=256),
+                                      max_decode_seqs=256 if full
+                                      else 128),
             # explicit pool (4 GB KV): the axon-attached chip advertises
             # no memory_stats and over-allocating hangs device init
             cache=CacheConfig(page_size=16, num_pages=8192))
