@@ -42,3 +42,9 @@ class StepBatch(NamedTuple):
     # (0 where unavailable); present only when a seq requested
     # prompt_logprobs.
     plp_targets: Optional[jnp.ndarray] = None      # [T] int32
+    # Speculative decoding (prompt-lookup drafts, verified in-step):
+    # per-seq row indices of the verify rows (padded rows repeat the
+    # seq's first row) and the drafts (-1 pad never matches an argmax,
+    # stopping acceptance).
+    spec_rows: Optional[jnp.ndarray] = None        # [S, k+1] int32
+    spec_drafts: Optional[jnp.ndarray] = None      # [S, k] int32

@@ -93,6 +93,18 @@ class EngineConfig:
     # pp_size batches running, scheduler.py:358-364). 1 forces serialized
     # launch-collect — the control arm for measuring pipeline overlap.
     pp_pipeline_depth: Optional[int] = None
+    # Prompt-lookup (n-gram) speculative decoding — beyond the reference:
+    # propose up to spec_k draft tokens from the most recent spec_ngram
+    # match in the sequence's own history and verify them in ONE forward
+    # pass (k+1 rows through the chunked-prefill machinery). Greedy
+    # verification makes outputs byte-identical to plain greedy decoding
+    # by construction; per-seq eligibility (temperature 0, no penalties,
+    # no logprobs) gates drafts, everything else runs normally in the
+    # same batch. On TPU this multiplies tokens-per-dispatch and turns
+    # decode GEMVs into small GEMMs for the MXU.
+    spec_decode: Optional[str] = None        # None | "ngram"
+    spec_k: int = 4
+    spec_ngram: int = 2
     # Quantization: None | "int8" | "fp8" | "int4" (weight-only,
     # per-output-channel, XLA-fused dequant) | "w8a8" (int8 weights +
     # per-token int8 activations on the MXU) — reference quantization
@@ -146,3 +158,14 @@ class EngineConfig:
             raise ValueError(
                 f"unknown quantization {self.quantization!r} "
                 "(choices: int8, fp8, int4, w8a8, fp8_block)")
+        if self.spec_decode not in (None, "ngram"):
+            raise ValueError(
+                f"unknown spec_decode {self.spec_decode!r} "
+                "(choices: ngram)")
+        if self.spec_decode is not None:
+            if self.overlap_scheduling or self.multi_step_decode > 1:
+                raise ValueError(
+                    "spec_decode composes its own multi-token steps; "
+                    "disable overlap_scheduling / multi_step_decode")
+            if self.spec_k < 1 or self.spec_ngram < 1:
+                raise ValueError("spec_k and spec_ngram must be >= 1")

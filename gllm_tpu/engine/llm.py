@@ -127,6 +127,18 @@ class LLM:
                                      pp_size=config.parallel.pp)
                            for mm in self.memory_managers]
         self.scheduler = self.schedulers[0]
+        if (config.spec_decode == "ngram" and self.dp == 1
+                and config.parallel.pp == 1
+                and not config.overlap_scheduling
+                and not model_cfg.use_hybrid):
+            # hybrid (GDN) excluded: the recurrent SSM state advances over
+            # draft rows and cannot rewind a rejected draft (paged KV can:
+            # the real token's KV overwrites the slot later)
+            self.scheduler.spec_cfg = (config.spec_ngram, config.spec_k)
+        elif config.spec_decode is not None:
+            logger.warning(
+                "spec_decode=%s disabled for this topology (needs dp=1, "
+                "pp=1, no overlap, non-hybrid model)", config.spec_decode)
         self._rr = 0
         self._seq_replica: dict = {}
         self.eos_token_ids = frozenset(model_cfg.eos_token_ids)
@@ -293,12 +305,28 @@ class LLM:
                     b, row.tolist(), self.eos_token_ids))
             self._check_stop_strings(outs)
             return outs
+        spec = aux.pop("spec", None) if aux else None
         if aux:
             # before process_output: ScheduledSeq.samples reads the seq's
             # CURRENT token count, which process_output advances
             self._record_logprobs(batch, aux)
-        outs = self.scheduler.process_output(batch, tokens.tolist(),
-                                             self.eos_token_ids)
+        if spec is not None:
+            # speculative step: draft items commit their verified run +
+            # correction token; everything else commits its sampled token
+            tok_mat, accept = spec
+            token_lists = []
+            for i, it in enumerate(batch.items):
+                if it.draft_tokens:
+                    a = min(int(accept[i]), len(it.draft_tokens))
+                    token_lists.append(
+                        [int(t) for t in tok_mat[i, :a + 1]])
+                else:
+                    token_lists.append([int(tokens[i])])
+            outs = self.scheduler.process_output_multi(
+                batch, token_lists, self.eos_token_ids)
+        else:
+            outs = self.scheduler.process_output(batch, tokens.tolist(),
+                                                 self.eos_token_ids)
         self._check_stop_strings(outs)
         return outs
 
@@ -368,6 +396,7 @@ class LLM:
             off = 0
             for it in batch.items:
                 n, seq = it.num_new_tokens, it.seq
+                rows = n + len(it.draft_tokens)   # row layout incl. drafts
                 sp = seq.sampling_params
                 if (sp.prompt_logprobs is not None
                         and it.computed_before < seq.prompt_len):
@@ -383,7 +412,7 @@ class LLM:
                         seq.prompt_logprobs[pos] = (
                             float(chosen[row]), top_ids[row, :k].tolist(),
                             top_lps[row, :k].tolist())
-                off += n
+                off += rows
 
     def _check_stop_strings(self, outs) -> None:
         """Host-side stop-string matching over the incrementally detokenized

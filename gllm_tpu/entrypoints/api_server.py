@@ -554,6 +554,9 @@ def build_engine_config(args) -> EngineConfig:
         allow_hub_download=args.allow_hub_download,
         attention_impl=args.attention_impl,
         overlap_scheduling=args.overlap_scheduling,
+        spec_decode=args.spec_decode,
+        spec_k=args.spec_k,
+        spec_ngram=args.spec_ngram,
         quantization=args.quantization,
         scheduler=SchedulerConfig(
             schedule_method=args.schedule_method,
@@ -615,6 +618,12 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--overlap-scheduling", action="store_true",
                    help="chain decode steps on-device (no host round trip "
                         "between decode iterations)")
+    p.add_argument("--spec-decode", default=None, choices=["ngram"],
+                   help="prompt-lookup speculative decoding: verify up to "
+                        "--spec-k n-gram drafts per decode step (greedy "
+                        "requests only; byte-identical outputs)")
+    p.add_argument("--spec-k", type=int, default=4)
+    p.add_argument("--spec-ngram", type=int, default=2)
     p.add_argument("--tool-call-parser", default=None,
                    choices=["qwen", "hermes", "deepseek", "none"],
                    help="tool-call markup parser (default: auto-detect "

@@ -45,8 +45,11 @@ class BatchBuilder:
         self.use_mm = use_mm
         self.use_ssm = use_ssm
         sc = config.scheduler
-        # Upper bounds for the shape buckets.
-        self.max_tokens = sc.max_prefill_tokens + sc.max_decode_seqs
+        # Upper bounds for the shape buckets. Speculative decoding adds up
+        # to spec_k draft rows per decode seq.
+        spec_rows = (config.spec_k if config.spec_decode else 0)
+        self.max_tokens = (sc.max_prefill_tokens
+                           + sc.max_decode_seqs * (1 + spec_rows))
         self.max_seqs = min(config.max_num_seqs,
                             sc.max_decode_seqs + sc.max_prefill_tokens)
         self.max_pages_per_seq = config.max_pages_per_seq
@@ -60,14 +63,22 @@ class BatchBuilder:
         of max_model_len — decode cost tracks actual sequence lengths.
         """
         s = bucket_size(batch.num_seqs, 8, self.max_seqs)
-        max_q = max(it.num_new_tokens for it in batch.items)
+        rows = [it.num_new_tokens + len(it.draft_tokens)
+                for it in batch.items]
+        max_q = max(rows)
         if max_q == 1:
             t, q = s, 1          # pure decode: one token per seq
         else:
-            t = bucket_size(batch.total_tokens, 16, self.max_tokens)
+            t = bucket_size(sum(rows), 16, self.max_tokens)
             q = t
+        # a seq's table can be LONGER than this step needs (a previous
+        # speculative step allocated for drafts that were then rejected) —
+        # the scatter writes whole table rows, so the bucket must cover
+        # the real lengths
         max_pages = max(
-            cdiv(it.computed_before + it.num_new_tokens, self.page_size)
+            max(cdiv(it.computed_before + it.num_new_tokens
+                     + len(it.draft_tokens), self.page_size),
+                len(it.seq.page_table))
             for it in batch.items)
         p = bucket_size(max_pages, 4, self.max_pages_per_seq)
         return t, s, q, p
@@ -208,8 +219,12 @@ class BatchBuilder:
         # them. Semantics byte-identical (engine identity tests).
         items = batch.items
         K = len(items)
-        ns = np.fromiter((it.num_new_tokens for it in items), np.int64,
-                         count=K)
+        # speculative drafts add verify rows after each item's committed
+        # chunk; everything downstream (positions, slots, kv_lens, causal
+        # attention) treats them as ordinary chunk rows
+        ns = np.fromiter(
+            (it.num_new_tokens + len(it.draft_tokens) for it in items),
+            np.int64, count=K)
         befores = np.fromiter((it.computed_before for it in items),
                               np.int64, count=K)
         ends = np.cumsum(ns)
@@ -253,7 +268,11 @@ class BatchBuilder:
             tid = it.seq.token_ids
             b, n = it.computed_before, it.num_new_tokens
             v = tid[b:b + n]
-            return v if len(v) == n else list(v) + [0] * (n - len(v))
+            if len(v) != n:
+                v = list(v) + [0] * (n - len(v))
+            if it.draft_tokens:
+                v = list(v) + list(it.draft_tokens)
+            return v
 
         tokens[:total] = np.fromiter(
             (t for it in items for t in _tok_vals(it)), np.int32,
@@ -357,6 +376,24 @@ class BatchBuilder:
             token_counts = PenaltyTokens(jnp.asarray(ids),
                                          jnp.asarray(mask))
 
+        spec_rows_arr = spec_drafts_arr = None
+        if any(it.draft_tokens for it in items):
+            kmax = self.config.spec_k
+            spec_rows = np.zeros((s_pad, kmax + 1), np.int32)
+            spec_drafts = np.full((s_pad, kmax), -1, np.int32)
+            for i, it in enumerate(items):
+                d = len(it.draft_tokens)
+                # verify rows: the item's LAST committed row + its draft
+                # rows (row r predicts the token at r's position + 1);
+                # no-draft / padded entries point at row 0 with -1 drafts
+                # (never accepted, argmax there unused)
+                if d:
+                    base = int(offs[i]) + it.num_new_tokens - 1
+                    spec_rows[i, :d + 1] = base + np.arange(d + 1)
+                    spec_drafts[i, :d] = it.draft_tokens
+            spec_rows_arr = jnp.asarray(spec_rows)
+            spec_drafts_arr = jnp.asarray(spec_drafts)
+
         step_batch = StepBatch(
             token_ids=jnp.asarray(tokens),
             positions=jnp.asarray(positions),
@@ -392,5 +429,7 @@ class BatchBuilder:
             ssm_slots=jnp.asarray(ssm_slots) if self.use_ssm else None,
             plp_targets=(jnp.asarray(plp_targets)
                          if plp_targets is not None else None),
+            spec_rows=spec_rows_arr,
+            spec_drafts=spec_drafts_arr,
         )
         return step_batch, max_q, token_counts

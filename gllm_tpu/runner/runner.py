@@ -411,6 +411,26 @@ class ModelRunner:
             tokens = sample(logits, batch.sampling, token_counts)
             aux = lp_aux(params, cfg, logits, tokens, hidden, residual,
                          batch, token_counts, logprobs_k, prompt_lp)
+            if batch.spec_rows is not None:
+                # Speculative verify: gather hidden/residual at the verify
+                # rows FIRST (S·(k+1) rows), then project only those — a
+                # full [T, V] logits materialization per decode step would
+                # cost hundreds of MB of HBM at large vocab. Row r's
+                # argmax IS the correct greedy token for position r+1
+                # given the committed prefix, so emitting preds[:accept+1]
+                # is byte-identical to plain greedy; acceptance = run of
+                # drafts matching the previous row's argmax (pad -1 never
+                # matches).
+                from gllm_tpu.models.dense import compute_full_logits
+                rows = batch.spec_rows.reshape(-1)          # [S*(k+1)]
+                sl = compute_full_logits(params, hidden[rows],
+                                         residual[rows], cfg)
+                preds = jnp.argmax(sl, axis=-1).astype(jnp.int32)
+                tok_mat = preds.reshape(batch.spec_rows.shape)
+                ok = tok_mat[:, :-1] == batch.spec_drafts   # [S, k]
+                accept = jnp.cumprod(ok.astype(jnp.int32),
+                                     axis=-1).sum(axis=-1)
+                aux["spec"] = (tok_mat, accept)
             return tokens, kv, aux
 
         if self.dp > 1:

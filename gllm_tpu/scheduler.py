@@ -47,6 +47,10 @@ class ScheduledSeq:
     seq: Sequence
     num_new_tokens: int          # tokens computed this step
     computed_before: int         # seq.num_computed_tokens when scheduled
+    # Speculative decode: draft tokens appended after the committed rows
+    # (prompt-lookup proposals, verified on-device in the same step).
+    # Not counted in num_new_tokens — the batch builder adds their rows.
+    draft_tokens: tuple = ()
 
     @property
     def samples(self) -> bool:
@@ -56,6 +60,35 @@ class ScheduledSeq:
                 == self.seq.num_tokens)
 
 
+def propose_ngram_drafts(token_ids, n: int, k: int,
+                         window: int = 4096) -> tuple:
+    """Prompt-lookup proposal (beyond the reference): the continuation of
+    the most recent earlier occurrence of the last-``n``-token pattern,
+    up to ``k`` tokens. One vectorized sliding-window compare (numpy) —
+    a Python scan here would cost O(window) list slices per decode seq
+    per step and could eat the speculative win on the host side."""
+    import numpy as np
+    L = len(token_ids)
+    if L <= n or k <= 0:
+        return ()
+    lo = max(0, L - window)
+    arr = np.asarray(token_ids[lo:], dtype=np.int64)
+    M = len(arr)
+    if M <= n:
+        return ()
+    pattern = arr[-n:]
+    m = M - n + 1                     # number of window start positions
+    match = np.ones(m, dtype=bool)
+    for d in range(n):
+        match &= arr[d:d + m] == pattern[d]
+    idx = np.flatnonzero(match[:m - 1])   # exclude the pattern itself
+    if idx.size == 0:
+        return ()
+    j = int(idx[-1])                  # most recent occurrence
+    cont = arr[j + n:j + n + k]
+    return tuple(int(t) for t in cont)
+
+
 @dataclasses.dataclass
 class ScheduledBatch:
     items: List[ScheduledSeq]
@@ -63,6 +96,10 @@ class ScheduledBatch:
     @property
     def num_seqs(self) -> int:
         return len(self.items)
+
+    @property
+    def has_drafts(self) -> bool:
+        return any(it.draft_tokens for it in self.items)
 
     @property
     def total_tokens(self) -> int:
@@ -106,6 +143,11 @@ class Scheduler:
         self._decode_offset = 0
         self._last_stats_time = 0.0
         self.num_preemptions = 0
+        # (ngram_n, k) when the ENGINE enabled speculative decoding for
+        # this topology (single runner, no overlap, non-hybrid model) —
+        # the engine sets it after construction; None disables proposals
+        self.spec_cfg = None
+        self.spec_stats = {"proposed": 0, "accepted": 0}
 
     # ---- intake -----------------------------------------------------------
 
@@ -251,7 +293,14 @@ class Scheduler:
                 # would double-schedule it against _schedule_prefill.
                 continue
             protect.add(seq.seq_id)
-            if not self._allocate_with_preemption(seq, 1, protect):
+            drafts = self._propose_drafts(seq)
+            if drafts and not self.mm.can_allocate(
+                    self.mm.pages_needed(seq, 1 + len(drafts))):
+                # under memory pressure speculation must never COST a seq
+                # its KV: drop the drafts before reaching for preemption
+                drafts = ()
+            if not self._allocate_with_preemption(seq, 1 + len(drafts),
+                                                  protect):
                 protect.discard(seq.seq_id)
                 if seq.status == SequenceStatus.RUNNING:
                     # No victim available — preempt this seq itself so the
@@ -264,7 +313,31 @@ class Scheduler:
                     self.num_preemptions += 1
                     self.new_token_ratio = self.sched_cfg.init_new_token_ratio
                 continue
-            items.append(ScheduledSeq(seq, 1, seq.num_computed_tokens))
+            items.append(ScheduledSeq(seq, 1, seq.num_computed_tokens,
+                                      draft_tokens=drafts))
+
+    def _propose_drafts(self, seq: Sequence) -> tuple:
+        """Per-seq speculative drafts: n-gram prompt-lookup, only for
+        requests where greedy argmax IS the sampling rule (temperature 0,
+        no penalties, no logprobs) so verification preserves byte
+        identity."""
+        if self.spec_cfg is None:
+            return ()
+        sp = seq.sampling_params
+        if (sp.temperature != 0 or sp.logprobs is not None
+                or sp.presence_penalty != 0 or sp.frequency_penalty != 0
+                or sp.repetition_penalty != 1.0 or sp.stop):
+            # stop STRINGS must be checked between tokens (a committed
+            # draft run would stream past the match — same rule as the
+            # fused multi-step gate)
+            return ()
+        n, k = self.spec_cfg
+        # positions fed run to num_tokens-1+len(drafts); keep every row
+        # inside max_model_len (page table + rope table sizing)
+        k = min(k, self.config.max_model_len - seq.num_tokens)
+        drafts = propose_ngram_drafts(seq.token_ids, n, k)
+        self.spec_stats["proposed"] += len(drafts)
+        return drafts
 
     def _ssm_align_chunk(self, seq: Sequence, n: int) -> int:
         """Hybrid models: end non-final prefill chunks at page boundaries
@@ -426,8 +499,21 @@ class Scheduler:
         """Advance state after a step. ``sampled_tokens[i]`` is the sampled
         token for batch item i (ignored for items that don't sample).
         ``eos_token_ids`` is a collection of terminator ids (or None)."""
+        return self.process_output_multi(
+            batch, [[t] for t in sampled_tokens], eos_token_ids)
+
+    def process_output_multi(self, batch: ScheduledBatch,
+                             token_lists: List[List[int]],
+                             eos_token_ids) -> List[SeqOutput]:
+        """Like process_output but each item may commit SEVERAL tokens
+        (speculative decoding: the verified draft run + the correction
+        token). Tokens append in order with per-token finish checks; a
+        finish mid-list discards the rest. ``num_computed_tokens``
+        advances by the number of rows whose input token proved correct —
+        rejected draft rows' KV is overwritten when the real token at
+        that position is fed later."""
         outputs: List[SeqOutput] = []
-        for it, tok in zip(batch.items, sampled_tokens):
+        for it, toks in zip(batch.items, token_lists):
             seq = it.seq
             seq.num_in_flight -= 1
             if seq.status is not SequenceStatus.RUNNING:
@@ -442,18 +528,34 @@ class Scheduler:
                 continue
             if seq.seq_id in self._aborted_ids:
                 continue  # handled in _process_aborts
-            seq.num_computed_tokens = it.computed_before + it.num_new_tokens
-            new_token: Optional[int] = None
             finish: Optional[str] = None
-            if it.samples:
+            if not it.samples:
+                seq.num_computed_tokens = (it.computed_before
+                                           + it.num_new_tokens)
+                self.mm.register_computed_pages(seq)
+                outputs.append(SeqOutput(seq, None, None))
+                continue
+            emitted = 0
+            for tok in toks:
                 seq.append_token(int(tok))
-                new_token = int(tok)
+                emitted += 1
                 finish = seq.check_finish(eos_token_ids)
-                # Hard cap: the KV layout (page_table width, rope table) is
-                # sized for max_model_len; never decode past it.
+                # Hard cap: the KV layout (page_table width, rope table)
+                # is sized for max_model_len; never decode past it.
                 if (finish is None
                         and seq.num_tokens >= self.config.max_model_len):
                     finish = "length"
+                outputs.append(SeqOutput(seq, int(tok),
+                                         finish))
+                if finish is not None:
+                    break
+            if self.spec_cfg is not None and it.draft_tokens:
+                self.spec_stats["accepted"] += emitted - 1
+            # rows fed were num_new_tokens committed tokens (+ drafts);
+            # valid KV covers the rows whose inputs were correct: the
+            # chunk plus the accepted drafts = num_new-1 + emitted rows
+            seq.num_computed_tokens = (it.computed_before
+                                       + it.num_new_tokens - 1 + emitted)
             self.mm.register_computed_pages(seq)
             if finish is not None:
                 seq.status = SequenceStatus.FINISHED
@@ -465,7 +567,6 @@ class Scheduler:
                     self._deferred_free.add(seq)
                 else:
                     self.mm.free_seq(seq)
-            outputs.append(SeqOutput(seq, new_token, finish))
         return outputs
 
     def finish_seq(self, seq: Sequence, reason: str = "stop") -> None:
@@ -521,8 +622,14 @@ class Scheduler:
         n_prefill = len(self.running) - n_decode
         util = 1.0 - self.mm.free_ratio
         hit = getattr(self.mm, "cache_hit_rate", None)
+        spec = ""
+        if self.spec_cfg is not None and self.spec_stats["proposed"]:
+            spec = (" spec_accept={:.1f}%".format(
+                100.0 * self.spec_stats["accepted"]
+                / self.spec_stats["proposed"]))
         logger.info(
-            "sched: wait=%d run=%d prefill=%d decode=%d kv_util=%.1f%%%s",
+            "sched: wait=%d run=%d prefill=%d decode=%d kv_util=%.1f%%%s%s",
             len(self.waiting), len(self.running), n_prefill, n_decode,
             util * 100.0,
-            f" cache_hit={hit*100.0:.1f}%" if hit is not None else "")
+            f" cache_hit={hit*100.0:.1f}%" if hit is not None else "",
+            spec)

@@ -1,0 +1,167 @@
+"""Prompt-lookup (n-gram) speculative decoding — beyond the reference.
+
+Correctness contract: greedy outputs are BYTE-IDENTICAL with and without
+spec decoding (the verify step emits exactly the per-position argmax),
+while accepted drafts reduce the number of engine steps. Covers: the
+proposer, byte-identity on draft-friendly (repetitive) and draft-hostile
+(random) workloads, EOS inside an accepted run, max-token/length caps,
+non-greedy requests falling back in the same batch, and prefix-cache
+interaction.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.sampling_params import SamplingParams
+from gllm_tpu.scheduler import propose_ngram_drafts
+
+
+def test_proposer_basic():
+    #           0  1  2  3  4  5  6  7
+    toks = [5, 6, 7, 8, 5, 6]           # pattern (5,6) recurs
+    assert propose_ngram_drafts(toks, 2, 3) == (7, 8, 5)
+    assert propose_ngram_drafts(toks, 2, 1) == (7,)
+    # no earlier occurrence → no drafts
+    assert propose_ngram_drafts([1, 2, 3, 4], 2, 3) == ()
+    # short sequence
+    assert propose_ngram_drafts([1], 2, 3) == ()
+    # most RECENT match wins
+    toks2 = [5, 6, 9, 5, 6, 1, 5, 6]
+    assert propose_ngram_drafts(toks2, 2, 2) == (1, 5)
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(7)
+    d = str(tmp_path_factory.mktemp("tiny_spec"))
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        max_position_embeddings=512, eos_token_id=0,
+        attention_bias=False)).save_pretrained(d, safe_serialization=True)
+    return d
+
+
+def make_llm(ckpt, spec=False, prefix=False, **kw):
+    cfg = EngineConfig(
+        model=ckpt, dtype="float32", max_model_len=256,
+        spec_decode="ngram" if spec else None, spec_k=4, spec_ngram=2,
+        cache=CacheConfig(page_size=4, num_pages=128,
+                          enable_prefix_caching=prefix), **kw)
+    return LLM(config=cfg)
+
+
+# Greedy models on random weights loop quickly → the draft-friendly
+# regime; a random prompt exercises cold proposals too.
+PROMPTS = [
+    [5, 9, 23, 5, 9, 23, 5, 9],          # immediate n-gram structure
+    [7, 7, 7, 7],                        # degenerate repetition
+    list(range(1, 30)),                  # no repeats in the prompt
+    [101, 3, 101, 3, 101],
+]
+
+
+def greedy(llm, prompts, n=32, **sp_kw):
+    sp = SamplingParams(temperature=0.0, max_tokens=n, ignore_eos=True,
+                        **sp_kw)
+    outs = llm.generate(prompt_token_ids=[list(p) for p in prompts],
+                        sampling_params=sp)
+    return [o.output_token_ids for o in outs]
+
+
+def test_spec_byte_identity_and_fewer_steps(ckpt):
+    base = make_llm(ckpt)
+    want = greedy(base, PROMPTS)
+    base_steps = base.runner._step_count
+    del base
+
+    llm = make_llm(ckpt, spec=True)
+    got = greedy(llm, PROMPTS)
+    assert got == want, (got, want)
+    st = llm.scheduler.spec_stats
+    assert st["proposed"] > 0
+    assert st["accepted"] > 0, "greedy loops must accept some drafts"
+    assert llm.runner._step_count < base_steps, \
+        (llm.runner._step_count, base_steps)
+
+
+def test_spec_respects_eos_and_max_tokens(ckpt):
+    """EOS inside an accepted draft run must truncate exactly like plain
+    decoding (no ignore_eos), and max_tokens caps mid-run."""
+    llm = make_llm(ckpt, spec=True)
+    base = make_llm(ckpt)
+    sp = dict(temperature=0.0, max_tokens=19)
+    a = llm.generate(prompt_token_ids=[list(p) for p in PROMPTS],
+                     sampling_params=SamplingParams(**sp))
+    b = base.generate(prompt_token_ids=[list(p) for p in PROMPTS],
+                      sampling_params=SamplingParams(**sp))
+    for x, y in zip(a, b):
+        assert x.output_token_ids == y.output_token_ids
+        assert x.finish_reason == y.finish_reason
+
+
+def test_spec_mixed_batch_with_sampling_requests(ckpt):
+    """Non-greedy / penalized requests share the batch but never get
+    drafts; their outputs match the non-spec engine seeded run."""
+    llm = make_llm(ckpt, spec=True)
+    base = make_llm(ckpt)
+    prompts = [PROMPTS[0], PROMPTS[1], PROMPTS[2]]
+    sps = [SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True),
+           SamplingParams(temperature=0.8, seed=3, max_tokens=16,
+                          ignore_eos=True),
+           SamplingParams(temperature=0.0, repetition_penalty=1.3,
+                          max_tokens=16, ignore_eos=True)]
+    a = llm.generate(prompt_token_ids=[list(p) for p in prompts],
+                     sampling_params=sps)
+    b = base.generate(prompt_token_ids=[list(p) for p in prompts],
+                      sampling_params=sps)
+    for x, y in zip(a, b):
+        assert x.output_token_ids == y.output_token_ids
+    # the greedy seq still used spec
+    assert llm.scheduler.spec_stats["proposed"] > 0
+
+
+def test_spec_with_prefix_cache_cold_warm(ckpt):
+    """Prefix caching registers pages over multi-token commits; a warm
+    re-run stays byte-identical."""
+    llm = make_llm(ckpt, spec=True, prefix=True)
+    want = greedy(make_llm(ckpt), [PROMPTS[0]], n=48)
+    cold = greedy(llm, [PROMPTS[0]], n=48)
+    warm = greedy(llm, [PROMPTS[0]], n=48)
+    assert cold == want and warm == want
+
+
+def test_spec_near_max_model_len(ckpt):
+    """Drafts are trimmed so no row lands past max_model_len, and the
+    length finish fires at the same token as the plain engine."""
+    long_prompt = ([11, 13] * 120)[:238]          # close to 256 cap
+    llm = make_llm(ckpt, spec=True)
+    base = make_llm(ckpt)
+    sp = SamplingParams(temperature=0.0, max_tokens=64, ignore_eos=True)
+    a = llm.generate(prompt_token_ids=[list(long_prompt)],
+                     sampling_params=sp)[0]
+    b = base.generate(prompt_token_ids=[list(long_prompt)],
+                      sampling_params=sp)[0]
+    assert a.output_token_ids == b.output_token_ids
+    assert a.finish_reason == b.finish_reason == "length"
+
+
+def test_spec_stop_strings_excluded_and_identical(ckpt):
+    """Stop-string requests never get drafts (a committed run would
+    stream past the match) — outputs identical to the plain engine."""
+    from transformers import AutoTokenizer
+    llm = make_llm(ckpt, spec=True)
+    base = make_llm(ckpt)
+    sp = dict(temperature=0.0, max_tokens=24, ignore_eos=True,
+              stop=["xyzzy"])     # never matches; exercises the path
+    a = llm.generate(prompt_token_ids=[list(PROMPTS[0])],
+                     sampling_params=SamplingParams(**sp))[0]
+    b = base.generate(prompt_token_ids=[list(PROMPTS[0])],
+                      sampling_params=SamplingParams(**sp))[0]
+    assert a.output_token_ids == b.output_token_ids
+    # the stop-string request must not have produced drafts
+    assert llm.scheduler.spec_stats["proposed"] == 0
