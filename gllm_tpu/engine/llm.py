@@ -128,9 +128,9 @@ class LLM:
                            for mm in self.memory_managers]
         self.scheduler = self.schedulers[0]
         if (config.spec_decode == "ngram" and self.dp == 1
-                and config.parallel.pp == 1
                 and not config.overlap_scheduling
                 and not model_cfg.use_hybrid):
+            # single-runner AND pp pipelines (the last stage verifies);
             # hybrid (GDN) excluded: the recurrent SSM state advances over
             # draft rows and cannot rewind a rejected draft (paged KV can:
             # the real token's KV overwrites the slot later)
@@ -138,7 +138,7 @@ class LLM:
         elif config.spec_decode is not None:
             logger.warning(
                 "spec_decode=%s disabled for this topology (needs dp=1, "
-                "pp=1, no overlap, non-hybrid model)", config.spec_decode)
+                "no overlap, non-hybrid model)", config.spec_decode)
         self._rr = 0
         self._seq_replica: dict = {}
         self.eos_token_ids = frozenset(model_cfg.eos_token_ids)

@@ -394,6 +394,20 @@ class PPModelRunner(ModelRunner):
                     aux["plp"] = compute_logprobs(full_logits,
                                                   batch.plp_targets,
                                                   max(logprobs_k, 1))
+                if batch.spec_rows is not None:
+                    # speculative verify on the LAST stage — same math as
+                    # the single runner (runner.py step): project only the
+                    # gathered verify rows, accept the matching draft run
+                    from gllm_tpu.models.dense import compute_full_logits
+                    rows = batch.spec_rows.reshape(-1)
+                    sl = compute_full_logits(params, hidden[rows],
+                                             residual[rows], scfg)
+                    preds = jnp.argmax(sl, axis=-1).astype(jnp.int32)
+                    tok_mat = preds.reshape(batch.spec_rows.shape)
+                    ok = tok_mat[:, :-1] == batch.spec_drafts
+                    accept = jnp.cumprod(ok.astype(jnp.int32),
+                                         axis=-1).sum(axis=-1)
+                    aux["spec"] = (tok_mat, accept)
                 return (tokens, aux), kv
             return (hidden, residual), kv
 

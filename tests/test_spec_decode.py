@@ -165,3 +165,21 @@ def test_spec_stop_strings_excluded_and_identical(ckpt):
     assert a.output_token_ids == b.output_token_ids
     # the stop-string request must not have produced drafts
     assert llm.scheduler.spec_stats["proposed"] == 0
+
+
+def test_spec_under_pp2(ckpt):
+    """Speculative decoding through a pp=2 pipeline (last stage verifies)
+    — byte-identical to the plain single-stage engine."""
+    from gllm_tpu.config import ParallelConfig
+    base = make_llm(ckpt)
+    want = greedy(base, PROMPTS)
+    del base
+    cfg = EngineConfig(
+        model=ckpt, dtype="float32", max_model_len=256,
+        spec_decode="ngram", spec_k=4, spec_ngram=2,
+        cache=CacheConfig(page_size=4, num_pages=128),
+        parallel=ParallelConfig(pp=2))
+    llm = LLM(config=cfg)
+    got = greedy(llm, PROMPTS)
+    assert got == want, (got, want)
+    assert llm.scheduler.spec_stats["accepted"] > 0
