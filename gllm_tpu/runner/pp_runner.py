@@ -119,9 +119,6 @@ class PPModelRunner(ModelRunner):
         self.model_def = get_model_def(model_cfg)
         pp, tp = config.parallel.pp, config.parallel.tp
         dp = self.dp = config.parallel.dp
-        if model_cfg.use_hybrid and tp > 1:
-            raise NotImplementedError(
-                "hybrid (GDN) models with tp > 1 are not wired up yet")
         devices = jax.devices()
         if len(devices) < dp * pp * tp:
             raise ValueError(f"dp={dp} pp={pp} tp={tp} needs "

@@ -203,3 +203,48 @@ def test_hybrid_dp2_matches_dp1(tmp_path):
                                       sampling_params=sp)]
 
     assert run(2) == run(1)
+
+
+def test_hybrid_tp2_matches_tp1(tmp_path):
+    """GDN stack under tensor parallelism (GSPMD hybrid_param_specs /
+    hybrid_kv_specs shard the attention and value-head axes) —
+    byte-identical to tp=1."""
+    from gllm_tpu.config import ParallelConfig
+    make_ckpt(tmp_path)
+    want = [o.output_token_ids for o in make_llm(str(tmp_path)).generate(
+        prompt_token_ids=[[5, 9, 23], [7, 12, 2, 44]],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
+                                       ignore_eos=True))]
+    cfg = EngineConfig(
+        model=str(tmp_path), dtype="float32", max_model_len=256,
+        cache=CacheConfig(page_size=4, num_pages=128,
+                          ssm_snapshot_slots=16),
+        parallel=ParallelConfig(tp=2))
+    got = [o.output_token_ids for o in LLM(config=cfg).generate(
+        prompt_token_ids=[[5, 9, 23], [7, 12, 2, 44]],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
+                                       ignore_eos=True))]
+    assert got == want, (got, want)
+
+
+def test_hybrid_pp2_tp2_matches_single(tmp_path):
+    """GDN stack through a pp=2 × tp=2 grid: period-aligned stages +
+    GSPMD-sharded SSM pools per stage — byte-identical to the plain
+    engine."""
+    from gllm_tpu.config import ParallelConfig
+    # two layer-type periods so pp=2 has a period-aligned split
+    make_ckpt(tmp_path, num_hidden_layers=8,
+              layer_types=["linear_attention", "linear_attention",
+                           "linear_attention", "full_attention"] * 2)
+    prompts = [[5, 9, 23], [7, 12, 2, 44]]
+    sp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    want = [o.output_token_ids for o in make_llm(str(tmp_path)).generate(
+        prompt_token_ids=[list(p) for p in prompts], sampling_params=sp)]
+    cfg = EngineConfig(
+        model=str(tmp_path), dtype="float32", max_model_len=256,
+        cache=CacheConfig(page_size=4, num_pages=128,
+                          ssm_snapshot_slots=16),
+        parallel=ParallelConfig(pp=2, tp=2))
+    got = [o.output_token_ids for o in LLM(config=cfg).generate(
+        prompt_token_ids=[list(p) for p in prompts], sampling_params=sp)]
+    assert got == want, (got, want)
