@@ -127,18 +127,20 @@ class LLM:
                                      pp_size=config.parallel.pp)
                            for mm in self.memory_managers]
         self.scheduler = self.schedulers[0]
-        if (config.spec_decode == "ngram" and self.dp == 1
+        if (config.spec_decode == "ngram"
                 and not config.overlap_scheduling
                 and not model_cfg.use_hybrid):
-            # single-runner AND pp pipelines (the last stage verifies);
+            # single runner, pp pipelines (the last stage verifies), and
+            # dp replicas (per-replica verify in the stacked program);
             # hybrid (GDN) excluded: the recurrent SSM state advances over
             # draft rows and cannot rewind a rejected draft (paged KV can:
             # the real token's KV overwrites the slot later)
-            self.scheduler.spec_cfg = (config.spec_ngram, config.spec_k)
+            for s in self.schedulers:
+                s.spec_cfg = (config.spec_ngram, config.spec_k)
         elif config.spec_decode is not None:
             logger.warning(
-                "spec_decode=%s disabled for this topology (needs dp=1, "
-                "no overlap, non-hybrid model)", config.spec_decode)
+                "spec_decode=%s disabled for this topology (no overlap, "
+                "non-hybrid model required)", config.spec_decode)
         self._rr = 0
         self._seq_replica: dict = {}
         self.eos_token_ids = frozenset(model_cfg.eos_token_ids)
@@ -368,9 +370,24 @@ class LLM:
         outs: List[SeqOutput] = []
         for sched, b, row, aux in zip(self.schedulers, batches, rows,
                                       auxes):
-            if b is not None:
-                if aux:
-                    self._record_logprobs(b, aux)
+            if b is None:
+                continue
+            spec = aux.pop("spec", None) if aux else None
+            if aux:
+                self._record_logprobs(b, aux)
+            if spec is not None and b.has_drafts:
+                tok_mat, accept = spec
+                token_lists = []
+                for i, it in enumerate(b.items):
+                    if it.draft_tokens:
+                        a = min(int(accept[i]), len(it.draft_tokens))
+                        token_lists.append(
+                            [int(t) for t in tok_mat[i, :a + 1]])
+                    else:
+                        token_lists.append([int(row[i])])
+                outs.extend(sched.process_output_multi(
+                    b, token_lists, self.eos_token_ids))
+            else:
                 outs.extend(sched.process_output(b, row.tolist(),
                                                  self.eos_token_ids))
         self._check_stop_strings(outs)

@@ -115,6 +115,12 @@ class BatchBuilder:
                       if "seed" in force_extras else None),
                 out_step=(jnp.zeros(s_pad, jnp.int32)
                           if "seed" in force_extras else None)),
+            spec_rows=(jnp.zeros(
+                (s_pad, self.config.spec_k + 1), jnp.int32)
+                if "spec" in force_extras else None),
+            spec_drafts=(jnp.full(
+                (s_pad, self.config.spec_k), -1, jnp.int32)
+                if "spec" in force_extras else None),
             plp_targets=(jnp.zeros(t_pad, jnp.int32)
                          if "plp" in force_extras else None),
             ssm_slots=(jnp.zeros(s_pad, jnp.int32) if self.use_ssm
@@ -159,6 +165,8 @@ class BatchBuilder:
                                       it.computed_before
                                       + it.num_new_tokens] >= 0).any()):
                 extras.add("mm")
+            if it.draft_tokens:
+                extras.add("spec")
         return frozenset(extras)
 
     def build(self, batch: ScheduledBatch, step_key,
@@ -377,7 +385,7 @@ class BatchBuilder:
                                          jnp.asarray(mask))
 
         spec_rows_arr = spec_drafts_arr = None
-        if any(it.draft_tokens for it in items):
+        if any(it.draft_tokens for it in items) or "spec" in force_extras:
             kmax = self.config.spec_k
             spec_rows = np.zeros((s_pad, kmax + 1), np.int32)
             spec_drafts = np.full((s_pad, kmax), -1, np.int32)

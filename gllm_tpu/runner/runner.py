@@ -452,6 +452,19 @@ class ModelRunner:
                 aux = lp_aux(params, cfg_dp, logits, tokens, hidden,
                              residual, batch_r, counts_r, logprobs_k,
                              prompt_lp)
+                if batch_r.spec_rows is not None:
+                    # per-replica speculative verify (same math as the
+                    # single-runner step)
+                    from gllm_tpu.models.dense import compute_full_logits
+                    rows = batch_r.spec_rows.reshape(-1)
+                    sl = compute_full_logits(params, hidden[rows],
+                                             residual[rows], cfg_dp)
+                    preds = jnp.argmax(sl, axis=-1).astype(jnp.int32)
+                    tok_mat = preds.reshape(batch_r.spec_rows.shape)
+                    ok = tok_mat[:, :-1] == batch_r.spec_drafts
+                    accept = jnp.cumprod(ok.astype(jnp.int32),
+                                         axis=-1).sum(axis=-1)
+                    aux["spec"] = (tok_mat, accept)
                 return tokens, kv_r, aux
 
             @functools.partial(jax.jit,
@@ -492,6 +505,8 @@ class ModelRunner:
                     aux_spec["lp"] = (P(AXIS_DP),) * 3
                 if prompt_lp:
                     aux_spec["plp"] = (P(AXIS_DP),) * 3
+                if batch.spec_rows is not None:
+                    aux_spec["spec"] = (P(AXIS_DP),) * 2
 
                 def body(kv_s, batch_s, counts_s, params_s, cos_s):
                     sq = lambda t: jax.tree.map(lambda x: x[0], t)

@@ -183,3 +183,26 @@ def test_spec_under_pp2(ckpt):
     got = greedy(llm, PROMPTS)
     assert got == want, (got, want)
     assert llm.scheduler.spec_stats["accepted"] > 0
+
+
+@pytest.mark.parametrize("par", [dict(dp=2), dict(dp=2, pp=2),
+                                 dict(tp=2)],
+                         ids=["dp2", "dp2pp2", "tp2"])
+def test_spec_under_dp(ckpt, par):
+    """Speculative decoding under DP replicas (per-replica verify in the
+    stacked program; independent pipelines under dp×pp) and TP (GSPMD
+    shards the verify projection) — byte-identical to the plain
+    single-replica engine."""
+    from gllm_tpu.config import ParallelConfig
+    base = make_llm(ckpt)
+    want = greedy(base, PROMPTS)
+    del base
+    cfg = EngineConfig(
+        model=ckpt, dtype="float32", max_model_len=256,
+        spec_decode="ngram", spec_k=4, spec_ngram=2,
+        cache=CacheConfig(page_size=4, num_pages=128),
+        parallel=ParallelConfig(**par))
+    llm = LLM(config=cfg)
+    got = greedy(llm, PROMPTS)
+    assert got == want, (got, want)
+    assert sum(s.spec_stats["accepted"] for s in llm.schedulers) > 0
