@@ -58,7 +58,7 @@ def main():
     ap.add_argument("--tools", action="store_true",
                     help="expose the demo toolset")
     args = ap.parse_args()
-    base = f"http://{args.host}:{args.port}/v1"
+    base = f"http://{args.host}:{args.port}"
 
     thinking, tools = args.thinking, args.tools
     history = []

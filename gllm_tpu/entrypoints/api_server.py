@@ -67,6 +67,18 @@ class ServerState:
                 raise
             except Exception as e:
                 raise proto.ProtocolError(f"multimodal encode failed: {e}")
+        # Text-only model: media parts must be rejected, not silently
+        # dropped — the caller would believe the model saw the image.
+        for m in req.messages:
+            c = m.get("content")
+            if isinstance(c, list) and any(
+                    isinstance(p, dict)
+                    and p.get("type") in ("image_url", "image", "video",
+                                          "video_url")
+                    for p in c):
+                raise proto.ProtocolError(
+                    "this model is not multimodal; image/video content "
+                    "parts are not supported")
         tok = self.llm.tokenizer
         if tok is None:
             raise proto.ProtocolError("server has no tokenizer loaded")
