@@ -223,10 +223,24 @@ def _run_disagg(jax, llm, result_path, model_dir):
         deadline = time.monotonic() + 150
         while seq.seq_id not in done and time.monotonic() < deadline:
             time.sleep(0.05)
+        # second request aborted mid-flight: the DisaggAbort event must
+        # drop state on BOTH hosts (the follower exiting cleanly through
+        # the shutdown tick proves it did not desync/hang)
+        sp2 = SamplingParams(temperature=0.0, max_tokens=48,
+                             ignore_eos=True)
+        seq2 = llm._allocate_seq(DISAGG_IDS, sp2)
+        eng.submit_disagg(seq2, [("image", disagg_image())])
+        while seq2.seq_id not in llm._seq_replica \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        eng.abort(seq2.seq_id)
+        while not seq2.is_finished and time.monotonic() < deadline:
+            time.sleep(0.05)
         eng.shutdown()
         t.join(timeout=30)
         with open(result_path, "w") as f:
             json.dump({"output": done.get(seq.seq_id),
+                       "abort_finish": seq2.finish_reason,
                        "procs": jax.process_count()}, f)
         eng.coord.close()
         enc.stop()

@@ -295,6 +295,9 @@ def test_two_process_disagg_serving(tmp_path):
     d = json.loads(result.read_text())
     assert d["procs"] == 2 and d["output"], (d, [o[-800:] for o in outs])
     assert d["output"][0] != "ERROR", d
+    # the mid-flight abort propagated (DisaggAbort event) and both
+    # processes exited cleanly (rc checks above)
+    assert d["abort_finish"] == "abort", d
 
     # oracle: SINGLE-host disagg run of the same request (single-host
     # disagg == monolith is covered by test_disagg)
