@@ -117,3 +117,16 @@ def test_mm_chat_request_shape(text_server):
     data = json.loads(resp.read())
     conn.close()
     assert resp.status >= 400 and "error" in data, (resp.status, data)
+
+
+def test_client_request_stream(text_server, capsys):
+    """examples/client.py request() in both modes against the server."""
+    mod = load_example("client")
+    body = {"model": "m", "prompt": "hello there", "max_tokens": 4,
+            "ignore_eos": True, "temperature": 0}
+    mod.request("127.0.0.1", text_server, "/v1/completions", body)
+    out = capsys.readouterr().out
+    assert json.loads(out)["choices"][0]["text"].strip()
+    mod.request("127.0.0.1", text_server, "/v1/completions",
+                {**body, "stream": True}, stream=True)
+    assert capsys.readouterr().out.strip()
