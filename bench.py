@@ -50,8 +50,12 @@ PHASE_TAG = "[bench phase] "
 
 # Degrade ladder, simplest first (VERDICT r02: the device-side stall is
 # suspected in the multi-step fused decode path — measure without it, then
-# with it, and report the best successful run).
-PROFILES = ("conservative", "full")
+# with it, and report the best successful run). ``minimal`` exists to get
+# ANY number on a freshly recovered tunnel: its bucket surface (decode
+# seqs ≤64, model_len 1024, prefill chunk 512) compiles in a fraction of
+# the conservative profile's, and every compile lands in the persistent
+# XLA cache so the later rungs start warm.
+PROFILES = ("minimal", "conservative", "full")
 
 
 def log(msg):
@@ -94,24 +98,37 @@ def last_phase(text):
 
 
 def supervise(args, argv):
-    """Degrade-ladder supervisor; always prints one JSON line."""
+    """Degrade-ladder supervisor; always prints one JSON line.
+
+    Each attempt's jit compiles land in the persistent XLA cache
+    (``.jax_cache/``) even when the attempt itself is killed, so a
+    timed-out profile is retried once: the retry replays every compile
+    the first attempt finished and spends its budget measuring. The
+    ladder therefore makes forward progress across wedges instead of
+    starting from scratch.
+    """
     deadline = time.monotonic() + (1020 if not args.tiny else 420)
     best = None          # best successful (value, profile, extra)
     last_tail, phase = "", "start"
     on_chip = not args.tiny
-    for profile in PROFILES:
+    ladder = [[p, 0] for p in PROFILES]   # [profile, attempts_so_far]
+    while ladder:
+        profile, tried = ladder[0]
         remaining = deadline - time.monotonic()
         if remaining < 120:
             break
         if best is not None and remaining < 360:
-            # don't risk a wedge chasing the full profile on a thin budget
+            # don't risk a wedge chasing a bigger profile on a thin budget
             break
         if on_chip and not probe_tunnel(
                 min(deadline - 60, time.monotonic() + remaining / 2)):
             log("[bench supervisor] tunnel never answered; stopping")
             break
         budget = max(60, min(deadline - time.monotonic(), 640))
-        log(f"[bench supervisor] profile={profile}, budget {budget:.0f}s")
+        log(f"[bench supervisor] profile={profile} attempt {tried + 1}, "
+            f"budget {budget:.0f}s")
+        ladder[0][1] += 1
+        timed_out = False
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--inner",
@@ -131,8 +148,13 @@ def supervise(args, argv):
                         except json.JSONDecodeError:
                             continue
                         if parsed.get("metric") == METRIC:
-                            if best is None or parsed["value"] > best[0]:
-                                best = (parsed["value"], profile, parsed)
+                            # minimal's shorter-context workload is not
+                            # comparable to the other rungs: any
+                            # conservative/full number outranks it
+                            rank = (0 if profile == "minimal" else 1,
+                                    parsed["value"])
+                            if best is None or rank > best[0]:
+                                best = (rank, profile, parsed)
                             break
                 if best is None:
                     last_tail = tail[-1500:]
@@ -148,11 +170,19 @@ def supervise(args, argv):
                            f"'{phase}' profile={profile}]")
             log(f"[bench supervisor] profile={profile} timed out in "
                 f"phase '{phase}'")
+            timed_out = True
             # a timeout on chip very likely wedged the tunnel; the next
             # loop iteration's probe will wait it out
+        if timed_out and ladder[0][1] < 2:
+            continue          # retry same profile, now cache-warm
+        ladder.pop(0)
     if best is not None:
-        value, profile, parsed = best
+        _, profile, parsed = best
         parsed["profile"] = profile
+        if profile == "minimal":
+            # shorter-context fallback workload; don't read this as the
+            # round-over-round headline (see PROFILES docstring)
+            parsed["comparable"] = False
         print(json.dumps(parsed))
         return 0
     print(json.dumps({
@@ -180,6 +210,70 @@ def build_workload(rng, n_requests, max_model_len, tiny=False):
         params.append(SamplingParams(temperature=0.0, max_tokens=o_len,
                                      ignore_eos=True))
     return prompts, params
+
+
+# Dense-peak bf16 TFLOP/s by TPU generation (public spec sheets); used only
+# to turn measured tok/s into an MFU so rounds compare efficiency, not just
+# absolute rate on a changing workload (VERDICT r03 next #3).
+PEAK_TFLOPS = (("v5 lite", 197.0), ("v5e", 197.0), ("v6", 918.0),
+               ("trillium", 918.0), ("v5p", 459.0), ("v5", 459.0),
+               ("v4", 275.0), ("v3", 123.0))
+
+
+def chip_peak_flops() -> float:
+    """Peak bf16 FLOP/s of device 0, or 0.0 when unknown (CPU)."""
+    ov = os.environ.get("GLLM_TPU_PEAK_TFLOPS")
+    if ov:
+        try:
+            return float(ov) * 1e12
+        except ValueError:
+            log(f"[bench] ignoring malformed GLLM_TPU_PEAK_TFLOPS={ov!r}")
+    import jax
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, tf in PEAK_TFLOPS:
+        if tag in kind:
+            return tf * 1e12
+    return 0.0
+
+
+def model_flops(mc, prompts, params, prefill_chunk: int) -> float:
+    """Total forward matmul FLOPs for the workload on the dense
+    Llama-family bench model.
+
+    Per processed token: 2·(weight params on the matmul path); embedding
+    gather excluded. The lm_head projection runs once per engine step per
+    sequence (the runner gathers last-token rows before the vocab GEMM,
+    models/dense.py compute_logits), i.e. ~once per output token plus once
+    per prefill chunk — NOT once per prompt token. Attention is
+    token-weighted causally — a prefill token at position i attends i keys
+    (Σ over the prompt = p²/2), a decode token at output position j attends
+    p+j keys (Σ = o·p + o²/2) — at 2·2·ctx·Hq·D FLOPs per token (QKᵀ+PV).
+    """
+    import math
+    qkv = mc.hidden_size * (mc.num_heads + 2 * mc.num_kv_heads) * mc.head_dim
+    o_proj = mc.num_heads * mc.head_dim * mc.hidden_size
+    mlp = 3 * mc.hidden_size * mc.intermediate_size
+    body_tok = 2 * mc.num_layers * (qkv + o_proj + mlp)
+    lm_head = 2 * mc.vocab_size * mc.hidden_size
+    n_tok = sum(len(p) + s.max_tokens for p, s in zip(prompts, params))
+    n_head_rows = sum(s.max_tokens + math.ceil(len(p) / prefill_chunk)
+                      for p, s in zip(prompts, params))
+    ctx_sum = sum(len(p) ** 2 / 2
+                  + s.max_tokens * len(p) + s.max_tokens ** 2 / 2
+                  for p, s in zip(prompts, params))
+    attn = mc.num_layers * 4 * mc.num_heads * mc.head_dim * ctx_sum
+    return n_tok * body_tok + n_head_rows * lm_head + attn
+
+
+def flagship_model_cfg():
+    """Llama-3.2-1B shape (BASELINE config 1), dummy weights — shared by
+    every on-chip ladder rung so all rungs benchmark the same model."""
+    from gllm_tpu.models.config import ModelConfig
+    return ModelConfig(
+        architecture="LlamaForCausalLM", vocab_size=128256,
+        hidden_size=2048, num_layers=16, num_heads=32, num_kv_heads=8,
+        head_dim=64, intermediate_size=8192, max_position=4096,
+        rope_theta=500000.0, tie_word_embeddings=True)
 
 
 def phase(name):
@@ -225,19 +319,14 @@ def main():
     if args.tiny:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                          os.path.join(os.path.dirname(__file__) or ".",
-                                       ".jax_cache"))
     phase("import_jax")
     import numpy as np
     import jax
     if args.tiny:
         jax.config.update("jax_platforms", "cpu")
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ["JAX_COMPILATION_CACHE_DIR"])
-    except Exception:
-        pass
+    from gllm_tpu.utils import enable_compilation_cache
+    enable_compilation_cache(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
 
     from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
@@ -245,6 +334,7 @@ def main():
     from gllm_tpu.models.config import ModelConfig
 
     full = args.profile == "full"
+    minimal = args.profile == "minimal"
     if args.tiny:
         model_cfg = ModelConfig(
             architecture="LlamaForCausalLM", vocab_size=2048,
@@ -258,13 +348,24 @@ def main():
                                       max_decode_seqs=16),
             cache=CacheConfig(page_size=4, num_pages=512))
         n_requests = args.requests or 8
+    elif minimal:
+        # Same Llama-3.2-1B model, smallest serviceable bucket surface:
+        # decode buckets {8..64}, page buckets {4..64}, one 512-token
+        # prefill chunk bucket — roughly half the conservative profile's
+        # compile count, for a first number on a fresh tunnel. NOTE: the
+        # shorter-context workload is NOT comparable to the conservative/
+        # full rungs; the supervisor only reports it when no comparable
+        # rung produced a number, and tags the JSON.
+        model_cfg = flagship_model_cfg()
+        engine_cfg = EngineConfig(
+            load_format="dummy", dtype="bfloat16", max_model_len=1024,
+            max_num_seqs=64, overlap_scheduling=False, multi_step_decode=1,
+            scheduler=SchedulerConfig(max_prefill_tokens=512,
+                                      max_decode_seqs=64),
+            cache=CacheConfig(page_size=16, num_pages=4096))
+        n_requests = args.requests or 64
     else:
-        # Llama-3.2-1B shape (BASELINE config 1), dummy weights.
-        model_cfg = ModelConfig(
-            architecture="LlamaForCausalLM", vocab_size=128256,
-            hidden_size=2048, num_layers=16, num_heads=32, num_kv_heads=8,
-            head_dim=64, intermediate_size=8192, max_position=4096,
-            rope_theta=500000.0, tie_word_embeddings=True)
+        model_cfg = flagship_model_cfg()
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="bfloat16", max_model_len=2048,
             # conservative halves the decode width: fewer/smaller decode
@@ -316,13 +417,22 @@ def main():
     out_tokens = sum(o.num_output_tokens for o in outs)
     assert out_tokens == total_out, (out_tokens, total_out)
     value = out_tokens / dt
+
+    # MFU: every processed token (prompt + output) makes one forward pass.
+    total_proc = total_in + total_out
+    flops = model_flops(model_cfg, prompts, params,
+                        engine_cfg.scheduler.max_prefill_tokens)
+    peak = chip_peak_flops()
+    mfu = round(flops / dt / peak, 4) if peak else None
     log(f"measured pass: {dt:.2f}s → {value:.1f} output tok/s "
-        f"({n_requests / dt:.2f} req/s)")
+        f"({n_requests / dt:.2f} req/s, "
+        f"{total_proc / dt:.0f} processed tok/s, mfu={mfu})")
     print(json.dumps({
         "metric": METRIC,
         "value": round(value, 2),
         "unit": "tok/s",
         "vs_baseline": round(value / 2000.0, 4),
+        "mfu": mfu,
     }))
 
 

@@ -166,6 +166,10 @@ def main():
         name = sys.argv[2]
         import faulthandler
         faulthandler.dump_traceback_later(PROBE_TIMEOUT_S - 10, exit=False)
+        # share one persistent compile cache with bench.py so probe
+        # compiles survive tunnel wedges and later benefit the bench
+        from gllm_tpu.utils import enable_compilation_cache
+        enable_compilation_cache(os.path.join(REPO, ".jax_cache"))
         t0 = time.monotonic()
         PROBES[name]()
         print(f"[probe inner] {name} ok {time.monotonic() - t0:.1f}s",

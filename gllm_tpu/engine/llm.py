@@ -79,6 +79,18 @@ class LLM:
         config.validate()
         self.config = config
 
+        # Persistent XLA compilation cache: a restarted server (or a bench
+        # retry after a tunnel wedge) replays every previously-compiled
+        # bucket from disk instead of paying the remote compile again.
+        # Skipped on the CPU backend (tests, library embeds) unless the
+        # user opted in via GLLM_TPU_XLA_CACHE — sub-second CPU compiles
+        # aren't worth the disk churn.
+        import jax
+        if (jax.default_backend() != "cpu"
+                or os.environ.get("GLLM_TPU_XLA_CACHE")):
+            from gllm_tpu.utils import enable_compilation_cache
+            enable_compilation_cache()
+
         if config.model and not os.path.isdir(config.model):
             from gllm_tpu.models.loader import resolve_model_path
             config.model = resolve_model_path(

@@ -682,9 +682,23 @@ def main(argv=None):
                        args.host_id)
         import jax
         multihost = jax.process_count() > 1
+    t_start = time.monotonic()
     llm = LLM(config=build_engine_config(args))
     if not args.skip_warmup:
         llm.runner.warmup()
+        if not multihost:
+            # Serving-readiness yardstick (reference: CUDA-graph capture
+            # logs): one real token through the full engine path.
+            from gllm_tpu.sampling_params import SamplingParams
+            t0 = time.monotonic()
+            llm.generate(prompt_token_ids=[[1, 2, 3]],
+                         sampling_params=SamplingParams(
+                             temperature=0.0, max_tokens=1,
+                             ignore_eos=True))
+            logger.info("[startup] phase=first_token seconds=%.2f "
+                        "total_startup_seconds=%.2f",
+                        time.monotonic() - t0,
+                        time.monotonic() - t_start)
     if multihost:
         # Host 0 runs the HTTP frontend + broadcasts every tick's intake;
         # followers mirror the deterministic engine loop so all processes

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -161,6 +162,7 @@ class ModelRunner:
         self._step_count = 0
 
         ep_loaded = False
+        _t_load = time.monotonic()
         if params is not None:
             self.params = params
         elif config.load_format == "dummy" or not config.model:
@@ -210,6 +212,11 @@ class ModelRunner:
             if "visual" not in self.params:
                 specs.pop("visual", None)
             self.params = shard_params(self.params, specs, self.mesh)
+        # Startup latency breakdown (reference: CUDA-graph capture logs);
+        # one structured line per phase so serving-readiness regressions
+        # show up in logs, not just vibes.
+        logger.info("[startup] phase=weight_load seconds=%.2f",
+                    time.monotonic() - _t_load)
 
         self.dp = config.parallel.dp
         if model_cfg.use_hybrid:
@@ -897,6 +904,7 @@ class ModelRunner:
         combos += [(decode_buckets[-1], p) for p in page_buckets[:-1]]
 
         page = self.config.cache.page_size
+        _t_warm = time.monotonic()
         for nseq, npages in combos:
             items = []
             for i in range(nseq):
@@ -911,7 +919,11 @@ class ModelRunner:
                 seq.num_computed_tokens = ctx
                 items.append(ScheduledSeq(seq, 1, ctx))
             if items:
+                t0 = time.monotonic()
                 self.step(ScheduledBatch(items))
+                logger.info("[startup] phase=warmup_bucket seqs=%d "
+                            "pages=%d seconds=%.2f", nseq, npages,
+                            time.monotonic() - t0)
 
         # Mixed prefill+decode signatures — the shapes a newly admitted
         # request hits mid-serving (chunked prefill riding with the decode
@@ -934,7 +946,13 @@ class ModelRunner:
                                  for j in range(page_buckets[-1])]
                 s2.num_computed_tokens = ctx
                 items.append(ScheduledSeq(s2, 1, ctx))
+            t0 = time.monotonic()
             self.step(ScheduledBatch(items))
+            logger.info("[startup] phase=warmup_bucket seqs=%d "
+                        "prefill_chunk=%d seconds=%.2f", nseq, chunk,
+                        time.monotonic() - t0)
             mixed += 1
+        logger.info("[startup] phase=warmup seconds=%.2f buckets=%d",
+                    time.monotonic() - _t_warm, len(combos) + mixed)
         logger.info("warmed %d decode + %d mixed shape buckets",
                     len(combos), mixed)

@@ -8,6 +8,8 @@ reference engine's power-of-two CUDA-graph buckets
 
 from __future__ import annotations
 
+import os
+
 
 def cdiv(a: int, b: int) -> int:
     return -(-a // b)
@@ -80,6 +82,42 @@ class LRUBytesCache:
                or self._cur_bytes > self.max_bytes):
             _, evicted = self._cache.popitem(last=False)
             self._cur_bytes -= self._size_of(evicted)
+
+
+def enable_compilation_cache(cache_dir: str = None) -> str:
+    """Turn on JAX's persistent (on-disk) XLA compilation cache.
+
+    Serving cold-start is compile-bound: the bucketed jit grid is ~15-30
+    programs and a TPU compile through the remote tunnel costs tens of
+    seconds each (the reference pays the analogous cost once per CUDA-graph
+    capture, model_runner.py:1525). With the persistent cache every process
+    that compiles the same (program, compile-options) pair — a restarted
+    server, a bench retry after a tunnel wedge, the next round — reuses the
+    serialized executable instead of recompiling.
+
+    min_entry_size/min_compile_time are forced to 0 because the default
+    thresholds (1 s compile floor) silently skip exactly the small bucketed
+    decode programs we most need cached. Safe to call repeatedly; first
+    caller's directory wins. Returns the directory in effect.
+    """
+    import jax
+    d = (cache_dir
+         or os.environ.get("GLLM_TPU_XLA_CACHE")
+         or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+         or os.path.expanduser("~/.cache/gllm_tpu/xla_cache"))
+    existing = jax.config.jax_compilation_cache_dir
+    if existing:
+        d = existing
+    else:
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+    for knob, val in (("jax_persistent_cache_min_entry_size_bytes", 0),
+                      ("jax_persistent_cache_min_compile_time_secs", 0)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # pragma: no cover - knob renamed upstream
+            pass
+    return d
 
 
 def tpu_compiler_options() -> dict:

@@ -1,0 +1,43 @@
+"""Startup-latency instrumentation (VERDICT r03 next #9).
+
+The engine logs one structured ``[startup] phase=... seconds=...`` line per
+startup phase (weight load, each warmup bucket compile, warmup total) — the
+serving-readiness breakdown the reference gets from its CUDA-graph capture
+logs (model_runner.py:1525-1615). These tests pin the lines' presence so
+the instrumentation can't silently rot.
+"""
+
+import logging
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.models.config import ModelConfig
+
+
+def _tiny_llm():
+    mcfg = ModelConfig(
+        architecture="LlamaForCausalLM", vocab_size=256, hidden_size=64,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        intermediate_size=96, max_position=256)
+    cfg = EngineConfig(
+        load_format="dummy", dtype="float32", max_model_len=64,
+        max_num_seqs=8,
+        scheduler=SchedulerConfig(max_prefill_tokens=32, max_decode_seqs=8),
+        cache=CacheConfig(page_size=4, num_pages=64))
+    return LLM(config=cfg, model_cfg=mcfg)
+
+
+def test_startup_phase_lines(caplog):
+    with caplog.at_level(logging.INFO):
+        llm = _tiny_llm()
+        llm.runner.warmup()
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("[startup] phase=weight_load seconds=" in m for m in msgs)
+    # per-bucket compile lines (decode and mixed prefill+decode variants)
+    assert any("[startup] phase=warmup_bucket seqs=" in m
+               and "pages=" in m for m in msgs)
+    assert any("[startup] phase=warmup_bucket seqs=" in m
+               and "prefill_chunk=" in m for m in msgs)
+    # warmup total with bucket count
+    assert any("[startup] phase=warmup seconds=" in m and "buckets=" in m
+               for m in msgs)
