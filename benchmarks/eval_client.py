@@ -1,0 +1,86 @@
+"""Shared concurrent HTTP client for the eval harnesses.
+
+VERDICT r03 weak #6: the evals were one-connection-per-question serial
+loops — correctness-adequate, useless as load generators. This helper
+gives every harness the reference's eval ergonomics
+(reference benchmarks/evaluate_mmlu_pro.py drives a thread pool against
+the server): a thread pool with per-thread persistent connections,
+bounded retries with backoff, and order-preserving results.
+
+``serve_bench.py`` remains the source of TTFT/TPOT latency claims; this
+is about saturating the server during accuracy runs so a 1k-question
+eval doesn't serialize on round-trips.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import http.client
+import json
+import sys
+import threading
+import time
+
+_tls = threading.local()
+
+
+def _conn(host: str, port: int, timeout: float):
+    c = getattr(_tls, "conn", None)
+    if c is None or _tls.addr != (host, port):
+        if c is not None:
+            try:
+                c.close()
+            except OSError:
+                pass
+        c = http.client.HTTPConnection(host, port, timeout=timeout)
+        _tls.conn = c
+        _tls.addr = (host, port)
+    return c
+
+
+def post_json(host: str, port: int, path: str, body: dict, *,
+              timeout: float = 600.0, retries: int = 3) -> dict:
+    """POST ``body`` as JSON; returns the parsed response. Retries
+    connection errors and 5xx with exponential backoff; 4xx raise
+    immediately (a malformed request never becomes valid by retrying)."""
+    delay = 1.0
+    for attempt in range(retries + 1):
+        conn = _conn(host, port, timeout)
+        try:
+            conn.request("POST", path, body=json.dumps(body),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status < 400:
+                return json.loads(data)
+            if resp.status < 500:
+                raise RuntimeError(
+                    f"HTTP {resp.status} from {path}: {data[:300]!r}")
+            err = f"HTTP {resp.status}"
+        except (OSError, http.client.HTTPException,
+                json.JSONDecodeError) as e:
+            err = repr(e)
+            _tls.conn = None          # drop the broken connection
+        if attempt == retries:
+            raise RuntimeError(f"{path} failed after {retries + 1} "
+                               f"attempts: {err}")
+        time.sleep(delay)
+        delay = min(delay * 2, 15.0)
+
+
+def map_concurrent(fn, items, *, concurrency: int = 8, label: str = "",
+                   progress_every: int = 50):
+    """Run ``fn(item)`` over ``items`` with a thread pool; returns results
+    in input order. Progress goes to stderr every ``progress_every``
+    completions."""
+    results = [None] * len(items)
+    done = 0
+    with cf.ThreadPoolExecutor(max_workers=max(1, concurrency)) as ex:
+        futs = {ex.submit(fn, it): i for i, it in enumerate(items)}
+        for fut in cf.as_completed(futs):
+            results[futs[fut]] = fut.result()
+            done += 1
+            if progress_every and done % progress_every == 0:
+                print(f"[{label or 'eval'}] {done}/{len(items)}",
+                      file=sys.stderr, flush=True)
+    return results

@@ -20,9 +20,11 @@ Each line:
 
 import argparse
 import ast
-import http.client
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def _bracket_spans(text):
@@ -154,11 +156,8 @@ def ask(host, port, q, native):
              "or [] if none apply.\n" + tool_text},
             {"role": "user", "content": q["question"]},
         ]
-    conn = http.client.HTTPConnection(host, port, timeout=600)
-    conn.request("POST", "/v1/chat/completions", body=json.dumps(body),
-                 headers={"Content-Type": "application/json"})
-    d = json.loads(conn.getresponse().read())
-    conn.close()
+    from eval_client import post_json
+    d = post_json(host, port, "/v1/chat/completions", body)
     msg = d["choices"][0]["message"]
     return (parse_native_calls(msg) if native
             else parse_prompt_calls(msg.get("content")))
@@ -172,6 +171,7 @@ def main():
     ap.add_argument("--mode", choices=("prompt", "native"),
                     default="prompt")
     ap.add_argument("--limit", type=int, default=None)
+    ap.add_argument("--concurrency", type=int, default=8)
     args = ap.parse_args()
 
     with open(args.data_path) as f:
@@ -179,10 +179,13 @@ def main():
     if args.limit:
         samples = samples[:args.limit]
 
-    ok = 0
-    for q in samples:
-        calls = ask(args.host, args.port, q, args.mode == "native")
-        ok += score(calls, q.get("expect", []), q.get("irrelevant", False))
+    from eval_client import map_concurrent
+    native = args.mode == "native"
+    calls_per_q = map_concurrent(
+        lambda q: ask(args.host, args.port, q, native), samples,
+        concurrency=args.concurrency, label="bfcl")
+    ok = sum(score(calls, q.get("expect", []), q.get("irrelevant", False))
+             for q, calls in zip(samples, calls_per_q))
     print(f"accuracy: {ok}/{len(samples)} = {ok / max(len(samples), 1):.3f}")
     return 0 if samples else 1
 

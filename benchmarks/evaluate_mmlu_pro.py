@@ -9,7 +9,6 @@ first choice letter.
 """
 
 import argparse
-import http.client
 import json
 import os
 import sys
@@ -38,6 +37,7 @@ def main():
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, required=True)
     ap.add_argument("--limit", type=int, default=None)
+    ap.add_argument("--concurrency", type=int, default=16)
     args = ap.parse_args()
 
     with open(args.data_path) as f:
@@ -45,24 +45,25 @@ def main():
     if args.limit:
         questions = questions[:args.limit]
 
-    correct = total = 0
-    for q in questions:
-        body = {"messages": [{"role": "user",
-                              "content": format_prompt(q)}],
-                "max_tokens": 8, "temperature": 0.0}
-        conn = http.client.HTTPConnection(args.host, args.port, timeout=600)
-        conn.request("POST", "/v1/chat/completions", body=json.dumps(body),
-                     headers={"Content-Type": "application/json"})
-        d = json.loads(conn.getresponse().read())
-        conn.close()
-        got = extract_choice(d["choices"][0]["message"]["content"] or "")
+    from eval_client import map_concurrent, post_json
+
+    def ask(q):
+        d = post_json(args.host, args.port, "/v1/chat/completions",
+                      {"messages": [{"role": "user",
+                                     "content": format_prompt(q)}],
+                       "max_tokens": 8, "temperature": 0.0})
+        return extract_choice(d["choices"][0]["message"]["content"] or "")
+
+    answers = map_concurrent(ask, questions,
+                             concurrency=args.concurrency,
+                             label="mmlu_pro")
+    correct = 0
+    for q, got in zip(questions, answers):
         want = q["answer"]
         if isinstance(want, int):
             want = LETTERS[want]
-        total += 1
         correct += int(got == str(want).strip().upper())
-        if total % 50 == 0:
-            print(f"{total}: acc={correct / total:.3f}", file=sys.stderr)
+    total = len(questions)
     print(json.dumps({"metric": "mmlu_pro_accuracy",
                       "value": correct / max(1, total),
                       "n": total}))

@@ -11,8 +11,11 @@ Drives the OpenAI endpoint of a running server OR an in-process LLM
 
 import argparse
 import json
+import os
 import random
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
 def build_case(rng, tokenizer, context_tokens):
@@ -42,17 +45,16 @@ def run_inprocess(args, cases):
 
 
 def run_server(args, cases):
-    import http.client
-    answers = []
-    for prompt, _ in cases:
-        conn = http.client.HTTPConnection(args.host, args.port, timeout=600)
-        conn.request("POST", "/v1/completions", body=json.dumps({
-            "prompt": prompt, "max_tokens": 16, "temperature": 0.0}),
-            headers={"Content-Type": "application/json"})
-        d = json.loads(conn.getresponse().read())
-        answers.append(d["choices"][0]["text"])
-        conn.close()
-    return answers
+    from eval_client import map_concurrent, post_json
+
+    def ask(case):
+        d = post_json(args.host, args.port, "/v1/completions",
+                      {"prompt": case[0], "max_tokens": 16,
+                       "temperature": 0.0})
+        return d["choices"][0]["text"]
+
+    return map_concurrent(ask, cases, concurrency=args.concurrency,
+                          label="ruler")
 
 
 def main():
@@ -62,6 +64,7 @@ def main():
     ap.add_argument("--port", type=int, default=None, help="server mode")
     ap.add_argument("--context-lens", default="1024,2048,4096")
     ap.add_argument("--num-cases", type=int, default=10)
+    ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--max-model-len", type=int, default=8192)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()

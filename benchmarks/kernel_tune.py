@@ -1,0 +1,251 @@
+"""On-chip block-size sweep for the Pallas attention kernels.
+
+TPU analogue of the reference's Triton autotuner runs that produced
+``fused_moe_triton/configs/`` (VERDICT r03 next #2): sweep
+``q_block``/``kv_block`` over 64-512 on representative prefill/decode
+workloads, then write the winners into the committed per-device table
+(``gllm_tpu/ops/pallas/tuning.py`` → ``tables.json``).
+
+Every config runs in a fresh timeout-bounded subprocess (the chip_probes
+discipline): a config that overflows VMEM or stalls the Mosaic pipeline
+reports as FAIL/TIMEOUT without wedging the sweep or the single-tenant
+tunnel session. Timing is fetch-based (``np.asarray``) over a chained
+dependency loop because ``block_until_ready`` does not actually wait under
+axon.
+
+    python benchmarks/kernel_tune.py                 # sweep both kernels
+    python benchmarks/kernel_tune.py --write         # ... and update tables.json
+    python benchmarks/kernel_tune.py --vmem-probe    # find Mosaic's real VMEM
+                                                     # ceiling (validates the 6 MB
+                                                     # heuristic in ragged_attention)
+"""
+
+import argparse
+import functools
+import itertools
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CONFIG_TIMEOUT_S = 150
+BLOCKS = (64, 128, 256, 512)
+
+
+# ---------------------------------------------------------------------------
+# inner: one timed config in a fresh process
+# ---------------------------------------------------------------------------
+
+def _fetch(x):
+    import numpy as np
+    return np.asarray(x)
+
+
+def _mixed_workload(T=1024, S=8, Hq=32, Hkv=8, D=128, page=16, ctx=1024):
+    """Representative prefill batch: S seqs, T packed tokens, ctx KV."""
+    import jax
+    import jax.numpy as jnp
+    P = S * (ctx // page) + 1
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (T, Hq, D), jnp.bfloat16)
+    k_cache = jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16)
+    v_cache = jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16)
+    per = T // S
+    cu = jnp.asarray([i * per for i in range(S)] + [T], jnp.int32)
+    kv_lens = jnp.full((S,), ctx, jnp.int32)
+    pt = (jnp.arange(S * (ctx // page), dtype=jnp.int32)
+          .reshape(S, ctx // page) + 1)
+    return q, k_cache, v_cache, cu, kv_lens, pt, D ** -0.5
+
+
+def time_ragged(q_block, kv_block, iters=12):
+    import jax
+    import jax.numpy as jnp
+    from gllm_tpu.ops.pallas.ragged_attention import ragged_paged_attention
+    from gllm_tpu.utils import tpu_compiler_options
+    q, kc, vc, cu, kl, pt, scale = _mixed_workload()
+
+    # same scoped-VMEM compile options the serving step jit uses, so the
+    # sweep measures what the runner will actually run
+    @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
+    def run(qq):
+        return ragged_paged_attention(qq, kc, vc, cu, kl, pt, scale=scale,
+                                      q_block=q_block, kv_block=kv_block)
+
+    out = run(q)
+    _fetch(out)                                    # compile + first fetch
+    t0 = time.monotonic()
+    for _ in range(iters):
+        # chain: next q depends on previous out so device work serializes
+        q = q + 0.0 * out.astype(jnp.bfloat16)
+        out = run(q)
+    _fetch(out)
+    return (time.monotonic() - t0) / iters * 1e3
+
+
+def time_decode(kv_block, iters=25):
+    import jax
+    import jax.numpy as jnp
+    from gllm_tpu.ops.pallas.decode_attention import paged_decode_attention
+    S, Hq, Hkv, D, page, ctx = 128, 32, 8, 128, 16, 2048
+    P = S * (ctx // page) + 1
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (S, Hq, D), jnp.bfloat16)
+    kc = jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16)
+    vc = jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16)
+    kl = jnp.full((S,), ctx, jnp.int32)
+    pt = (jnp.arange(S * (ctx // page), dtype=jnp.int32)
+          .reshape(S, ctx // page) + 1)
+    from gllm_tpu.utils import tpu_compiler_options
+
+    @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
+    def run(qq):
+        return paged_decode_attention(qq, kc, vc, kl, pt, scale=D ** -0.5,
+                                      kv_block=kv_block)
+
+    out = run(q)
+    _fetch(out)
+    t0 = time.monotonic()
+    for _ in range(iters):
+        q = q + 0.0 * out.astype(jnp.bfloat16)
+        out = run(q)
+    _fetch(out)
+    return (time.monotonic() - t0) / iters * 1e3
+
+
+VMEM_PROBE_CONFIGS = ((128, 256), (256, 256), (256, 512), (512, 512),
+                      (1024, 512), (1024, 1024), (2048, 1024))
+
+
+def vmem_probe_one(qb: int, kb: int):
+    """One oversized-tile compile attempt: the heuristic in
+    ragged_attention.py is disabled via its env override so Mosaic itself
+    rules on the tile. Runs in its own subprocess (a stalling compile must
+    not take the later configs with it); the parent's last-good/first-bad
+    pair brackets the REAL VMEM ceiling the 6 MB heuristic guesses at."""
+    os.environ["GLLM_TPU_VMEM_TILE_LIMIT_MB"] = "100000"
+    import functools as ft
+
+    import jax
+    from gllm_tpu.ops.pallas.ragged_attention import ragged_paged_attention
+    from gllm_tpu.utils import tpu_compiler_options
+    q, kc, vc, cu, kl, pt, scale = _mixed_workload(T=2048, ctx=2048)
+    tile_mb = q.shape[1] * qb * kb * 4 / 1e6
+
+    @ft.partial(jax.jit, compiler_options=tpu_compiler_options())
+    def run(qq):
+        return ragged_paged_attention(qq, kc, vc, cu, kl, pt, scale=scale,
+                                      q_block=qb, kv_block=kb)
+
+    try:
+        _fetch(run(q))
+        print(f"[vmem] q_block={qb} kv_block={kb} "
+              f"score_tile={tile_mb:.1f}MB: OK", flush=True)
+    except Exception as e:
+        msg = str(e).splitlines()[0][:200]
+        print(f"[vmem] q_block={qb} kv_block={kb} "
+              f"score_tile={tile_mb:.1f}MB: FAIL {msg}", flush=True)
+
+
+# ---------------------------------------------------------------------------
+# outer: subprocess sweep supervisor
+# ---------------------------------------------------------------------------
+
+def run_inner(spec: str):
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner", spec],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=CONFIG_TIMEOUT_S)
+        out = proc.stdout
+        if proc.returncode == 0:
+            for line in reversed(out.strip().splitlines()):
+                if line.startswith("RESULT "):
+                    return float(line.split()[1]), out
+        return None, out
+    except subprocess.TimeoutExpired as e:
+        return None, "TIMEOUT\n" + str(e.stdout or "")[-500:]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inner", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--write", action="store_true",
+                    help="merge winners into gllm_tpu/ops/pallas/tables.json")
+    ap.add_argument("--vmem-probe", action="store_true")
+    ap.add_argument("--kernel", choices=("ragged", "decode"), default=None)
+    args = ap.parse_args()
+
+    if args.inner:
+        from gllm_tpu.utils import enable_compilation_cache
+        enable_compilation_cache(os.path.join(REPO, ".jax_cache"))
+        parts = args.inner.split(":")
+        if parts[0] == "ragged":
+            ms = time_ragged(int(parts[1]), int(parts[2]))
+        elif parts[0] == "decode":
+            ms = time_decode(int(parts[1]))
+        elif parts[0] == "vmem":
+            vmem_probe_one(int(parts[1]), int(parts[2]))
+            print("RESULT 0.0", flush=True)
+            return
+        else:
+            raise SystemExit(f"unknown inner spec {args.inner}")
+        print(f"RESULT {ms:.3f}", flush=True)
+        return
+
+    if args.vmem_probe:
+        for qb, kb in VMEM_PROBE_CONFIGS:
+            ms, out = run_inner(f"vmem:{qb}:{kb}")
+            sys.stdout.write(out if ms is not None
+                             else f"[vmem] q_block={qb} kv_block={kb}: "
+                                  f"TIMEOUT/CRASH\n{out[-300:]}\n")
+            sys.stdout.flush()
+        return
+
+    results = {"ragged": {}, "decode": {}}
+    if args.kernel in (None, "ragged"):
+        for qb, kb in itertools.product(BLOCKS, BLOCKS):
+            ms, _ = run_inner(f"ragged:{qb}:{kb}")
+            results["ragged"][f"{qb}x{kb}"] = ms
+            print(f"[tune] ragged q={qb} kv={kb}: "
+                  f"{'%.2f ms' % ms if ms else 'FAIL'}",
+                  file=sys.stderr, flush=True)
+    if args.kernel in (None, "decode"):
+        for kb in BLOCKS:
+            ms, _ = run_inner(f"decode:{kb}")
+            results["decode"][str(kb)] = ms
+            print(f"[tune] decode kv={kb}: "
+                  f"{'%.2f ms' % ms if ms else 'FAIL'}",
+                  file=sys.stderr, flush=True)
+
+    best = {}
+    ok_r = {k: v for k, v in results["ragged"].items() if v}
+    if ok_r:
+        qb, kb = min(ok_r, key=ok_r.get).split("x")
+        best["ragged"] = {"q_block": int(qb), "kv_block": int(kb)}
+    ok_d = {k: v for k, v in results["decode"].items() if v}
+    if ok_d:
+        best["decode"] = {"kv_block": int(min(ok_d, key=ok_d.get))}
+    print(json.dumps({"results": results, "best": best}))
+
+    if args.write and best:
+        from gllm_tpu.ops.pallas.tuning import _TABLES_PATH, device_tag
+        table = {}
+        if os.path.exists(_TABLES_PATH):
+            with open(_TABLES_PATH) as f:
+                table = json.load(f)
+        dev = table.setdefault(device_tag(), {})
+        for kern, params in best.items():
+            dev.setdefault(kern, {}).update(params)
+        with open(_TABLES_PATH, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+        print(f"[tune] wrote {_TABLES_PATH} for {device_tag()}",
+              file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,10 +44,23 @@ def sampling_from_request(d: dict, default_max_tokens: int) -> SamplingParams:
     elif not (isinstance(stop, list)
               and all(isinstance(s, str) for s in stop)):
         raise ProtocolError("stop must be a string or list of strings")
+    logit_bias = d.get("logit_bias")
+    if logit_bias is not None:
+        if not isinstance(logit_bias, dict):
+            raise ProtocolError("logit_bias must be an object")
+        try:
+            # OpenAI sends token ids as JSON-object string keys
+            logit_bias = {int(k): float(v) for k, v in logit_bias.items()}
+        except (TypeError, ValueError) as e:
+            raise ProtocolError(
+                "logit_bias keys must be token ids and values "
+                "numbers") from e
     sp = SamplingParams(
         temperature=_get(d, "temperature", float, 1.0),
         top_p=_get(d, "top_p", float, 1.0),
         top_k=_get(d, "top_k", int, -1),
+        min_p=_get(d, "min_p", float, 0.0),
+        logit_bias=logit_bias,
         repetition_penalty=_get(d, "repetition_penalty", float, 1.0),
         presence_penalty=_get(d, "presence_penalty", float, 0.0),
         frequency_penalty=_get(d, "frequency_penalty", float, 0.0),

@@ -45,9 +45,35 @@ Params = dict
 class LatentKVCache(NamedTuple):
     """latent: [L, num_pages, page_size, kv_lora_rank + qk_rope_head_dim];
     index_k: parallel DSA indexer-key cache [L, num_pages, page_size,
-    index_head_dim] (V3.2 only — reference store_index_k_fp8 cache)."""
+    index_head_dim], stored fp8-e4m3 with per-token scales in
+    ``index_scale`` [L, num_pages, page_size] (the reference's packed
+    132-byte store_index_k_fp8 layout, layers/ops/cache_kernels.py — here
+    two parallel paged arrays instead of byte-packing, which XLA can't
+    slice)."""
     latent: jnp.ndarray
     index_k: Optional[jnp.ndarray] = None
+    index_scale: Optional[jnp.ndarray] = None
+
+
+def index_cache_fp8() -> bool:
+    """fp8 index-K storage (the reference's fixed layout) — default on;
+    ``GLLM_TPU_DSA_INDEX_DTYPE=native`` keeps the cache in the model
+    dtype. Read once per process (the choice is baked into compiled
+    programs)."""
+    import os
+    return os.environ.get("GLLM_TPU_DSA_INDEX_DTYPE", "fp8") == "fp8"
+
+
+def fp8_score() -> bool:
+    """Score the lightning indexer with fp8 operands (reference
+    GLLM_DSA_FP8_SCORE): q rows quantized per (seq, query, head), the
+    fp8×fp8 dot accumulated in f32 and rescaled. Off by default (bf16/f32
+    scoring of dequantized keys)."""
+    import os
+    return os.environ.get("GLLM_DSA_FP8_SCORE", "0") == "1"
+
+
+_FP8_MAX = 448.0     # float8_e4m3fn finite max
 
 
 def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
@@ -55,11 +81,18 @@ def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
     latent = jnp.zeros(
         (cfg.num_stage_layers, num_pages, page_size, cfg.mla_cache_width),
         dtype)
-    index_k = None
+    index_k = index_scale = None
     if cfg.use_dsa:
-        index_k = jnp.zeros((cfg.num_stage_layers, num_pages, page_size,
-                             cfg.index_head_dim), dtype)
-    return LatentKVCache(latent, index_k)
+        if index_cache_fp8():
+            index_k = jnp.zeros((cfg.num_stage_layers, num_pages,
+                                 page_size, cfg.index_head_dim),
+                                jnp.float8_e4m3fn)
+            index_scale = jnp.ones((cfg.num_stage_layers, num_pages,
+                                    page_size), jnp.float32)
+        else:
+            index_k = jnp.zeros((cfg.num_stage_layers, num_pages,
+                                 page_size, cfg.index_head_dim), dtype)
+    return LatentKVCache(latent, index_k, index_scale)
 
 
 def make_rope_table(cfg: ModelConfig) -> jnp.ndarray:
@@ -157,7 +190,8 @@ def _moe_block(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 def _indexer_topk_slots(lp, x, q_resid, batch: StepBatch, index_cache,
-                        cfg: ModelConfig, cos_sin, *, max_q_len: int):
+                        index_scale, cfg: ModelConfig, cos_sin, *,
+                        max_q_len: int):
     """DSA lightning indexer (reference deepseek_v32.py:86-338): score each
     query against its sequence's cached indexer keys — ReLU(q·k)·scale
     weighted per head and summed — causally mask, top-k, and return
@@ -194,8 +228,19 @@ def _indexer_topk_slots(lp, x, q_resid, batch: StepBatch, index_cache,
     # store this step's keys into the parallel paged index cache
     P, page, _ = index_cache.shape
     flat_k = index_cache.reshape(P * page, hd)
-    index_cache = flat_k.at[batch.slot_mapping].set(
-        k.astype(flat_k.dtype)).reshape(index_cache.shape)
+    if index_scale is not None:
+        # fp8 store (reference store_index_k_fp8): per-token amax scale,
+        # quantized payload + f32 scale land in parallel paged arrays
+        kf = k.astype(jnp.float32)
+        scl = jnp.maximum(jnp.max(jnp.abs(kf), axis=-1), 1e-6) / _FP8_MAX
+        index_cache = flat_k.at[batch.slot_mapping].set(
+            (kf / scl[:, None]).astype(flat_k.dtype)
+        ).reshape(index_cache.shape)
+        index_scale = index_scale.reshape(P * page).at[
+            batch.slot_mapping].set(scl).reshape(P, page)
+    else:
+        index_cache = flat_k.at[batch.slot_mapping].set(
+            k.astype(flat_k.dtype)).reshape(index_cache.shape)
 
     # per-seq gather (same ragged layout as the XLA attention oracle)
     S, max_pages = md.page_table.shape
@@ -208,8 +253,28 @@ def _indexer_topk_slots(lp, x, q_resid, batch: StepBatch, index_cache,
     kg = index_cache[md.page_table].reshape(S, max_kv, hd)
     qg = q[q_idx]                                        # [S, Q, nh, hd]
     wg = weights[q_idx]                                  # [S, Q, nh]
-    sc = jnp.einsum("sqhd,skd->sqhk", qg.astype(jnp.float32),
-                    kg.astype(jnp.float32)) * hd ** -0.5
+    if index_scale is not None:
+        kscl = index_scale[md.page_table].reshape(S, max_kv)
+        if fp8_score():
+            # fp8×fp8 scoring (reference GLLM_DSA_FP8_SCORE): quantize q
+            # per score row too; the dot accumulates in f32 and the two
+            # scales rescale the raw scores — scaling commutes with the
+            # ReLU because both scales are positive.
+            qf = qg.astype(jnp.float32)
+            qscl = jnp.maximum(jnp.max(jnp.abs(qf), axis=-1),
+                               1e-6) / _FP8_MAX        # [S, Q, nh]
+            qq = (qf / qscl[..., None]).astype(index_cache.dtype)
+            raw = jnp.einsum("sqhd,skd->sqhk", qq, kg,
+                             preferred_element_type=jnp.float32)
+            sc = (raw * qscl[..., None] * kscl[:, None, None, :]
+                  * hd ** -0.5)
+        else:
+            kf32 = kg.astype(jnp.float32) * kscl[..., None]
+            sc = jnp.einsum("sqhd,skd->sqhk", qg.astype(jnp.float32),
+                            kf32) * hd ** -0.5
+    else:
+        sc = jnp.einsum("sqhd,skd->sqhk", qg.astype(jnp.float32),
+                        kg.astype(jnp.float32)) * hd ** -0.5
     logits = jnp.einsum("sqhk,sqh->sqk", jax.nn.relu(sc), wg)
 
     kv_pos = jnp.arange(max_kv, dtype=jnp.int32)
@@ -233,7 +298,7 @@ def _indexer_topk_slots(lp, x, q_resid, batch: StepBatch, index_cache,
     src = jnp.where(q_valid[..., None], sel_slots,
                     -1).reshape(S * max_q_len, kk)
     flat_sel = flat_sel.at[q_idx.reshape(-1)].max(src.astype(jnp.int32))
-    return index_cache, flat_sel
+    return index_cache, index_scale, flat_sel
 
 
 def _sparse_mla(q_full, latent_cache, sel_slots, *, scale, lora):
@@ -257,7 +322,8 @@ def _sparse_mla(q_full, latent_cache, sel_slots, *, scale, lora):
 
 def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
                    cos_sin, *, max_q_len: int, scale: float,
-                   attn_impl: str = "xla", index_cache=None):
+                   attn_impl: str = "xla", index_cache=None,
+                   index_scale=None):
     T = x.shape[0]
     Hq = cfg.num_heads
     nope, rope, lora = (cfg.qk_nope_head_dim, cfg.qk_rope_head_dim,
@@ -300,8 +366,8 @@ def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
     if cfg.use_dsa:
         # DSA: indexer top-k physical slots, then sparse attention over
         # only the selected latent rows (reference deepseek_v32.py).
-        index_cache, sel = _indexer_topk_slots(
-            lp, x, qa, batch, index_cache, cfg, cos_sin,
+        index_cache, index_scale, sel = _indexer_topk_slots(
+            lp, x, qa, batch, index_cache, index_scale, cfg, cos_sin,
             max_q_len=max_q_len)
         out_lat = _sparse_mla(q_full, latent_cache, sel, scale=scale,
                               lora=lora).astype(x.dtype)
@@ -318,7 +384,7 @@ def _mla_attention(lp, x, batch: StepBatch, latent_cache, cfg: ModelConfig,
     out = jnp.einsum("thl,hlv->thv", out_lat.astype(jnp.float32),
                      lp["w_uv"].astype(jnp.float32)).astype(x.dtype)
     return (qmm(out.reshape(T, Hq * cfg.v_head_dim), lp["o_proj"]),
-            latent_cache, index_cache)
+            latent_cache, index_cache, index_scale)
 
 
 # ---------------------------------------------------------------------------
@@ -429,43 +495,55 @@ def forward(params, kv: LatentKVCache, batch: StepBatch, cfg: ModelConfig,
 
     cache = kv.latent
     icache = kv.index_k if cfg.use_dsa else jnp.zeros((), jnp.float32)
+    has_iscale = cfg.use_dsa and kv.index_scale is not None
+    iscale = kv.index_scale if has_iscale else jnp.zeros((), jnp.float32)
     first, last = cfg.stage_layers
     n_dense = max(0, min(cfg.first_k_dense_replace, last) - first)
 
     def make_step(mlp_fn, layer_offset):
         def layer_step(carry, lp):
-            h, res, cache, icache, li = carry
+            h, res, cache, icache, iscale, li = carry
             normed, res = fused_add_rms_norm(h, res, lp["input_norm"],
                                              cfg.rms_norm_eps)
             lc = jax.lax.dynamic_index_in_dim(cache, li, 0, keepdims=False)
             ic = (jax.lax.dynamic_index_in_dim(icache, li, 0,
                                                keepdims=False)
                   if cfg.use_dsa else None)
-            attn_out, lc, ic = _mla_attention(
+            isc = (jax.lax.dynamic_index_in_dim(iscale, li, 0,
+                                                keepdims=False)
+                   if has_iscale else None)
+            attn_out, lc, ic, isc = _mla_attention(
                 lp, normed, batch, lc, cfg, cos_sin, max_q_len=max_q_len,
-                scale=scale, attn_impl=attn_impl, index_cache=ic)
+                scale=scale, attn_impl=attn_impl, index_cache=ic,
+                index_scale=isc)
             cache = jax.lax.dynamic_update_index_in_dim(cache, lc, li, 0)
             if cfg.use_dsa:
                 icache = jax.lax.dynamic_update_index_in_dim(icache, ic,
                                                              li, 0)
+            if has_iscale:
+                iscale = jax.lax.dynamic_update_index_in_dim(iscale, isc,
+                                                             li, 0)
             normed2, res = fused_add_rms_norm(attn_out, res,
                                               lp["post_attn_norm"],
                                               cfg.rms_norm_eps)
-            return (mlp_fn(lp, normed2), res, cache, icache, li + 1), None
+            return (mlp_fn(lp, normed2), res, cache, icache, iscale,
+                    li + 1), None
         return layer_step
 
     li = jnp.int32(0)
     if "dense_layers" in params:
-        (hidden, residual, cache, icache, li), _ = jax.lax.scan(
+        (hidden, residual, cache, icache, iscale, li), _ = jax.lax.scan(
             make_step(dense._mlp, 0), (hidden, residual, cache, icache,
-                                       li),
+                                       iscale, li),
             params["dense_layers"])
     if "moe_layers" in params:
-        (hidden, residual, cache, icache, li), _ = jax.lax.scan(
+        (hidden, residual, cache, icache, iscale, li), _ = jax.lax.scan(
             make_step(lambda lp, x: _moe_block(lp, x, cfg), n_dense),
-            (hidden, residual, cache, icache, li), params["moe_layers"])
+            (hidden, residual, cache, icache, iscale, li),
+            params["moe_layers"])
     return hidden, residual, LatentKVCache(
-        cache, icache if cfg.use_dsa else kv.index_k)
+        cache, icache if cfg.use_dsa else kv.index_k,
+        iscale if has_iscale else kv.index_scale)
 
 
 compute_logits = dense.compute_logits

@@ -215,16 +215,21 @@ def _paged_attention(
                     f"S={metadata.kv_lens.shape[0]}")
             from gllm_tpu.ops.pallas.decode_attention import (
                 paged_decode_attention)
+            from gllm_tpu.ops.pallas.tuning import get as tuned
             out = paged_decode_attention(
                 q, k_cache, v_cache, metadata.kv_lens, metadata.page_table,
-                scale=scale, interpret=interpret, v_dim=v_dim)
+                scale=scale, interpret=interpret, v_dim=v_dim,
+                kv_block=tuned("decode")["kv_block"])
         else:
             from gllm_tpu.ops.pallas.ragged_attention import (
                 ragged_paged_attention)
+            from gllm_tpu.ops.pallas.tuning import get as tuned
+            blocks = tuned("ragged")
             out = ragged_paged_attention(
                 q, k_cache, v_cache, metadata.cu_q_lens, metadata.kv_lens,
                 metadata.page_table, scale=scale, interpret=interpret,
-                v_dim=v_dim)
+                v_dim=v_dim, q_block=blocks["q_block"],
+                kv_block=blocks["kv_block"])
         if pack > 1:
             # The packed p·v_packed dot produced every lane block; keep
             # each head's own block (the rest mixed other heads' values).

@@ -207,10 +207,14 @@ def ragged_paged_attention(
 
     # Honor the requested q block (tests use small ones to force blocks
     # that span sequences), but scale it down when the f32 score tile
-    # would crowd VMEM next to the double-buffered KV blocks.
+    # would crowd VMEM next to the double-buffered KV blocks. The 6 MB
+    # default is overridable so benchmarks/kernel_tune.py --vmem-probe can
+    # present oversized tiles to Mosaic and observe the REAL ceiling.
+    import os
+    limit_b = float(os.environ.get("GLLM_TPU_VMEM_TILE_LIMIT_MB", "6")) \
+        * 1024 * 1024
     bq = min(q_block, T)
-    while (num_q_heads * bq * kv_block * 4 > 6 * 1024 * 1024
-           and bq > 16):
+    while num_q_heads * bq * kv_block * 4 > limit_b and bq > 16:
         bq //= 2
     t_pad = -(-T // bq) * bq
     if t_pad != T:

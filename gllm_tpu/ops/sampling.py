@@ -36,6 +36,15 @@ class SamplingMetadata(NamedTuple):
     # independent of batch composition; seed < 0 → engine step_key.
     seed: Optional[jnp.ndarray] = None       # [S] i32
     out_step: Optional[jnp.ndarray] = None   # [S] i32 output-token index
+    # min_p nucleus floor (reference protocol.py min_p): after temperature,
+    # drop tokens whose prob < min_p · max_prob. 0.0 → disabled.
+    min_p: Optional[jnp.ndarray] = None      # [S] f32
+    # OpenAI logit_bias (reference protocol.py logit_bias): per-seq sparse
+    # (token id, bias) pairs scatter-added to the logits before greedy,
+    # sampling, and logprobs. Padding rows carry bias 0 (a no-op add), so
+    # no mask array is needed.
+    bias_ids: Optional[jnp.ndarray] = None   # [S, B] i32
+    bias_vals: Optional[jnp.ndarray] = None  # [S, B] f32
 
 
 class PenaltyTokens(NamedTuple):
@@ -81,9 +90,32 @@ def apply_penalties(logits: jnp.ndarray,
     return logits
 
 
+def apply_logit_bias(logits: jnp.ndarray,
+                     md: "SamplingMetadata") -> jnp.ndarray:
+    """Scatter-add the per-seq OpenAI logit_bias pairs (reference
+    protocol.py logit_bias → sampler logits add). Padding entries carry
+    value 0, so the add is a no-op there."""
+    if md.bias_ids is None:
+        return logits
+    rows = jnp.arange(logits.shape[0], dtype=jnp.int32)[:, None]
+    return logits.at[rows, md.bias_ids].add(md.bias_vals)
+
+
+def adjust_logits(logits: jnp.ndarray, token_counts,
+                  md: "SamplingMetadata") -> jnp.ndarray:
+    """All pre-sampling logit adjustments in distribution order: logit_bias
+    first (it defines the distribution), then repetition/presence/frequency
+    penalties. Shared by the sample path and the logprob path so reported
+    logprobs match what was sampled from."""
+    logits = apply_logit_bias(logits.astype(jnp.float32), md)
+    return apply_penalties(logits, token_counts, md)
+
+
 def _topk_topp_mask(logits: jnp.ndarray, top_k: jnp.ndarray,
-                    top_p: jnp.ndarray) -> jnp.ndarray:
-    """Mask logits outside the per-row top-k / top-p nucleus to -inf."""
+                    top_p: jnp.ndarray,
+                    min_p: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Mask logits outside the per-row top-k / top-p / min-p nucleus to
+    -inf."""
     vocab = logits.shape[-1]
     sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]          # desc
     # top-k threshold value per row; top_k <= 0 is the "disabled" sentinel
@@ -103,39 +135,133 @@ def _topk_topp_mask(logits: jnp.ndarray, top_k: jnp.ndarray,
                      axis=-1, keepdims=True)
     keep_p = logits >= thresh
 
-    return jnp.where(keep_k & keep_p, logits, -jnp.inf)
+    keep = keep_k & keep_p
+    if min_p is not None:
+        # min_p floor: keep tokens with prob >= min_p · max_prob. The
+        # condition is monotone along the sorted axis, so the smallest
+        # kept sorted logit is a per-row threshold like top-p's.
+        keep_sorted_mp = (sorted_probs
+                          >= min_p[:, None] * sorted_probs[:, :1])
+        mp_thresh = jnp.min(
+            jnp.where(keep_sorted_mp, sorted_logits, jnp.inf),
+            axis=-1, keepdims=True)
+        keep = keep & (logits >= mp_thresh)
+    return jnp.where(keep, logits, -jnp.inf)
 
 
 def sample(logits: jnp.ndarray, md: SamplingMetadata,
            token_counts: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """logits: [S, V] → sampled token ids [S] int32."""
-    logits = apply_penalties(logits.astype(jnp.float32), token_counts, md)
+    logits = adjust_logits(logits, token_counts, md)
     greedy_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     temp = jnp.maximum(md.temperature, 1e-6)[:, None]
-    scaled = _topk_topp_mask(logits / temp, md.top_k, md.top_p)
+    scaled = _topk_topp_mask(logits / temp, md.top_k, md.top_p, md.min_p)
     # Gumbel-max == categorical sampling, stays fused on device.
     if md.seed is None:
         gumbel = jax.random.gumbel(md.step_key, scaled.shape,
                                    dtype=jnp.float32)
     else:
         S, V = scaled.shape
-        rows = jnp.arange(S, dtype=jnp.uint32)
-        unseeded = jax.vmap(jax.random.fold_in,
-                            in_axes=(None, 0))(md.step_key, rows)
-        seeded = jax.vmap(
-            lambda s, t: jax.random.fold_in(
-                jax.random.key(s.astype(jnp.uint32)), t))(
-            md.seed, md.out_step.astype(jnp.uint32))
-        key_data = jnp.where((md.seed >= 0)[:, None],
-                             jax.random.key_data(seeded),
-                             jax.random.key_data(unseeded))
-        keys = jax.random.wrap_key_data(key_data)
+        keys = _row_base_keys(md, S)
         gumbel = jax.vmap(
             lambda k: jax.random.gumbel(k, (V,), dtype=jnp.float32))(keys)
     sampled = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
 
     return jnp.where(md.temperature == 0.0, greedy_tokens, sampled)
+
+
+def _row_base_keys(md: "SamplingMetadata", S: int):
+    """Per-seq verification keys, same derivation discipline as sample():
+    seeded rows are a pure function of (seed, out_step) so a request is
+    deterministic across batch compositions; unseeded rows fold the engine
+    step key."""
+    rows = jnp.arange(S, dtype=jnp.uint32)
+    unseeded = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        md.step_key, rows)
+    if md.seed is None:
+        return unseeded
+    seeded = jax.vmap(
+        lambda s, t: jax.random.fold_in(
+            jax.random.key(s.astype(jnp.uint32)), t))(
+        md.seed, md.out_step.astype(jnp.uint32))
+    key_data = jnp.where((md.seed >= 0)[:, None],
+                         jax.random.key_data(seeded),
+                         jax.random.key_data(unseeded))
+    return jax.random.wrap_key_data(key_data)
+
+
+def spec_verify(logits_mat: jnp.ndarray, drafts: jnp.ndarray,
+                md: "SamplingMetadata"):
+    """Verify speculative drafts against the target model's logits.
+
+    logits_mat: [S, K+1, V] — row i is the target distribution for the
+    token AFTER draft position i (row 0 follows the last committed token).
+    drafts: [S, K] int32, -1 padding. Returns (tok_mat [S, K+1] int32,
+    accept [S] int32) under the engine contract: the scheduler commits
+    ``tok_mat[s, :accept+1]`` — accepted positions hold the draft itself,
+    position ``accept`` holds the correction (or the bonus token when all
+    K drafts were accepted).
+
+    Greedy rows (temperature 0) accept by argmax equality — byte-identical
+    to non-speculative greedy. Sampled rows use rejection sampling against
+    the deterministic prompt-lookup proposal (q = δ at the draft): accept
+    draft d_i with prob p_i(d_i); on rejection resample from the residual
+    p_i with d_i excluded, which preserves the target distribution exactly
+    (the standard speculative-sampling correction specialised to a
+    one-hot q). Distribution-level equivalence, not realization-level: a
+    seeded request's sampled tokens consume different draw indices than
+    its non-speculative run."""
+    S, K1, V = logits_mat.shape
+    K = K1 - 1
+    logits_f = logits_mat.astype(jnp.float32)
+    greedy_mat = jnp.argmax(logits_f, axis=-1).astype(jnp.int32)
+    ok_g = greedy_mat[:, :-1] == drafts                   # pad -1 never ==
+
+    # target sampling distribution per verify row (temperature + top-k/p +
+    # min-p masks, renormalized by the softmax)
+    temp = jnp.maximum(md.temperature, 1e-6)[:, None, None]
+    rep = lambda a: jnp.repeat(a, K1, axis=0)             # noqa: E731
+    masked = _topk_topp_mask(
+        (logits_f / temp).reshape(S * K1, V), rep(md.top_k), rep(md.top_p),
+        None if md.min_p is None else rep(md.min_p))
+    p = jax.nn.softmax(masked, axis=-1).reshape(S, K1, V)
+
+    base = _row_base_keys(md, S)
+    pos_keys = jax.vmap(
+        lambda k: jax.vmap(lambda i: jax.random.fold_in(k, i))(
+            jnp.arange(K1, dtype=jnp.uint32)))(base)      # [S, K1] keys
+    u = jax.vmap(jax.vmap(
+        lambda k: jax.random.uniform(jax.random.fold_in(k, 0), ())))(
+        pos_keys)                                         # [S, K1]
+    gumbel = jax.vmap(jax.vmap(
+        lambda k: jax.random.gumbel(jax.random.fold_in(k, 1), (V,),
+                                    dtype=jnp.float32)))(pos_keys)
+
+    d_safe = jnp.maximum(drafts, 0)
+    p_draft = jnp.take_along_axis(p[:, :K], d_safe[..., None],
+                                  axis=-1)[..., 0]        # [S, K]
+    ok_s = (u[:, :K] < p_draft) & (drafts >= 0)
+
+    # corrections: position j < K samples the residual (draft banned);
+    # position K samples the bonus token from its full distribution
+    iota = jnp.arange(V, dtype=jnp.int32)
+    ban = (iota[None, None, :] == d_safe[..., None]) & \
+        (drafts >= 0)[..., None]
+    p_corr = jnp.concatenate(
+        [jnp.where(ban, 0.0, p[:, :K]), p[:, K:]], axis=1)
+    logp = jnp.where(p_corr > 0, jnp.log(jnp.maximum(p_corr, 1e-30)),
+                     -jnp.inf)
+    corr = jnp.argmax(logp + gumbel, axis=-1).astype(jnp.int32)  # [S, K1]
+
+    tok_sampled = jnp.concatenate(
+        [jnp.where(ok_s, drafts, corr[:, :K]), corr[:, K:]], axis=1)
+
+    greedy_rows = md.temperature == 0.0
+    ok = jnp.where(greedy_rows[:, None], ok_g, ok_s)
+    accept = jnp.cumprod(ok.astype(jnp.int32), axis=-1).sum(axis=-1)
+    tok_mat = jnp.where(greedy_rows[:, None], greedy_mat, tok_sampled)
+    return tok_mat, accept
 
 
 def compute_logprobs(logits: jnp.ndarray, token_ids: jnp.ndarray,

@@ -106,10 +106,12 @@ def kv_cache_specs(cfg: ModelConfig, tp: int):
 
 def latent_kv_specs(cfg: ModelConfig, tp: int):
     """MLA latent cache is MQA-shaped (no head axis) → replicated over tp."""
-    from gllm_tpu.models.deepseek import LatentKVCache
+    from gllm_tpu.models.deepseek import LatentKVCache, index_cache_fp8
     return LatentKVCache(
         P(None, None, None, None),
-        P(None, None, None, None) if cfg.use_dsa else None)
+        P(None, None, None, None) if cfg.use_dsa else None,
+        P(None, None, None) if (cfg.use_dsa and index_cache_fp8())
+        else None)
 
 
 def shard_params(params, specs, mesh: Optional[Mesh]):

@@ -377,10 +377,10 @@ class PPModelRunner(ModelRunner):
                     # same shapes as the single-runner step (reference
                     # computes logprobs on the last rank too,
                     # sampler.py:71-91)
-                    from gllm_tpu.ops.sampling import (apply_penalties,
+                    from gllm_tpu.ops.sampling import (adjust_logits,
                                                        compute_logprobs)
-                    lp_logits = apply_penalties(logits, token_counts,
-                                                batch.sampling)
+                    lp_logits = adjust_logits(logits, token_counts,
+                                              batch.sampling)
                     aux["lp"] = compute_logprobs(lp_logits, tokens,
                                                  max(logprobs_k, 1))
                 if prompt_lp:
@@ -394,17 +394,16 @@ class PPModelRunner(ModelRunner):
                 if batch.spec_rows is not None:
                     # speculative verify on the LAST stage — same math as
                     # the single runner (runner.py step): project only the
-                    # gathered verify rows, accept the matching draft run
+                    # gathered verify rows (greedy argmax acceptance or
+                    # rejection sampling, ops/sampling.py spec_verify)
                     from gllm_tpu.models.dense import compute_full_logits
+                    from gllm_tpu.ops.sampling import spec_verify
                     rows = batch.spec_rows.reshape(-1)
                     sl = compute_full_logits(params, hidden[rows],
                                              residual[rows], scfg)
-                    preds = jnp.argmax(sl, axis=-1).astype(jnp.int32)
-                    tok_mat = preds.reshape(batch.spec_rows.shape)
-                    ok = tok_mat[:, :-1] == batch.spec_drafts
-                    accept = jnp.cumprod(ok.astype(jnp.int32),
-                                         axis=-1).sum(axis=-1)
-                    aux["spec"] = (tok_mat, accept)
+                    aux["spec"] = spec_verify(
+                        sl.reshape(batch.spec_rows.shape + sl.shape[-1:]),
+                        batch.spec_drafts, batch.sampling)
                 return (tokens, aux), kv
             return (hidden, residual), kv
 

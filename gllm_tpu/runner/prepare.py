@@ -83,13 +83,15 @@ class BatchBuilder:
         p = bucket_size(max_pages, 4, self.max_pages_per_seq)
         return t, s, q, p
 
-    def empty(self, signature, step_key, force_extras=frozenset()):
+    def empty(self, signature, step_key, force_extras=frozenset(),
+              force_bias_len=None):
         """An all-padding StepBatch of the given signature (idle DP
         replicas run these so every replica contributes the same jit
         signature — the TPU analogue of the reference's idle-replica dummy
         batches, worker.py:750-829). ``force_extras`` must match the live
         replicas' optional-field structure."""
         t_pad, s_pad, _, p_pad = signature
+        bias_len = force_bias_len or 8
         return StepBatch(
             token_ids=jnp.zeros(t_pad, jnp.int32),
             positions=jnp.zeros(t_pad, jnp.int32),
@@ -114,7 +116,12 @@ class BatchBuilder:
                 seed=(jnp.full((s_pad,), -1, jnp.int32)
                       if "seed" in force_extras else None),
                 out_step=(jnp.zeros(s_pad, jnp.int32)
-                          if "seed" in force_extras else None)),
+                          if "seed" in force_extras else None),
+                min_p=jnp.zeros(s_pad, jnp.float32),
+                bias_ids=(jnp.zeros((s_pad, bias_len), jnp.int32)
+                          if "bias" in force_extras else None),
+                bias_vals=(jnp.zeros((s_pad, bias_len), jnp.float32)
+                           if "bias" in force_extras else None)),
             spec_rows=(jnp.zeros(
                 (s_pad, self.config.spec_k + 1), jnp.int32)
                 if "spec" in force_extras else None),
@@ -143,6 +150,13 @@ class BatchBuilder:
         return max(16, next_pow2(max(lens))) if lens else 16
 
     @staticmethod
+    def bias_len_bucket(ns) -> int:
+        """Shared logit_bias entry-count bucket (build + dp wrapper must
+        agree on the jit-signature B)."""
+        from gllm_tpu.utils import next_pow2
+        return max(8, next_pow2(max(ns))) if ns else 8
+
+    @staticmethod
     def batch_extras(batch: ScheduledBatch) -> frozenset:
         """Which optional StepBatch fields this batch populates — DP
         replicas must agree on the union so stacked pytrees match."""
@@ -154,6 +168,8 @@ class BatchBuilder:
             if (sp.repetition_penalty != 1.0 or sp.presence_penalty != 0.0
                     or sp.frequency_penalty != 0.0):
                 extras.add("penalties")
+            if sp.logit_bias:
+                extras.add("bias")
             if (sp.prompt_logprobs is not None
                     and it.computed_before < it.seq.prompt_len):
                 extras.add("plp")
@@ -171,7 +187,7 @@ class BatchBuilder:
 
     def build(self, batch: ScheduledBatch, step_key,
               force_signature=None, force_extras=frozenset(),
-              force_penalty_len=None):
+              force_penalty_len=None, force_bias_len=None):
         """Returns (StepBatch, max_q_len, token_counts_or_None).
 
         ``force_signature`` overrides the computed shape buckets and
@@ -194,6 +210,7 @@ class BatchBuilder:
         temperature = np.zeros(s_pad, np.float32)
         top_p = np.ones(s_pad, np.float32)
         top_k = np.full(s_pad, -1, np.int32)
+        min_p = np.zeros(s_pad, np.float32)
         rep_penalty = np.ones(s_pad, np.float32)
         seeds = np.full(s_pad, -1, np.int32)
         out_steps = np.zeros(s_pad, np.int32)
@@ -293,6 +310,8 @@ class BatchBuilder:
                                 count=K)
         top_k[:K] = np.fromiter((sp.top_k for sp in sps), np.int32,
                                 count=K)
+        min_p[:K] = np.fromiter((sp.min_p for sp in sps), np.float32,
+                                count=K)
         rep_penalty[:K] = np.fromiter((sp.repetition_penalty for sp in sps),
                                       np.float32, count=K)
         if self.use_ssm:
@@ -384,6 +403,24 @@ class BatchBuilder:
             token_counts = PenaltyTokens(jnp.asarray(ids),
                                          jnp.asarray(mask))
 
+        # OpenAI logit_bias: sparse per-seq (id, bias) pairs, padded to a
+        # shared bucket B (reference protocol.py logit_bias → sampler add).
+        bias_ids = bias_vals = None
+        if "bias" in force_extras or any(sp.logit_bias for sp in sps):
+            B = force_bias_len or self.bias_len_bucket(
+                [len(sp.logit_bias) for sp in sps if sp.logit_bias])
+            bias_ids = np.zeros((s_pad, B), np.int32)
+            bias_vals = np.zeros((s_pad, B), np.float32)
+            for i, sp in enumerate(sps):
+                if sp.logit_bias:
+                    # ids past the bucket (or the LM vocab) are dropped;
+                    # value 0 padding keeps the scatter-add a no-op
+                    pairs = [(t, b) for t, b in sp.logit_bias.items()
+                             if t < (self.vocab_size or 1 << 30)][:B]
+                    for j, (t, b) in enumerate(pairs):
+                        bias_ids[i, j] = t
+                        bias_vals[i, j] = b
+
         spec_rows_arr = spec_drafts_arr = None
         if any(it.draft_tokens for it in items) or "spec" in force_extras:
             kmax = self.config.spec_k
@@ -428,7 +465,12 @@ class BatchBuilder:
                 seed=(jnp.asarray(seeds)
                       if any_seeded or force_seeded else None),
                 out_step=(jnp.asarray(out_steps)
-                          if any_seeded or force_seeded else None)),
+                          if any_seeded or force_seeded else None),
+                min_p=jnp.asarray(min_p),
+                bias_ids=(jnp.asarray(bias_ids)
+                          if bias_ids is not None else None),
+                bias_vals=(jnp.asarray(bias_vals)
+                           if bias_vals is not None else None)),
             mrope_positions=jnp.asarray(mrope) if self.use_mm else None,
             mm_embeds=(jnp.asarray(mm_embeds)
                        if mm_embeds is not None else None),

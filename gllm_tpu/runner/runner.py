@@ -310,12 +310,17 @@ class ModelRunner:
         itemsize = jnp.dtype(self._kv_dtype()).itemsize
         if cfg.use_mla:
             # MLA latent cache: one tile-padded [lora+rope] row per token,
-            # replicated over tp (MQA-shaped); DSA adds the index-K cache.
-            width = cfg.mla_cache_width
+            # replicated over tp (MQA-shaped); DSA adds the index-K cache
+            # (fp8 payload + f32 per-token scale by default — the
+            # reference's 132-byte store_index_k_fp8 layout).
+            per_tok = cfg.mla_cache_width * itemsize
             if cfg.use_dsa:
-                width += cfg.index_head_dim
-            return (n_layers or cfg.num_stage_layers) * page * width \
-                * itemsize
+                from gllm_tpu.models.deepseek import index_cache_fp8
+                if index_cache_fp8():
+                    per_tok += cfg.index_head_dim + 4
+                else:
+                    per_tok += cfg.index_head_dim * itemsize
+            return (n_layers or cfg.num_stage_layers) * page * per_tok
         tp = self.config.parallel.tp
         shards = tp if (self.mesh is not None
                         and cfg.num_kv_heads % tp == 0) else 1
@@ -384,10 +389,10 @@ class ModelRunner:
             if logprobs_k >= 0:
                 # Output logprobs of the SAMPLED tokens over the
                 # penalty-adjusted distribution (reference sampler.py:71-91)
-                from gllm_tpu.ops.sampling import (apply_penalties,
+                from gllm_tpu.ops.sampling import (adjust_logits,
                                                    compute_logprobs)
-                lp_logits = apply_penalties(logits, token_counts,
-                                            batch.sampling)
+                lp_logits = adjust_logits(logits, token_counts,
+                                          batch.sampling)
                 aux["lp"] = compute_logprobs(lp_logits, tokens,
                                              max(logprobs_k, 1))
             if prompt_lp:
@@ -422,22 +427,19 @@ class ModelRunner:
                 # Speculative verify: gather hidden/residual at the verify
                 # rows FIRST (S·(k+1) rows), then project only those — a
                 # full [T, V] logits materialization per decode step would
-                # cost hundreds of MB of HBM at large vocab. Row r's
-                # argmax IS the correct greedy token for position r+1
-                # given the committed prefix, so emitting preds[:accept+1]
-                # is byte-identical to plain greedy; acceptance = run of
-                # drafts matching the previous row's argmax (pad -1 never
-                # matches).
+                # cost hundreds of MB of HBM at large vocab. Greedy rows
+                # accept by argmax equality (byte-identical to plain
+                # greedy); sampled rows use rejection sampling against the
+                # deterministic prompt-lookup proposal (ops/sampling.py
+                # spec_verify).
                 from gllm_tpu.models.dense import compute_full_logits
+                from gllm_tpu.ops.sampling import spec_verify
                 rows = batch.spec_rows.reshape(-1)          # [S*(k+1)]
                 sl = compute_full_logits(params, hidden[rows],
                                          residual[rows], cfg)
-                preds = jnp.argmax(sl, axis=-1).astype(jnp.int32)
-                tok_mat = preds.reshape(batch.spec_rows.shape)
-                ok = tok_mat[:, :-1] == batch.spec_drafts   # [S, k]
-                accept = jnp.cumprod(ok.astype(jnp.int32),
-                                     axis=-1).sum(axis=-1)
-                aux["spec"] = (tok_mat, accept)
+                aux["spec"] = spec_verify(
+                    sl.reshape(batch.spec_rows.shape + sl.shape[-1:]),
+                    batch.spec_drafts, batch.sampling)
             return tokens, kv, aux
 
         if self.dp > 1:
@@ -463,15 +465,14 @@ class ModelRunner:
                     # per-replica speculative verify (same math as the
                     # single-runner step)
                     from gllm_tpu.models.dense import compute_full_logits
+                    from gllm_tpu.ops.sampling import spec_verify
                     rows = batch_r.spec_rows.reshape(-1)
                     sl = compute_full_logits(params, hidden[rows],
                                              residual[rows], cfg_dp)
-                    preds = jnp.argmax(sl, axis=-1).astype(jnp.int32)
-                    tok_mat = preds.reshape(batch_r.spec_rows.shape)
-                    ok = tok_mat[:, :-1] == batch_r.spec_drafts
-                    accept = jnp.cumprod(ok.astype(jnp.int32),
-                                         axis=-1).sum(axis=-1)
-                    aux["spec"] = (tok_mat, accept)
+                    aux["spec"] = spec_verify(
+                        sl.reshape(batch_r.spec_rows.shape
+                                   + sl.shape[-1:]),
+                        batch_r.spec_drafts, batch_r.sampling)
                 return tokens, kv_r, aux
 
             @functools.partial(jax.jit,
@@ -672,17 +673,25 @@ class ModelRunner:
         if "penalties" in extras:
             pen_len = self.builder.penalty_len_bucket(
                 [len(it.seq.token_ids) for b in live for it in b.items])
+        # logit_bias entry lists likewise share one B across replicas
+        bias_len = None
+        if "bias" in extras:
+            bias_len = self.builder.bias_len_bucket(
+                [len(it.seq.sampling_params.logit_bias)
+                 for b in live for it in b.items
+                 if it.seq.sampling_params.logit_bias])
 
         parts = []
         counts_any = False
         for r, b in enumerate(sched_batches):
             key = jax.random.fold_in(base_key, r)
             if b is None:
-                parts.append((self.builder.empty(sig, key, extras), None))
+                parts.append((self.builder.empty(
+                    sig, key, extras, force_bias_len=bias_len), None))
             else:
                 batch, _, counts = self.builder.build(
                     b, key, force_signature=sig, force_extras=extras,
-                    force_penalty_len=pen_len)
+                    force_penalty_len=pen_len, force_bias_len=bias_len)
                 counts_any = counts_any or counts is not None
                 parts.append((batch, counts))
         token_counts = None

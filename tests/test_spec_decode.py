@@ -105,9 +105,13 @@ def test_spec_respects_eos_and_max_tokens(ckpt):
 
 
 def test_spec_mixed_batch_with_sampling_requests(ckpt):
-    """Non-greedy / penalized requests share the batch but never get
-    drafts; their outputs match the non-spec engine seeded run."""
+    """Greedy and penalized requests keep byte-identity with the non-spec
+    engine (penalized requests never get drafts — the verify rows see raw
+    logits); a seeded sampled request in the same batch now speculates by
+    rejection sampling, so it asserts run-to-run determinism instead of
+    realization-identity with the non-spec engine."""
     llm = make_llm(ckpt, spec=True)
+    llm2 = make_llm(ckpt, spec=True)
     base = make_llm(ckpt)
     prompts = [PROMPTS[0], PROMPTS[1], PROMPTS[2]]
     sps = [SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True),
@@ -117,11 +121,15 @@ def test_spec_mixed_batch_with_sampling_requests(ckpt):
                           max_tokens=16, ignore_eos=True)]
     a = llm.generate(prompt_token_ids=[list(p) for p in prompts],
                      sampling_params=sps)
+    a2 = llm2.generate(prompt_token_ids=[list(p) for p in prompts],
+                       sampling_params=sps)
     b = base.generate(prompt_token_ids=[list(p) for p in prompts],
                       sampling_params=sps)
-    for x, y in zip(a, b):
-        assert x.output_token_ids == y.output_token_ids
-    # the greedy seq still used spec
+    # greedy + penalized: byte-identical to non-spec
+    assert a[0].output_token_ids == b[0].output_token_ids
+    assert a[2].output_token_ids == b[2].output_token_ids
+    # seeded sampled: deterministic under spec
+    assert a[1].output_token_ids == a2[1].output_token_ids
     assert llm.scheduler.spec_stats["proposed"] > 0
 
 
@@ -230,3 +238,111 @@ def test_spec_under_memory_pressure_preemption(ckpt):
     got, _ = run(True)
     assert got == want, (got, want)
     assert base_preempt >= 0          # pool small enough to be tight
+
+
+# ---- rejection sampling + adaptive k (VERDICT r03 weak #4 / next #6) -------
+
+def test_spec_sampled_distribution_preserved(ckpt):
+    """Rejection sampling against the one-hot prompt-lookup proposal must
+    preserve the target distribution: aggregate next-token histograms over
+    many seeded runs match between the spec and non-spec engines on a
+    draft-friendly (repetitive) prompt."""
+    import collections
+
+    llm = make_llm(ckpt, spec=True)
+    base = make_llm(ckpt)
+    prompt = [5, 9, 5, 9, 5, 9, 5, 9]          # (5,9) pattern → drafts fire
+    n_runs, n_tok = 120, 6
+
+    def histogram(engine):
+        # one batched generate: n_runs seeded requests of the same prompt
+        sps = [SamplingParams(temperature=1.0, seed=s, max_tokens=n_tok,
+                              ignore_eos=True) for s in range(n_runs)]
+        outs = engine.generate(
+            prompt_token_ids=[list(prompt) for _ in range(n_runs)],
+            sampling_params=sps)
+        h = collections.Counter()
+        for out in outs:
+            h.update(out.output_token_ids)
+        return h
+
+    h_spec, h_base = histogram(llm), histogram(base)
+    assert llm.scheduler.spec_stats["proposed"] > 0
+    total = n_runs * n_tok
+    support = set(h_spec) | set(h_base)
+    l1 = sum(abs(h_spec[t] - h_base[t]) for t in support) / total
+    # L1 distance between two empirical draws of the SAME distribution at
+    # this sample size is typically < 0.2; a wrong residual distribution
+    # (e.g. re-drawing the rejected draft) lands far above
+    assert l1 < 0.35, f"L1 distance {l1:.3f} (spec={h_spec}, base={h_base})"
+
+
+def test_spec_sampled_seeded_deterministic(ckpt):
+    """spec_ngram=1 + a prompt covering the whole vocab: every sampled
+    continuation token has an earlier occurrence, so drafts fire on
+    (almost) every decode step — and the seeded run is reproducible."""
+    def spec1_llm():
+        return LLM(config=EngineConfig(
+            model=ckpt, dtype="float32", max_model_len=256,
+            spec_decode="ngram", spec_k=4, spec_ngram=1,
+            cache=CacheConfig(page_size=4, num_pages=128)))
+
+    llm1, llm2 = spec1_llm(), spec1_llm()
+    sp = SamplingParams(temperature=0.9, seed=11, max_tokens=24,
+                        ignore_eos=True)
+    p = list(range(1, 120))
+    a = llm1.generate(prompt_token_ids=[list(p)], sampling_params=sp)[0]
+    b = llm2.generate(prompt_token_ids=[list(p)], sampling_params=sp)[0]
+    assert a.output_token_ids == b.output_token_ids
+    assert llm1.scheduler.spec_stats["proposed"] > 0
+
+
+def test_adaptive_k_collapses_and_regrows():
+    """AIMD draft length: zero-accepted runs collapse a seq's k to 1; full
+    sweeps grow it back one per step up to spec_k."""
+    from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+    from gllm_tpu.memory_manager import make_memory_manager
+    from gllm_tpu.scheduler import ScheduledBatch, ScheduledSeq, Scheduler
+    from gllm_tpu.sequence import Sequence
+
+    cfg = EngineConfig(load_format="dummy", max_model_len=256,
+                       spec_decode="ngram", spec_k=4, spec_ngram=2,
+                       scheduler=SchedulerConfig(),
+                       cache=CacheConfig(page_size=4, num_pages=64))
+    mm = make_memory_manager(64, 4, False)
+    sched = Scheduler(cfg, mm)
+    sched.spec_cfg = (cfg.spec_ngram, cfg.spec_k)
+
+    seq = Sequence(0, [5, 9, 5, 9, 5, 9], SamplingParams(
+        temperature=0.0, max_tokens=64, ignore_eos=True))
+    sched.add_seq(seq)
+    batch = sched.schedule_once()          # prefill
+    sched.process_output(batch, [5], frozenset())
+
+    # decode with drafts proposed from the (5,9) pattern
+    batch = sched.schedule_once()
+    it = batch.items[0]
+    assert it.draft_tokens, "repetitive prompt must draft"
+    k0 = len(it.draft_tokens)
+    # simulate ZERO accepted: only the correction token committed
+    sched.process_output_multi(batch, [[7]], frozenset())
+    assert seq.spec_k_cur == 1
+
+    # next proposal respects the collapsed k; simulate FULL sweeps after
+    # it (commit every draft + a continuation that keeps the 5/9 pattern
+    # alive so later proposals keep firing): k grows one per step to cap
+    first = True
+    for _ in range(8):
+        batch = sched.schedule_once()
+        it = batch.items[0]
+        d = len(it.draft_tokens)
+        if first:
+            assert d <= 1, d
+            first = False
+        last = seq.token_ids[-1]
+        nxt = 9 if last == 5 else 5
+        toks = (list(it.draft_tokens)
+                + [9 if it.draft_tokens[-1] == 5 else 5]) if d else [nxt]
+        sched.process_output_multi(batch, [toks], frozenset())
+    assert seq.spec_k_cur == cfg.spec_k, seq.spec_k_cur
+    assert k0 <= cfg.spec_k
