@@ -56,6 +56,12 @@ class ParallelConfig:
     pp: int = 1
     tp: int = 1
     dp: int = 1
+    # Sequence/context parallelism (beyond the reference, which has none —
+    # SURVEY.md §2.2): long single-seq prefill chunks run causal ring
+    # attention over the ``sp`` mesh axis (parallel/ring_attention.py);
+    # decode and mixed batches use the paged path with activations
+    # sharded over sp. Composes with tp; requires pp == dp == 1.
+    sp: int = 1
     enable_ep: bool = False
     # Explicit per-stage layer counts (reference --assigned-layers,
     # dist_utils.py:494-528); None → even split.
@@ -63,7 +69,7 @@ class ParallelConfig:
 
     @property
     def world_size(self) -> int:
-        return self.pp * self.tp * self.dp
+        return self.pp * self.tp * self.dp * self.sp
 
 
 @dataclasses.dataclass
@@ -111,6 +117,10 @@ class EngineConfig:
     # stack SURVEY §2.6
     quantization: Optional[str] = None
     enforce_eager: bool = False           # disable donation/async tricks (debug)
+    # Minimum single-seq prefill chunk (tokens) that routes through ring
+    # attention when parallel.sp > 1; shorter chunks / mixed batches /
+    # decode use the paged path with activations sharded over sp.
+    sp_ring_threshold: int = 1024
     # Resolve a non-local model id via HF-hub snapshot download (file-lock
     # serialized, reference model_loader.py hub path). Off by default:
     # loads are local-path-only unless explicitly opted in.
@@ -169,3 +179,8 @@ class EngineConfig:
                     "disable overlap_scheduling / multi_step_decode")
             if self.spec_k < 1 or self.spec_ngram < 1:
                 raise ValueError("spec_k and spec_ngram must be >= 1")
+        if self.parallel.sp > 1 and (self.parallel.pp > 1
+                                     or self.parallel.dp > 1):
+            raise ValueError(
+                "sp (sequence parallelism) composes with tp only; "
+                "set pp = dp = 1")

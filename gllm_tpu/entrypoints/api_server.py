@@ -570,6 +570,7 @@ def build_engine_config(args) -> EngineConfig:
         spec_k=args.spec_k,
         spec_ngram=args.spec_ngram,
         quantization=args.quantization,
+        sp_ring_threshold=args.sp_ring_threshold,
         scheduler=SchedulerConfig(
             schedule_method=args.schedule_method,
             max_decode_seqs=args.maxd,
@@ -585,7 +586,7 @@ def build_engine_config(args) -> EngineConfig:
             enable_prefix_caching=args.enable_prefix_caching,
         ),
         parallel=ParallelConfig(pp=args.pp, tp=args.tp, dp=args.dp,
-                                enable_ep=args.enable_ep),
+                                sp=args.sp, enable_ep=args.enable_ep),
     )
 
 
@@ -657,6 +658,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--pp", type=int, default=1)
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--dp", type=int, default=1)
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence parallelism: long prefill chunks run "
+                        "ring attention over an sp mesh axis (beyond the "
+                        "reference); requires pp=dp=1")
+    p.add_argument("--sp-ring-threshold", type=int, default=1024)
     p.add_argument("--enable-ep", action="store_true")
     return p
 

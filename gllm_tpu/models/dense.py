@@ -138,9 +138,22 @@ def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
                    else apply_rope)
         q, k = rope_fn(q, k, batch.positions, cos_sin)
     k_cache, v_cache = write_kv(k_cache, v_cache, k, v, batch.slot_mapping)
-    attn = paged_attention(q, k_cache, v_cache, batch.attn,
-                           scale=D ** -0.5, max_q_len=max_q_len,
-                           impl=attn_impl)
+    if attn_impl == "ring":
+        # Sequence-parallel prefill (sp mesh axis): the runner routes a
+        # single-seq from-position-0 chunk here — self-attention over the
+        # fresh k/v runs as causal ring attention (ICI neighbor
+        # exchanges), no paged gather at all. KV was still written above
+        # for the decode steps that follow. Bucketed padding rows are
+        # masked via kv_valid (padded KEYS must not leak into real rows).
+        from gllm_tpu.parallel.mesh import AXIS_SP
+        from gllm_tpu.parallel.ring_attention import ring_attention_sharded
+        attn = ring_attention_sharded(q, k, v, axis_name=AXIS_SP,
+                                      scale=D ** -0.5,
+                                      kv_valid=batch.attn.kv_lens[0])
+    else:
+        attn = paged_attention(q, k_cache, v_cache, batch.attn,
+                               scale=D ** -0.5, max_q_len=max_q_len,
+                               impl=attn_impl)
     out = qmm(attn.reshape(T, Hq * D), lp["o_proj"])
     return out, k_cache, v_cache
 

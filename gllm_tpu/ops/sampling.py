@@ -192,8 +192,13 @@ def _row_base_keys(md: "SamplingMetadata", S: int):
 
 
 def spec_verify(logits_mat: jnp.ndarray, drafts: jnp.ndarray,
-                md: "SamplingMetadata"):
+                md: "SamplingMetadata", sampled: bool = True):
     """Verify speculative drafts against the target model's logits.
+
+    ``sampled`` is a TRACE-TIME flag (the runner passes it as a jit
+    static): False means every draft row in this batch is greedy, and the
+    verify compiles to the single argmax of rounds past — no sort,
+    softmax, or RNG on the hot path.
 
     logits_mat: [S, K+1, V] — row i is the target distribution for the
     token AFTER draft position i (row 0 follows the last committed token).
@@ -217,6 +222,9 @@ def spec_verify(logits_mat: jnp.ndarray, drafts: jnp.ndarray,
     logits_f = logits_mat.astype(jnp.float32)
     greedy_mat = jnp.argmax(logits_f, axis=-1).astype(jnp.int32)
     ok_g = greedy_mat[:, :-1] == drafts                   # pad -1 never ==
+    if not sampled:
+        accept = jnp.cumprod(ok_g.astype(jnp.int32), axis=-1).sum(axis=-1)
+        return greedy_mat, accept
 
     # target sampling distribution per verify row (temperature + top-k/p +
     # min-p masks, renormalized by the softmax)

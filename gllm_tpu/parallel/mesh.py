@@ -22,17 +22,21 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_DP = "dp"
 AXIS_TP = "tp"
+AXIS_SP = "sp"
 
 
-def make_mesh(dp: int = 1, tp: int = 1,
+def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
+    # axis order (dp, sp, tp): tp innermost so its all-reduces ride
+    # adjacent chips; the sp ring's neighbor exchanges stay within the
+    # next-contiguous block
     if devices is None:
         devices = jax.devices()
-    n = dp * tp
+    n = dp * tp * sp
     if len(devices) < n:
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
-    arr = np.asarray(devices[:n]).reshape(dp, tp)
-    return Mesh(arr, (AXIS_DP, AXIS_TP))
+    arr = np.asarray(devices[:n]).reshape(dp, sp, tp)
+    return Mesh(arr, (AXIS_DP, AXIS_SP, AXIS_TP))
 
 
 @contextlib.contextmanager

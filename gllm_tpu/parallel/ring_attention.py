@@ -67,11 +67,16 @@ def _merge(acc, m, l, out_b, m_b, l_b):
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   *, axis_name: str, scale: Optional[float] = None):
+                   kv_valid=None, *, axis_name: str,
+                   scale: Optional[float] = None):
     """Causal ring attention inside shard_map.
 
     q: [C, Hq, D] local query shard (global seq sharded over axis_name)
     k/v: [C, Hkv, D] local key/value shards.
+    kv_valid: optional replicated scalar — global token count actually
+    valid; keys at positions >= kv_valid are masked everywhere (the
+    engine's bucketed prefill pads the token axis, and a padded KEY at a
+    fake position must not leak into real queries' softmax).
     Returns the local output shard [C, Hq, D].
     """
     C, Hq, D = q.shape
@@ -97,6 +102,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         src = jax.lax.rem(my - i + n, n)     # whose shard we hold this hop
         pos_k = src * C + jnp.arange(C)
         mask = pos_k[None, :] <= pos_q[:, None]
+        if kv_valid is not None:
+            mask = mask & (pos_k[None, :] < kv_valid)
         out_b, m_b, l_b = _block_attention(q, k_cur, v_cur, scale, mask)
         # skip fully-masked hops (src > my): l_b is all zero there and the
         # merge is a no-op because m_b is 0-masked rows with l_b=0.
@@ -111,14 +118,28 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
-def ring_attention_sharded(q, k, v, mesh: Mesh, axis_name: str = "sp",
-                           scale: Optional[float] = None):
-    """Convenience wrapper: shard q/k/v over ``axis_name`` on their sequence
-    axis and run ring attention via shard_map."""
+def ring_attention_sharded(q, k, v, mesh: Optional[Mesh] = None,
+                           axis_name: str = "sp",
+                           scale: Optional[float] = None, kv_valid=None):
+    """Shard q/k/v over ``axis_name`` on their sequence axis and run ring
+    attention via shard_map.
+
+    mesh=None binds the CONTEXT abstract mesh with only ``axis_name``
+    manual — the form the serving step uses inside its jit trace (the
+    other mesh axes stay GSPMD-auto); a concrete mesh is bound fully
+    (standalone / unit-test use). ``kv_valid``: optional replicated scalar
+    masking padded keys (see ring_attention)."""
     from jax import shard_map
 
     spec = P(axis_name, None, None)
-    fn = shard_map(
-        functools.partial(ring_attention, axis_name=axis_name, scale=scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
-    return fn(q, k, v)
+    kw = (dict(mesh=None, axis_names={axis_name}) if mesh is None
+          else dict(mesh=mesh))
+    part = functools.partial(ring_attention, axis_name=axis_name,
+                             scale=scale)
+    if kv_valid is None:
+        fn = shard_map(part, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False, **kw)
+        return fn(q, k, v)
+    fn = shard_map(part, in_specs=(spec, spec, spec, P()),
+                   out_specs=spec, check_vma=False, **kw)
+    return fn(q, k, v, kv_valid)

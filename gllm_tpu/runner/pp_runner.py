@@ -357,12 +357,12 @@ class PPModelRunner(ModelRunner):
 
         @functools.partial(jax.jit,
                            static_argnames=("max_q_len", "logprobs_k",
-                                            "prompt_lp"),
+                                            "prompt_lp", "spec_sampled"),
                            compiler_options=tpu_compiler_options(),
                            donate_argnums=(1,))
         def stage(params, kv, batch, cos_sin, hidden, residual,
                   token_counts, *, max_q_len: int, logprobs_k: int = -1,
-                  prompt_lp: bool = False):
+                  prompt_lp: bool = False, spec_sampled: bool = False):
             hidden, residual, kv = fwd(params, kv, batch, scfg,
                                        cos_sin=cos_sin,
                                        attn_impl=attn_impl,
@@ -403,7 +403,8 @@ class PPModelRunner(ModelRunner):
                                              residual[rows], scfg)
                     aux["spec"] = spec_verify(
                         sl.reshape(batch.spec_rows.shape + sl.shape[-1:]),
-                        batch.spec_drafts, batch.sampling)
+                        batch.spec_drafts, batch.sampling,
+                        sampled=spec_sampled)
                 return (tokens, aux), kv
             return (hidden, residual), kv
 
@@ -428,8 +429,10 @@ class PPModelRunner(ModelRunner):
         """Launch one microbatch through one replica's stage chain; all
         dispatch is async — returns (tokens_future, aux, num_seqs)."""
         from gllm_tpu.parallel.mesh import mesh_context
+        from gllm_tpu.runner.runner import _spec_sampled
         batch, max_q, presence = self.builder.build(sched_batch, step_key)
         lp_k, want_plp = self._lp_flags(sched_batch)
+        spec_sampled = _spec_sampled(sched_batch.items)
         hidden = residual = None
         out = None
         # one batched host→device transfer fans the step batch out to
@@ -452,7 +455,8 @@ class PPModelRunner(ModelRunner):
             # lp flags are static jit args — only the last stage reads
             # them, so earlier stages keep their (-1, False) cache entry
             # for every logprobs pattern (no pipeline-wide recompiles)
-            lp_kw = (dict(logprobs_k=lp_k, prompt_lp=want_plp)
+            lp_kw = (dict(logprobs_k=lp_k, prompt_lp=want_plp,
+                          spec_sampled=spec_sampled)
                      if stage.cfg.is_last_stage else {})
             with mesh_context(stage.mesh):
                 out, stage.kv = stage.fn(stage.params, stage.kv, sb,

@@ -128,6 +128,15 @@ def pick_kv_pack(cfg: ModelConfig, tp_sharded: bool) -> int:
     return pack
 
 
+def _spec_sampled(items) -> bool:
+    """Any draft row in this batch samples (temperature > 0)? Trace-time
+    flag for spec_verify: the all-greedy case keeps the argmax-only
+    verify program (ops/sampling.py)."""
+    return any(it.draft_tokens
+               and it.seq.sampling_params.temperature != 0
+               for it in items)
+
+
 class ModelRunner:
     def __init__(self, config: EngineConfig, model_cfg: ModelConfig,
                  params=None, mesh=None):
@@ -135,7 +144,8 @@ class ModelRunner:
         self.model_cfg = model_cfg
         if mesh is None and config.parallel.world_size > 1:
             from gllm_tpu.parallel.mesh import make_mesh
-            mesh = make_mesh(dp=config.parallel.dp, tp=config.parallel.tp)
+            mesh = make_mesh(dp=config.parallel.dp, tp=config.parallel.tp,
+                             sp=config.parallel.sp)
         self.mesh = mesh
         self.dtype = _DTYPES[config.dtype]
         self.model_def = get_model_def(model_cfg)
@@ -409,15 +419,18 @@ class ModelRunner:
 
         @functools.partial(jax.jit,
                            static_argnames=("max_q_len", "logprobs_k",
-                                            "prompt_lp"),
+                                            "prompt_lp", "ring",
+                                            "spec_sampled"),
                            donate_argnums=(1,),
                            compiler_options=tpu_compiler_options())
         def step(params, kv, batch: StepBatch, cos_sin, token_counts,
                  *, max_q_len: int, logprobs_k: int = -1,
-                 prompt_lp: bool = False):
+                 prompt_lp: bool = False, ring: bool = False,
+                 spec_sampled: bool = False):
             hidden, residual, kv = fwd(params, kv, batch, cfg,
                                        cos_sin=cos_sin,
-                                       attn_impl=attn_impl,
+                                       attn_impl=("ring" if ring
+                                                  else attn_impl),
                                        max_q_len=max_q_len)
             logits = logits_fn(params, hidden, residual, batch, cfg)
             tokens = sample(logits, batch.sampling, token_counts)
@@ -439,7 +452,8 @@ class ModelRunner:
                                          residual[rows], cfg)
                 aux["spec"] = spec_verify(
                     sl.reshape(batch.spec_rows.shape + sl.shape[-1:]),
-                    batch.spec_drafts, batch.sampling)
+                    batch.spec_drafts, batch.sampling,
+                    sampled=spec_sampled)
             return tokens, kv, aux
 
         if self.dp > 1:
@@ -450,7 +464,8 @@ class ModelRunner:
             from gllm_tpu.parallel.mesh import AXIS_DP
 
             def one(kv_r, batch_r, counts_r, params, cos_sin, *,
-                    max_q_len, logprobs_k, prompt_lp):
+                    max_q_len, logprobs_k, prompt_lp,
+                    spec_sampled=False):
                 hidden, residual, kv_r = fwd(params, kv_r, batch_r,
                                              cfg_dp, cos_sin=cos_sin,
                                              attn_impl=attn_impl,
@@ -472,19 +487,22 @@ class ModelRunner:
                     aux["spec"] = spec_verify(
                         sl.reshape(batch_r.spec_rows.shape
                                    + sl.shape[-1:]),
-                        batch_r.spec_drafts, batch_r.sampling)
+                        batch_r.spec_drafts, batch_r.sampling,
+                        sampled=spec_sampled)
                 return tokens, kv_r, aux
 
             @functools.partial(jax.jit,
                                static_argnames=("max_q_len", "logprobs_k",
-                                                "prompt_lp"),
+                                                "prompt_lp",
+                                                "spec_sampled"),
                                donate_argnums=(1,),
                                compiler_options=tpu_compiler_options())
             def step_dp(params, kv, batch, cos_sin, token_counts, *,
                         max_q_len: int, logprobs_k: int = -1,
-                        prompt_lp: bool = False):
+                        prompt_lp: bool = False,
+                        spec_sampled: bool = False):
                 kw = dict(max_q_len=max_q_len, logprobs_k=logprobs_k,
-                          prompt_lp=prompt_lp)
+                          prompt_lp=prompt_lp, spec_sampled=spec_sampled)
                 if attn_impl != "pallas" or mesh is None:
                     # XLA attention: plain vmap over stacked replicas —
                     # GSPMD partitions the batched program over the
@@ -722,7 +740,8 @@ class ModelRunner:
         with mesh_context(self.mesh):
             tokens, self.kv, aux = self._step_fn_dp(
                 self.params, self.kv, stacked, self.cos_sin, token_counts,
-                max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp)
+                max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp,
+                spec_sampled=any(_spec_sampled(b.items) for b in live))
         return tokens, aux, [b.num_seqs if b is not None else 0
                              for b in sched_batches]
 
@@ -752,8 +771,31 @@ class ModelRunner:
         with mesh_context(self.mesh):
             tokens, self.kv, aux = self._step_fn(
                 self.params, self.kv, batch, self.cos_sin, token_counts,
-                max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp)
+                max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp,
+                ring=self._use_ring(sched_batch,
+                                    batch.token_ids.shape[0]),
+                spec_sampled=_spec_sampled(sched_batch.items))
         return tokens, aux, sched_batch.num_seqs
+
+    def _use_ring(self, sched_batch: ScheduledBatch, t_pad: int) -> bool:
+        """Route a long single-seq from-position-0 prefill chunk through
+        ring attention over the sp mesh axis (parallel/ring_attention.py;
+        the reference has no CP at all). Everything else — decode, mixed
+        batches, later chunks attending cached prefix, MM/hybrid/MLA
+        models — keeps the paged path (still sharded over the mesh by
+        GSPMD)."""
+        sp = self.config.parallel.sp
+        if sp <= 1 or len(sched_batch.items) != 1:
+            return False
+        if self.model_def.family not in ("dense", "moe"):
+            return False
+        if self.model_cfg.use_mm or self.model_cfg.use_hybrid \
+                or self.model_cfg.use_mla:
+            return False
+        it = sched_batch.items[0]
+        return (it.computed_before == 0 and not it.draft_tokens
+                and it.num_new_tokens >= self.config.sp_ring_threshold
+                and t_pad % sp == 0)
 
     def step_async_chained(self, sched_batch: ScheduledBatch, prev_handle):
         """Launch a chained decode step whose input tokens are the PREVIOUS

@@ -40,11 +40,15 @@ def bucket_size(x: int, minimum: int, maximum: int) -> int:
 class LRUBytesCache:
     """Byte-budgeted LRU (reference MultiModalEmbeddingCache,
     model_runner.py:161-221): caps both entry count and total bytes so one
-    huge entry can't squat on the pool."""
+    huge entry can't squat on the pool. Thread-safe: the multihost blob
+    chain serves this cache from a peer-server handler thread while the
+    engine thread writes it."""
 
     def __init__(self, max_entries: int = 64, max_mb: float = 256.0):
+        import threading
         from collections import OrderedDict
         self._cache = OrderedDict()
+        self._lock = threading.Lock()
         self.max_entries = max_entries
         self.max_bytes = int(max_mb * 1024 * 1024)
         self._cur_bytes = 0
@@ -61,27 +65,29 @@ class LRUBytesCache:
         return 0
 
     def get(self, key):
-        v = self._cache.get(key)
-        if v is None:
-            self.misses += 1
-            return None
-        self.hits += 1
-        self._cache.move_to_end(key)
-        return v
+        with self._lock:
+            v = self._cache.get(key)
+            if v is None:
+                self.misses += 1
+                return None
+            self.hits += 1
+            self._cache.move_to_end(key)
+            return v
 
     def put(self, key, value) -> None:
         sz = self._size_of(value)
         if sz > self.max_bytes:
             return
-        if key in self._cache:
-            self._cur_bytes -= self._size_of(self._cache[key])
-            self._cache.move_to_end(key)
-        self._cache[key] = value
-        self._cur_bytes += sz
-        while (len(self._cache) > self.max_entries
-               or self._cur_bytes > self.max_bytes):
-            _, evicted = self._cache.popitem(last=False)
-            self._cur_bytes -= self._size_of(evicted)
+        with self._lock:
+            if key in self._cache:
+                self._cur_bytes -= self._size_of(self._cache[key])
+                self._cache.move_to_end(key)
+            self._cache[key] = value
+            self._cur_bytes += sz
+            while (len(self._cache) > self.max_entries
+                   or self._cur_bytes > self.max_bytes):
+                _, evicted = self._cache.popitem(last=False)
+                self._cur_bytes -= self._size_of(evicted)
 
 
 def enable_compilation_cache(cache_dir: str = None) -> str:

@@ -169,7 +169,14 @@ def _run_mm(jax, llm, result_path):
             json.dump({"output": results.get(sid),
                        "procs": jax.process_count()}, f)
     else:
-        MultihostEngine(llm).run_follower()
+        eng = MultihostEngine(llm)
+        eng.run_follower()
+        if eng._blob_client is not None:
+            # blob-channel fan-out observability: which source served this
+            # follower's fetches (tests assert the chain skipped host 0)
+            with open(f"{result_path}.blobstats{jax.process_index()}",
+                      "w") as f:
+                json.dump(eng._blob_client.stats, f)
 
 
 def disagg_image():
