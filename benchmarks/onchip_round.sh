@@ -1,0 +1,39 @@
+#!/bin/bash
+# One-shot on-chip sequence for a freshly recovered axon tunnel
+# (single-tenant: NOTHING else may touch the chip while this runs).
+#
+#   bash benchmarks/onchip_round.sh [outdir]
+#
+# Order is deliberate (VERDICT r03 next #1/#2):
+#  1. chip_probes  — each Pallas kernel + engine path, per-probe subprocess
+#                    timeouts; a stall names its kernel instead of wedging
+#                    the session.
+#  2. kernel_tune  — block-size sweep; winners land in
+#                    gllm_tpu/ops/pallas/tables.json (--write).
+#  3. vmem probe   — oversized tiles until Mosaic refuses: validates the
+#                    6 MB heuristic in ragged_attention.py.
+#  4. bench.py     — the headline number (supervised, degrade ladder,
+#                    persistent compile cache shared with steps 1-3).
+# Every step appends to $OUT; steps are individually timeout-bounded and
+# the script continues past failures so one bad step can't eat the rest.
+
+set -u
+OUT=${1:-/root/repo/.tunnel/onchip}
+mkdir -p "$OUT"
+cd /root/repo
+
+run() {
+  name=$1; tmo=$2; shift 2
+  echo "=== $name ($(date -u +%FT%TZ)) ===" | tee -a "$OUT/sequence.log"
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>&1
+  rc=$?
+  echo "$name rc=$rc" | tee -a "$OUT/sequence.log"
+  tail -5 "$OUT/$name.out" | sed 's/^/    /' >> "$OUT/sequence.log"
+}
+
+run chip_probes 700 python benchmarks/chip_probes.py
+run kernel_tune 1500 python benchmarks/kernel_tune.py --write
+run vmem_probe 900 python benchmarks/kernel_tune.py --vmem-probe
+run bench 1200 python bench.py
+echo "=== done ($(date -u +%FT%TZ)) ===" | tee -a "$OUT/sequence.log"
+grep -h "sharegpt_output" "$OUT/bench.out" | tail -1
