@@ -18,14 +18,14 @@
 # the script continues past failures so one bad step can't eat the rest.
 
 set -u
-OUT=${1:-/root/repo/.tunnel/onchip}
-mkdir -p "$OUT"
 cd /root/repo
+OUT=$(readlink -f "${1:-/root/repo/.tunnel/onchip}")
+mkdir -p "$OUT"
 
 run() {
   name=$1; tmo=$2; shift 2
   echo "=== $name ($(date -u +%FT%TZ)) ===" | tee -a "$OUT/sequence.log"
-  timeout "$tmo" "$@" >"$OUT/$name.out" 2>&1
+  timeout -k 30 "$tmo" "$@" >"$OUT/$name.out" 2>&1
   rc=$?
   echo "$name rc=$rc" | tee -a "$OUT/sequence.log"
   tail -5 "$OUT/$name.out" | sed 's/^/    /' >> "$OUT/sequence.log"

@@ -430,7 +430,8 @@ class PPModelRunner(ModelRunner):
         dispatch is async — returns (tokens_future, aux, num_seqs)."""
         from gllm_tpu.parallel.mesh import mesh_context
         from gllm_tpu.runner.runner import _spec_sampled
-        batch, max_q, presence = self.builder.build(sched_batch, step_key)
+        batch, max_q, presence = self.builder.build(sched_batch, step_key,
+                                                    device=False)
         lp_k, want_plp = self._lp_flags(sched_batch)
         spec_sampled = _spec_sampled(sched_batch.items)
         hidden = residual = None

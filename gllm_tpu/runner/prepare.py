@@ -6,12 +6,15 @@ buffers, query-start offsets, per-seq kv lens and page tables, all padded to
 *bucketed* static shapes so the jit cache stays small (the reference's
 power-of-two CUDA-graph buckets → our compile-cache buckets).
 
-Staging happens in numpy and ships to device in one transfer per array.
-The base fill is vectorized (flat scatters over ragged rows — the
-reference's vectorized-fill war story, input_data.py:436-476); only rare
-per-item features (seeds, mm splicing, prompt-logprob targets) loop, and
-only over the items that use them. ~3.5 ms at a 256-seq decode bucket,
-amortized further by the fused multi-step decode.
+Staging happens in numpy and ships to device as ONE batched
+``jax.device_put`` of the whole StepBatch pytree — a dozen separate
+per-array transfers each paid the dispatch (and, on a remote-attached
+TPU, the network) round trip. The base fill is vectorized (flat scatters
+over ragged rows — the reference's vectorized-fill war story,
+input_data.py:436-476); only rare per-item features (seeds, mm splicing,
+prompt-logprob targets) loop, and only over the items that use them.
+~2 ms at a 256-seq decode bucket, amortized further by the fused
+multi-step decode.
 """
 
 from __future__ import annotations
@@ -93,52 +96,52 @@ class BatchBuilder:
         t_pad, s_pad, _, p_pad = signature
         bias_len = force_bias_len or 8
         return StepBatch(
-            token_ids=jnp.zeros(t_pad, jnp.int32),
-            positions=jnp.zeros(t_pad, jnp.int32),
-            slot_mapping=jnp.zeros(t_pad, jnp.int32),
-            logits_indices=jnp.zeros(s_pad, jnp.int32),
+            token_ids=np.zeros(t_pad, np.int32),
+            positions=np.zeros(t_pad, np.int32),
+            slot_mapping=np.zeros(t_pad, np.int32),
+            logits_indices=np.zeros(s_pad, np.int32),
             attn=AttentionMetadata(
-                cu_q_lens=jnp.zeros(s_pad + 1, jnp.int32),
-                kv_lens=jnp.zeros(s_pad, jnp.int32),
-                page_table=jnp.zeros((s_pad, p_pad), jnp.int32),
-                num_seqs=jnp.asarray(0, jnp.int32)),
+                cu_q_lens=np.zeros(s_pad + 1, np.int32),
+                kv_lens=np.zeros(s_pad, np.int32),
+                page_table=np.zeros((s_pad, p_pad), np.int32),
+                num_seqs=np.asarray(0, np.int32)),
             sampling=SamplingMetadata(
-                temperature=jnp.zeros(s_pad, jnp.float32),
-                top_p=jnp.ones(s_pad, jnp.float32),
-                top_k=jnp.full((s_pad,), -1, jnp.int32),
-                repetition_penalty=jnp.ones(s_pad, jnp.float32),
+                temperature=np.zeros(s_pad, np.float32),
+                top_p=np.ones(s_pad, np.float32),
+                top_k=np.full((s_pad,), -1, np.int32),
+                repetition_penalty=np.ones(s_pad, np.float32),
                 step_key=step_key,
-                presence_penalty=(jnp.zeros(s_pad, jnp.float32)
+                presence_penalty=(np.zeros(s_pad, np.float32)
                                   if "penalties" in force_extras else None),
-                frequency_penalty=(jnp.zeros(s_pad, jnp.float32)
+                frequency_penalty=(np.zeros(s_pad, np.float32)
                                    if "penalties" in force_extras
                                    else None),
-                seed=(jnp.full((s_pad,), -1, jnp.int32)
+                seed=(np.full((s_pad,), -1, np.int32)
                       if "seed" in force_extras else None),
-                out_step=(jnp.zeros(s_pad, jnp.int32)
+                out_step=(np.zeros(s_pad, np.int32)
                           if "seed" in force_extras else None),
-                min_p=jnp.zeros(s_pad, jnp.float32),
-                bias_ids=(jnp.zeros((s_pad, bias_len), jnp.int32)
+                min_p=np.zeros(s_pad, np.float32),
+                bias_ids=(np.zeros((s_pad, bias_len), np.int32)
                           if "bias" in force_extras else None),
-                bias_vals=(jnp.zeros((s_pad, bias_len), jnp.float32)
+                bias_vals=(np.zeros((s_pad, bias_len), np.float32)
                            if "bias" in force_extras else None)),
-            spec_rows=(jnp.zeros(
-                (s_pad, self.config.spec_k + 1), jnp.int32)
+            spec_rows=(np.zeros(
+                (s_pad, self.config.spec_k + 1), np.int32)
                 if "spec" in force_extras else None),
-            spec_drafts=(jnp.full(
-                (s_pad, self.config.spec_k), -1, jnp.int32)
+            spec_drafts=(np.full(
+                (s_pad, self.config.spec_k), -1, np.int32)
                 if "spec" in force_extras else None),
-            plp_targets=(jnp.zeros(t_pad, jnp.int32)
+            plp_targets=(np.zeros(t_pad, np.int32)
                          if "plp" in force_extras else None),
-            ssm_slots=(jnp.zeros(s_pad, jnp.int32) if self.use_ssm
+            ssm_slots=(np.zeros(s_pad, np.int32) if self.use_ssm
                        else None),
-            mrope_positions=(jnp.zeros((3, t_pad), jnp.int32)
+            mrope_positions=(np.zeros((3, t_pad), np.int32)
                              if self.use_mm else None),
             # mm_mask rides with mm_embeds (build's structure): both exist
             # iff a replica this step carries visual rows ("mm" forced)
-            mm_mask=(jnp.zeros(t_pad, bool)
+            mm_mask=(np.zeros(t_pad, bool)
                      if self.use_mm and "mm" in force_extras else None),
-            mm_embeds=(jnp.zeros((t_pad, self.mm_embed_dim), jnp.float32)
+            mm_embeds=(np.zeros((t_pad, self.mm_embed_dim), np.float32)
                        if self.use_mm and "mm" in force_extras else None),
         )
 
@@ -187,12 +190,19 @@ class BatchBuilder:
 
     def build(self, batch: ScheduledBatch, step_key,
               force_signature=None, force_extras=frozenset(),
-              force_penalty_len=None, force_bias_len=None):
+              force_penalty_len=None, force_bias_len=None, device=True):
         """Returns (StepBatch, max_q_len, token_counts_or_None).
 
         ``force_signature`` overrides the computed shape buckets and
         ``force_extras`` forces optional fields to exist (DP replicas must
-        agree on one signature + structure per step)."""
+        agree on one signature + structure per step).
+
+        ``device``: place the whole StepBatch with ONE batched
+        ``jax.device_put`` (a dozen separate small `jnp.asarray` transfers
+        per step would each pay the dispatch — and on the remote axon
+        tunnel, the network — round trip). Callers that re-place the batch
+        themselves (dp stacking with shardings, PP per-stage fan-out) pass
+        ``device=False`` and receive host numpy leaves."""
         t_pad, s_pad, max_q, p_pad = (force_signature
                                       or self.shape_signature(batch))
         page = self.page_size
@@ -400,8 +410,7 @@ class BatchBuilder:
                     mask[i, :len(row)] = True
                     pres[i] = sp.presence_penalty
                     freq[i] = sp.frequency_penalty
-            token_counts = PenaltyTokens(jnp.asarray(ids),
-                                         jnp.asarray(mask))
+            token_counts = PenaltyTokens(ids, mask)
 
         # OpenAI logit_bias: sparse per-seq (id, bias) pairs, padded to a
         # shared bucket B (reference protocol.py logit_bias → sampler add).
@@ -436,50 +445,49 @@ class BatchBuilder:
                     base = int(offs[i]) + it.num_new_tokens - 1
                     spec_rows[i, :d + 1] = base + np.arange(d + 1)
                     spec_drafts[i, :d] = it.draft_tokens
-            spec_rows_arr = jnp.asarray(spec_rows)
-            spec_drafts_arr = jnp.asarray(spec_drafts)
+            spec_rows_arr = spec_rows
+            spec_drafts_arr = spec_drafts
 
         step_batch = StepBatch(
-            token_ids=jnp.asarray(tokens),
-            positions=jnp.asarray(positions),
-            slot_mapping=jnp.asarray(slots),
-            logits_indices=jnp.asarray(logits_idx),
+            token_ids=tokens,
+            positions=positions,
+            slot_mapping=slots,
+            logits_indices=logits_idx,
             attn=AttentionMetadata(
-                cu_q_lens=jnp.asarray(cu),
-                kv_lens=jnp.asarray(kv_lens),
-                page_table=jnp.asarray(page_table),
-                num_seqs=jnp.asarray(batch.num_seqs, jnp.int32)),
+                cu_q_lens=cu,
+                kv_lens=kv_lens,
+                page_table=page_table,
+                num_seqs=np.asarray(batch.num_seqs, np.int32)),
             sampling=SamplingMetadata(
-                temperature=jnp.asarray(temperature),
-                top_p=jnp.asarray(top_p),
-                top_k=jnp.asarray(top_k),
-                repetition_penalty=jnp.asarray(rep_penalty),
+                temperature=temperature,
+                top_p=top_p,
+                top_k=top_k,
+                repetition_penalty=rep_penalty,
                 step_key=step_key,
-                presence_penalty=(jnp.asarray(pres)
-                                  if pres is not None else None),
-                frequency_penalty=(jnp.asarray(freq)
-                                   if freq is not None else None),
+                presence_penalty=pres,
+                frequency_penalty=freq,
                 # None keeps the fused single-draw gumbel path (the common
                 # all-unseeded case); per-row keys only when a request
                 # actually asked for a seed (one extra jit variant).
-                seed=(jnp.asarray(seeds)
-                      if any_seeded or force_seeded else None),
-                out_step=(jnp.asarray(out_steps)
+                seed=(seeds if any_seeded or force_seeded else None),
+                out_step=(out_steps
                           if any_seeded or force_seeded else None),
-                min_p=jnp.asarray(min_p),
-                bias_ids=(jnp.asarray(bias_ids)
-                          if bias_ids is not None else None),
-                bias_vals=(jnp.asarray(bias_vals)
-                           if bias_vals is not None else None)),
-            mrope_positions=jnp.asarray(mrope) if self.use_mm else None,
-            mm_embeds=(jnp.asarray(mm_embeds)
-                       if mm_embeds is not None else None),
-            mm_mask=(jnp.asarray(mm_mask)
+                min_p=min_p,
+                bias_ids=bias_ids,
+                bias_vals=bias_vals),
+            mrope_positions=mrope if self.use_mm else None,
+            mm_embeds=mm_embeds,
+            mm_mask=(mm_mask
                      if self.use_mm and mm_embeds is not None else None),
-            ssm_slots=jnp.asarray(ssm_slots) if self.use_ssm else None,
-            plp_targets=(jnp.asarray(plp_targets)
-                         if plp_targets is not None else None),
+            ssm_slots=ssm_slots if self.use_ssm else None,
+            plp_targets=plp_targets,
             spec_rows=spec_rows_arr,
             spec_drafts=spec_drafts_arr,
         )
+        if device:
+            # one batched transfer for the whole step batch (token_counts
+            # rides separately: its bucketed L changes more often)
+            step_batch = jax.device_put(step_batch)
+            if token_counts is not None:
+                token_counts = jax.device_put(token_counts)
         return step_batch, max_q, token_counts

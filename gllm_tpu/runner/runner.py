@@ -709,14 +709,15 @@ class ModelRunner:
             else:
                 batch, _, counts = self.builder.build(
                     b, key, force_signature=sig, force_extras=extras,
-                    force_penalty_len=pen_len, force_bias_len=bias_len)
+                    force_penalty_len=pen_len, force_bias_len=bias_len,
+                    device=False)   # stacked + sharded below
                 counts_any = counts_any or counts is not None
                 parts.append((batch, counts))
         token_counts = None
         if counts_any:
             from gllm_tpu.ops.sampling import PenaltyTokens
-            blank = PenaltyTokens(jnp.zeros((sig[1], pen_len), jnp.int32),
-                                  jnp.zeros((sig[1], pen_len), bool))
+            blank = PenaltyTokens(np.zeros((sig[1], pen_len), np.int32),
+                                  np.zeros((sig[1], pen_len), bool))
             token_counts = jax.tree.map(
                 lambda *xs: jnp.stack(xs),
                 *[c if c is not None else blank for _, c in parts])
