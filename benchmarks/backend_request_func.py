@@ -94,23 +94,31 @@ def percentile(vals: List[float], p: float) -> float:
     return vals[i]
 
 
+def _dist(vals: List[float]) -> dict:
+    """mean/p50/p90/p99 in ms (the reference's serving-benchmark shape)."""
+    if not vals:
+        return {"mean": 0, "p50": 0, "p90": 0, "p99": 0}
+    return {"mean": round(1e3 * sum(vals) / len(vals), 1),
+            "p50": round(1e3 * percentile(vals, 50), 1),
+            "p90": round(1e3 * percentile(vals, 90), 1),
+            "p99": round(1e3 * percentile(vals, 99), 1)}
+
+
 def summarize(results: List[RequestResult], wall_s: float) -> dict:
     ok = [r for r in results if r.success]
     out_toks = sum(r.output_tokens for r in ok)
-    ttfts = [r.ttft_s for r in ok]
-    tpots = [r.tpot_s for r in ok if r.itl_s]
+    itls = [t for r in ok for t in r.itl_s]
     return {
         "completed": len(ok),
         "failed": len(results) - len(ok),
         "wall_s": round(wall_s, 2),
         "request_throughput_rps": round(len(ok) / wall_s, 3),
         "output_tok_s": round(out_toks / wall_s, 1),
-        "ttft_ms": {"mean": round(1e3 * sum(ttfts) / len(ttfts), 1)
-                    if ttfts else 0,
-                    "p50": round(1e3 * percentile(ttfts, 50), 1),
-                    "p99": round(1e3 * percentile(ttfts, 99), 1)},
-        "tpot_ms": {"mean": round(1e3 * sum(tpots) / len(tpots), 1)
-                    if tpots else 0,
-                    "p50": round(1e3 * percentile(tpots, 50), 1),
-                    "p99": round(1e3 * percentile(tpots, 99), 1)},
+        "output_tokens": out_toks,
+        "ttft_ms": _dist([r.ttft_s for r in ok]),
+        "tpot_ms": _dist([r.tpot_s for r in ok if r.itl_s]),
+        # per-token inter-arrival across ALL requests: the tail here is
+        # what streaming users feel as a stall
+        "itl_ms": _dist(itls),
+        "e2e_ms": _dist([r.e2e_s for r in ok]),
     }

@@ -45,6 +45,12 @@ def _fetch(x):
     return np.asarray(x)
 
 
+def _interp() -> bool:
+    """CPU smoke mode: Pallas runs interpreted (no Mosaic on CPU)."""
+    import jax
+    return jax.default_backend() == "cpu"
+
+
 def _mixed_workload(T=1024, S=8, Hq=32, Hkv=8, D=128, page=16, ctx=1024):
     """Representative prefill batch: S seqs, T packed tokens, ctx KV."""
     import jax
@@ -71,10 +77,13 @@ def time_ragged(q_block, kv_block, iters=12):
 
     # same scoped-VMEM compile options the serving step jit uses, so the
     # sweep measures what the runner will actually run
+    interp = _interp()
+
     @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
     def run(qq):
         return ragged_paged_attention(qq, kc, vc, cu, kl, pt, scale=scale,
-                                      q_block=q_block, kv_block=kv_block)
+                                      q_block=q_block, kv_block=kv_block,
+                                      interpret=interp)
 
     out = run(q)
     _fetch(out)                                    # compile + first fetch
@@ -102,10 +111,12 @@ def time_decode(kv_block, iters=25):
           .reshape(S, ctx // page) + 1)
     from gllm_tpu.utils import tpu_compiler_options
 
+    interp = _interp()
+
     @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
     def run(qq):
         return paged_decode_attention(qq, kc, vc, kl, pt, scale=D ** -0.5,
-                                      kv_block=kv_block)
+                                      kv_block=kv_block, interpret=interp)
 
     out = run(q)
     _fetch(out)
@@ -136,10 +147,13 @@ def vmem_probe_one(qb: int, kb: int):
     q, kc, vc, cu, kl, pt, scale = _mixed_workload(T=2048, ctx=2048)
     tile_mb = q.shape[1] * qb * kb * 4 / 1e6
 
+    interp = _interp()
+
     @ft.partial(jax.jit, compiler_options=tpu_compiler_options())
     def run(qq):
         return ragged_paged_attention(qq, kc, vc, cu, kl, pt, scale=scale,
-                                      q_block=qb, kv_block=kb)
+                                      q_block=qb, kv_block=kb,
+                                      interpret=interp)
 
     try:
         _fetch(run(q))
@@ -234,6 +248,11 @@ def main():
 
     if args.write and best:
         from gllm_tpu.ops.pallas.tuning import _TABLES_PATH, device_tag
+        if device_tag().startswith("cpu") or _interp():
+            print("[tune] refusing --write on the CPU backend: interpret-"
+                  "mode timings are meaningless for the committed table",
+                  file=sys.stderr)
+            return
         table = {}
         if os.path.exists(_TABLES_PATH):
             with open(_TABLES_PATH) as f:

@@ -3,9 +3,16 @@
 Counterpart of the reference's serving benchmark flow (backend_request_func
 driven over a request list with bounded concurrency). stdlib threads.
 
+Arrival model mirrors the reference's serving benchmark: with
+``--request-rate R`` requests arrive as a Poisson process at R req/s
+(exponential inter-arrivals, seeded); the default (inf) fires everything
+at once, bounded only by ``--concurrency`` — the closed-loop saturation
+measurement.
+
 Usage:
   python benchmarks/serve_bench.py --port 8000 --num-prompts 64 \
-      --concurrency 16 --prompt-len 256 --output-len 128
+      --concurrency 16 --prompt-len 256 --output-len 128 \
+      [--request-rate 8]
 """
 
 import argparse
@@ -29,6 +36,8 @@ def main():
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--prompt-len", type=int, default=256)
     ap.add_argument("--output-len", type=int, default=128)
+    ap.add_argument("--request-rate", type=float, default=float("inf"),
+                    help="poisson arrival rate (req/s); inf = all at once")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -51,10 +60,18 @@ def main():
         with sem:
             results[i] = stream_completion(args.host, args.port, payloads[i])
 
+    arrivals = np.zeros(len(payloads))
+    if np.isfinite(args.request_rate) and args.request_rate > 0:
+        arrivals = np.cumsum(
+            rng.exponential(1.0 / args.request_rate, size=len(payloads)))
+
     t0 = time.perf_counter()
     threads = [threading.Thread(target=worker, args=(i,))
                for i in range(len(payloads))]
-    for t in threads:
+    for i, t in enumerate(threads):
+        wait = arrivals[i] - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
         t.start()
     for t in threads:
         t.join()

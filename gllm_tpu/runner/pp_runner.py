@@ -190,6 +190,8 @@ class PPModelRunner(ModelRunner):
         # place them on REPLICA 0's device block as we go (peak host memory
         # is one stage; page sizing then reads live device stats).
         staged = []
+        import time as _time
+        _t_load = _time.monotonic()
         for i, (first, last) in enumerate(bounds):
             scfg = dataclasses.replace(model_cfg, first_layer=first,
                                        last_layer=last)
@@ -232,6 +234,9 @@ class PPModelRunner(ModelRunner):
             # calls differ only in arg placement → per-sharding compiles
             # dedupe through the jit cache)
             staged.append((scfg, sparams, self._make_stage_fn(scfg)))
+            logger.info("[startup] phase=weight_load stage=%d seconds=%.2f",
+                        i, _time.monotonic() - _t_load)
+            _t_load = _time.monotonic()
 
         # Phase 2: one shared page count from the TIGHTEST stage device
         # (page tables are global; honors cache.memory_util). Replicas are

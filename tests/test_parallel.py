@@ -53,7 +53,8 @@ def run(model_dir, tp=1, dp=1):
 def test_mesh_construction():
     assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
     mesh = make_mesh(dp=2, tp=4)
-    assert mesh.shape == {"dp": 2, "tp": 4}
+    assert mesh.shape == {"dp": 2, "sp": 1, "tp": 4}
+    assert make_mesh(sp=4, tp=2).shape == {"dp": 1, "sp": 4, "tp": 2}
 
 
 def test_tp4_matches_single_device(ckpt):

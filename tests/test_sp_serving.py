@@ -32,12 +32,13 @@ def ckpt(tmp_path_factory):
     return str(d)
 
 
-def make_llm(ckpt, sp=1, tp=1, threshold=16, maxp=128):
+def make_llm(ckpt, sp=1, tp=1, threshold=16, maxp=128, prefix=False):
     return LLM(config=EngineConfig(
         model=ckpt, dtype="float32", max_model_len=256,
         sp_ring_threshold=threshold,
         scheduler=SchedulerConfig(max_prefill_tokens=maxp),
-        cache=CacheConfig(page_size=4, num_pages=128),
+        cache=CacheConfig(page_size=4, num_pages=128,
+                          enable_prefix_caching=prefix),
         parallel=ParallelConfig(sp=sp, tp=tp)))
 
 
@@ -113,3 +114,15 @@ def test_sp_requires_no_pp_dp():
         EngineConfig(parallel=ParallelConfig(sp=2, dp=2)).validate()
     with pytest.raises(ValueError):
         EngineConfig(parallel=ParallelConfig(sp=2, pp=2)).validate()
+
+
+def test_sp2_prefix_cache_cold_warm(ckpt):
+    """Ring-prefill writes KV that the prefix cache registers; a warm
+    re-run (cache hit → shorter from-nonzero chunk → paged path) stays
+    byte-identical to sp=1."""
+    prompt = [int(1 + (i * 11) % 120) for i in range(60)]
+    want = greedy(make_llm(ckpt), [prompt])
+    llm = make_llm(ckpt, sp=2, prefix=True)
+    cold = greedy(llm, [prompt])
+    warm = greedy(llm, [prompt])
+    assert cold == want and warm == want
