@@ -206,10 +206,27 @@ def main():
             vmem_probe_one(int(parts[1]), int(parts[2]))
             print("RESULT 0.0", flush=True)
             return
+        elif parts[0] == "devtag":
+            from gllm_tpu.ops.pallas.tuning import device_tag
+            print(f"DEVTAG {device_tag()}", flush=True)
+            print("RESULT 0.0", flush=True)
+            return
         else:
             raise SystemExit(f"unknown inner spec {args.inner}")
         print(f"RESULT {ms:.3f}", flush=True)
         return
+
+    # The PARENT must never import jax: on a single-tenant remote TPU it
+    # would hold the device lease and deadlock the sweep children. The
+    # device tag comes from a short-lived child, resolved LAZILY at each
+    # write (an early probe timing out on a flaky relay must not forfeit
+    # winners the later sweep measures).
+    def probe_dev_tag() -> str:
+        _, out = run_inner("devtag")
+        for line in out.splitlines():
+            if line.startswith("DEVTAG "):
+                return line.split(None, 1)[1].strip()
+        return "unknown"
 
     if args.vmem_probe:
         for qb, kb in VMEM_PROBE_CONFIGS:
@@ -220,7 +237,36 @@ def main():
             sys.stdout.flush()
         return
 
+    def write_best(best: dict) -> None:
+        """Merge winners into the committed table IMMEDIATELY — an outer
+        timeout killing the rest of the sweep must not forfeit results
+        already measured."""
+        if not (args.write and best):
+            return
+        tag = probe_dev_tag()
+        if tag.startswith("cpu") or tag in ("unknown", "default"):
+            # cpu → interpret-mode timings; unknown/default → the probe
+            # couldn't name the device (a "default" entry would layer
+            # under EVERY device kind) — either way, don't pollute the
+            # committed table
+            print(f"[tune] not writing table: device tag {tag!r}",
+                  file=sys.stderr)
+            return
+        from gllm_tpu.ops.pallas.tuning import _TABLES_PATH
+        table = {}
+        if os.path.exists(_TABLES_PATH):
+            with open(_TABLES_PATH) as f:
+                table = json.load(f)
+        dev = table.setdefault(tag, {})
+        for kern, params in best.items():
+            dev.setdefault(kern, {}).update(params)
+        with open(_TABLES_PATH, "w") as f:
+            json.dump(table, f, indent=1, sort_keys=True)
+        print(f"[tune] wrote {_TABLES_PATH} for {tag}",
+              file=sys.stderr)
+
     results = {"ragged": {}, "decode": {}}
+    best = {}
     if args.kernel in (None, "ragged"):
         for qb, kb in itertools.product(BLOCKS, BLOCKS):
             ms, _ = run_inner(f"ragged:{qb}:{kb}")
@@ -228,6 +274,11 @@ def main():
             print(f"[tune] ragged q={qb} kv={kb}: "
                   f"{'%.2f ms' % ms if ms else 'FAIL'}",
                   file=sys.stderr, flush=True)
+        ok_r = {k: v for k, v in results["ragged"].items() if v}
+        if ok_r:
+            qb, kb = min(ok_r, key=ok_r.get).split("x")
+            best["ragged"] = {"q_block": int(qb), "kv_block": int(kb)}
+            write_best({"ragged": best["ragged"]})
     if args.kernel in (None, "decode"):
         for kb in BLOCKS:
             ms, _ = run_inner(f"decode:{kb}")
@@ -235,35 +286,11 @@ def main():
             print(f"[tune] decode kv={kb}: "
                   f"{'%.2f ms' % ms if ms else 'FAIL'}",
                   file=sys.stderr, flush=True)
-
-    best = {}
-    ok_r = {k: v for k, v in results["ragged"].items() if v}
-    if ok_r:
-        qb, kb = min(ok_r, key=ok_r.get).split("x")
-        best["ragged"] = {"q_block": int(qb), "kv_block": int(kb)}
-    ok_d = {k: v for k, v in results["decode"].items() if v}
-    if ok_d:
-        best["decode"] = {"kv_block": int(min(ok_d, key=ok_d.get))}
+        ok_d = {k: v for k, v in results["decode"].items() if v}
+        if ok_d:
+            best["decode"] = {"kv_block": int(min(ok_d, key=ok_d.get))}
+            write_best({"decode": best["decode"]})
     print(json.dumps({"results": results, "best": best}))
-
-    if args.write and best:
-        from gllm_tpu.ops.pallas.tuning import _TABLES_PATH, device_tag
-        if device_tag().startswith("cpu") or _interp():
-            print("[tune] refusing --write on the CPU backend: interpret-"
-                  "mode timings are meaningless for the committed table",
-                  file=sys.stderr)
-            return
-        table = {}
-        if os.path.exists(_TABLES_PATH):
-            with open(_TABLES_PATH) as f:
-                table = json.load(f)
-        dev = table.setdefault(device_tag(), {})
-        for kern, params in best.items():
-            dev.setdefault(kern, {}).update(params)
-        with open(_TABLES_PATH, "w") as f:
-            json.dump(table, f, indent=1, sort_keys=True)
-        print(f"[tune] wrote {_TABLES_PATH} for {device_tag()}",
-              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -32,7 +32,7 @@ run() {
 }
 
 run chip_probes 700 python benchmarks/chip_probes.py
-run kernel_tune 1500 python benchmarks/kernel_tune.py --write
+run kernel_tune 2800 python benchmarks/kernel_tune.py --write
 run vmem_probe 900 python benchmarks/kernel_tune.py --vmem-probe
 run bench 1200 python bench.py
 echo "=== done ($(date -u +%FT%TZ)) ===" | tee -a "$OUT/sequence.log"

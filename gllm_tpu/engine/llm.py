@@ -346,16 +346,21 @@ class LLM:
 
     def _schedule_multi(self, prev_batch, multi: int):
         """Chain up to ``multi`` decode steps off ``prev_batch`` for one
-        fused dispatch (gated to plain decode: penalties / seeds /
-        logprobs / hybrid-SSM paths fall back to single chained steps)."""
+        fused dispatch. Greedy, sampled, and SEEDED rows all fuse (their
+        device draws advance with the scan); penalties / logit_bias /
+        logprobs / stop-strings / hybrid-SSM fall back to single chained
+        steps."""
         first = self.scheduler.schedule_chained(prev_batch)
         if first is None:
             return []
         if multi <= 1 or self.model_cfg.use_hybrid:
             return [first]
         from gllm_tpu.runner.prepare import BatchBuilder
-        if BatchBuilder.batch_extras(first):
-            return [first]          # seeded / penalized rows: step-by-step
+        if BatchBuilder.batch_extras(first) - {"seed"}:
+            # penalties / bias / plp / mm / spec need per-step host work;
+            # SEEDED rows fuse fine — their draws are a pure function of
+            # (seed, out_step), which the fused scan advances on device
+            return [first]
         if any(it.seq.sampling_params.logprobs is not None
                or it.seq.sampling_params.stop
                for it in first.items):

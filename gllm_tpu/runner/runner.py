@@ -890,7 +890,14 @@ class ModelRunner:
                     slot_mapping=slots,
                     attn=batch.attn._replace(
                         kv_lens=batch.attn.kv_lens + k),
-                    sampling=batch.sampling._replace(step_key=key),
+                    # seeded rows draw from (seed, out_step): advancing
+                    # out_step per sub-step keeps the fused block
+                    # byte-identical to K single seeded steps
+                    sampling=batch.sampling._replace(
+                        step_key=key,
+                        out_step=(batch.sampling.out_step + k
+                                  if batch.sampling.out_step is not None
+                                  else None)),
                     mrope_positions=(batch.mrope_positions + k
                                      if batch.mrope_positions is not None
                                      else None),

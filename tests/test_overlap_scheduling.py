@@ -132,3 +132,17 @@ def test_multi_step_sampling_key_schedule_identical(ckpt):
                         ignore_eos=True)
     prompts = [[3, 14, 15], [9, 2, 6]]
     assert run_multi(ckpt, 4, prompts, sp) == run(ckpt, True, prompts, sp)
+
+
+def test_seeded_sampling_fused_multi_step(ckpt):
+    """Seeded requests ride the fused multi-step block since r4: their
+    draws are a pure function of (seed, out_step), which the fused scan
+    advances on device — outputs byte-identical to the plain engine."""
+    prompts = [[5, 17, 93, 41], [9, 9, 3, 77, 21, 60]]
+    sps = [SamplingParams(temperature=0.9, seed=7, max_tokens=24,
+                          ignore_eos=True),
+           SamplingParams(temperature=0.7, seed=11, max_tokens=24,
+                          ignore_eos=True)]
+    base = run(ckpt, False, [list(p) for p in prompts], sps)
+    fused = run_multi(ckpt, 4, [list(p) for p in prompts], sps)
+    assert base == fused
