@@ -41,3 +41,54 @@ def test_startup_phase_lines(caplog):
     # warmup total with bucket count
     assert any("[startup] phase=warmup seconds=" in m and "buckets=" in m
                for m in msgs)
+
+
+def test_api_server_first_token_line(tmp_path):
+    """The api_server CLI logs the serving-readiness yardstick
+    (`[startup] phase=first_token`) after warmup — asserted through the
+    real entrypoint in a subprocess."""
+    import os
+    import subprocess
+    import sys
+    import time
+
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(3)
+    d = tmp_path / "srv"
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        max_position_embeddings=256, eos_token_id=0,
+        attention_bias=False)).save_pretrained(d, safe_serialization=True)
+
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from gllm_tpu.entrypoints.api_server import main\n"
+        f"main(['--model', {str(d)!r}, '--tokenizer', '', '--port', '0',\n"
+        "      '--max-model-len', '64', '--max-num-seqs', '8',\n"
+        "      '--num-pages', '64', '--page-size', '4',\n"
+        "      '--maxp', '32', '--maxd', '8'])\n")
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    log = tmp_path / "srv.log"
+    with open(log, "w") as lf:
+        proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                                stdout=lf, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.monotonic() + 300
+        seen = False
+        while time.monotonic() < deadline and not seen:
+            time.sleep(2)
+            seen = "phase=first_token" in log.read_text()
+            assert proc.poll() is None or seen, log.read_text()[-2000:]
+        assert seen, log.read_text()[-2000:]
+        txt = log.read_text()
+        assert "total_startup_seconds=" in txt
+    finally:
+        proc.kill()
+        proc.wait(timeout=30)
