@@ -152,12 +152,41 @@ def probe_mla():
     assert len(outs[0].output_token_ids) == 8
 
 
+def probe_bench_shape():
+    """The HEADLINE bench geometry (Llama-3.2-1B: head_dim 64, GQA 32/8 →
+    packed-KV pack=2) through the real engine in bfloat16 — the exact
+    attention configuration bench.py will serve, so a Mosaic surprise
+    shows up here, named, instead of inside a 600 s bench budget."""
+    from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.models.config import ModelConfig
+    from gllm_tpu.sampling_params import SamplingParams
+
+    mcfg = ModelConfig(
+        architecture="LlamaForCausalLM", vocab_size=512, hidden_size=256,
+        num_layers=2, num_heads=32, num_kv_heads=8, head_dim=64,
+        intermediate_size=512, max_position=512, rope_theta=500000.0,
+        tie_word_embeddings=True)
+    llm = LLM(config=EngineConfig(
+        load_format="dummy", dtype="bfloat16", max_model_len=256,
+        scheduler=SchedulerConfig(max_prefill_tokens=128,
+                                  max_decode_seqs=16),
+        cache=CacheConfig(page_size=16, num_pages=128)),
+        model_cfg=mcfg)
+    outs = llm.generate(
+        prompt_token_ids=[[3, 5, 7] * 20, [11, 13]],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=16,
+                                       ignore_eos=True))
+    assert all(len(o.output_token_ids) == 16 for o in outs)
+
+
 PROBES = {
     "ragged": probe_ragged,
     "decode": probe_decode,
     "gdn": probe_gdn,
     "multistep": probe_multistep,
     "mla": probe_mla,
+    "bench_shape": probe_bench_shape,
 }
 
 

@@ -31,7 +31,7 @@ run() {
   tail -5 "$OUT/$name.out" | sed 's/^/    /' >> "$OUT/sequence.log"
 }
 
-run chip_probes 700 python benchmarks/chip_probes.py
+run chip_probes 950 python benchmarks/chip_probes.py
 run kernel_tune 2800 python benchmarks/kernel_tune.py --write
 run vmem_probe 900 python benchmarks/kernel_tune.py --vmem-probe
 run bench 1200 python bench.py
