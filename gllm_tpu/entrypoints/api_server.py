@@ -42,8 +42,9 @@ class ServerState:
         self.served_model = served_model
         self.start_time = time.time()
         self._profiling = False
-        self.tool_parser = get_tool_parser(tool_parser,
-                                           llm.config.model or served_model)
+        self.tool_parser = get_tool_parser(
+            tool_parser, llm.config.model or served_model,
+            architecture=getattr(llm.model_cfg, "architecture", "") or "")
 
     # ---- request handling -------------------------------------------------
 

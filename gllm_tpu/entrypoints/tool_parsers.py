@@ -9,6 +9,9 @@ name (reference api_server.py:543-575).
 Formats:
 - ``qwen`` (hermes-style, Qwen/Qwen2.5/Qwen3):
   ``<tool_call>\\n{"name": ..., "arguments": {...}}\\n</tool_call>``
+- ``qwen3.5`` (XML form the Qwen3.5 hybrids natively emit):
+  ``<tool_call><function=NAME><parameter=ARG>VALUE</parameter>...
+  </function></tool_call>``
 - ``deepseek`` (DeepSeek V3-family unicode-fenced sections):
   ``<｜tool▁calls▁begin｜><｜tool▁call▁begin｜>NAME<｜tool▁sep｜>JSON
   <｜tool▁call▁end｜>...<｜tool▁calls▁end｜>``
@@ -131,6 +134,74 @@ class QwenToolParser(ToolParser):
                 args = coerce_arguments(args, schemas.get(name))
             calls.append(ToolCall(name=name, arguments=json.dumps(
                 args, ensure_ascii=False)))
+            end = m.end()
+        return calls, end
+
+
+class Qwen3XmlToolParser(ToolParser):
+    """Qwen3.5 native XML tool markup (reference tool_parsers.py:346-425):
+
+    ``<tool_call>\\n<function=NAME>\\n<parameter=ARG>\\nVALUE\\n</parameter>
+    ...\\n</function>\\n</tool_call>``
+
+    Parameter values arrive as raw text with no type information; they are
+    type-corrected against the declared JSON schema via
+    :func:`coerce_arguments` — ``string`` params stay strings (schema-less
+    ``json.loads`` on every value would break BFCL's string-typed
+    categories), everything else is coerced to its declared type.
+
+    Robustness choices mirrored from the reference: a value runs until its
+    ``</parameter>``, the next ``<parameter=``, or the end of the function
+    body (the model sometimes drops the final closing tag); ``<function=``
+    blocks are scanned in the whole text so a garbled ``</tool_call>``
+    does not hide calls. Deviation: we also treat a bare ``<function=``
+    (no enclosing ``<tool_call>``) as tool markup, so the streaming
+    adapter never leaks half a call as content."""
+
+    _FUNC = re.compile(r"<function=(?P<name>[^>\n]+)>(?P<body>.*?)"
+                       r"</function>", re.DOTALL)
+    _PARAM = re.compile(r"<parameter=(?P<key>[^>\n]+)>(?P<val>.*?)"
+                        r"(?:</parameter>|(?=<parameter=)|\Z)", re.DOTALL)
+    STREAM_MARKERS = ("<tool_call>", "<function=")
+    # One call unit is complete at </function> — before the trailing
+    # </tool_call> ever arrives, so streamed calls surface a token early.
+    END_MARKERS = ("</function>",)
+
+    def _call_from(self, m: "re.Match", schemas) -> Optional[ToolCall]:
+        name = m.group("name").strip()
+        if not name:
+            return None
+        args = {k: v for k, v in
+                ((pm.group("key").strip(), pm.group("val").strip())
+                 for pm in self._PARAM.finditer(m.group("body"))) if k}
+        if schemas:
+            args = coerce_arguments(args, schemas.get(name))
+        return ToolCall(name=name,
+                        arguments=json.dumps(args, ensure_ascii=False))
+
+    _BLOCK = re.compile(r"<tool_call>\s*(?:<function=.*?</function>\s*)*"
+                        r"(?:</tool_call>)?|<function=.*?</function>",
+                        re.DOTALL)
+
+    def parse(self, text, schemas=None):
+        calls = [c for c in (self._call_from(m, schemas)
+                             for m in self._FUNC.finditer(text)) if c]
+        if not calls:
+            # Prose that merely mentions the markup (or malformed markup)
+            # passes through untouched, like the hermes parser.
+            return text, []
+        # Remove only the matched markup; assistant text before, between,
+        # and after the calls survives (the reference keeps only the
+        # prefix — ours deliberately preserves trailing text too, matching
+        # our hermes behavior and its streaming finish() contract).
+        return self._BLOCK.sub("", text).strip(), calls
+
+    def completed_calls(self, text, schemas=None):
+        calls, end = [], 0
+        for m in self._FUNC.finditer(text):
+            c = self._call_from(m, schemas)
+            if c:
+                calls.append(c)
             end = m.end()
         return calls, end
 
@@ -362,27 +433,42 @@ class StreamingToolCalls:
 _PARSERS = {
     "qwen": QwenToolParser,
     "hermes": QwenToolParser,
+    "qwen3.5": Qwen3XmlToolParser,
+    "qwen3_5": Qwen3XmlToolParser,
+    "qwen_xml": Qwen3XmlToolParser,
     "deepseek": DeepSeekToolParser,
     "kimi": KimiToolParser,
     "none": ToolParser,
 }
 
 
+def _is_qwen35(s: str) -> bool:
+    return "qwen3.5" in s or "qwen3_5" in s or "qwen3-5" in s
+
+
 def get_tool_parser(name: Optional[str] = None,
-                    model_name: str = "") -> ToolParser:
-    """Explicit name, or auto-detect from the model id
-    (reference api_server.py:543-575)."""
+                    model_name: str = "",
+                    architecture: str = "") -> ToolParser:
+    """Explicit name, or auto-detect from the model id / architecture
+    (reference api_server.py:543-575 + tool_parsers.py:616-623: Qwen3.5
+    switched from Hermes JSON to the ``<function=..>`` XML form, so the
+    qwen-family resolves on the architecture string)."""
     if name:
         if name not in _PARSERS:
             raise ValueError(f"unknown tool parser {name!r}; "
                              f"choices: {sorted(_PARSERS)}")
+        if name == "qwen" and _is_qwen35(architecture.lower()):
+            return Qwen3XmlToolParser()
         return _PARSERS[name]()
     m = model_name.lower()
-    if "qwen" in m:
+    arch = architecture.lower()
+    if _is_qwen35(m) or _is_qwen35(arch):
+        return Qwen3XmlToolParser()
+    if "qwen" in m or "qwen" in arch:
         return QwenToolParser()
-    if "deepseek" in m:
+    if "deepseek" in m or "deepseek" in arch:
         return DeepSeekToolParser()
-    if "kimi" in m:
+    if "kimi" in m or "kimi" in arch:
         return KimiToolParser()
     return ToolParser()
 

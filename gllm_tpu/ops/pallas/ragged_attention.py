@@ -211,8 +211,14 @@ def ragged_paged_attention(
     # default is overridable so benchmarks/kernel_tune.py --vmem-probe can
     # present oversized tiles to Mosaic and observe the REAL ceiling.
     import os
-    limit_b = float(os.environ.get("GLLM_TPU_VMEM_TILE_LIMIT_MB", "6")) \
-        * 1024 * 1024
+    try:
+        limit_mb = float(os.environ.get("GLLM_TPU_VMEM_TILE_LIMIT_MB", "6"))
+    except ValueError:
+        import warnings
+        warnings.warn("malformed GLLM_TPU_VMEM_TILE_LIMIT_MB; using 6",
+                      stacklevel=2)
+        limit_mb = 6.0
+    limit_b = limit_mb * 1024 * 1024
     bq = min(q_block, T)
     while num_q_heads * bq * kv_block * 4 > limit_b and bq > 16:
         bq //= 2

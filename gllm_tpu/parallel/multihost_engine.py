@@ -241,11 +241,18 @@ class BlobClient:
         self._parent = parent
 
     def serve_from_cache(self, key: str):
-        """Peer-server handler: bytes on LRU hit, b'' = not (yet) here."""
+        """Peer-server handler → (payload, header): bytes on LRU hit;
+        b'' with header "never" when the value was rejected as oversize
+        (a downstream fetcher should stop polling and go to host 0);
+        b'' with header None = not (yet) here."""
         cached = self._cache.get(key)
-        return cached if cached is not None else b""
+        if cached is not None:
+            return cached, None
+        if key in self._cache.oversize:
+            return b"", "never"
+        return b"", None
 
-    def _fetch_from(self, addr: str, key: str) -> bytes:
+    def _fetch_from(self, addr: str, key: str):
         from gllm_tpu.disagg.wire import connect, recv_msg, recv_raw, \
             send_msg
         sock = self._socks.get(addr)
@@ -253,8 +260,8 @@ class BlobClient:
             host, _, port = addr.rpartition(":")
             sock = self._socks[addr] = connect((host, int(port)))
         send_msg(sock, key)
-        recv_msg(sock)                        # header (None)
-        return recv_raw(sock)
+        hdr = recv_msg(sock)                  # None | "never"
+        return recv_raw(sock), hdr
 
     def fetch(self, key: str) -> bytes:
         cached = self._cache.get(key)
@@ -266,7 +273,7 @@ class BlobClient:
             delay = 0.005
             while time.monotonic() < deadline:
                 try:
-                    raw = self._fetch_from(self._parent, key)
+                    raw, hdr = self._fetch_from(self._parent, key)
                 except OSError:
                     self._socks.pop(self._parent, None)
                     break                      # parent gone → host 0
@@ -274,9 +281,11 @@ class BlobClient:
                     self.stats["peer"] += 1
                     self._cache.put(key, raw)
                     return raw
+                if hdr == "never":
+                    break  # parent can never serve it (oversize) → host 0
                 time.sleep(delay)
                 delay = min(delay * 2, 0.2)
-        raw = self._fetch_from(self._addr, key)
+        raw, _ = self._fetch_from(self._addr, key)
         if not raw:
             raise RuntimeError(f"blob {key} unavailable on host 0")
         self.stats["host0"] += 1
@@ -296,7 +305,8 @@ class PeerBlobServer:
         self.port = self._srv.port
 
     def _on_req(self, msg, sock):
-        self._send(sock, None, raw=self._client.serve_from_cache(msg))
+        raw, hdr = self._client.serve_from_cache(msg)
+        self._send(sock, hdr, raw=raw)
 
     def close(self) -> None:
         self._srv.stop()
@@ -565,13 +575,32 @@ class MultihostEngine:
             my_peer = None
             if not self.is_host0:
                 peer_srv = PeerBlobServer(self._blob_client)
+                # Advertise the IP of the interface that actually routes
+                # to host 0 (gethostbyname(hostname) commonly resolves to
+                # loopback in containers). A UDP connect performs no
+                # traffic but binds the socket to the outbound interface.
                 host0_ip = addr.rpartition(":")[0]
                 import socket as _s
                 try:
-                    my_ip = _s.gethostbyname(_s.gethostname())
+                    probe = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
+                    try:
+                        probe.connect((host0_ip, 1))
+                        my_ip = probe.getsockname()[0]
+                    finally:
+                        probe.close()
                 except OSError:
-                    my_ip = host0_ip
-                my_peer = f"{my_ip}:{peer_srv.port}"
+                    my_ip = None
+                # Loopback is only usable when host 0 itself is loopback
+                # (single-machine topology); across machines it would point
+                # the child at itself.
+                host0_local = (host0_ip == "localhost"
+                               or host0_ip.startswith("127."))
+                if my_ip and (host0_local
+                              or not my_ip.startswith("127.")):
+                    my_peer = f"{my_ip}:{peer_srv.port}"
+                # else: advertise None — children skip an unusable parent
+                # and keep host 0, instead of burning retries on a wrong
+                # endpoint.
             peers = allgather_payload(my_peer)
             p = jax.process_index()
             if p >= 2 and peers[p - 1]:

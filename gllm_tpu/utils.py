@@ -54,6 +54,11 @@ class LRUBytesCache:
         self._cur_bytes = 0
         self.hits = 0
         self.misses = 0
+        # Keys whose values exceeded max_bytes and were rejected by put():
+        # a peer serving this cache can answer "will never have" instead
+        # of letting downstream fetchers poll out their full deadline.
+        self.oversize = set()
+        self._oversize_capped = False
 
     @staticmethod
     def _size_of(value) -> int:
@@ -77,6 +82,22 @@ class LRUBytesCache:
     def put(self, key, value) -> None:
         sz = self._size_of(value)
         if sz > self.max_bytes:
+            with self._lock:
+                if key not in self.oversize:
+                    import logging
+                    log = logging.getLogger("gllm_tpu")
+                    if len(self.oversize) < 1024:
+                        self.oversize.add(key)
+                        log.warning(
+                            "LRUBytesCache: value for %r (%d B) exceeds "
+                            "max_bytes=%d — never cacheable", key, sz,
+                            self.max_bytes)
+                    elif not self._oversize_capped:
+                        self._oversize_capped = True
+                        log.warning(
+                            "LRUBytesCache: oversize-key set capped at "
+                            "1024 — further oversize keys lose the peer "
+                            "'never' fast-path")
             return
         with self._lock:
             if key in self._cache:
@@ -117,10 +138,17 @@ def enable_compilation_cache(cache_dir: str = None) -> str:
     else:
         os.makedirs(d, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", d)
-    for knob, val in (("jax_persistent_cache_min_entry_size_bytes", 0),
-                      ("jax_persistent_cache_min_compile_time_secs", 0)):
+    # Zero the skip thresholds, but only where they still hold jax's
+    # library defaults (0 bytes / 1.0 s): a pre-existing non-default value
+    # is a deliberate choice by an embedding application and is respected.
+    # A cache DIR configured via env expresses no opinion on thresholds,
+    # so the zeros still apply there.
+    for knob, default in (("jax_persistent_cache_min_entry_size_bytes", 0),
+                          ("jax_persistent_cache_min_compile_time_secs",
+                           1.0)):
         try:
-            jax.config.update(knob, val)
+            if getattr(jax.config, knob) == default:
+                jax.config.update(knob, 0)
         except Exception:  # pragma: no cover - knob renamed upstream
             pass
     return d

@@ -248,3 +248,31 @@ def test_serve_bench_summary_and_poisson(tmp_path, capsys, monkeypatch):
     # e2e spans the 4 staggered chunks; itl granularity depends on socket
     # buffering, so only the always-true distribution is asserted
     assert d["e2e_ms"]["p50"] > 0
+
+
+def test_bfcl_native_mode_qwen35_xml_chain():
+    """BFCL native mode over the Qwen3.5 XML markup: model output →
+    Qwen3XmlToolParser (schema coercion) → OpenAI message shape →
+    bfcl.parse_native_calls → AST scorer. Proves the whole native-mode
+    chain the reference exercises with its qwen3 parser
+    (tool_parsers.py:346-425)."""
+    from gllm_tpu.entrypoints.tool_parsers import (Qwen3XmlToolParser,
+                                                   schemas_from_tools)
+    tools = [{"type": "function", "function": {
+        "name": "get_weather", "parameters": {
+            "properties": {"city": {"type": "string"},
+                           "days": {"type": "integer"}}}}}]
+    model_out = ("<tool_call>\n<function=get_weather>\n"
+                 "<parameter=city>\nParis\n</parameter>\n"
+                 "<parameter=days>\n3\n</parameter>\n"
+                 "</function>\n</tool_call>")
+    _, calls = Qwen3XmlToolParser().parse(model_out,
+                                          schemas_from_tools(tools))
+    message = {"tool_calls": [c.to_openai() for c in calls]}
+    parsed = bfcl.parse_native_calls(message)
+    assert parsed == [("get_weather", {"city": "Paris", "days": 3})]
+    assert bfcl.score(
+        parsed,
+        [{"name": "get_weather",
+          "args": {"city": ["Paris"], "days": [3]},
+          "required": ["city", "days"]}], False) is True

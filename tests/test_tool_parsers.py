@@ -173,3 +173,115 @@ def test_streaming_trailing_content_after_calls_survives():
     got_text, deltas = _feed_chunks(s, text, n=6)
     assert [d["index"] for d in deltas] == [0, 0]
     assert "I called the tool for you." in got_text
+
+
+# ---- Qwen3.5 XML form (reference tool_parsers.py:346-425) -----------------
+
+_XML_CALL = ("Let me compute.\n<tool_call>\n<function=add>\n"
+             "<parameter=x>\n7\n</parameter>\n<parameter=note>\n"
+             "keep as text\n</parameter>\n</function>\n</tool_call>")
+_ADD_TOOLS = [{"type": "function", "function": {
+    "name": "add", "parameters": {
+        "properties": {"x": {"type": "integer"},
+                       "note": {"type": "string"}}}}}]
+
+
+def test_qwen3_xml_parse_with_schema_coercion():
+    from gllm_tpu.entrypoints.tool_parsers import Qwen3XmlToolParser
+    content, calls = Qwen3XmlToolParser().parse(
+        _XML_CALL, schemas_from_tools(_ADD_TOOLS))
+    assert content == "Let me compute."
+    assert len(calls) == 1 and calls[0].name == "add"
+    # int param coerced, string param stays a string (BFCL string
+    # categories break if values are json.loads'd unconditionally)
+    assert json.loads(calls[0].arguments) == {"x": 7,
+                                              "note": "keep as text"}
+
+
+def test_qwen3_xml_schemaless_values_stay_strings():
+    from gllm_tpu.entrypoints.tool_parsers import Qwen3XmlToolParser
+    _, calls = Qwen3XmlToolParser().parse(_XML_CALL)
+    assert json.loads(calls[0].arguments) == {"x": "7",
+                                              "note": "keep as text"}
+
+
+def test_qwen3_xml_multiple_calls_and_missing_closers():
+    """Dropped </parameter> and </tool_call> tags must not hide calls."""
+    from gllm_tpu.entrypoints.tool_parsers import Qwen3XmlToolParser
+    text = ("<tool_call>\n<function=a>\n<parameter=p>\nv1\n"
+            "<parameter=q>\nv2\n</function>\n"          # no </parameter>s
+            "<function=b>\n</function>")                 # no </tool_call>
+    content, calls = Qwen3XmlToolParser().parse(text)
+    assert content == ""
+    assert [c.name for c in calls] == ["a", "b"]
+    assert json.loads(calls[0].arguments) == {"p": "v1", "q": "v2"}
+    assert json.loads(calls[1].arguments) == {}
+
+
+def test_qwen3_xml_streaming_incremental():
+    from gllm_tpu.entrypoints.tool_parsers import (Qwen3XmlToolParser,
+                                                   StreamingToolCalls)
+    s = StreamingToolCalls(Qwen3XmlToolParser(),
+                           schemas_from_tools(_ADD_TOOLS))
+    got_text, deltas = _feed_chunks(s, _XML_CALL, n=6)
+    assert got_text.strip() == "Let me compute."
+    assert [d["index"] for d in deltas] == [0, 0]
+    assert deltas[0]["function"]["name"] == "add"
+    assert json.loads(deltas[1]["function"]["arguments"]) == \
+        {"x": 7, "note": "keep as text"}
+    assert s.saw_tool_calls
+
+
+def test_qwen3_xml_streaming_emits_before_tool_call_close():
+    """A call unit completes at </function>; the delta must not wait for
+    the trailing </tool_call> (which a length-capped stream never sends)."""
+    from gllm_tpu.entrypoints.tool_parsers import (Qwen3XmlToolParser,
+                                                   StreamingToolCalls)
+    s = StreamingToolCalls(Qwen3XmlToolParser())
+    _, d1 = s.feed("<tool_call>\n<function=go>\n</function>")
+    assert [d["index"] for d in d1] == [0, 0]
+    assert d1[0]["function"]["name"] == "go"
+    text, d2 = s.finish()
+    assert text == "" and d2 == []
+
+
+def test_qwen3_xml_autodetect_and_explicit_names():
+    from gllm_tpu.entrypoints.tool_parsers import Qwen3XmlToolParser
+    # by architecture (the hybrid checkpoints' id often lacks "3.5")
+    assert isinstance(
+        get_tool_parser(None, "some/checkpoint",
+                        architecture="Qwen3_5ForCausalLM"),
+        Qwen3XmlToolParser)
+    # qwen-family explicit name defers to the architecture (ref
+    # tool_parsers.py:616-623)
+    assert isinstance(
+        get_tool_parser("qwen", "", architecture="Qwen3_5MoeForCausalLM"),
+        Qwen3XmlToolParser)
+    # hermes still forces the JSON form even on a 3.5 arch
+    assert isinstance(
+        get_tool_parser("hermes", "", architecture="Qwen3_5ForCausalLM"),
+        QwenToolParser)
+    for name in ("qwen3.5", "qwen3_5", "qwen_xml"):
+        assert isinstance(get_tool_parser(name, ""), Qwen3XmlToolParser)
+    # older qwen stays hermes
+    assert isinstance(get_tool_parser(None, "Qwen/Qwen3-8B"),
+                      QwenToolParser)
+
+
+def test_qwen3_xml_prose_mentioning_markup_passes_through():
+    """Text that merely mentions '<function=' without a complete call must
+    not be truncated (regression: parse used to cut at the marker)."""
+    from gllm_tpu.entrypoints.tool_parsers import Qwen3XmlToolParser
+    text = "Use the syntax <function=name> like this, then stop."
+    content, calls = Qwen3XmlToolParser().parse(text)
+    assert calls == [] and content == text
+
+
+def test_qwen3_xml_trailing_and_interleaved_content_survives():
+    from gllm_tpu.entrypoints.tool_parsers import Qwen3XmlToolParser
+    text = ("before\n<tool_call>\n<function=a>\n</function>\n</tool_call>\n"
+            "middle <function=b>\n</function> after")
+    content, calls = Qwen3XmlToolParser().parse(text)
+    assert [c.name for c in calls] == ["a", "b"]
+    for piece in ("before", "middle", "after"):
+        assert piece in content, content
