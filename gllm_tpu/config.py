@@ -121,6 +121,12 @@ class EngineConfig:
     # attention when parallel.sp > 1; shorter chunks / mixed batches /
     # decode use the paged path with activations sharded over sp.
     sp_ring_threshold: int = 1024
+    # Bounds on the pixel count the multimodal processor resizes images /
+    # video frames into (reference --mm-processor-min/max-pixels,
+    # api_server.py:488-494 → encoder_engine.py:67-74). max_pixels is the
+    # operator lever that keeps large-image ViT inputs inside HBM.
+    mm_processor_min_pixels: Optional[int] = None
+    mm_processor_max_pixels: Optional[int] = None
     # Resolve a non-local model id via HF-hub snapshot download (file-lock
     # serialized, reference model_loader.py hub path). Off by default:
     # loads are local-path-only unless explicitly opted in.

@@ -64,7 +64,8 @@ class EncoderEngine:
     """Processor + vision tower + per-item embedding cache (reference
     encoder_engine.py:35-178)."""
 
-    def __init__(self, model_dir: str, dtype="float32"):
+    def __init__(self, model_dir: str, dtype="float32",
+                 min_pixels=None, max_pixels=None):
         import jax.numpy as jnp
 
         from gllm_tpu.models.config import from_hf_config
@@ -80,7 +81,8 @@ class EncoderEngine:
         self.params = self._load_visual(model_dir)
         from gllm_tpu.engine.mm_processing import load_image_processor
         self.processor = load_image_processor(
-            model_dir, self.model_cfg.vision_config or {})
+            model_dir, self.model_cfg.vision_config or {},
+            min_pixels=min_pixels, max_pixels=max_pixels)
         self._cache = LRUBytesCache()
         merge = (self.model_cfg.vision_config or {}).get(
             "spatial_merge_size", 2)

@@ -19,14 +19,21 @@ def detokenize_incrementally(
     token_ids: List[int],
     prefix_offset: int,
     read_offset: int,
+    end: int = None,
 ) -> Tuple[str, int, int]:
-    """Returns (new_text, new_prefix_offset, new_read_offset)."""
+    """Returns (new_text, new_prefix_offset, new_read_offset).
+
+    ``end`` bounds the token window (default: all of ``token_ids``) —
+    callers replaying a multi-token commit one token at a time pass it
+    instead of slicing the full list per token."""
+    if end is None:
+        end = len(token_ids)
     prefix_text = tokenizer.decode(token_ids[prefix_offset:read_offset],
                                    skip_special_tokens=False)
-    full_text = tokenizer.decode(token_ids[prefix_offset:],
+    full_text = tokenizer.decode(token_ids[prefix_offset:end],
                                  skip_special_tokens=False)
     if len(full_text) > len(prefix_text) and not full_text.endswith(
             REPLACEMENT):
         return (full_text[len(prefix_text):],
-                read_offset, len(token_ids))
+                read_offset, end)
     return "", prefix_offset, read_offset

@@ -140,19 +140,21 @@ class LLM:
                            for mm in self.memory_managers]
         self.scheduler = self.schedulers[0]
         if (config.spec_decode == "ngram"
-                and not config.overlap_scheduling
-                and not model_cfg.use_hybrid):
+                and not config.overlap_scheduling):
             # single runner, pp pipelines (the last stage verifies), and
             # dp replicas (per-replica verify in the stacked program);
-            # hybrid (GDN) excluded: the recurrent SSM state advances over
-            # draft rows and cannot rewind a rejected draft (paged KV can:
-            # the real token's KV overwrites the slot later)
+            # hybrid (GDN) speculates via snapshot-rollback: the pre-draft
+            # recurrent state is checkpointed into an SSM snapshot slot
+            # and restored on a partial acceptance, with the accepted
+            # tokens re-fed so the state re-advances over exactly the
+            # committed run (paged KV needs no rollback: the real token's
+            # KV overwrites the slot later)
             for s in self.schedulers:
                 s.spec_cfg = (config.spec_ngram, config.spec_k)
         elif config.spec_decode is not None:
             logger.warning(
-                "spec_decode=%s disabled for this topology (no overlap, "
-                "non-hybrid model required)", config.spec_decode)
+                "spec_decode=%s disabled for this topology (no overlap)",
+                config.spec_decode)
         self._rr = 0
         self._seq_replica: dict = {}
         self.eos_token_ids = frozenset(model_cfg.eos_token_ids)
@@ -237,10 +239,17 @@ class LLM:
         return self.tokenizer.encode(prompt)
 
     def add_seq(self, seq: Sequence) -> None:
-        """Admit a sequence, round-robining over DP replicas."""
+        """Admit a sequence: pinned to ``seq.target_dp`` when set
+        (per-DP-endpoint affinity keeps a conversation's prefix cache on
+        one replica, reference llm_engine.py:121-133), else round-robined
+        over DP replicas."""
         sp = seq.sampling_params
-        r = self._rr % self.dp
-        self._rr += 1
+        t = getattr(seq, "target_dp", None)
+        if t is not None and 0 <= t < self.dp:
+            r = t
+        else:
+            r = self._rr % self.dp
+            self._rr += 1
         self._seq_replica[seq.seq_id] = r
         self.schedulers[r].add_seq(seq)
 
@@ -320,6 +329,7 @@ class LLM:
             self._check_stop_strings(outs)
             return outs
         spec = aux.pop("spec", None) if aux else None
+        spec_lp = aux.pop("spec_lp", None) if aux else None
         if aux:
             # before process_output: ScheduledSeq.samples reads the seq's
             # CURRENT token count, which process_output advances
@@ -338,6 +348,7 @@ class LLM:
                     token_lists.append([int(tokens[i])])
             outs = self.scheduler.process_output_multi(
                 batch, token_lists, self.eos_token_ids)
+            self._record_spec_logprobs(batch, spec_lp, outs)
         else:
             outs = self.scheduler.process_output(batch, tokens.tolist(),
                                                  self.eos_token_ids)
@@ -390,6 +401,7 @@ class LLM:
             if b is None:
                 continue
             spec = aux.pop("spec", None) if aux else None
+            spec_lp = aux.pop("spec_lp", None) if aux else None
             if aux:
                 self._record_logprobs(b, aux)
             if spec is not None and b.has_drafts:
@@ -402,8 +414,10 @@ class LLM:
                             [int(t) for t in tok_mat[i, :a + 1]])
                     else:
                         token_lists.append([int(row[i])])
-                outs.extend(sched.process_output_multi(
-                    b, token_lists, self.eos_token_ids))
+                b_outs = sched.process_output_multi(
+                    b, token_lists, self.eos_token_ids)
+                self._record_spec_logprobs(b, spec_lp, b_outs)
+                outs.extend(b_outs)
             else:
                 outs.extend(sched.process_output(b, row.tolist(),
                                                  self.eos_token_ids))
@@ -418,6 +432,11 @@ class LLM:
             for i, it in enumerate(batch.items):
                 sp = it.seq.sampling_params
                 if not it.samples or sp.logprobs is None:
+                    continue
+                if it.draft_tokens:
+                    # speculative items commit tok_mat rows, not the
+                    # last-row sample this aux describes — their logprobs
+                    # come from the verify rows (_record_spec_logprobs)
                     continue
                 if it.seq.output_logprobs is None:
                     it.seq.output_logprobs = []
@@ -448,34 +467,122 @@ class LLM:
                             top_lps[row, :k].tolist())
                 off += rows
 
+    def _record_spec_logprobs(self, batch, spec_lp, outs) -> None:
+        """Logprobs for speculatively committed tokens, from the verify
+        rows' adjusted distributions (runner aux ``spec_lp``). Appended
+        AFTER process_output_multi so the count matches the tokens
+        actually emitted (a finish mid-run discards the rest)."""
+        if spec_lp is None:
+            return
+        chosen, top_ids, top_lps = spec_lp
+        emitted = {}
+        for out in outs:
+            if out.new_token_id is not None:
+                emitted[out.seq.seq_id] = emitted.get(out.seq.seq_id,
+                                                      0) + 1
+        for i, it in enumerate(batch.items):
+            sp = it.seq.sampling_params
+            if not it.draft_tokens or sp.logprobs is None:
+                continue
+            m = emitted.get(it.seq.seq_id, 0)
+            if it.seq.output_logprobs is None:
+                it.seq.output_logprobs = []
+            k = sp.logprobs
+            for j in range(m):
+                it.seq.output_logprobs.append(
+                    (float(chosen[i, j]), top_ids[i, j, :k].tolist(),
+                     top_lps[i, j, :k].tolist()))
+
     def _check_stop_strings(self, outs) -> None:
         """Host-side stop-string matching over the incrementally detokenized
         output; the response text is truncated BEFORE the match (OpenAI
         semantics, reference frontend stop handling). Only the tail window
         (new text plus len(stop)-1 overlap chars) is rescanned per step.
+
+        Multi-token commits (speculative decoding) replay this step's
+        tokens one at a time through the incremental detokenizer — exactly
+        the scan a sequence of single-token steps would have run — so the
+        match lands on the token that completed it: later tokens are
+        trimmed from the sequence (ids, computed count, logprobs) and
+        their SeqOutputs dropped, keeping streamed text AND usage
+        accounting identical to non-speculative stop handling.
         Finished seq ids also drop out of the DP routing table here."""
+        n_new: dict = {}
+        for out in outs:
+            if out.finish_reason is not None:
+                self._seq_replica.pop(out.seq.seq_id, None)
+            if out.new_token_id is not None:
+                sid = out.seq.seq_id
+                n_new[sid] = n_new.get(sid, 0) + 1
+        cuts: dict = {}
+        scanned_ids = set()
         for out in outs:
             seq = out.seq
             sp = seq.sampling_params
-            if out.finish_reason is not None:
-                self._seq_replica.pop(seq.seq_id, None)
-            if (out.new_token_id is None or out.finish_reason is not None
-                    or not sp.stop or self.tokenizer is None):
+            if (out.new_token_id is None or not sp.stop
+                    or self.tokenizer is None
+                    or seq.seq_id in scanned_ids):
                 continue
-            self._stream_detokenize(seq)
+            scanned_ids.add(seq.seq_id)
             max_stop = max(len(s) for s in sp.stop)
-            start = max(0, getattr(seq, "_stop_scanned", 0) - max_stop + 1)
-            window = seq.output_text[start:]
-            hit = min((start + idx for idx in (window.find(s)
-                                               for s in sp.stop)
-                       if idx >= 0), default=-1)
+            first = seq.num_tokens - n_new[seq.seq_id]
+            hit = -1
+            for j in range(first, seq.num_tokens):
+                text, seq.detok_prefix_offset, seq.detok_read_offset = (
+                    detokenize_incrementally(self.tokenizer,
+                                             seq.token_ids,
+                                             seq.detok_prefix_offset,
+                                             seq.detok_read_offset,
+                                             end=j + 1))
+                if not text:
+                    continue
+                seq.output_text += text
+                start = max(0, getattr(seq, "_stop_scanned", 0)
+                            - max_stop + 1)
+                window = seq.output_text[start:]
+                hit = min((start + idx for idx in (window.find(s)
+                                                   for s in sp.stop)
+                           if idx >= 0), default=-1)
+                seq._stop_scanned = len(seq.output_text)
+                if hit >= 0:
+                    cuts[seq.seq_id] = j + 1 - first
+                    break
+            if hit < 0:
+                continue
+            keep = first + cuts[seq.seq_id]
+            if keep < seq.num_tokens:
+                dropped = seq.num_tokens - keep
+                del seq.token_ids[keep:]
+                if seq.mm is not None:
+                    del seq.mm.hash_token_ids[
+                        len(seq.mm.hash_token_ids) - dropped:]
+                seq._pt_np = None
+                seq.num_computed_tokens = min(seq.num_computed_tokens,
+                                              keep)
+                if seq.output_logprobs is not None:
+                    del seq.output_logprobs[keep - seq.prompt_len:]
+            seq.output_text = seq.output_text[:hit]
+            # stop any further (re-)detokenization of trimmed state
+            seq.detok_read_offset = seq.num_tokens
+            seq.detok_prefix_offset = min(seq.detok_prefix_offset,
+                                          seq.num_tokens)
             seq._stop_scanned = len(seq.output_text)
-            if hit >= 0:
-                seq.output_text = seq.output_text[:hit]
-                seq.detok_read_offset = seq.num_tokens  # stop re-detok
-                r = self._seq_replica.pop(seq.seq_id, 0)
-                self.schedulers[r].finish_seq(seq, "stop")
-                out.finish_reason = "stop"
+            r = self._seq_replica.pop(seq.seq_id, 0)
+            self.schedulers[r].finish_seq(seq, "stop")
+            seq.finish_reason = "stop"
+        if cuts:
+            kept, cnt = [], {}
+            for out in outs:
+                sid = out.seq.seq_id
+                if sid in cuts and out.new_token_id is not None:
+                    c = cnt.get(sid, 0)
+                    if c >= cuts[sid]:
+                        continue               # past-match token: drop
+                    cnt[sid] = c + 1
+                    out.finish_reason = ("stop" if cnt[sid] == cuts[sid]
+                                         else None)
+                kept.append(out)
+            outs[:] = kept
 
     def generate(
         self,
@@ -579,8 +686,13 @@ class LLM:
         """Lazy HF processor for multimodal chat templates + pixels."""
         if getattr(self, "_processor", None) is None:
             from transformers import AutoProcessor
-            self._processor = AutoProcessor.from_pretrained(
-                self.config.model, local_files_only=True)
+
+            from gllm_tpu.engine.mm_processing import apply_pixel_bounds
+            self._processor = apply_pixel_bounds(
+                AutoProcessor.from_pretrained(
+                    self.config.model, local_files_only=True),
+                self.config.mm_processor_min_pixels,
+                self.config.mm_processor_max_pixels)
         return self._processor
 
     def process_mm_messages(self, messages: List[dict], **kwargs):

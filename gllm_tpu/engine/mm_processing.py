@@ -19,9 +19,14 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
-def processor_config_hash(model_dir: str) -> str:
+def processor_config_hash(model_dir: str, min_pixels=None,
+                          max_pixels=None) -> str:
     """Digest of the checkpoint's processor configs — encoder and LM must
-    agree on preprocessing for disagg (reference mm_common.py:23-58)."""
+    agree on preprocessing for disagg (reference mm_common.py:23-58).
+    Runtime pixel-bound overrides change the effective preprocessing, so
+    they are folded into the digest: an encoder capped with
+    --mm-processor-max-pixels and an uncapped LM frontend must NOT pass
+    the agreement check (their placeholder grids would disagree)."""
     import hashlib
     import os
     h = hashlib.sha256()
@@ -32,6 +37,8 @@ def processor_config_hash(model_dir: str) -> str:
             with open(path, "rb") as f:
                 h.update(fname.encode())
                 h.update(f.read())
+    if min_pixels is not None or max_pixels is not None:
+        h.update(f"pixel_bounds:{min_pixels}:{max_pixels}".encode())
     return h.hexdigest()[:16]
 
 
@@ -51,18 +58,42 @@ def extract_mm_items(messages: List[dict]) -> List[Tuple[str, object]]:
     return items
 
 
-def load_image_processor(model_dir: str, vision_config: Dict):
+def apply_pixel_bounds(processor, min_pixels=None, max_pixels=None):
+    """Clamp the pixel budget of an HF (image/video) processor in place
+    (reference --mm-processor-min/max-pixels, encoder_engine.py:67-74):
+    the smart-resize logic reads ``min_pixels``/``max_pixels`` (newer
+    processors read ``size['shortest_edge'/'longest_edge']`` instead, so
+    both spellings are set). Accepts an AutoProcessor (bounds applied to
+    its image and video sub-processors) or a bare image processor."""
+    subs = [s for s in (getattr(processor, "image_processor", None),
+                        getattr(processor, "video_processor", None))
+            if s is not None] or [processor]
+    for sub in subs:
+        if min_pixels is not None:
+            sub.min_pixels = min_pixels
+            if isinstance(getattr(sub, "size", None), dict):
+                sub.size["shortest_edge"] = min_pixels
+        if max_pixels is not None:
+            sub.max_pixels = max_pixels
+            if isinstance(getattr(sub, "size", None), dict):
+                sub.size["longest_edge"] = max_pixels
+    return processor
+
+
+def load_image_processor(model_dir: str, vision_config: Dict,
+                         min_pixels=None, max_pixels=None):
     """The checkpoint's image processor, or a config-derived default."""
     from transformers.models.qwen2_vl.image_processing_qwen2_vl import (
         Qwen2VLImageProcessor)
     try:
-        return Qwen2VLImageProcessor.from_pretrained(
+        proc = Qwen2VLImageProcessor.from_pretrained(
             model_dir, local_files_only=True)
     except Exception:
-        return Qwen2VLImageProcessor(
+        proc = Qwen2VLImageProcessor(
             patch_size=vision_config.get("patch_size", 14),
             temporal_patch_size=vision_config.get("temporal_patch_size", 2),
             merge_size=vision_config.get("spatial_merge_size", 2))
+    return apply_pixel_bounds(proc, min_pixels, max_pixels)
 
 
 def encode_mm_fallback(tokenizer, image_processor, messages: List[dict],
@@ -139,6 +170,8 @@ def encode_mm_messages(llm, messages: List[dict], **kwargs):
         raise ValueError("multimodal chat requires a tokenizer")
     if getattr(llm, "_mm_image_processor", None) is None:
         llm._mm_image_processor = load_image_processor(
-            llm.config.model, llm.model_cfg.vision_config or {})
+            llm.config.model, llm.model_cfg.vision_config or {},
+            min_pixels=llm.config.mm_processor_min_pixels,
+            max_pixels=llm.config.mm_processor_max_pixels)
     return encode_mm_fallback(llm.tokenizer, llm._mm_image_processor,
                               messages, llm.model_cfg, **kwargs)

@@ -113,7 +113,8 @@ class ServingEngine:
     def submit(self, token_ids: List[int],
                sampling_params: SamplingParams,
                mm_input: Optional[dict] = None,
-               disagg_items: Optional[list] = None) -> RequestHandle:
+               disagg_items: Optional[list] = None,
+               target_dp: Optional[int] = None) -> RequestHandle:
         sampling_params.validate()
         mm_state = None
         if mm_input:
@@ -126,6 +127,12 @@ class ServingEngine:
         with self._lock:
             seq = self.llm._allocate_seq(token_ids, sampling_params)
             seq.mm = mm_state
+            if target_dp is not None:
+                # per-DP-endpoint pinning (reference --endpoint-per-dp,
+                # llm_engine.py:121-133 + sequence.py:79-83): the endpoint
+                # that received the request pins its KV/prefix-cache to
+                # that replica
+                seq.target_dp = target_dp
             if disagg_items is not None:
                 # skeleton request → coordinator (gate A admits it later)
                 seq._disagg_items = disagg_items

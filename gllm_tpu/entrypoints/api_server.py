@@ -35,11 +35,15 @@ logger = logging.getLogger(__name__)
 
 class ServerState:
     def __init__(self, llm: LLM, served_model: str,
-                 tool_parser: Optional[str] = None, engine=None):
+                 tool_parser: Optional[str] = None, engine=None,
+                 pin_dp: Optional[int] = None):
         from gllm_tpu.entrypoints.tool_parsers import get_tool_parser
         self.llm = llm
         self.engine = engine if engine is not None else ServingEngine(llm)
         self.served_model = served_model
+        # per-DP-replica endpoint: every request this state admits is
+        # pinned to replica ``pin_dp`` (reference --endpoint-per-dp)
+        self.pin_dp = pin_dp
         self.start_time = time.time()
         self._profiling = False
         self.tool_parser = get_tool_parser(
@@ -256,7 +260,8 @@ class Handler(BaseHTTPRequestHandler):
                     sp.logprobs = 0      # chosen-logprob only, for ranking
                 handles.append(st.engine.submit(list(ids), sp,
                                                 mm_input=mm_input,
-                                                disagg_items=disagg_items))
+                                                disagg_items=disagg_items,
+                                                target_dp=st.pin_dp))
         except Exception:
             # partial submit must not leak running sequences: abort the
             # choices already admitted before re-raising
@@ -403,7 +408,8 @@ class Handler(BaseHTTPRequestHandler):
             return
         handle = st.engine.submit(list(ids), req.sampling,
                                   mm_input=mm_input,
-                                  disagg_items=disagg_items)
+                                  disagg_items=disagg_items,
+                                  target_dp=st.pin_dp)
         if req.stream and parse_tools:
             # Incremental tool streaming (reference streams tool deltas):
             # text deltas flow through live; only potential-markup suffixes
@@ -469,7 +475,8 @@ class Handler(BaseHTTPRequestHandler):
                                                    text or "", fin,
                                                    index=i))
                 return
-            handle = st.engine.submit(ids, req.sampling)
+            handle = st.engine.submit(ids, req.sampling,
+                                      target_dp=st.pin_dp)
             if not self._sse_open([handle]):
                 return
             self._stream(handle, lambda text, fin: proto.completion_chunk(
@@ -572,6 +579,8 @@ def build_engine_config(args) -> EngineConfig:
         spec_ngram=args.spec_ngram,
         quantization=args.quantization,
         sp_ring_threshold=args.sp_ring_threshold,
+        mm_processor_min_pixels=args.mm_processor_min_pixels,
+        mm_processor_max_pixels=args.mm_processor_max_pixels,
         scheduler=SchedulerConfig(
             schedule_method=args.schedule_method,
             max_decode_seqs=args.maxd,
@@ -638,6 +647,22 @@ def make_parser() -> argparse.ArgumentParser:
                         "requests only; byte-identical outputs)")
     p.add_argument("--spec-k", type=int, default=4)
     p.add_argument("--spec-ngram", type=int, default=2)
+    p.add_argument("--mm-processor-min-pixels", type=int, default=None,
+                   help="lower bound on image/video resolution fed to the "
+                        "multimodal processor (reference "
+                        "api_server.py:488-494)")
+    p.add_argument("--mm-processor-max-pixels", type=int, default=None,
+                   help="upper bound on image/video resolution — the "
+                        "lever that keeps large-image workloads inside "
+                        "HBM")
+    p.add_argument("--endpoint-per-dp", action="store_true",
+                   help="one HTTP listener per DP replica, each pinning "
+                        "its requests to that replica (session affinity "
+                        "keeps a conversation's prefix cache on one "
+                        "replica; reference --endpoint-per-dp)")
+    p.add_argument("--endpoint-per-dp-ports", default=None,
+                   help="comma-separated ports, one per replica in "
+                        "DP-rank order (default: port, port+1, ...)")
     p.add_argument("--tool-call-parser", default=None,
                    choices=["qwen", "hermes", "deepseek", "none"],
                    help="tool-call markup parser (default: auto-detect "
@@ -670,13 +695,32 @@ def make_parser() -> argparse.ArgumentParser:
 
 def serve(llm: LLM, host: str, port: int,
           served_model: Optional[str] = None,
-          tool_parser: Optional[str] = None) -> ThreadingHTTPServer:
+          tool_parser: Optional[str] = None,
+          pin_dp: Optional[int] = None,
+          engine=None) -> ThreadingHTTPServer:
     """Build the HTTP server (caller decides foreground vs thread)."""
-    state = ServerState(llm, served_model or llm.config.model, tool_parser)
+    state = ServerState(llm, served_model or llm.config.model, tool_parser,
+                        engine=engine, pin_dp=pin_dp)
     handler = type("BoundHandler", (Handler,), {"state": state})
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.state = state
     return httpd
+
+
+def serve_per_dp(llm: LLM, host: str, ports: List[int],
+                 served_model: Optional[str] = None,
+                 tool_parser: Optional[str] = None
+                 ) -> List[ThreadingHTTPServer]:
+    """One HTTP listener per DP replica, all sharing ONE engine: listener
+    d pins its requests to replica d, so a client holding a conversation
+    on one endpoint keeps its prefix cache (and KV) on one replica
+    (reference --endpoint-per-dp, api_server.py run_server +
+    llm_engine.py:121-133 pinning)."""
+    assert len(ports) == llm.dp, (len(ports), llm.dp)
+    engine = ServingEngine(llm)
+    return [serve(llm, host, p, served_model, tool_parser,
+                  pin_dp=d, engine=engine)
+            for d, p in enumerate(ports)]
 
 
 def main(argv=None):
@@ -728,6 +772,36 @@ def main(argv=None):
         handler = type("BoundHandler", (Handler,), {"state": state})
         httpd = ThreadingHTTPServer((args.host, args.port), handler)
         httpd.state = state
+    elif args.endpoint_per_dp and args.dp > 1:
+        if args.endpoint_per_dp_ports:
+            ports = [int(p) for p in
+                     args.endpoint_per_dp_ports.split(",") if p]
+            if len(ports) != args.dp:
+                raise SystemExit(
+                    f"--endpoint-per-dp-ports has {len(ports)} ports "
+                    f"but dp={args.dp}")
+        else:
+            ports = [args.port + d for d in range(args.dp)]
+        servers = serve_per_dp(llm, args.host, ports,
+                               args.served_model_name or args.model,
+                               tool_parser=args.tool_call_parser)
+        logger.info("DP per-replica endpoints: %s",
+                    ", ".join(f"dp{d}->:{p}"
+                              for d, p in enumerate(ports)))
+        import threading
+        threads = [threading.Thread(target=s.serve_forever, daemon=True)
+                   for s in servers[1:]]
+        for t in threads:
+            t.start()
+        try:
+            servers[0].serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            for s in servers[1:]:
+                s.shutdown()
+            servers[0].state.engine.shutdown()
+        return
     else:
         httpd = serve(llm, args.host, args.port,
                       args.served_model_name or args.model,

@@ -22,6 +22,10 @@ def make_parser():
     p.add_argument("--port", type=int, default=0,
                    help="job-server port (0 = ephemeral)")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--mm-processor-min-pixels", type=int, default=None)
+    p.add_argument("--mm-processor-max-pixels", type=int, default=None,
+                   help="pixel bounds for the image/video processor "
+                        "(reference api_server.py:488-494)")
     return p
 
 
@@ -30,11 +34,15 @@ def main(argv=None):
     args = make_parser().parse_args(argv)
     from gllm_tpu.disagg.encoder_runtime import EncoderEngine, EncoderRuntime
     from gllm_tpu.engine.mm_processing import processor_config_hash
-    engine = EncoderEngine(args.model, dtype=args.dtype)
+    engine = EncoderEngine(args.model, dtype=args.dtype,
+                           min_pixels=args.mm_processor_min_pixels,
+                           max_pixels=args.mm_processor_max_pixels)
     runtime = EncoderRuntime(
         engine, args.discovery_endpoint, encoder_id=args.encoder_id,
         advertise_host=args.advertise_host,
-        processor_config_hash=processor_config_hash(args.model),
+        processor_config_hash=processor_config_hash(
+            args.model, min_pixels=args.mm_processor_min_pixels,
+            max_pixels=args.mm_processor_max_pixels),
         port=args.port)
     logger.info("encoder %s serving %s (port %d)", args.encoder_id,
                 args.model, runtime.port)

@@ -100,7 +100,9 @@ def _init_disagg(llm, args) -> None:
         is_lm=True, skip_visual=True,
         discovery_endpoint=args.discovery_endpoint,
         lm_id=args.lm_id,
-        processor_config_hash=processor_config_hash(args.model),
+        processor_config_hash=processor_config_hash(
+            args.model, min_pixels=args.mm_processor_min_pixels,
+            max_pixels=args.mm_processor_max_pixels),
         advertise_host=args.advertise_host,
         num_slots=args.num_slots,
         max_vis_tokens=args.max_vis_tokens,

@@ -180,8 +180,9 @@ class Qwen3XmlToolParser(ToolParser):
                         arguments=json.dumps(args, ensure_ascii=False))
 
     _BLOCK = re.compile(r"<tool_call>\s*(?:<function=.*?</function>\s*)*"
-                        r"(?:</tool_call>)?|<function=.*?</function>",
-                        re.DOTALL)
+                        r"(?:</tool_call>)?|<function=.*?</function>"
+                        r"|</tool_call>",   # orphaned closer (interleaved
+                        re.DOTALL)          # text split it from its opener)
 
     def parse(self, text, schemas=None):
         calls = [c for c in (self._call_from(m, schemas)

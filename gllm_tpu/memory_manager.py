@@ -83,6 +83,7 @@ class MemoryManager:
         # ("snapshot", work, snap) | ("zero", slot, 0) | ("restore", snap,
         # work) — drained by the runner, applied snapshot→zero→restore.
         self.ssm_intents: List[Tuple[str, int, int]] = []
+        self._snap_free_pending: List[int] = []
 
     # ---- SSM slots (hybrid models) ----------------------------------------
 
@@ -109,12 +110,30 @@ class MemoryManager:
     def _free_ssm(self, seq: Sequence) -> None:
         slot = getattr(seq, "ssm_slot", None)
         if slot is not None:
+            # Drop pending restores INTO this slot (e.g. a spec-decode
+            # rollback for a seq preempted before the drain): the slot may
+            # be reallocated before the intents apply, and restores run
+            # AFTER zeros — a stale one would clobber the new tenant.
+            self.ssm_intents = [t for t in self.ssm_intents
+                                if not (t[0] == "restore"
+                                        and t[2] == slot)]
             self.ssm_intents.append(("zero", slot, 0))
             self.ssm_alloc.free(slot)
             seq.ssm_slot = None
 
+    def free_snap_after_drain(self, snap: int) -> None:
+        """Return a snapshot slot to the pool only once the currently
+        pending intents have been drained. A pending ``restore`` may still
+        read the slot; an immediate free could let a NEW ``snapshot``
+        claim it in the same drain batch — and snapshots apply BEFORE
+        restores, so the restore would read the new tenant's state."""
+        self._snap_free_pending.append(snap)
+
     def drain_ssm_intents(self) -> List[Tuple[str, int, int]]:
         out, self.ssm_intents = self.ssm_intents, []
+        pend, self._snap_free_pending = self._snap_free_pending, []
+        for snap in pend:
+            self.ssm_snap_alloc.free(snap)
         return out
 
     # ---- stats ------------------------------------------------------------

@@ -191,6 +191,55 @@ def _row_base_keys(md: "SamplingMetadata", S: int):
     return jax.random.wrap_key_data(key_data)
 
 
+def spec_adjust_logits(logits_mat: jnp.ndarray, drafts: jnp.ndarray,
+                       md: "SamplingMetadata",
+                       token_counts=None) -> jnp.ndarray:
+    """Per-verify-row logit adjustments for speculative decoding.
+
+    Verify row i of seq s scores the token that would follow
+    ``committed_tokens + drafts[:i]`` — so penalties must see the base
+    occurrence counts PLUS the draft prefix of that row (computed on
+    device: the draft one-hots are exclusive-cumsummed along the row
+    axis), while logit_bias is position-independent and simply repeats
+    per row. With both applied here, spec_verify's accept/argmax math
+    runs on exactly the distribution the non-speculative path samples
+    from (reference applies the same sampler adjustments to its verify
+    logits via its unified sampler; we share adjust_logits for the same
+    reason). No-op when the batch carries neither penalties nor bias."""
+    if token_counts is None and md.bias_ids is None:
+        return logits_mat
+    S, K1, V = logits_mat.shape
+    K = K1 - 1
+    rep = lambda a: (None if a is None                      # noqa: E731
+                     else jnp.repeat(a, K1, axis=0))
+    md_rep = md._replace(
+        repetition_penalty=rep(md.repetition_penalty),
+        presence_penalty=rep(md.presence_penalty),
+        frequency_penalty=rep(md.frequency_penalty),
+        bias_ids=rep(md.bias_ids), bias_vals=rep(md.bias_vals))
+    counts_flat = None
+    if token_counts is not None:
+        base = (_counts_from_tokens(token_counts, V)
+                if isinstance(token_counts, PenaltyTokens)
+                else token_counts)                          # [S, V]
+        # int8 keeps the [S, K, V] intermediates 4x smaller than the
+        # verify logits they sit next to (counts per draft run <= K < 127)
+        d_safe = jnp.maximum(drafts, 0)
+        live = (drafts >= 0).astype(jnp.int8)
+        dhot = jnp.zeros((S, K, V), jnp.int8).at[
+            jnp.arange(S)[:, None], jnp.arange(K)[None, :],
+            d_safe].add(live)
+        # row i sees drafts[:i]: exclusive cumsum, then the bonus row
+        # (i = K) sees all K drafts
+        dcum = jnp.cumsum(dhot, axis=1)
+        dpfx = jnp.concatenate(
+            [jnp.zeros((S, 1, V), jnp.int8), dcum], axis=1)  # [S, K1, V]
+        counts_flat = (base[:, None, :]
+                       + dpfx.astype(jnp.int32)).reshape(S * K1, V)
+    return adjust_logits(logits_mat.reshape(S * K1, V).astype(jnp.float32),
+                         counts_flat, md_rep).reshape(S, K1, V)
+
+
 def spec_verify(logits_mat: jnp.ndarray, drafts: jnp.ndarray,
                 md: "SamplingMetadata", sampled: bool = True):
     """Verify speculative drafts against the target model's logits.

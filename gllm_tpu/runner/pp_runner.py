@@ -165,7 +165,8 @@ class PPModelRunner(ModelRunner):
             self.ssm_working_slots = config.max_num_seqs
             self.ssm_snapshot_slots = (
                 config.cache.ssm_snapshot_slots
-                if config.cache.enable_prefix_caching else 0)
+                if (config.cache.enable_prefix_caching
+                    or config.spec_decode) else 0)
         else:
             period = 1
             self.ssm_working_slots = self.ssm_snapshot_slots = 0
@@ -398,18 +399,11 @@ class PPModelRunner(ModelRunner):
                                                   max(logprobs_k, 1))
                 if batch.spec_rows is not None:
                     # speculative verify on the LAST stage — same math as
-                    # the single runner (runner.py step): project only the
-                    # gathered verify rows (greedy argmax acceptance or
-                    # rejection sampling, ops/sampling.py spec_verify)
-                    from gllm_tpu.models.dense import compute_full_logits
-                    from gllm_tpu.ops.sampling import spec_verify
-                    rows = batch.spec_rows.reshape(-1)
-                    sl = compute_full_logits(params, hidden[rows],
-                                             residual[rows], scfg)
-                    aux["spec"] = spec_verify(
-                        sl.reshape(batch.spec_rows.shape + sl.shape[-1:]),
-                        batch.spec_drafts, batch.sampling,
-                        sampled=spec_sampled)
+                    # the single runner (runner.py spec_aux)
+                    from gllm_tpu.runner.runner import spec_aux
+                    aux.update(spec_aux(params, hidden, residual, batch,
+                                        scfg, token_counts, logprobs_k,
+                                        spec_sampled))
                 return (tokens, aux), kv
             return (hidden, residual), kv
 

@@ -128,6 +128,34 @@ def pick_kv_pack(cfg: ModelConfig, tp_sharded: bool) -> int:
     return pack
 
 
+def spec_aux(params, hidden, residual, batch, cfg, token_counts,
+             logprobs_k: int, spec_sampled: bool) -> dict:
+    """Speculative-verify aux entries, shared by the single-runner step,
+    the DP per-replica body, and the PP last stage: gather only the verify
+    rows (a full [T, V] logits materialization per decode step would cost
+    hundreds of MB of HBM at large vocab), adjust for penalties/bias with
+    draft-prefix counts, verify (greedy argmax acceptance or rejection
+    sampling), and emit logprobs for the committed run when requested."""
+    from gllm_tpu.models.dense import compute_full_logits
+    from gllm_tpu.ops.sampling import (compute_logprobs,
+                                       spec_adjust_logits, spec_verify)
+    rows = batch.spec_rows.reshape(-1)              # [S*(k+1)]
+    sl = compute_full_logits(params, hidden[rows], residual[rows], cfg)
+    sl3 = spec_adjust_logits(
+        sl.reshape(batch.spec_rows.shape + sl.shape[-1:]),
+        batch.spec_drafts, batch.sampling, token_counts)
+    aux = {"spec": spec_verify(sl3, batch.spec_drafts, batch.sampling,
+                               sampled=spec_sampled)}
+    if logprobs_k >= 0:
+        Sk, K1k = batch.spec_rows.shape
+        slp = compute_logprobs(sl3.reshape(Sk * K1k, -1),
+                               aux["spec"][0].reshape(-1),
+                               max(logprobs_k, 1))
+        aux["spec_lp"] = tuple(x.reshape((Sk, K1k) + x.shape[1:])
+                               for x in slp)
+    return aux
+
+
 def _spec_sampled(items) -> bool:
     """Any draft row in this batch samples (temperature > 0)? Trace-time
     flag for spec_verify: the all-greedy case keeps the argmax-only
@@ -232,9 +260,13 @@ class ModelRunner:
         if model_cfg.use_hybrid:
             # slot 0 dummy + one working slot per live seq + snapshot range
             self.ssm_working_slots = config.max_num_seqs
+            # snapshot pool serves prefix-cache boundary states AND
+            # speculative-decoding pre-draft checkpoints (restored on
+            # rejection)
             self.ssm_snapshot_slots = (
                 config.cache.ssm_snapshot_slots
-                if config.cache.enable_prefix_caching else 0)
+                if (config.cache.enable_prefix_caching
+                    or config.spec_decode) else 0)
         else:
             self.ssm_working_slots = self.ssm_snapshot_slots = 0
         self.num_pages = (config.cache.num_pages
@@ -437,23 +469,9 @@ class ModelRunner:
             aux = lp_aux(params, cfg, logits, tokens, hidden, residual,
                          batch, token_counts, logprobs_k, prompt_lp)
             if batch.spec_rows is not None:
-                # Speculative verify: gather hidden/residual at the verify
-                # rows FIRST (S·(k+1) rows), then project only those — a
-                # full [T, V] logits materialization per decode step would
-                # cost hundreds of MB of HBM at large vocab. Greedy rows
-                # accept by argmax equality (byte-identical to plain
-                # greedy); sampled rows use rejection sampling against the
-                # deterministic prompt-lookup proposal (ops/sampling.py
-                # spec_verify).
-                from gllm_tpu.models.dense import compute_full_logits
-                from gllm_tpu.ops.sampling import spec_verify
-                rows = batch.spec_rows.reshape(-1)          # [S*(k+1)]
-                sl = compute_full_logits(params, hidden[rows],
-                                         residual[rows], cfg)
-                aux["spec"] = spec_verify(
-                    sl.reshape(batch.spec_rows.shape + sl.shape[-1:]),
-                    batch.spec_drafts, batch.sampling,
-                    sampled=spec_sampled)
+                aux.update(spec_aux(params, hidden, residual, batch, cfg,
+                                    token_counts, logprobs_k,
+                                    spec_sampled))
             return tokens, kv, aux
 
         if self.dp > 1:
@@ -479,16 +497,9 @@ class ModelRunner:
                 if batch_r.spec_rows is not None:
                     # per-replica speculative verify (same math as the
                     # single-runner step)
-                    from gllm_tpu.models.dense import compute_full_logits
-                    from gllm_tpu.ops.sampling import spec_verify
-                    rows = batch_r.spec_rows.reshape(-1)
-                    sl = compute_full_logits(params, hidden[rows],
-                                             residual[rows], cfg_dp)
-                    aux["spec"] = spec_verify(
-                        sl.reshape(batch_r.spec_rows.shape
-                                   + sl.shape[-1:]),
-                        batch_r.spec_drafts, batch_r.sampling,
-                        sampled=spec_sampled)
+                    aux.update(spec_aux(params, hidden, residual, batch_r,
+                                        cfg_dp, counts_r, logprobs_k,
+                                        spec_sampled))
                 return tokens, kv_r, aux
 
             @functools.partial(jax.jit,
@@ -533,6 +544,8 @@ class ModelRunner:
                     aux_spec["plp"] = (P(AXIS_DP),) * 3
                 if batch.spec_rows is not None:
                     aux_spec["spec"] = (P(AXIS_DP),) * 2
+                    if logprobs_k >= 0:
+                        aux_spec["spec_lp"] = (P(AXIS_DP),) * 3
 
                 def body(kv_s, batch_s, counts_s, params_s, cos_s):
                     sq = lambda t: jax.tree.map(lambda x: x[0], t)

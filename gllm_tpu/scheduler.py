@@ -299,6 +299,12 @@ class Scheduler:
                 # under memory pressure speculation must never COST a seq
                 # its KV: drop the drafts before reaching for preemption
                 drafts = ()
+            if drafts and self.mm.use_ssm and (
+                    self.mm.ssm_snap_alloc is None
+                    or self.mm.ssm_snap_alloc.num_free == 0):
+                # hybrid needs a free snapshot slot to checkpoint the
+                # pre-draft recurrent state; without one, don't speculate
+                drafts = ()
             if not self._allocate_with_preemption(seq, 1 + len(drafts),
                                                   protect):
                 protect.discard(seq.seq_id)
@@ -313,6 +319,14 @@ class Scheduler:
                     self.num_preemptions += 1
                     self.new_token_ratio = self.sched_cfg.init_new_token_ratio
                 continue
+            if drafts and self.mm.use_ssm:
+                # checkpoint the pre-draft SSM state (the snapshot intent
+                # drains before this step's forward runs); restored +
+                # re-fed on a partial acceptance (process_output_multi)
+                snap = self.mm.ssm_snap_alloc.allocate()
+                self.mm.ssm_intents.append(("snapshot", seq.ssm_slot,
+                                            snap))
+                seq._spec_ssm_snap = snap
             items.append(ScheduledSeq(seq, 1, seq.num_computed_tokens,
                                       draft_tokens=drafts))
 
@@ -321,20 +335,23 @@ class Scheduler:
         requests verify by argmax equality (byte-identical); sampled
         requests (temperature > 0) verify by rejection sampling against
         the one-hot proposal (ops/sampling.py spec_verify) — the
-        distribution is preserved exactly. Penalties / logit_bias /
-        logprobs are excluded (the verify rows see raw logits), as are
-        stop STRINGS (must be checked between tokens — a committed draft
-        run would stream past the match, same rule as the fused
-        multi-step gate)."""
+        distribution is preserved exactly. Penalties / logit_bias ride
+        the verify rows via on-device draft-prefix counts
+        (ops/sampling.py spec_adjust_logits); logprobs for the committed
+        run come from the verify distributions (aux spec_lp). Stop
+        STRINGS stay eligible with a capped draft length: the engine's
+        stop scan truncates the streamed text exactly at the match and
+        trims over-committed tokens, so a draft run can overshoot by at
+        most the (small) cap without the client ever seeing past the
+        match."""
         if self.spec_cfg is None:
             return ()
         sp = seq.sampling_params
-        if (sp.logprobs is not None
-                or sp.presence_penalty != 0 or sp.frequency_penalty != 0
-                or sp.repetition_penalty != 1.0 or sp.stop
-                or sp.logit_bias):
-            return ()
         n, k = self.spec_cfg
+        if sp.stop:
+            # bound wasted verify rows past a potential match; AIMD below
+            # shrinks it further on rejection streaks
+            k = min(k, 2)
         # acceptance-adaptive draft length (VERDICT r03 weak #4): each
         # seq's k follows its own acceptance history — grow by one on a
         # fully-accepted run, drop to the accepted length otherwise, so
@@ -352,8 +369,12 @@ class Scheduler:
         so the GDN state at chunk end can be snapshotted for that page
         (prefix caching restores state only at boundaries it has — see
         PrefixMemoryManager.register_computed_pages)."""
-        if getattr(self.mm, "ssm_snap_alloc", None) is None:
-            return n   # no snapshot pool → aligning would only waste steps
+        if (getattr(self.mm, "ssm_snap_alloc", None) is None
+                or getattr(self.mm, "page2snap", None) is None):
+            # no snapshot pool, or no PREFIX-CACHE page snapshots (the
+            # pool may exist only for spec-decode rollback checkpoints) →
+            # aligning chunks at page boundaries would only waste steps
+            return n
         page = self.mm.page_size
         end = seq.num_computed_tokens + n
         if end >= seq.prompt_len:
@@ -524,6 +545,16 @@ class Scheduler:
         for it, toks in zip(batch.items, token_lists):
             seq = it.seq
             seq.num_in_flight -= 1
+            snap = getattr(seq, "_spec_ssm_snap", None)
+            if snap is not None:
+                seq._spec_ssm_snap = None
+                if (seq.status is not SequenceStatus.RUNNING
+                        or seq.seq_id in self._aborted_ids):
+                    # finished/aborted/preempted mid-flight: the state no
+                    # longer matters; just return the slot (drain-deferred
+                    # — a pending intent may still reference it)
+                    self.mm.free_snap_after_drain(snap)
+                    snap = None
             if seq.status is not SequenceStatus.RUNNING:
                 # finished at an earlier (chained) step while this one was
                 # in flight: release its deferred pages once the last
@@ -557,6 +588,7 @@ class Scheduler:
                                          finish))
                 if finish is not None:
                     break
+            ssm_rollback = False
             if self.spec_cfg is not None and it.draft_tokens:
                 accepted = emitted - 1
                 self.spec_stats["accepted"] += accepted
@@ -568,11 +600,24 @@ class Scheduler:
                     seq.spec_k_cur = min(cap, cur + 1)
                 else:
                     seq.spec_k_cur = max(1, accepted)
+                if snap is not None:
+                    if (accepted < len(it.draft_tokens)
+                            and finish is None):
+                        # hybrid partial acceptance: the recurrent state
+                        # advanced over rejected draft rows too — restore
+                        # the pre-draft snapshot and re-feed the committed
+                        # run (the rolled-back num_computed below routes
+                        # the seq through the chunked re-feed path)
+                        self.mm.ssm_intents.append(
+                            ("restore", snap, seq.ssm_slot))
+                        ssm_rollback = True
+                    self.mm.free_snap_after_drain(snap)
             # rows fed were num_new_tokens committed tokens (+ drafts);
             # valid KV covers the rows whose inputs were correct: the
             # chunk plus the accepted drafts = num_new-1 + emitted rows
-            seq.num_computed_tokens = (it.computed_before
-                                       + it.num_new_tokens - 1 + emitted)
+            seq.num_computed_tokens = (
+                it.computed_before + it.num_new_tokens - 1
+                + (0 if ssm_rollback else emitted))
             self.mm.register_computed_pages(seq)
             if finish is not None:
                 seq.status = SequenceStatus.FINISHED

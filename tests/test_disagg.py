@@ -501,3 +501,19 @@ def test_disagg_video_per_frame(vl3_ckpt):
         llm.disagg_coordinator.close()
         enc.stop()
         srv.stop()
+
+
+def test_processor_hash_includes_pixel_bounds(tmp_path):
+    """Runtime pixel-bound overrides change the effective preprocessing,
+    so they must change the encoder/LM agreement hash — an encoder capped
+    with --mm-processor-max-pixels and an uncapped LM must not pass the
+    disagg preprocessing-agreement check."""
+    from gllm_tpu.engine.mm_processing import processor_config_hash
+    d = str(tmp_path)
+    base = processor_config_hash(d)
+    assert processor_config_hash(d) == base
+    capped = processor_config_hash(d, max_pixels=50176)
+    assert capped != base
+    assert processor_config_hash(d, max_pixels=50176) == capped
+    assert processor_config_hash(d, min_pixels=28 * 28,
+                                 max_pixels=50176) != capped

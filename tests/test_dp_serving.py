@@ -209,3 +209,81 @@ def test_dp2_penalties_match_dp1(ckpt):
            for o in make_llm(ckpt, dp=2).generate(prompt_token_ids=prompts,
                                                   sampling_params=sps)]
     assert base == dp2
+
+
+# ---- per-DP-replica endpoints / request pinning ---------------------------
+
+def _prefix_llm(ckpt, dp):
+    cfg = EngineConfig(
+        model=ckpt, dtype="float32", max_model_len=128,
+        cache=CacheConfig(page_size=4, num_pages=64,
+                          enable_prefix_caching=True),
+        parallel=ParallelConfig(dp=dp))
+    return LLM(config=cfg)
+
+
+def test_dp_pinning_keeps_prefix_cache_warm(ckpt):
+    """target_dp pins a seq to one replica; a multi-turn conversation's
+    second turn warm-hits that replica's prefix cache. Round-robin sends
+    turn 2 to the OTHER replica: no hit (reference --endpoint-per-dp
+    rationale, llm_engine.py:121-133)."""
+    from gllm_tpu.sampling_params import SamplingParams
+    prompt = list(range(1, 25))             # 6 full pages of prefix
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+
+    llm = _prefix_llm(ckpt, dp=2)
+    for _ in range(2):                      # two turns, pinned to dp0
+        seq = llm._allocate_seq(list(prompt), sp)
+        seq.target_dp = 0
+        llm.add_seq(seq)
+        while llm.schedulers[0].has_unfinished:
+            llm.step()
+    pinned_hits = llm.schedulers[0].mm.hit_tokens
+
+    rr = _prefix_llm(ckpt, dp=2)
+    for _ in range(2):                      # round-robin: dp0 then dp1
+        seq = rr._allocate_seq(list(prompt), sp)
+        rr.add_seq(seq)
+        while any(s.has_unfinished for s in rr.schedulers):
+            rr.step()
+    assert rr.schedulers[0].mm.hit_tokens == 0
+    assert rr.schedulers[1].mm.hit_tokens == 0
+    assert pinned_hits > 0
+
+
+def test_endpoint_per_dp_http_pins_requests(ckpt):
+    """serve_per_dp: one listener per replica over ONE shared engine;
+    requests to listener d land on scheduler d."""
+    import http.client
+    import json as _json
+    import threading
+
+    from gllm_tpu.entrypoints.api_server import serve_per_dp
+    llm = _prefix_llm(ckpt, dp=2)
+    servers = serve_per_dp(llm, "127.0.0.1", [0, 0])
+    ports = [s.server_address[1] for s in servers]
+    threads = [threading.Thread(target=s.serve_forever, daemon=True)
+               for s in servers]
+    for t in threads:
+        t.start()
+    try:
+        for d, port in enumerate(ports):
+            for _ in range(2):
+                c = http.client.HTTPConnection("127.0.0.1", port,
+                                               timeout=60)
+                c.request("POST", "/v1/completions", body=_json.dumps({
+                    "prompt": [5, 6, 7, 8] * 5, "max_tokens": 3,
+                    "temperature": 0, "ignore_eos": True}),
+                    headers={"Content-Type": "application/json"})
+                r = c.getresponse()
+                assert r.status == 200, r.read()
+                r.read()
+                c.close()
+        # each endpoint pinned its two requests to its own replica:
+        # turn 2 warm-hits the same replica's prefix cache on BOTH
+        assert llm.schedulers[0].mm.hit_tokens > 0
+        assert llm.schedulers[1].mm.hit_tokens > 0
+    finally:
+        for s in servers:
+            s.shutdown()
+        servers[0].state.engine.shutdown()

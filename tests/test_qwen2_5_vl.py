@@ -394,3 +394,42 @@ def test_vl_pp2_matches_pp1(vl_ckpt):
                                       mm_inputs=mm, sampling_params=sp)]
 
     assert run(2) == run(1)
+
+
+def test_mm_processor_pixel_bounds():
+    """--mm-processor-min/max-pixels clamp the smart-resize budget
+    (reference api_server.py:488-494 → encoder_engine.py:67-74): a large
+    image processed under max_pixels yields fewer patches; min_pixels
+    upscales a tiny image."""
+    import numpy as np
+
+    from gllm_tpu.engine.mm_processing import (apply_pixel_bounds,
+                                               load_image_processor)
+    big = np.random.randint(0, 255, (336, 336, 3), np.uint8)
+    base = load_image_processor("/nonexistent", {})
+    n_base = base(images=[big],
+                  return_tensors="np")["pixel_values"].shape[0]
+    capped = load_image_processor("/nonexistent", {},
+                                  max_pixels=64 * 28 * 28)
+    n_capped = capped(images=[big],
+                      return_tensors="np")["pixel_values"].shape[0]
+    assert n_capped < n_base
+
+    tiny = np.random.randint(0, 255, (56, 56, 3), np.uint8)
+    floored = load_image_processor("/nonexistent", {},
+                                   min_pixels=128 * 28 * 28)
+    n_floor = floored(images=[tiny],
+                      return_tensors="np")["pixel_values"].shape[0]
+    n_tiny = base(images=[tiny],
+                  return_tensors="np")["pixel_values"].shape[0]
+    assert n_floor > n_tiny
+
+    # AutoProcessor-shaped object: bounds land on both sub-processors
+    class Sub:
+        size = None
+    class Proc:
+        image_processor = Sub()
+        video_processor = Sub()
+    p = apply_pixel_bounds(Proc(), min_pixels=111, max_pixels=999)
+    assert p.image_processor.min_pixels == 111
+    assert p.video_processor.max_pixels == 999

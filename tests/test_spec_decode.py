@@ -106,10 +106,11 @@ def test_spec_respects_eos_and_max_tokens(ckpt):
 
 def test_spec_mixed_batch_with_sampling_requests(ckpt):
     """Greedy and penalized requests keep byte-identity with the non-spec
-    engine (penalized requests never get drafts — the verify rows see raw
-    logits); a seeded sampled request in the same batch now speculates by
-    rejection sampling, so it asserts run-to-run determinism instead of
-    realization-identity with the non-spec engine."""
+    engine (penalized requests speculate too: the verify rows see
+    draft-prefix-adjusted logits via spec_adjust_logits); a seeded
+    sampled request in the same batch speculates by rejection sampling,
+    so it asserts run-to-run determinism instead of realization-identity
+    with the non-spec engine."""
     llm = make_llm(ckpt, spec=True)
     llm2 = make_llm(ckpt, spec=True)
     base = make_llm(ckpt)
@@ -158,10 +159,10 @@ def test_spec_near_max_model_len(ckpt):
     assert a.finish_reason == b.finish_reason == "length"
 
 
-def test_spec_stop_strings_excluded_and_identical(ckpt):
-    """Stop-string requests never get drafts (a committed run would
-    stream past the match) — outputs identical to the plain engine."""
-    from transformers import AutoTokenizer
+def test_spec_stop_strings_capped_drafts_and_identical(ckpt):
+    """Stop-string requests speculate with a capped draft length (k<=2,
+    scheduler._propose_drafts); a never-matching stop keeps outputs
+    identical to the plain engine."""
     llm = make_llm(ckpt, spec=True)
     base = make_llm(ckpt)
     sp = dict(temperature=0.0, max_tokens=24, ignore_eos=True,
@@ -171,8 +172,98 @@ def test_spec_stop_strings_excluded_and_identical(ckpt):
     b = base.generate(prompt_token_ids=[list(PROMPTS[0])],
                       sampling_params=SamplingParams(**sp))[0]
     assert a.output_token_ids == b.output_token_ids
-    # the stop-string request must not have produced drafts
-    assert llm.scheduler.spec_stats["proposed"] == 0
+    assert llm.scheduler.spec_stats["proposed"] > 0
+
+
+class _CharTok:
+    """1 char per token — makes text<->token mapping exact for stop
+    tests."""
+    eos_token_id = 0
+
+    def decode(self, ids, skip_special_tokens=False):
+        return "".join(chr(65 + (i % 26)) for i in ids)
+
+    def encode(self, text):
+        return [ord(c) - 65 for c in text]
+
+
+def test_spec_stop_string_match_trims_exactly(ckpt):
+    """A stop string completing INSIDE an accepted draft run: text is
+    truncated before the match, over-committed tokens are trimmed, and
+    output ids/usage equal the non-spec engine's (per-token stop scan)
+    result byte-for-byte."""
+    from gllm_tpu.config import CacheConfig, EngineConfig
+    mk = lambda spec: LLM(config=EngineConfig(   # noqa: E731
+        model=ckpt, dtype="float32", max_model_len=256,
+        spec_decode="ngram" if spec else None, spec_k=4, spec_ngram=2,
+        cache=CacheConfig(page_size=4, num_pages=128)),
+        tokenizer=_CharTok())
+    base = mk(False)
+    free0 = base.scheduler.mm.num_free_pages
+    probe = base.generate(prompt_token_ids=[list(PROMPTS[0])],
+                          sampling_params=SamplingParams(
+                              temperature=0.0, max_tokens=24,
+                              ignore_eos=True))[0]
+    # pick a stop string that completes mid-output (chars 6..7 of the
+    # output text), so with spec_k=4 a draft run can overshoot it
+    stop = probe.text[6:8]
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True,
+                        stop=[stop])
+    b = base.generate(prompt_token_ids=[list(PROMPTS[0])],
+                      sampling_params=sp)[0]
+    llm = mk(True)
+    a = llm.generate(prompt_token_ids=[list(PROMPTS[0])],
+                     sampling_params=sp)[0]
+    assert b.finish_reason == "stop" and a.finish_reason == "stop"
+    assert a.text == b.text
+    assert a.output_token_ids == b.output_token_ids
+    assert a.num_output_tokens == b.num_output_tokens
+    assert stop not in a.text
+    # trimmed seqs must leak no pages
+    assert llm.scheduler.mm.num_free_pages == \
+        base.scheduler.mm.num_free_pages == free0
+
+
+def test_spec_penalties_and_bias_byte_identity(ckpt):
+    """Penalties + logit_bias requests speculate and stay byte-identical:
+    the verify rows apply the same on-device adjustments (with
+    draft-prefix counts) the plain sampler applies."""
+    llm = make_llm(ckpt, spec=True)
+    base = make_llm(ckpt)
+    sp = SamplingParams(temperature=0.0, max_tokens=24, ignore_eos=True,
+                        repetition_penalty=1.3, presence_penalty=0.4,
+                        frequency_penalty=0.2,
+                        logit_bias={7: 3.5, 23: -2.0})
+    prompts = [PROMPTS[0], PROMPTS[1]]
+    a = llm.generate(prompt_token_ids=[list(p) for p in prompts],
+                     sampling_params=[sp, sp])
+    b = base.generate(prompt_token_ids=[list(p) for p in prompts],
+                      sampling_params=[sp, sp])
+    assert [o.output_token_ids for o in a] == \
+        [o.output_token_ids for o in b]
+    st = llm.scheduler.spec_stats
+    assert st["proposed"] > 0 and st["accepted"] > 0
+
+
+def test_spec_logprobs_match_plain(ckpt):
+    """logprobs requests speculate; reported logprobs come from the
+    verify rows' distributions and match the plain engine's exactly
+    (greedy => same tokens, same log-softmax rows)."""
+    llm = make_llm(ckpt, spec=True)
+    base = make_llm(ckpt)
+    sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True,
+                        logprobs=2)
+    a = llm.generate(prompt_token_ids=[list(PROMPTS[0])],
+                     sampling_params=sp)[0]
+    b = base.generate(prompt_token_ids=[list(PROMPTS[0])],
+                      sampling_params=sp)[0]
+    assert a.output_token_ids == b.output_token_ids
+    assert llm.scheduler.spec_stats["accepted"] > 0
+    assert a.logprobs is not None and len(a.logprobs) == len(b.logprobs)
+    for (ca, ia, va), (cb, ib, vb) in zip(a.logprobs, b.logprobs):
+        assert ia == ib
+        np.testing.assert_allclose(ca, cb, rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(va, vb, rtol=2e-4, atol=2e-5)
 
 
 def test_spec_under_pp2(ckpt):
@@ -346,3 +437,31 @@ def test_adaptive_k_collapses_and_regrows():
         sched.process_output_multi(batch, [toks], frozenset())
     assert seq.spec_k_cur == cfg.spec_k, seq.spec_k_cur
     assert k0 <= cfg.spec_k
+
+
+def test_spec_under_pp2_penalties_and_logprobs(ckpt):
+    """The pp last-stage verify applies the same draft-prefix logit
+    adjustments and emits spec logprobs — penalized/bias/logprobs
+    requests stay byte-identical to the single-stage plain engine."""
+    from gllm_tpu.config import ParallelConfig
+    sp = SamplingParams(temperature=0.0, max_tokens=16, ignore_eos=True,
+                        repetition_penalty=1.3, presence_penalty=0.4,
+                        logit_bias={7: 2.5}, logprobs=2)
+    base = make_llm(ckpt)
+    b = base.generate(prompt_token_ids=[list(PROMPTS[0])],
+                      sampling_params=sp)[0]
+    del base
+    cfg = EngineConfig(
+        model=ckpt, dtype="float32", max_model_len=256,
+        spec_decode="ngram", spec_k=4, spec_ngram=2,
+        cache=CacheConfig(page_size=4, num_pages=128),
+        parallel=ParallelConfig(pp=2))
+    llm = LLM(config=cfg)
+    a = llm.generate(prompt_token_ids=[list(PROMPTS[0])],
+                     sampling_params=sp)[0]
+    assert a.output_token_ids == b.output_token_ids
+    assert llm.scheduler.spec_stats["proposed"] > 0
+    assert a.logprobs is not None and len(a.logprobs) == len(b.logprobs)
+    for (ca, ia, va), (cb, ib, vb) in zip(a.logprobs, b.logprobs):
+        assert ia == ib
+        np.testing.assert_allclose(ca, cb, rtol=2e-4, atol=2e-5)

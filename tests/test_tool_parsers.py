@@ -285,3 +285,15 @@ def test_qwen3_xml_trailing_and_interleaved_content_survives():
     assert [c.name for c in calls] == ["a", "b"]
     for piece in ("before", "middle", "after"):
         assert piece in content, content
+
+
+def test_qwen3_xml_interleaved_text_no_orphan_closer():
+    """Text between <tool_call> and <function=..> must not leak an
+    orphaned </tool_call> tag into content."""
+    from gllm_tpu.entrypoints.tool_parsers import Qwen3XmlToolParser
+    text = ("<tool_call>\nnote to self\n<function=a>\n</function>\n"
+            "</tool_call>")
+    content, calls = Qwen3XmlToolParser().parse(text)
+    assert [c.name for c in calls] == ["a"]
+    assert "</tool_call>" not in content and "<tool_call>" not in content
+    assert "note to self" in content
