@@ -166,7 +166,8 @@ class PPModelRunner(ModelRunner):
             self.ssm_snapshot_slots = (
                 config.cache.ssm_snapshot_slots
                 if (config.cache.enable_prefix_caching
-                    or config.spec_decode) else 0)
+                    or (config.spec_decode
+                        and not config.overlap_scheduling)) else 0)
         else:
             period = 1
             self.ssm_working_slots = self.ssm_snapshot_slots = 0
