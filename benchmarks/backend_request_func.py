@@ -48,7 +48,10 @@ def stream_completion(host: str, port: int, payload: dict,
             return res
         buf = b""
         while True:
-            chunk = resp.read(4096)
+            # read1 returns as soon as ANY bytes are available; plain
+            # read(4096) would block until 4 KiB accumulate across SSE
+            # events, batching arrivals and faking TTFT/ITL
+            chunk = resp.read1(4096)
             if not chunk:
                 break
             buf += chunk
@@ -84,6 +87,45 @@ def stream_completion(host: str, port: int, payload: dict,
     except Exception as e:  # noqa: BLE001
         res.error = str(e)
     return res
+
+
+def run_requests(host: str, port: int, payloads: List[dict],
+                 concurrency: int, request_rate: float = float("inf"),
+                 seed: int = 0, path: str = "/v1/completions"):
+    """Drive pre-built payloads with bounded concurrency and (optionally)
+    Poisson arrivals; returns (results, wall_s). Payloads and the arrival
+    schedule are fully materialized BEFORE any thread starts, so seeded
+    runs reproduce exactly (a shared RNG touched from worker threads
+    would not be thread-safe). Shared by serve_bench and latency_bench."""
+    import random
+    import threading
+
+    results: List[RequestResult] = [None] * len(payloads)
+    sem = threading.Semaphore(concurrency)
+
+    def worker(i):
+        with sem:
+            results[i] = stream_completion(host, port, payloads[i],
+                                           path=path)
+
+    arrivals = [0.0] * len(payloads)
+    if request_rate > 0 and request_rate != float("inf"):
+        r, t = random.Random(seed), 0.0
+        for i in range(len(payloads)):
+            t += r.expovariate(request_rate)
+            arrivals[i] = t
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(payloads))]
+    for i, t in enumerate(threads):
+        wait = arrivals[i] - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        t.start()
+    for t in threads:
+        t.join()
+    return results, time.perf_counter() - t0
 
 
 def percentile(vals: List[float], p: float) -> float:

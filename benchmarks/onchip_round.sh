@@ -35,5 +35,10 @@ run chip_probes 950 python benchmarks/chip_probes.py
 run kernel_tune 2800 python benchmarks/kernel_tune.py --write
 run vmem_probe 900 python benchmarks/kernel_tune.py --vmem-probe
 run bench 1200 python bench.py
+#  5. latency    — TTFT/TPOT/ITL percentiles against the BASELINE <500 ms
+#                  p50-TTFT serving target (in-process server: still ONE
+#                  TPU holder).
+run latency 1200 python benchmarks/latency_bench.py
 echo "=== done ($(date -u +%FT%TZ)) ===" | tee -a "$OUT/sequence.log"
 grep -h "sharegpt_output" "$OUT/bench.out" | tail -1
+grep -h "ttft_p50_ms" "$OUT/latency.out" | tail -1

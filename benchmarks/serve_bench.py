@@ -18,14 +18,12 @@ Usage:
 import argparse
 import json
 import sys
-import threading
-import time
 
 import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
-from benchmarks.backend_request_func import (RequestResult,  # noqa: E402
-                                             stream_completion, summarize)
+from benchmarks.backend_request_func import (run_requests,  # noqa: E402
+                                             summarize)
 
 
 def main():
@@ -53,29 +51,9 @@ def main():
             "ignore_eos": True,
         })
 
-    results: list[RequestResult] = [None] * len(payloads)
-    sem = threading.Semaphore(args.concurrency)
-
-    def worker(i):
-        with sem:
-            results[i] = stream_completion(args.host, args.port, payloads[i])
-
-    arrivals = np.zeros(len(payloads))
-    if np.isfinite(args.request_rate) and args.request_rate > 0:
-        arrivals = np.cumsum(
-            rng.exponential(1.0 / args.request_rate, size=len(payloads)))
-
-    t0 = time.perf_counter()
-    threads = [threading.Thread(target=worker, args=(i,))
-               for i in range(len(payloads))]
-    for i, t in enumerate(threads):
-        wait = arrivals[i] - (time.perf_counter() - t0)
-        if wait > 0:
-            time.sleep(wait)
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.perf_counter() - t0
+    results, wall = run_requests(args.host, args.port, payloads,
+                                 args.concurrency, args.request_rate,
+                                 seed=args.seed)
 
     summary = summarize(results, wall)
     errors = {r.error for r in results if r and not r.success and r.error}
