@@ -119,6 +119,13 @@ def main():
                                  seed=args.seed)
 
     summary = summarize([r for r in results if r is not None], wall)
+    if summary["failed"] or summary["completed"] != n_prompts:
+        # a post-warmup wedge must FAIL the step, not report 0.0 ms
+        errs = sorted({r.error for r in results
+                       if r is not None and not r.success})[:3]
+        print(f"[latency_bench] measured pass failed: {summary['failed']}"
+              f" errors, e.g. {errs}", file=sys.stderr, flush=True)
+        sys.exit(1)
     ttft_p50 = summary["ttft_ms"].get("p50", 0.0)
     httpd.shutdown()
     llm_engine = httpd.state.engine

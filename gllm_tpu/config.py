@@ -160,6 +160,14 @@ class EngineConfig:
                     self.multi_step_decode)
             self.overlap_scheduling = False
             self.multi_step_decode = 1
+        if self.parallel.assigned_layers is not None \
+                and len(self.parallel.assigned_layers) != self.parallel.pp:
+            # catch --assigned-layers with a forgotten/mismatched --pp at
+            # config time (pp_runner re-checks per-stage sums later, but
+            # only engages for pp > 1 — pp=1 would silently drop the flag)
+            raise ValueError(
+                f"assigned_layers has {len(self.parallel.assigned_layers)}"
+                f" entries but pp={self.parallel.pp}")
         if self.cache.page_size <= 0:
             raise ValueError("page_size must be positive")
         if self.scheduler.max_prefill_tokens < self.cache.page_size:

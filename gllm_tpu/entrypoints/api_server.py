@@ -587,7 +587,10 @@ def build_engine_config(args) -> EngineConfig:
             max_prefill_tokens=args.maxp,
             min_prefill_tokens=args.minp,
             iter_smooth=args.iterp,
+            init_new_token_ratio=args.init_new_token_ratio,
+            min_new_token_ratio=args.min_new_token_ratio,
         ),
+        enforce_eager=args.enforce_eager,
         cache=CacheConfig(
             page_size=args.page_size,
             memory_util=args.memory_util,
@@ -595,8 +598,12 @@ def build_engine_config(args) -> EngineConfig:
             kv_cache_dtype=args.kv_cache_dtype,
             enable_prefix_caching=args.enable_prefix_caching,
         ),
-        parallel=ParallelConfig(pp=args.pp, tp=args.tp, dp=args.dp,
-                                sp=args.sp, enable_ep=args.enable_ep),
+        parallel=ParallelConfig(
+            pp=args.pp, tp=args.tp, dp=args.dp,
+            sp=args.sp, enable_ep=args.enable_ep,
+            assigned_layers=([int(x) for x in
+                              args.assigned_layers.split(",") if x]
+                             if args.assigned_layers else None)),
     )
 
 
@@ -624,6 +631,14 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--maxp", type=int, default=2048)
     p.add_argument("--minp", type=int, default=128)
     p.add_argument("--iterp", type=int, default=16)
+    p.add_argument("--init-new-token-ratio", type=float, default=0.7,
+                   help="adaptive KV admission ramp start (reference "
+                        "--init-new-token-ratio)")
+    p.add_argument("--min-new-token-ratio", type=float, default=0.1,
+                   help="admission ramp floor")
+    p.add_argument("--enforce-eager", action="store_true",
+                   help="disable donation/async dispatch tricks (debug; "
+                        "the reference's --disable-cuda-graph analogue)")
     # cache
     p.add_argument("--page-size", type=int, default=16)
     p.add_argument("--memory-util", type=float, default=0.9,
@@ -682,6 +697,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-hosts", type=int, default=1)
     p.add_argument("--host-id", type=int, default=None)
     p.add_argument("--pp", type=int, default=1)
+    p.add_argument("--assigned-layers", default=None,
+                   help="comma-separated per-stage layer counts for pp "
+                        "(reference --assigned-layers)")
     p.add_argument("--tp", type=int, default=1)
     p.add_argument("--dp", type=int, default=1)
     p.add_argument("--sp", type=int, default=1,
