@@ -187,10 +187,12 @@ class EngineConfig:
                 f"unknown spec_decode {self.spec_decode!r} "
                 "(choices: ngram)")
         if self.spec_decode is not None:
-            if self.overlap_scheduling or self.multi_step_decode > 1:
-                raise ValueError(
-                    "spec_decode composes its own multi-token steps; "
-                    "disable overlap_scheduling / multi_step_decode")
+            # May be combined with overlap_scheduling/multi_step_decode:
+            # speculation then OWNS decode dispatch (schedule_chained
+            # defers — drafting needs committed token values a chained
+            # step leaves on device), each accepted draft replacing the
+            # dispatch round trip a chain would have hidden; prefill
+            # batches still pipeline through the in-flight depth.
             if self.spec_k < 1 or self.spec_ngram < 1:
                 raise ValueError("spec_k and spec_ngram must be >= 1")
         if self.parallel.sp > 1 and (self.parallel.pp > 1

@@ -139,22 +139,21 @@ class LLM:
                                      pp_size=config.parallel.pp)
                            for mm in self.memory_managers]
         self.scheduler = self.schedulers[0]
-        if (config.spec_decode == "ngram"
-                and not config.overlap_scheduling):
-            # single runner, pp pipelines (the last stage verifies), and
-            # dp replicas (per-replica verify in the stacked program);
-            # hybrid (GDN) speculates via snapshot-rollback: the pre-draft
-            # recurrent state is checkpointed into an SSM snapshot slot
-            # and restored on a partial acceptance, with the accepted
-            # tokens re-fed so the state re-advances over exactly the
-            # committed run (paged KV needs no rollback: the real token's
-            # KV overwrites the slot later)
+        if config.spec_decode == "ngram":
+            # Works under every topology: single runner, pp pipelines
+            # (the last stage verifies), dp replicas (per-replica verify
+            # in the stacked program), and overlap scheduling — there
+            # speculation owns decode dispatch (schedule_chained defers;
+            # drafting needs committed token VALUES a chained step leaves
+            # on device). Hybrid (GDN) speculates via snapshot-rollback:
+            # the pre-draft recurrent state is checkpointed into an SSM
+            # snapshot slot and restored on a partial acceptance, with
+            # the accepted tokens re-fed so the state re-advances over
+            # exactly the committed run (paged KV needs no rollback: the
+            # real token's KV overwrites the slot later). validate()
+            # already rejected any other spec_decode value.
             for s in self.schedulers:
                 s.spec_cfg = (config.spec_ngram, config.spec_k)
-        elif config.spec_decode is not None:
-            logger.warning(
-                "spec_decode=%s disabled for this topology (no overlap)",
-                config.spec_decode)
         self._rr = 0
         self._seq_replica: dict = {}
         self.eos_token_ids = frozenset(model_cfg.eos_token_ids)

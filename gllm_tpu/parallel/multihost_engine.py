@@ -726,7 +726,10 @@ class MultihostServingEngine:
         self._thread.start()
 
     def submit(self, token_ids, sampling_params, mm_input=None,
-               disagg_items=None):
+               disagg_items=None, target_dp=None):
+        # target_dp (per-DP-endpoint pinning) is accepted for interface
+        # parity with ServingEngine but ignored: the multihost plane runs
+        # dp=1 per host group (replica routing happens in the engine loop)
         if disagg_items:
             # coordinator runs on host 0; the admit reaches every host as
             # a tick event (gate-B flips ride the blob channel)

@@ -143,9 +143,10 @@ class Scheduler:
         self._decode_offset = 0
         self._last_stats_time = 0.0
         self.num_preemptions = 0
-        # (ngram_n, k) when the ENGINE enabled speculative decoding for
-        # this topology (single runner, no overlap, non-hybrid model) —
-        # the engine sets it after construction; None disables proposals
+        # (ngram_n, k) when the ENGINE enabled speculative decoding —
+        # set after construction for every topology (incl. overlap, where
+        # spec owns decode dispatch and schedule_chained defers, and
+        # hybrid GDN via SSM snapshot-rollback); None disables proposals
         self.spec_cfg = None
         self.spec_stats = {"proposed": 0, "accepted": 0}
 
@@ -482,6 +483,15 @@ class Scheduler:
         every prev item samples and is guaranteed not to finish by length
         at prev's step, and pages are available without preemption.
         """
+        if self.spec_cfg is not None:
+            # Speculation and chaining are competing dispatch-hiding
+            # mechanisms, and drafting needs the committed token VALUES
+            # (prompt-lookup over token_ids) which a chained step leaves
+            # on device — so when spec is on it owns decode dispatch:
+            # every decode schedules synchronously with drafts, each
+            # accepted draft removing a dispatch round trip the chain
+            # would have hidden.
+            return None
         items: List[ScheduledSeq] = []
         total_need = 0
         for it in prev.items:

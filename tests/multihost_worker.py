@@ -34,8 +34,10 @@ def main():
 
     # tp spans ALL global devices (2 virtual per process) so the mesh —
     # and its collectives — cross the process boundary.
+    spec = mode == "spec"
     cfg = EngineConfig(
         model=model_dir, dtype="float32", max_model_len=64,
+        spec_decode="ngram" if spec else None, spec_k=4, spec_ngram=2,
         cache=CacheConfig(page_size=4, num_pages=64),
         parallel=ParallelConfig(tp=len(jax.devices())))
     llm = LLM(config=cfg)
@@ -70,11 +72,18 @@ def main():
         import threading
         t = threading.Thread(target=eng.run_host0, daemon=True)
         t.start()
-        sid1 = eng.submit([5, 9, 23],
-                          SamplingParams(temperature=0.0, max_tokens=4,
+        # spec mode: draft-friendly repetitive prompts + longer outputs
+        # so drafts actually get proposed AND accepted on both hosts
+        p1, p2 = (([5, 9, 23, 5, 9, 23, 5, 9], [7, 7, 7, 7])
+                  if spec else ([5, 9, 23], [7, 7]))
+        n_out = 8 if spec else 4
+        sid1 = eng.submit(list(p1),
+                          SamplingParams(temperature=0.0,
+                                         max_tokens=n_out,
                                          ignore_eos=True))
-        sid2 = eng.submit([7, 7],
-                          SamplingParams(temperature=0.0, max_tokens=4,
+        sid2 = eng.submit(list(p2),
+                          SamplingParams(temperature=0.0,
+                                         max_tokens=n_out,
                                          ignore_eos=True))
         import time
         deadline = time.monotonic() + 120
@@ -85,7 +94,8 @@ def main():
         with open(result_path, "w") as f:
             json.dump({"outputs": [results.get(sid1), results.get(sid2)],
                        "procs": jax.process_count(),
-                       "devices": len(jax.devices())}, f)
+                       "devices": len(jax.devices()),
+                       "spec_stats": dict(llm.scheduler.spec_stats)}, f)
     else:
         MultihostEngine(llm).run_follower()
     jax.distributed.shutdown()

@@ -402,3 +402,62 @@ def test_three_process_blob_peer_chain(tmp_path):
     # follower 2 fetched everything from its peer / LRU — host 0 skipped
     assert s2["peer"] >= 1, s2
     assert s2["host0"] == 0, s2
+
+
+def test_two_process_spec_serving(tmp_path):
+    """Speculative decoding under multi-host: drafts are proposed from
+    identical token state on every host (the mirror loops issue identical
+    jit programs), and outputs stay byte-identical to a single-process
+    plain engine."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(4)
+    model_dir = tmp_path / "m"
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        max_position_embeddings=256, eos_token_id=0,
+        attention_bias=False)).save_pretrained(model_dir,
+                                               safe_serialization=True)
+    result = tmp_path / "result.json"
+    port = free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(port), "2", str(i), str(model_dir),
+         str(result), "spec"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out.decode(errors="replace"))
+            assert p.returncode == 0, outs[-1][-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    d = json.loads(result.read_text())
+    assert d["procs"] == 2
+    assert d["spec_stats"]["proposed"] > 0, d
+    assert d["spec_stats"]["accepted"] > 0, d
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from gllm_tpu.config import CacheConfig, EngineConfig
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.sampling_params import SamplingParams
+    llm = LLM(config=EngineConfig(
+        model=str(model_dir), dtype="float32", max_model_len=64,
+        cache=CacheConfig(page_size=4, num_pages=64)))
+    want = [o.output_token_ids for o in llm.generate(
+        prompt_token_ids=[[5, 9, 23, 5, 9, 23, 5, 9], [7, 7, 7, 7]],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
+                                       ignore_eos=True))]
+    assert d["outputs"] == want, (d["outputs"], want)

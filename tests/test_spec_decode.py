@@ -465,3 +465,32 @@ def test_spec_under_pp2_penalties_and_logprobs(ckpt):
     for (ca, ia, va), (cb, ib, vb) in zip(a.logprobs, b.logprobs):
         assert ia == ib
         np.testing.assert_allclose(ca, cb, rtol=2e-4, atol=2e-5)
+
+
+def test_spec_under_overlap_scheduling(ckpt):
+    """Overlap scheduling no longer disables speculation: draft batches
+    dispatch synchronously (their commit count is device-decided) while
+    non-spec steps keep chaining — greedy outputs stay byte-identical and
+    drafts are actually proposed."""
+    base = make_llm(ckpt)
+    want = greedy(base, PROMPTS)
+    del base
+    llm = make_llm(ckpt, spec=True, overlap_scheduling=True,
+                   overlap_depth=2)
+    got = greedy(llm, PROMPTS)
+    assert got == want, (got, want)
+    st = llm.scheduler.spec_stats
+    assert st["proposed"] > 0 and st["accepted"] > 0
+
+
+def test_spec_under_overlap_multi_step(ckpt):
+    """Spec + overlap + fused multi-step decode coexist: spec batches are
+    excluded from fused chains but the engine stays byte-identical."""
+    base = make_llm(ckpt)
+    want = greedy(base, PROMPTS)
+    del base
+    llm = make_llm(ckpt, spec=True, overlap_scheduling=True,
+                   overlap_depth=2, multi_step_decode=4)
+    got = greedy(llm, PROMPTS)
+    assert got == want, (got, want)
+    assert llm.scheduler.spec_stats["proposed"] > 0
