@@ -152,32 +152,67 @@ def probe_mla():
     assert len(outs[0].output_token_ids) == 8
 
 
-def probe_bench_shape():
-    """The HEADLINE bench geometry (Llama-3.2-1B: head_dim 64, GQA 32/8 →
-    packed-KV pack=2) through the real engine in bfloat16 — the exact
-    attention configuration bench.py will serve, so a Mosaic surprise
-    shows up here, named, instead of inside a 600 s bench budget."""
-    from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
-    from gllm_tpu.engine.llm import LLM
+def _headline_model_cfg():
+    """Tiny model with the HEADLINE bench head geometry (Llama-3.2-1B:
+    head_dim 64, GQA 32/8 → packed-KV pack=2) — shared by the probes that
+    must cover the exact attention configuration bench.py will serve."""
     from gllm_tpu.models.config import ModelConfig
-    from gllm_tpu.sampling_params import SamplingParams
-
-    mcfg = ModelConfig(
+    return ModelConfig(
         architecture="LlamaForCausalLM", vocab_size=512, hidden_size=256,
         num_layers=2, num_heads=32, num_kv_heads=8, head_dim=64,
         intermediate_size=512, max_position=512, rope_theta=500000.0,
         tie_word_embeddings=True)
+
+
+def probe_bench_shape():
+    """The headline bench geometry through the real engine in bfloat16 —
+    the exact attention configuration bench.py will serve, so a Mosaic
+    surprise shows up here, named, instead of inside a 600 s bench
+    budget."""
+    from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.sampling_params import SamplingParams
+
     llm = LLM(config=EngineConfig(
         load_format="dummy", dtype="bfloat16", max_model_len=256,
         scheduler=SchedulerConfig(max_prefill_tokens=128,
                                   max_decode_seqs=16),
         cache=CacheConfig(page_size=16, num_pages=128)),
-        model_cfg=mcfg)
+        model_cfg=_headline_model_cfg())
     outs = llm.generate(
         prompt_token_ids=[[3, 5, 7] * 20, [11, 13]],
         sampling_params=SamplingParams(temperature=0.0, max_tokens=16,
                                        ignore_eos=True))
     assert all(len(o.output_token_ids) == 16 for o in outs)
+
+
+def probe_spec():
+    """Speculative decoding through the real engine on the headline bench
+    head geometry (packed-KV D=64 GQA): the verify program (gathered
+    rows + spec_adjust_logits + spec_verify) is its own jit signature —
+    compile and run it on chip with drafts actually accepted, so a
+    Mosaic/compile surprise in the spec path shows up named."""
+    from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.sampling_params import SamplingParams
+
+    llm = LLM(config=EngineConfig(
+        load_format="dummy", dtype="bfloat16", max_model_len=256,
+        spec_decode="ngram", spec_k=4, spec_ngram=2,
+        scheduler=SchedulerConfig(max_prefill_tokens=128,
+                                  max_decode_seqs=16),
+        cache=CacheConfig(page_size=16, num_pages=128)),
+        model_cfg=_headline_model_cfg())
+    outs = llm.generate(
+        prompt_token_ids=[[3, 5, 7, 3, 5, 7, 3, 5], [11, 13]],
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=16,
+                                       ignore_eos=True))
+    assert all(len(o.output_token_ids) == 16 for o in outs)
+    st = llm.scheduler.spec_stats
+    # a greedy loop on this repetitive prompt MUST accept drafts — a
+    # verify program that silently rejects everything is exactly the
+    # on-chip miscompile this probe exists to name (CPU oracle: 14/14)
+    assert st["proposed"] > 0 and st["accepted"] > 0, st
 
 
 PROBES = {
@@ -187,6 +222,7 @@ PROBES = {
     "multistep": probe_multistep,
     "mla": probe_mla,
     "bench_shape": probe_bench_shape,
+    "spec": probe_spec,
 }
 
 
