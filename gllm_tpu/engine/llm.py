@@ -240,15 +240,40 @@ class LLM:
     def add_seq(self, seq: Sequence) -> None:
         """Admit a sequence: pinned to ``seq.target_dp`` when set
         (per-DP-endpoint affinity keeps a conversation's prefix cache on
-        one replica, reference llm_engine.py:121-133), else round-robined
-        over DP replicas."""
-        sp = seq.sampling_params
+        one replica, reference llm_engine.py:121-133); otherwise
+        CACHE-AWARE routing (beyond the reference's round-robin): the
+        replica whose prefix cache covers the most of this prompt wins —
+        a multi-turn conversation naturally sticks to the replica holding
+        its history even without endpoint pinning. No match → plain
+        round-robin (also the single-replica / no-prefix-cache path)."""
         t = getattr(seq, "target_dp", None)
         if t is not None and 0 <= t < self.dp:
             r = t
         else:
-            r = self._rr % self.dp
-            self._rr += 1
+            r = -1
+            if self.dp > 1 and self.config.cache.enable_prefix_caching:
+                from gllm_tpu.memory_manager import prefix_digests
+                # hash the prompt chain ONCE; probe every replica's maps
+                digests = prefix_digests(seq.cache_token_ids,
+                                         seq.prompt_len,
+                                         self.config.cache.page_size)
+                hits = [s.mm.peek_digests(digests)
+                        for s in self.schedulers]
+                best = max(hits)
+                cand = hits.index(best)
+                loads = [len(s.running) + len(s.waiting)
+                         for s in self.schedulers]
+                # Route by cache only when the hit is real AND substantial
+                # (at least half the prompt — a short shared system prompt
+                # must not funnel all traffic to one replica) and the
+                # winner isn't already far more loaded than the idlest
+                # replica (cache affinity must not starve the fleet).
+                if (best > 0 and best >= seq.prompt_len // 2
+                        and loads[cand] <= min(loads) + 8):
+                    r = cand
+            if r < 0:
+                r = self._rr % self.dp
+                self._rr += 1
         self._seq_replica[seq.seq_id] = r
         self.schedulers[r].add_seq(seq)
 

@@ -45,6 +45,22 @@ def _chain_hash(prev: bytes, token_ids: List[int], extra_key: bytes = b"") -> by
     return h.digest()
 
 
+def prefix_digests(cache_token_ids, prompt_len: int, page_size: int,
+                   extra_key: bytes = b"") -> List[Tuple[bytes, list]]:
+    """Chained page digests over the cacheable prompt prefix — only whole
+    pages, leaving >= 1 token to compute (the match_prefix guarantee).
+    Replica-independent: cache-aware DP routing computes this ONCE and
+    probes every replica's maps with it."""
+    out: List[Tuple[bytes, list]] = []
+    digest = b"root"
+    for i in range((prompt_len - 1) // page_size):
+        s = i * page_size
+        tokens = cache_token_ids[s:s + page_size]
+        digest = _chain_hash(digest, tokens, extra_key)
+        out.append((digest, tokens))
+    return out
+
+
 class MemoryManager:
     """Plain paged allocator (no prefix reuse).
 
@@ -172,6 +188,14 @@ class MemoryManager:
         """Prefix-cache hook; no-op without prefix caching."""
         return 0
 
+    def peek_prefix(self, cache_token_ids, prompt_len: int) -> int:
+        """Read-only prefix-match estimate; 0 without prefix caching."""
+        return 0
+
+    def peek_digests(self, digests) -> int:
+        """Read-only prefix-match estimate; 0 without prefix caching."""
+        return 0
+
     def register_computed_pages(self, seq: Sequence) -> None:
         """Prefix-cache hook; no-op without prefix caching."""
 
@@ -235,6 +259,38 @@ class PrefixMemoryManager(MemoryManager):
         # visual spans (Sequence.cache_token_ids).
         return seq.cache_token_ids[s:s + self.page_size]
 
+    def _probe_page(self, digest: bytes, tokens) -> Optional[int]:
+        """Cached page id for this chained digest, or None (missing /
+        canary mismatch = hash collision). Shared by the claiming walk
+        (match_prefix) and the read-only routing peek so the two can
+        never disagree on what counts as a hit."""
+        page = self.hash_to_page.get(digest)
+        if page is None:
+            return None
+        _, canary = self.page_meta[page]
+        if tuple(tokens[:_CANARY_TOKENS]) != canary:
+            return None
+        return page
+
+    def peek_digests(self, digests) -> int:
+        """Read-only estimate of the tokens ``match_prefix`` would claim,
+        given ``prefix_digests(...)`` output — no refcounts/claims. Used
+        by cache-aware DP routing (the frontend hashes the prompt ONCE
+        and probes every replica); the hybrid SSM-snapshot rollback
+        refinement is deliberately skipped (this is a routing heuristic,
+        not a reservation)."""
+        matched = 0
+        for digest, tokens in digests:
+            if self._probe_page(digest, tokens) is None:
+                break
+            matched += 1
+        return matched * self.page_size
+
+    def peek_prefix(self, cache_token_ids, prompt_len: int,
+                    extra_key: bytes = b"") -> int:
+        return self.peek_digests(prefix_digests(
+            cache_token_ids, prompt_len, self.page_size, extra_key))
+
     def match_prefix(self, seq: Sequence, extra_key: bytes = b"") -> int:
         """Claim cached pages covering the longest matching prompt prefix.
 
@@ -244,20 +300,15 @@ class PrefixMemoryManager(MemoryManager):
         """
         assert seq.num_computed_tokens == 0 and not seq.page_table
         self.query_tokens += seq.prompt_len
-        # Only whole pages are cacheable; leave >=1 token to compute.
-        max_pages = (seq.prompt_len - 1) // self.page_size
         matched_digest = b"root"
         matched = 0
         digests: List[bytes] = []
-        for i in range(max_pages):
-            tokens = self._page_tokens(seq, i)
-            digest = _chain_hash(matched_digest, tokens, extra_key)
-            page = self.hash_to_page.get(digest)
+        for digest, tokens in prefix_digests(
+                seq.cache_token_ids, seq.prompt_len, self.page_size,
+                extra_key):
+            page = self._probe_page(digest, tokens)
             if page is None:
                 break
-            _, canary = self.page_meta[page]
-            if tuple(tokens[:_CANARY_TOKENS]) != canary:
-                break  # hash collision
             if self.allocator.is_free(page):
                 self.allocator.allocate_id(page)
             self.ref_count[page] = self.ref_count.get(page, 0) + 1

@@ -240,9 +240,13 @@ def test_dp_pinning_keeps_prefix_cache_warm(ckpt):
             llm.step()
     pinned_hits = llm.schedulers[0].mm.hit_tokens
 
+    # control: force turn 2 onto the OTHER replica → its cache is cold.
+    # (Without any pin, cache-aware routing would follow the cache — see
+    # test_dp_cache_aware_routing.)
     rr = _prefix_llm(ckpt, dp=2)
-    for _ in range(2):                      # round-robin: dp0 then dp1
+    for pin in (0, 1):
         seq = rr._allocate_seq(list(prompt), sp)
+        seq.target_dp = pin
         rr.add_seq(seq)
         while any(s.has_unfinished for s in rr.schedulers):
             rr.step()
@@ -287,3 +291,50 @@ def test_endpoint_per_dp_http_pins_requests(ckpt):
         for s in servers:
             s.shutdown()
         servers[0].state.engine.shutdown()
+
+
+def test_dp_cache_aware_routing(ckpt):
+    """Without endpoint pinning, an UNPINNED second turn routes to the
+    replica holding its prefix (cache-aware routing, beyond the
+    reference's round-robin) — but a request with no substantial match
+    still round-robins."""
+    from gllm_tpu.sampling_params import SamplingParams
+    llm = _prefix_llm(ckpt, dp=2)
+    sp = SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True)
+    convo = list(range(1, 25))              # 6 full pages
+
+    def run(prompt):
+        seq = llm._allocate_seq(list(prompt), sp)
+        llm.add_seq(seq)
+        replica = llm._seq_replica[seq.seq_id]
+        while any(s.has_unfinished for s in llm.schedulers):
+            llm.step()
+        return replica
+
+    r1 = run(convo)                         # lands by round-robin
+    # turn 2 shares the whole turn-1 prompt → must follow the cache
+    r2 = run(convo + [90, 91, 92, 93])
+    assert r2 == r1, (r1, r2)
+    assert llm.schedulers[r1].mm.hit_tokens > 0
+    # unrelated prompt: no match → round-robin continues across replicas
+    seen = {run([100 + i for i in range(20)]),
+            run([60 + i for i in range(20)])}
+    assert len(seen) == 2, seen
+
+
+def test_dp_cache_routing_short_shared_prefix_balances(ckpt):
+    """A SHORT shared prefix (under half the prompt) must not funnel all
+    traffic to one replica."""
+    from gllm_tpu.sampling_params import SamplingParams
+    llm = _prefix_llm(ckpt, dp=2)
+    sp = SamplingParams(temperature=0.0, max_tokens=3, ignore_eos=True)
+    sys_prompt = [7, 8, 9, 10]              # one page of shared prefix
+    replicas = []
+    for i in range(4):
+        body = [20 + 5 * i + j for j in range(20)]  # 5 distinct pages
+        seq = llm._allocate_seq(sys_prompt + body, sp)
+        llm.add_seq(seq)
+        replicas.append(llm._seq_replica[seq.seq_id])
+        while any(s.has_unfinished for s in llm.schedulers):
+            llm.step()
+    assert len(set(replicas)) == 2, replicas
