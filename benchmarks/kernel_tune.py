@@ -68,9 +68,29 @@ def _mixed_workload(T=1024, S=8, Hq=32, Hkv=8, D=128, page=16, ctx=1024):
     return q, k_cache, v_cache, cu, kv_lens, pt, D ** -0.5
 
 
+def _time_reps(run, q, iters, *args, reps=3):
+    """min-of-reps timed loops (r5: at the fast end of the sweep a single
+    loop's per-dispatch tunnel jitter dominated the ranking — two configs
+    that compile to the SAME program measured 35.8 vs 68.4 ms)."""
+    import jax.numpy as jnp
+    out = run(q, *args)
+    _fetch(out)                                    # compile + first fetch
+    best = None
+    for _ in range(reps):
+        t0 = time.monotonic()
+        for _ in range(iters):
+            # chain: next q depends on previous out so device work
+            # serializes without a per-iter fetch
+            q = q + 0.0 * out.astype(jnp.bfloat16)
+            out = run(q, *args)
+        _fetch(out)
+        dt = (time.monotonic() - t0) / iters * 1e3
+        best = dt if best is None else min(best, dt)
+    return best
+
+
 def time_ragged(q_block, kv_block, iters=12):
     import jax
-    import jax.numpy as jnp
     from gllm_tpu.ops.pallas.ragged_attention import ragged_paged_attention
     from gllm_tpu.utils import tpu_compiler_options
     q, kc, vc, cu, kl, pt, scale = _mixed_workload()
@@ -79,21 +99,23 @@ def time_ragged(q_block, kv_block, iters=12):
     # sweep measures what the runner will actually run
     interp = _interp()
 
+    # the VMEM clamp can alias two requested configs to one program; name
+    # the program actually compiled so the parent dedupes the ranking
+    from gllm_tpu.ops.pallas.ragged_attention import effective_q_block
+    bq = effective_q_block(q_block, kv_block, q.shape[1], q.shape[0])
+    print(f"EFFECTIVE ragged:{bq}:{kv_block}", flush=True)
+
+    # KV caches ride as ARGUMENTS (device-buffer handles), never closure
+    # constants: axon's remote_compile ships captured constants in the
+    # request body, and a GB-scale cache gets HTTP 413 / an upload that
+    # outlives the config timeout (the r5 decode-sweep "hang")
     @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
-    def run(qq):
+    def run(qq, kc, vc):
         return ragged_paged_attention(qq, kc, vc, cu, kl, pt, scale=scale,
                                       q_block=q_block, kv_block=kv_block,
                                       interpret=interp)
 
-    out = run(q)
-    _fetch(out)                                    # compile + first fetch
-    t0 = time.monotonic()
-    for _ in range(iters):
-        # chain: next q depends on previous out so device work serializes
-        q = q + 0.0 * out.astype(jnp.bfloat16)
-        out = run(q)
-    _fetch(out)
-    return (time.monotonic() - t0) / iters * 1e3
+    return _time_reps(run, q, iters, kc, vc)
 
 
 def time_decode(kv_block, iters=25):
@@ -113,19 +135,13 @@ def time_decode(kv_block, iters=25):
 
     interp = _interp()
 
+    # caches as args, not closure constants (see time_ragged)
     @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
-    def run(qq):
+    def run(qq, kc, vc):
         return paged_decode_attention(qq, kc, vc, kl, pt, scale=D ** -0.5,
                                       kv_block=kv_block, interpret=interp)
 
-    out = run(q)
-    _fetch(out)
-    t0 = time.monotonic()
-    for _ in range(iters):
-        q = q + 0.0 * out.astype(jnp.bfloat16)
-        out = run(q)
-    _fetch(out)
-    return (time.monotonic() - t0) / iters * 1e3
+    return _time_reps(run, q, iters, kc, vc)
 
 
 VMEM_PROBE_CONFIGS = ((128, 256), (256, 256), (256, 512), (512, 512),
@@ -145,18 +161,20 @@ def vmem_probe_one(qb: int, kb: int):
     from gllm_tpu.ops.pallas.ragged_attention import ragged_paged_attention
     from gllm_tpu.utils import tpu_compiler_options
     q, kc, vc, cu, kl, pt, scale = _mixed_workload(T=2048, ctx=2048)
-    tile_mb = q.shape[1] * qb * kb * 4 / 1e6
+    # binary MB: the consumer (vmem_tile_limit_b) multiplies by 1024²
+    tile_mb = q.shape[1] * qb * kb * 4 / (1024 * 1024)
 
     interp = _interp()
 
+    # caches as args, not closure constants (see time_ragged)
     @ft.partial(jax.jit, compiler_options=tpu_compiler_options())
-    def run(qq):
+    def run(qq, kc, vc):
         return ragged_paged_attention(qq, kc, vc, cu, kl, pt, scale=scale,
                                       q_block=qb, kv_block=kb,
                                       interpret=interp)
 
     try:
-        _fetch(run(q))
+        _fetch(run(q, kc, vc))
         print(f"[vmem] q_block={qb} kv_block={kb} "
               f"score_tile={tile_mb:.1f}MB: OK", flush=True)
     except Exception as e:
@@ -183,6 +201,13 @@ def run_inner(spec: str):
         return None, out
     except subprocess.TimeoutExpired as e:
         return None, "TIMEOUT\n" + str(e.stdout or "")[-500:]
+
+
+def effective_spec(out: str, fallback: str) -> str:
+    for line in reversed(out.strip().splitlines()):
+        if line.startswith("EFFECTIVE "):
+            return line.split(None, 1)[1].strip()
+    return fallback
 
 
 def main():
@@ -228,15 +253,6 @@ def main():
                 return line.split(None, 1)[1].strip()
         return "unknown"
 
-    if args.vmem_probe:
-        for qb, kb in VMEM_PROBE_CONFIGS:
-            ms, out = run_inner(f"vmem:{qb}:{kb}")
-            sys.stdout.write(out if ms is not None
-                             else f"[vmem] q_block={qb} kv_block={kb}: "
-                                  f"TIMEOUT/CRASH\n{out[-300:]}\n")
-            sys.stdout.flush()
-        return
-
     def write_best(best: dict) -> None:
         """Merge winners into the committed table IMMEDIATELY — an outer
         timeout killing the rest of the sweep must not forfeit results
@@ -265,27 +281,74 @@ def main():
         print(f"[tune] wrote {_TABLES_PATH} for {tag}",
               file=sys.stderr)
 
+    if args.vmem_probe:
+        last_ok_mb = None
+        for qb, kb in VMEM_PROBE_CONFIGS:
+            ms, out = run_inner(f"vmem:{qb}:{kb}")
+            sys.stdout.write(out if ms is not None
+                             else f"[vmem] q_block={qb} kv_block={kb}: "
+                                  f"TIMEOUT/CRASH\n{out[-300:]}\n")
+            sys.stdout.flush()
+            if ms is not None and ": OK" in out:
+                # parse the score_tile the child itself computed/printed —
+                # one source of truth for geometry and MB convention
+                for line in out.splitlines():
+                    if "score_tile=" in line and line.rstrip().endswith("OK"):
+                        last_ok_mb = float(
+                            line.split("score_tile=")[1].split("MB")[0])
+        if last_ok_mb is not None:
+            # INFORMATIONAL only — never auto-written to the table. The
+            # score tile is a poor proxy for whole-kernel VMEM: on the r5
+            # chip a 16 MiB probe tile compiled fine, yet committing a
+            # 12 MiB limit let the SERVING program (bq=512) through and
+            # Mosaic's 64 MiB scoped-vmem cap rejected it at 74 MiB total
+            # (q block + scores + p + f32 accumulators ≈ 9× the tile).
+            # Only a real compile of the exact program validates a config
+            # — which is what the block sweep does; the sweep's winners
+            # are recorded in EFFECTIVE (clamped) form and deploy as-is.
+            print(f"[vmem] largest accepted score tile {last_ok_mb:.1f} "
+                  f"MB (informational; 6 MB clamp stays — see comment)",
+                  flush=True)
+        return
+
+    def report(kind, cfg, ms, out):
+        print(f"[tune] {kind} {cfg}: {'%.2f ms' % ms if ms else 'FAIL'}",
+              file=sys.stderr, flush=True)
+        if ms is None:
+            # a FAIL without its error is undiagnosable after the
+            # single-tenant session ends (r5: the decode sweep failed at
+            # all block sizes and left no evidence)
+            print("\n".join("[tune]   | " + ln
+                            for ln in out[-1200:].splitlines()[-12:]),
+                  file=sys.stderr, flush=True)
+
     results = {"ragged": {}, "decode": {}}
     best = {}
     if args.kernel in (None, "ragged"):
+        # requested configs whose VMEM-clamped program was already timed
+        # alias to one entry, keyed by the EFFECTIVE config the child
+        # compiled, and share the min of their timings
+        eff_ms = {}
         for qb, kb in itertools.product(BLOCKS, BLOCKS):
-            ms, _ = run_inner(f"ragged:{qb}:{kb}")
+            ms, out = run_inner(f"ragged:{qb}:{kb}")
+            eff = effective_spec(out, f"ragged:{qb}:{kb}")
+            if ms is not None:
+                eff_ms[eff] = min(ms, eff_ms.get(eff, ms))
             results["ragged"][f"{qb}x{kb}"] = ms
-            print(f"[tune] ragged q={qb} kv={kb}: "
-                  f"{'%.2f ms' % ms if ms else 'FAIL'}",
-                  file=sys.stderr, flush=True)
-        ok_r = {k: v for k, v in results["ragged"].items() if v}
-        if ok_r:
-            qb, kb = min(ok_r, key=ok_r.get).split("x")
+            tag = "" if eff == f"ragged:{qb}:{kb}" else f" [{eff}]"
+            report("ragged", f"q={qb} kv={kb}{tag}", ms, out)
+        if eff_ms:
+            # commit the EFFECTIVE winning program (clamped bq), not the
+            # requested label — the serving-time clamp re-derives the same
+            # program from it
+            _, qb, kb = min(eff_ms, key=eff_ms.get).split(":")
             best["ragged"] = {"q_block": int(qb), "kv_block": int(kb)}
             write_best({"ragged": best["ragged"]})
     if args.kernel in (None, "decode"):
         for kb in BLOCKS:
-            ms, _ = run_inner(f"decode:{kb}")
+            ms, out = run_inner(f"decode:{kb}")
             results["decode"][str(kb)] = ms
-            print(f"[tune] decode kv={kb}: "
-                  f"{'%.2f ms' % ms if ms else 'FAIL'}",
-                  file=sys.stderr, flush=True)
+            report("decode", f"kv={kb}", ms, out)
         ok_d = {k: v for k, v in results["decode"].items() if v}
         if ok_d:
             best["decode"] = {"kv_block": int(min(ok_d, key=ok_d.get))}

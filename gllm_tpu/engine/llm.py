@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import sys
 import time
 from typing import Callable, List, Optional, Sequence as Seq, Union
 
@@ -163,6 +164,15 @@ class LLM:
         self._next_seq_id = 0
         from collections import deque
         self._in_flight = deque()
+        # GLLM_TPU_STEP_TIMING=1: generate() records per-iteration collect
+        # latency / batch kind / committed tokens and prints one JSON
+        # summary line to stderr (where the serving wall-clock goes:
+        # dispatch-bound drain tails vs steady-state blocks). Armed only
+        # inside generate(): a serving engine drives step() directly and
+        # must not accumulate unbounded rows nobody will ever print.
+        self._step_timer = None
+        self._step_timing_enabled = (
+            os.environ.get("GLLM_TPU_STEP_TIMING", "0") not in ("", "0"))
         # Encoder disaggregation (gllm_tpu/disagg/): set by init_disagg on
         # LM nodes; monolith engines leave it None.
         self.disagg_coordinator = None
@@ -343,7 +353,18 @@ class LLM:
                 time.sleep(0.002)
             return []
         batch, handle = self._in_flight.popleft()
+        timer = self._step_timer
+        if timer is not None:
+            t0 = time.monotonic()
         tokens, aux = self.runner.collect(handle)
+        if timer is not None:
+            b = batch[-1] if isinstance(batch, list) else batch
+            kind = (f"decode_block{len(batch)}" if isinstance(batch, list)
+                    else "decode" if b.num_decode == b.num_seqs
+                    else "prefill_mixed")
+            timer.append((time.monotonic() - t0, kind,
+                          sum(x.total_tokens for x in batch)
+                          if isinstance(batch, list) else b.total_tokens))
         if isinstance(batch, list):
             # multi-step block: tokens [K, S]; advance K scheduler steps
             outs = []
@@ -385,31 +406,26 @@ class LLM:
         device draws advance with the scan); penalties / logit_bias /
         logprobs / stop-strings / hybrid-SSM fall back to single chained
         steps."""
-        first = self.scheduler.schedule_chained(prev_batch)
-        if first is None:
-            return []
-        if multi <= 1 or self.model_cfg.use_hybrid:
-            return [first]
-        from gllm_tpu.runner.prepare import BatchBuilder
-        if BatchBuilder.batch_extras(first) - {"seed"}:
-            # penalties / bias / plp / mm / spec need per-step host work;
-            # SEEDED rows fuse fine — their draws are a pure function of
-            # (seed, out_step), which the fused scan advances on device
-            return [first]
-        if any(it.seq.sampling_params.logprobs is not None
-               or it.seq.sampling_params.stop
-               for it in first.items):
-            # stop STRINGS must be checked between steps (a fused block
-            # would stream past the match); logprobs aren't plumbed
-            # through the fused program
-            return [first]
-        chain = [first]
-        while len(chain) < multi:
-            nxt = self.scheduler.schedule_chained(chain[-1])
-            if nxt is None:
-                break
-            chain.append(nxt)
-        return chain
+        k_max = multi
+        if k_max > 1:
+            if self.model_cfg.use_hybrid:
+                k_max = 1
+            # The fused block's OWN batches are all-decode, so prompt-only
+            # extras (mm, plp) can never apply to them — gate only on
+            # per-seq properties that would need per-step host work:
+            # logit_bias (device scatter not in the fused program),
+            # logprobs (not plumbed through it), stop strings (must be
+            # checked between steps or the block streams past the match).
+            # Penalties are refused inside schedule_chain; SEEDED rows
+            # fuse fine — their draws are a pure function of
+            # (seed, out_step), which the fused scan advances on device.
+            elif any(it.seq.sampling_params.logit_bias
+                     or it.seq.sampling_params.logprobs is not None
+                     or it.seq.sampling_params.stop
+                     or it.draft_tokens
+                     for it in prev_batch.items):
+                k_max = 1
+        return self.scheduler.schedule_chain(prev_batch, k_max)
 
     def _step_dp(self) -> List[SeqOutput]:
         """One synchronous step over all DP replicas (single jit program;
@@ -653,14 +669,44 @@ class LLM:
         for s in seqs:
             self.add_seq(s)
 
-        while self.has_unfinished:
-            for out in self.step():
-                if out.new_token_id is not None and self.tokenizer is not None:
-                    self._stream_detokenize(out.seq)
-                if stream_cb is not None and out.new_token_id is not None:
-                    stream_cb(out)
+        if self._step_timing_enabled:
+            self._step_timer = []
+            t_gen = time.monotonic()
+        try:
+            while self.has_unfinished:
+                for out in self.step():
+                    if out.new_token_id is not None \
+                            and self.tokenizer is not None:
+                        self._stream_detokenize(out.seq)
+                    if stream_cb is not None and out.new_token_id is not None:
+                        stream_cb(out)
+            if self._step_timer is not None:
+                self._print_step_timing(time.monotonic() - t_gen)
+        finally:
+            self._step_timer = None
 
         return [self._finalize(s) for s in seqs]
+
+    def _print_step_timing(self, wall_s: float) -> None:
+        import json as _json
+        rows = self._step_timer
+        by_kind: dict = {}
+        for dt, kind, toks in rows:
+            e = by_kind.setdefault(kind, [0, 0.0, 0])
+            e[0] += 1
+            e[1] += dt
+            e[2] += toks
+        summary = {
+            "wall_s": round(wall_s, 2),
+            "iters": len(rows),
+            "collect_s": round(sum(r[0] for r in rows), 2),
+            "kinds": {k: {"iters": v[0], "collect_s": round(v[1], 2),
+                          "tokens": v[2],
+                          "ms_per_iter": round(v[1] / v[0] * 1e3, 1)}
+                      for k, v in sorted(by_kind.items())},
+        }
+        print("[step timing] " + _json.dumps(summary), file=sys.stderr,
+              flush=True)
 
     def chat(self, messages: List[dict],
              sampling_params: Optional[SamplingParams] = None,

@@ -43,6 +43,44 @@ DEFAULT_Q_BLOCK = 128
 NEG_INF = float("-inf")
 
 
+def vmem_tile_limit_b() -> float:
+    """VMEM budget (bytes) for the f32 score tile, resolution order:
+    ``GLLM_TPU_VMEM_TILE_LIMIT_MB`` env (benchmarks/kernel_tune.py
+    --vmem-probe uses it to present oversized tiles to Mosaic and observe
+    the REAL ceiling) > a hand-maintained per-device ``vmem.tile_limit_mb``
+    tuning-table entry (nothing auto-writes it: the score tile is a poor
+    proxy for whole-kernel VMEM — a 12 MB limit derived from the r5 probe
+    let a serving program through that Mosaic's 64 MB scoped cap rejected
+    at 74 MB total) > the conservative 6 MB every chip tested so far
+    accepts."""
+    import os
+    raw = os.environ.get("GLLM_TPU_VMEM_TILE_LIMIT_MB")
+    if raw is not None:
+        try:
+            return float(raw) * 1024 * 1024
+        except ValueError:
+            import warnings
+            warnings.warn("malformed GLLM_TPU_VMEM_TILE_LIMIT_MB; "
+                          "falling back to the tuned/default limit",
+                          stacklevel=2)
+    from gllm_tpu.ops.pallas.tuning import get as tuned
+    return float(tuned("vmem").get("tile_limit_mb", 6.0)) * 1024 * 1024
+
+
+def effective_q_block(q_block: int, kv_block: int, num_q_heads: int,
+                      T: int) -> int:
+    """The q block actually compiled: the requested block (tests use small
+    ones to force blocks that span sequences), scaled down while the f32
+    score tile would crowd VMEM next to the double-buffered KV blocks.
+    Exposed so the block-size sweep can tell when two requested configs
+    alias the same program."""
+    limit_b = vmem_tile_limit_b()
+    bq = min(q_block, T)
+    while num_q_heads * bq * kv_block * 4 > limit_b and bq > 16:
+        bq //= 2
+    return bq
+
+
 def _kernel(cu_ref, kv_lens_ref, pt_ref, first_ref, last_ref,  # prefetch
             *refs,
             page_size: int, pages_per_block: int, scale: float,
@@ -205,23 +243,7 @@ def ragged_paged_attention(
         if v_cache is not None:
             v_cache = v_cache.reshape(num_pages, page_size, v_dim)
 
-    # Honor the requested q block (tests use small ones to force blocks
-    # that span sequences), but scale it down when the f32 score tile
-    # would crowd VMEM next to the double-buffered KV blocks. The 6 MB
-    # default is overridable so benchmarks/kernel_tune.py --vmem-probe can
-    # present oversized tiles to Mosaic and observe the REAL ceiling.
-    import os
-    try:
-        limit_mb = float(os.environ.get("GLLM_TPU_VMEM_TILE_LIMIT_MB", "6"))
-    except ValueError:
-        import warnings
-        warnings.warn("malformed GLLM_TPU_VMEM_TILE_LIMIT_MB; using 6",
-                      stacklevel=2)
-        limit_mb = 6.0
-    limit_b = limit_mb * 1024 * 1024
-    bq = min(q_block, T)
-    while num_q_heads * bq * kv_block * 4 > limit_b and bq > 16:
-        bq //= 2
+    bq = effective_q_block(q_block, kv_block, num_q_heads, T)
     t_pad = -(-T // bq) * bq
     if t_pad != T:
         q = jnp.pad(q, ((0, t_pad - T), (0, 0), (0, 0)))

@@ -30,6 +30,9 @@ BUILTIN = {
     "default": {
         "ragged": {"q_block": 128, "kv_block": 256},
         "decode": {"kv_block": 256},
+        # f32-score-tile VMEM budget for effective_q_block(); per-device
+        # entries are measured by kernel_tune.py --vmem-probe --write
+        "vmem": {"tile_limit_mb": 6.0},
     },
 }
 

@@ -492,43 +492,82 @@ class Scheduler:
             # accepted draft removing a dispatch round trip the chain
             # would have hidden.
             return None
-        items: List[ScheduledSeq] = []
-        total_need = 0
+        chain = self.schedule_chain(prev, 1)
+        return chain[0] if chain else None
+
+    def schedule_chain(self, prev: ScheduledBatch,
+                       k_max: int) -> List[ScheduledBatch]:
+        """Atomically schedule up to ``k_max`` chained decode steps off
+        ``prev`` (see :meth:`schedule_chained`). Feasibility of every link
+        is checked READ-ONLY first, the chain length is then quantized to
+        a power of two, and only the chosen links touch the allocator —
+        so the fused multi-step program (jit-static per K) compiles for
+        K ∈ {2,4,8,...} per bucket instead of every length the workload's
+        nearest-finish distance happens to produce, without any
+        allocator-unwind bookkeeping."""
+        if self.spec_cfg is not None:
+            # Speculation owns decode dispatch (see schedule_chained).
+            return []
         for it in prev.items:
             seq = it.seq
-            if not it.samples or seq.seq_id in self._aborted_ids:
-                return None
+            if seq.seq_id in self._aborted_ids:
+                return []
+            # Mid-prompt prefill chunks don't sample — nothing to chain
+            # off. A chunk at-or-past the end of HOST-known tokens does:
+            # ``prev`` may itself be a chained step whose sampled token
+            # only exists on device, so its chunk end exceeds
+            # seq.num_tokens (``it.samples``'s strict == refused those,
+            # silently capping every multi-step block at ONE chained
+            # step — r5 on-chip: profile=full ran msd=8 as single-token
+            # dispatches).
+            if it.computed_before + it.num_new_tokens < seq.num_tokens:
+                return []
             sp = seq.sampling_params
             if (sp.repetition_penalty != 1.0 or sp.presence_penalty != 0.0
                     or sp.frequency_penalty != 0.0):
-                return None  # needs host-built token counts
-            computed_next = it.computed_before + it.num_new_tokens
-            # Output length after prev's token is appended; chaining a seq
-            # that will finish by max_tokens would waste a step AND change
-            # the batch composition — skip chaining entirely.
-            out_after = computed_next + 1 - seq.prompt_len
-            if out_after >= seq.sampling_params.max_tokens:
-                return None
-            if computed_next + 1 > self.config.max_model_len:
-                return None
-            need = cdiv(computed_next + 1, self.mm.page_size) \
-                - len(seq.page_table)
-            total_need += max(0, need)
-            items.append(ScheduledSeq(seq, 1, computed_next))
-        # Validate the page need of the WHOLE chained batch before touching
-        # the allocator: per-item checks would each pass near a full pool
-        # yet exhaust it mid-allocation below, crashing the step with
-        # earlier items' num_in_flight already incremented.
-        if total_need and not self.mm.can_allocate(total_need):
-            return None
-        for it in items:
-            seq = it.seq
-            # cover tokens [0, computed_before+1) — num_computed_tokens
-            # hasn't advanced yet (prev is still in flight)
-            cover = it.computed_before + 1 - seq.num_computed_tokens
-            self.mm.allocate_seq_pages(seq, cover)
-            seq.num_in_flight += 1
-        return ScheduledBatch(items)
+                return []  # needs host-built token counts
+        # Read-only feasibility walk: link j processes token index
+        # cn0 + j and samples index cn0+j+1. Link j is admitted only
+        # while the PRECEDING step's commit leaves every seq short of its
+        # limit (cn0+j+1-prompt_len is the output count after link j-1 /
+        # prev) — so a chain may END on the step producing a seq's final
+        # token, and never schedules a dead step past a length finish.
+        feasible = 0
+        page = self.mm.page_size
+        base = [(it.seq, it.computed_before + it.num_new_tokens)
+                for it in prev.items]
+        while feasible < k_max:
+            j = feasible
+            if any(cn0 + j + 1 - seq.prompt_len
+                   >= seq.sampling_params.max_tokens
+                   or cn0 + j + 1 > self.config.max_model_len
+                   for seq, cn0 in base):
+                break
+            # validate the page need of the WHOLE chain so far before
+            # touching the allocator: per-link checks would each pass
+            # near a full pool yet exhaust it mid-allocation
+            need_cum = sum(
+                max(0, cdiv(cn0 + j + 1, page) - len(seq.page_table))
+                for seq, cn0 in base)
+            if not self.mm.can_allocate(need_cum):
+                break
+            feasible += 1
+        if not feasible:
+            return []
+        # quantize to a power of two so fused-block compiles stay bounded
+        k = 1 << (feasible.bit_length() - 1)
+        chain: List[ScheduledBatch] = []
+        for j in range(k):
+            items = [ScheduledSeq(seq, 1, cn0 + j) for seq, cn0 in base]
+            for it in items:
+                seq = it.seq
+                # cover tokens [0, computed_before+1) — num_computed_tokens
+                # hasn't advanced yet (prev is still in flight)
+                cover = it.computed_before + 1 - seq.num_computed_tokens
+                self.mm.allocate_seq_pages(seq, cover)
+                seq.num_in_flight += 1
+            chain.append(ScheduledBatch(items))
+        return chain
 
     # ---- output path ------------------------------------------------------
 
