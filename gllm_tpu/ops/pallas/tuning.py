@@ -31,7 +31,9 @@ BUILTIN = {
         "ragged": {"q_block": 128, "kv_block": 256},
         "decode": {"kv_block": 256},
         # f32-score-tile VMEM budget for effective_q_block(); per-device
-        # entries are measured by kernel_tune.py --vmem-probe --write
+        # entries are HAND-maintained from kernel_tune.py --vmem-probe's
+        # informational output (never auto-written — see the probe's
+        # comment on why the score tile is a poor proxy)
         "vmem": {"tile_limit_mb": 6.0},
     },
 }

@@ -45,6 +45,19 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "fp8": jnp.float8_e4m3fn}
 
 
+def _start_host_copy(tree) -> None:
+    """Begin the device→host copy of every array ``collect`` will fetch,
+    at DISPATCH time. Under the axon tunnel a synchronous fetch pays the
+    full host↔device round trip (~75 ms measured r5); a copy started
+    when the step is enqueued is already local by collect time (~9×
+    faster fetch, docs/onchip_r05). No-op where the backend lacks it."""
+    for leaf in jax.tree.leaves(tree):
+        try:
+            leaf.copy_to_host_async()
+        except (AttributeError, RuntimeError, TypeError):
+            pass
+
+
 def _to_host(x) -> np.ndarray:
     """Device→host that also works for multi-host global arrays: sampled
     tokens / logprobs are replicated, so the local shard IS the value."""
@@ -757,6 +770,7 @@ class ModelRunner:
                 self.params, self.kv, stacked, self.cos_sin, token_counts,
                 max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp,
                 spec_sampled=any(_spec_sampled(b.items) for b in live))
+        _start_host_copy((tokens, aux))
         return tokens, aux, [b.num_seqs if b is not None else 0
                              for b in sched_batches]
 
@@ -790,6 +804,7 @@ class ModelRunner:
                 ring=self._use_ring(sched_batch,
                                     batch.token_ids.shape[0]),
                 spec_sampled=_spec_sampled(sched_batch.items))
+        _start_host_copy((tokens, aux))
         return tokens, aux, sched_batch.num_seqs
 
     def _use_ring(self, sched_batch: ScheduledBatch, t_pad: int) -> bool:
@@ -837,6 +852,7 @@ class ModelRunner:
             tokens, self.kv, aux = self._step_fn(
                 self.params, self.kv, batch, self.cos_sin, token_counts,
                 max_q_len=1, logprobs_k=lp_k)
+        _start_host_copy((tokens, aux))
         return tokens, aux, sched_batch.num_seqs
 
     def step_multi(self, chain, prev_handle=None):
@@ -846,7 +862,7 @@ class ModelRunner:
         per-step chain can't provide — remote-attached TPUs pay a full
         host round trip per dispatch, so K steps per dispatch divides that
         cost by K. ``chain`` is K ScheduledBatches produced by
-        schedule_once + (K-1)×schedule_chained over the SAME sequences.
+        Scheduler.schedule_chain over the SAME sequences.
 
         Returns a handle whose collect() yields tokens [K, n]; chainable
         (the last step's on-device tokens feed the next block)."""
@@ -869,11 +885,23 @@ class ModelRunner:
             if prev_tokens.ndim == 2:       # previous multi block
                 prev_tokens = prev_tokens[-1]
             batch = batch._replace(token_ids=prev_tokens)
+        # Per-row alive-link count: rows whose seq dies (length cap)
+        # inside the block freeze their position and write KV to the
+        # dummy page from their death step on; bucket-padding rows are
+        # dead for the whole block. None → every real row runs all K.
+        s_bucket = batch.token_ids.shape[0]
+        au_np = np.zeros(s_bucket, np.int32)
+        n = chain[0].num_seqs
+        if chain[0].active_until is not None:
+            au_np[:n] = chain[0].active_until
+        else:
+            au_np[:n] = K
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
             tokens, self.kv = self._multi_step_fn(
                 self.params, self.kv, batch, self.cos_sin, keys,
-                num_steps=K)
+                jnp.asarray(au_np), num_steps=K)
+        _start_host_copy(tokens)
         return tokens, {}, chain[0].num_seqs
 
     def _build_multi_step_fn(self):
@@ -886,24 +914,31 @@ class ModelRunner:
         @functools.partial(jax.jit, static_argnames=("num_steps",),
                            compiler_options=tpu_compiler_options(),
                            donate_argnums=(1,))
-        def step_multi(params, kv, batch: StepBatch, cos_sin, keys, *,
-                       num_steps: int):
+        def step_multi(params, kv, batch: StepBatch, cos_sin, keys,
+                       active_until, *, num_steps: int):
             def body(carry, xs):
                 k, key = xs
                 kv, tokens = carry
-                pos = batch.positions + k
+                # rows whose seq died (length cap) earlier in the block
+                # freeze: position stops advancing (stays in-bounds of
+                # the page bucket) and KV writes land in the dummy page
+                # (slot 0) so a finished seq's — possibly prefix-cached —
+                # pages are never clobbered by its dead steps
+                adv = jnp.minimum(k, active_until)
+                alive = k < active_until
+                pos = batch.positions + adv
                 # decode rows: one token per seq; recompute flat KV slots
                 # from the (pre-allocated) page table as positions advance
                 page_idx = jnp.take_along_axis(
                     batch.attn.page_table, (pos // page)[:, None],
                     axis=1)[:, 0]
-                slots = page_idx * page + pos % page
+                slots = jnp.where(alive, page_idx * page + pos % page, 0)
                 b = batch._replace(
                     token_ids=tokens,
                     positions=pos,
                     slot_mapping=slots,
                     attn=batch.attn._replace(
-                        kv_lens=batch.attn.kv_lens + k),
+                        kv_lens=batch.attn.kv_lens + adv),
                     # seeded rows draw from (seed, out_step): advancing
                     # out_step per sub-step keeps the fused block
                     # byte-identical to K single seeded steps
@@ -912,7 +947,10 @@ class ModelRunner:
                         out_step=(batch.sampling.out_step + k
                                   if batch.sampling.out_step is not None
                                   else None)),
-                    mrope_positions=(batch.mrope_positions + k
+                    # [3, T]: broadcast the per-row advance over the
+                    # coordinate axis (text-only decode steps advance all
+                    # three mrope coords together)
+                    mrope_positions=(batch.mrope_positions + adv[None, :]
                                      if batch.mrope_positions is not None
                                      else None),
                 )

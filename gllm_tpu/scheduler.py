@@ -92,6 +92,13 @@ def propose_ngram_drafts(token_ids, n: int, k: int,
 @dataclasses.dataclass
 class ScheduledBatch:
     items: List[ScheduledSeq]
+    # Fused multi-step blocks (schedule_chain): per-item count of chain
+    # links in which the item is still ALIVE. A seq that reaches its
+    # length limit mid-block goes inactive — the device program freezes
+    # its position and redirects its KV writes to the dummy page; the
+    # host discards its later sampled tokens. None = every item alive
+    # for the whole block. Set on the FIRST batch of a chain only.
+    active_until: Optional[List[int]] = None
 
     @property
     def num_seqs(self) -> int:
@@ -466,23 +473,28 @@ class Scheduler:
         for seq in reversed(deferred_disagg):
             self.waiting.appendleft(seq)
 
-    def schedule_chained(self, prev: ScheduledBatch) -> \
-            Optional[ScheduledBatch]:
-        """Schedule the NEXT decode step for ``prev``'s sequences before
-        ``prev``'s sampled tokens have reached the host.
+    def schedule_chain(self, prev: ScheduledBatch,
+                       k_max: int) -> List[ScheduledBatch]:
+        """Atomically schedule up to ``k_max`` chained decode steps off
+        ``prev``, before ``prev``'s sampled tokens have reached the host.
 
         This is the overlap-scheduling trick (reference OverlapScheduler's
         deferred placeholder finalize, scheduler.py:702-783 + FutureMap):
-        the next step's input token values live only on the device, but page
-        allocation, positions, and slots depend solely on token *counts*,
-        which the host already knows. The runner feeds the previous step's
-        on-device sampled tokens straight into the chained step — no
+        the next steps' input token values live only on the device, but
+        page allocation, positions, and slots depend solely on token
+        *counts*, which the host already knows. The runner feeds each
+        step's on-device sampled tokens straight into the next — no
         host↔device round trip between decode iterations.
 
-        Returns None (caller falls back to the synchronous path) unless
-        every prev item samples and is guaranteed not to finish by length
-        at prev's step, and pages are available without preemption.
-        """
+        Feasibility of every link is checked READ-ONLY first, the chain
+        length is then quantized to a power of two, and only the chosen
+        links touch the allocator — so the fused multi-step program
+        (jit-static per K) compiles for K ∈ {2,4,8,...} per bucket
+        instead of every length the workload's nearest-finish distance
+        happens to produce, without any allocator-unwind bookkeeping.
+        Returns [] (caller falls back to the synchronous path) unless
+        every prev item samples from a RUNNING seq and pages are
+        available without preemption."""
         if self.spec_cfg is not None:
             # Speculation and chaining are competing dispatch-hiding
             # mechanisms, and drafting needs the committed token VALUES
@@ -491,26 +503,20 @@ class Scheduler:
             # every decode schedules synchronously with drafts, each
             # accepted draft removing a dispatch round trip the chain
             # would have hidden.
-            return None
-        chain = self.schedule_chain(prev, 1)
-        return chain[0] if chain else None
-
-    def schedule_chain(self, prev: ScheduledBatch,
-                       k_max: int) -> List[ScheduledBatch]:
-        """Atomically schedule up to ``k_max`` chained decode steps off
-        ``prev`` (see :meth:`schedule_chained`). Feasibility of every link
-        is checked READ-ONLY first, the chain length is then quantized to
-        a power of two, and only the chosen links touch the allocator —
-        so the fused multi-step program (jit-static per K) compiles for
-        K ∈ {2,4,8,...} per bucket instead of every length the workload's
-        nearest-finish distance happens to produce, without any
-        allocator-unwind bookkeeping."""
-        if self.spec_cfg is not None:
-            # Speculation owns decode dispatch (see schedule_chained).
             return []
         for it in prev.items:
             seq = it.seq
-            if seq.seq_id in self._aborted_ids:
+            # A non-RUNNING seq (EOS/stop finish committed while later
+            # links were in flight, abort, preemption) must force the
+            # sync re-form: without this gate a FINISHED seq whose
+            # in-flight chunk end ran ahead of its committed num_tokens
+            # would be re-chained forever as a zombie row — allocating
+            # pages toward its max_tokens frontier and burning a batch
+            # slot on discarded tokens. (The pre-run-through code's
+            # strict == chunk-end check refused this case as a side
+            # effect.)
+            if (seq.status is not SequenceStatus.RUNNING
+                    or seq.seq_id in self._aborted_ids):
                 return []
             # Mid-prompt prefill chunks don't sample — nothing to chain
             # off. A chunk at-or-past the end of HOST-known tokens does:
@@ -526,29 +532,38 @@ class Scheduler:
             if (sp.repetition_penalty != 1.0 or sp.presence_penalty != 0.0
                     or sp.frequency_penalty != 0.0):
                 return []  # needs host-built token counts
-        # Read-only feasibility walk: link j processes token index
-        # cn0 + j and samples index cn0+j+1. Link j is admitted only
-        # while the PRECEDING step's commit leaves every seq short of its
-        # limit (cn0+j+1-prompt_len is the output count after link j-1 /
-        # prev) — so a chain may END on the step producing a seq's final
-        # token, and never schedules a dead step past a length finish.
-        feasible = 0
+        # Per-seq DEATH step: link j processes token index cn0 + j and
+        # samples index cn0+j+1; seq s can take links j < d_s, where d_s
+        # caps at both its max_tokens and the model length. Link 0 needs
+        # EVERY seq alive (a batch already carrying finished rows forces
+        # the sync path, which re-forms a clean batch) — but a block may
+        # RUN THROUGH deaths that happen inside it: the dead row's device
+        # writes go to the dummy page and its later sampled tokens are
+        # discarded by process_output's not-RUNNING branch, while the
+        # other rows keep their fused block (the all-or-nothing refusal
+        # collapsed most blocks to 1-2 steps on the r5 ShareGPT bench —
+        # with ~150 live seqs SOME row is nearly always one step from
+        # finishing).
         page = self.mm.page_size
         base = [(it.seq, it.computed_before + it.num_new_tokens)
                 for it in prev.items]
-        while feasible < k_max:
+        deaths = [min(seq.sampling_params.max_tokens
+                      + seq.prompt_len - cn0 - 1,
+                      self.config.max_model_len - cn0)
+                  for seq, cn0 in base]
+        if min(deaths) < 1:
+            return []
+        feasible = 0
+        while feasible < min(k_max, max(deaths)):
             j = feasible
-            if any(cn0 + j + 1 - seq.prompt_len
-                   >= seq.sampling_params.max_tokens
-                   or cn0 + j + 1 > self.config.max_model_len
-                   for seq, cn0 in base):
-                break
             # validate the page need of the WHOLE chain so far before
             # touching the allocator: per-link checks would each pass
-            # near a full pool yet exhaust it mid-allocation
+            # near a full pool yet exhaust it mid-allocation. Dead links
+            # allocate nothing.
             need_cum = sum(
-                max(0, cdiv(cn0 + j + 1, page) - len(seq.page_table))
-                for seq, cn0 in base)
+                max(0, cdiv(cn0 + min(j + 1, d), page)
+                    - len(seq.page_table))
+                for (seq, cn0), d in zip(base, deaths))
             if not self.mm.can_allocate(need_cum):
                 break
             feasible += 1
@@ -558,15 +573,23 @@ class Scheduler:
         k = 1 << (feasible.bit_length() - 1)
         chain: List[ScheduledBatch] = []
         for j in range(k):
-            items = [ScheduledSeq(seq, 1, cn0 + j) for seq, cn0 in base]
-            for it in items:
-                seq = it.seq
-                # cover tokens [0, computed_before+1) — num_computed_tokens
-                # hasn't advanced yet (prev is still in flight)
-                cover = it.computed_before + 1 - seq.num_computed_tokens
-                self.mm.allocate_seq_pages(seq, cover)
+            # dead links freeze computed_before at the death position —
+            # the NEXT chain attempt off this batch then fails the
+            # link-0 gate above, forcing the sync re-form
+            items = [ScheduledSeq(seq, 1, cn0 + min(j, d))
+                     for (seq, cn0), d in zip(base, deaths)]
+            for it, ((seq, _), d) in zip(items, zip(base, deaths)):
+                if j < d:
+                    # cover tokens [0, computed_before+1) —
+                    # num_computed_tokens hasn't advanced yet (prev is
+                    # still in flight)
+                    cover = it.computed_before + 1 - seq.num_computed_tokens
+                    self.mm.allocate_seq_pages(seq, cover)
                 seq.num_in_flight += 1
             chain.append(ScheduledBatch(items))
+        if any(d < k for d in deaths):
+            chain[0] = dataclasses.replace(
+                chain[0], active_until=[min(d, k) for d in deaths])
         return chain
 
     # ---- output path ------------------------------------------------------
