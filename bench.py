@@ -288,6 +288,10 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--profile", choices=PROFILES, default="full",
                     help="serving-loop feature level (degrade ladder)")
+    ap.add_argument("--attn", choices=("auto", "pallas", "xla"),
+                    default="auto",
+                    help="attention_impl override (A/B the decode paths "
+                         "on chip without editing profiles)")
     ap.add_argument("--inner", action="store_true",
                     help="(internal) run the measurement directly; without"
                          " this flag a supervisor child-process wrapper"
@@ -386,6 +390,8 @@ def main():
     phase("backend_init")
     log(f"backend={jax.default_backend()} devices={jax.devices()} "
         f"profile={args.profile}")
+    if args.attn != "auto":
+        engine_cfg.attention_impl = args.attn
     phase("engine_build")
     t0 = time.monotonic()
     llm = LLM(config=engine_cfg, model_cfg=model_cfg)

@@ -118,7 +118,7 @@ def time_ragged(q_block, kv_block, iters=12):
     return _time_reps(run, q, iters, kc, vc)
 
 
-def time_decode(kv_block, iters=25):
+def time_decode(kv_block, gsz=1, iters=25):
     import jax
     import jax.numpy as jnp
     from gllm_tpu.ops.pallas.decode_attention import paged_decode_attention
@@ -139,7 +139,8 @@ def time_decode(kv_block, iters=25):
     @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
     def run(qq, kc, vc):
         return paged_decode_attention(qq, kc, vc, kl, pt, scale=D ** -0.5,
-                                      kv_block=kv_block, interpret=interp)
+                                      kv_block=kv_block, interpret=interp,
+                                      group_size=gsz)
 
     return _time_reps(run, q, iters, kc, vc)
 
@@ -226,7 +227,8 @@ def main():
         if parts[0] == "ragged":
             ms = time_ragged(int(parts[1]), int(parts[2]))
         elif parts[0] == "decode":
-            ms = time_decode(int(parts[1]))
+            ms = time_decode(int(parts[1]),
+                             int(parts[2]) if len(parts) > 2 else 1)
         elif parts[0] == "vmem":
             vmem_probe_one(int(parts[1]), int(parts[2]))
             print("RESULT 0.0", flush=True)
@@ -345,13 +347,17 @@ def main():
             best["ragged"] = {"q_block": int(qb), "kv_block": int(kb)}
             write_best({"ragged": best["ragged"]})
     if args.kernel in (None, "decode"):
-        for kb in BLOCKS:
-            ms, out = run_inner(f"decode:{kb}")
-            results["decode"][str(kb)] = ms
-            report("decode", f"kv={kb}", ms, out)
+        # group sweep: gsz seqs per program, one in-flight DMA each —
+        # the decode kernel's cost is a chain of DMA latencies, so the
+        # group dimension matters more than the block size
+        for kb, gsz in itertools.product(BLOCKS, (1, 2, 4, 8, 16)):
+            ms, out = run_inner(f"decode:{kb}:{gsz}")
+            results["decode"][f"{kb}g{gsz}"] = ms
+            report("decode", f"kv={kb} group={gsz}", ms, out)
         ok_d = {k: v for k, v in results["decode"].items() if v}
         if ok_d:
-            best["decode"] = {"kv_block": int(min(ok_d, key=ok_d.get))}
+            kb, gsz = min(ok_d, key=ok_d.get).split("g")
+            best["decode"] = {"kv_block": int(kb), "group": int(gsz)}
             write_best({"decode": best["decode"]})
     print(json.dumps({"results": results, "best": best}))
 

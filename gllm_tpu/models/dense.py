@@ -110,10 +110,23 @@ def init_params(cfg: ModelConfig, seed: int = 0,
 # Forward
 # ---------------------------------------------------------------------------
 
-def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
-               cos_sin, *, attn_impl: str, max_q_len: int):
+def _attention(lp, x, batch: StepBatch, k_all, v_all, cfg: ModelConfig,
+               cos_sin, *, attn_impl: str, max_q_len: int, li):
+    """One layer's attention against the STACKED [L, P, ...] cache.
+
+    The cache is addressed through a flat [L*P, ...] view with the layer
+    offset folded into the page table (+ li*P) and slot mapping
+    (+ li*P*page): the scan carry is only ever touched by a sparse
+    scatter (in-place under donation) and the kernels' page DMAs — the
+    earlier per-layer dynamic_index/dynamic_update_index round-trip
+    materialized TWO full layer-slice copies per layer per step (~26 ms
+    of a ~38 ms decode step on the r5 chip). Page 0 of every layer is
+    that layer's dummy page, so offset padding entries stay harmless."""
     T = x.shape[0]
     Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    L, P, page_size = k_all.shape[0], k_all.shape[1], k_all.shape[2]
+    k_cache = k_all.reshape((L * P,) + k_all.shape[2:])
+    v_cache = v_all.reshape((L * P,) + v_all.shape[2:])
 
     q = qmm(x, lp["q_proj"])
     k = qmm(x, lp["k_proj"])
@@ -137,7 +150,8 @@ def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
         rope_fn = (apply_rope_interleaved if cfg.rope_interleaved
                    else apply_rope)
         q, k = rope_fn(q, k, batch.positions, cos_sin)
-    k_cache, v_cache = write_kv(k_cache, v_cache, k, v, batch.slot_mapping)
+    k_cache, v_cache = write_kv(k_cache, v_cache, k, v,
+                                batch.slot_mapping + li * (P * page_size))
     if attn_impl == "ring":
         # Sequence-parallel prefill (sp mesh axis): the runner routes a
         # single-seq from-position-0 chunk here — self-attention over the
@@ -151,11 +165,14 @@ def _attention(lp, x, batch: StepBatch, k_cache, v_cache, cfg: ModelConfig,
                                       scale=D ** -0.5,
                                       kv_valid=batch.attn.kv_lens[0])
     else:
-        attn = paged_attention(q, k_cache, v_cache, batch.attn,
+        md = batch.attn._replace(
+            page_table=batch.attn.page_table + li * P)
+        attn = paged_attention(q, k_cache, v_cache, md,
                                scale=D ** -0.5, max_q_len=max_q_len,
                                impl=attn_impl)
     out = qmm(attn.reshape(T, Hq * D), lp["o_proj"])
-    return out, k_cache, v_cache
+    return (out, k_cache.reshape(k_all.shape),
+            v_cache.reshape(v_all.shape))
 
 
 def _mlp(lp, x):
@@ -207,13 +224,9 @@ def forward(
         h, res, k_all, v_all, li = carry
         normed, res = fused_add_rms_norm(h, res, lp["input_norm"],
                                          cfg.rms_norm_eps)
-        k_c = jax.lax.dynamic_index_in_dim(k_all, li, 0, keepdims=False)
-        v_c = jax.lax.dynamic_index_in_dim(v_all, li, 0, keepdims=False)
-        attn_out, k_c, v_c = _attention(
-            lp, normed, batch, k_c, v_c, cfg, cos_sin,
-            attn_impl=attn_impl, max_q_len=max_q_len)
-        k_all = jax.lax.dynamic_update_index_in_dim(k_all, k_c, li, 0)
-        v_all = jax.lax.dynamic_update_index_in_dim(v_all, v_c, li, 0)
+        attn_out, k_all, v_all = _attention(
+            lp, normed, batch, k_all, v_all, cfg, cos_sin,
+            attn_impl=attn_impl, max_q_len=max_q_len, li=li)
         if cfg.sandwich_norms:
             attn_out = rms_norm(attn_out, lp["post_self_attn_norm"],
                                 cfg.rms_norm_eps)

@@ -216,10 +216,12 @@ def _paged_attention(
             from gllm_tpu.ops.pallas.decode_attention import (
                 paged_decode_attention)
             from gllm_tpu.ops.pallas.tuning import get as tuned
+            cfg = tuned("decode")
             out = paged_decode_attention(
                 q, k_cache, v_cache, metadata.kv_lens, metadata.page_table,
                 scale=scale, interpret=interpret, v_dim=v_dim,
-                kv_block=tuned("decode")["kv_block"])
+                kv_block=cfg["kv_block"],
+                group_size=int(cfg.get("group", 1)))
         else:
             from gllm_tpu.ops.pallas.ragged_attention import (
                 ragged_paged_attention)

@@ -33,10 +33,94 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from gllm_tpu.ops.pallas.paged_kv import (block_kv, kv_stream_specs,
+from gllm_tpu.ops.pallas.paged_kv import (attend_block,
+                                          kv_stream_specs,
                                           make_fetch_fns)
 
 DEFAULT_KV_BLOCK = 256
+
+
+def _kernel_grouped(kv_lens_ref, pt_ref,    # scalar prefetch
+                    *refs,
+                    page_size: int, pages_per_block: int, scale: float,
+                    num_kv_heads: int, group: int, head_dim: int,
+                    v_dim: int, shared_kv: bool, mqa: bool, gsz: int):
+    """``gsz`` sequences per grid program, ONE buffer slot each, fetched
+    round-robin so up to ``gsz`` page DMAs are in flight at once.
+
+    Rationale (r5 on-chip): decode compute per kv block is ~0 — the MXU
+    dots are microscopic — so the per-seq double buffer of ``_kernel``
+    degenerates into a chain of bare DMA *latencies* (~44 µs/seq
+    measured; × S/2 programs per core × num_layers ≈ the whole decode
+    step). Interleaving ``gsz`` sequences divides that latency chain by
+    ``gsz`` without paying any padded-extent HBM traffic."""
+    if shared_kv:
+        q_ref, k_hbm, o_ref, k_buf, sems = refs
+        v_hbm = v_buf = None
+    else:
+        q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems = refs
+    gi = pl.program_id(0)
+    bk = pages_per_block * page_size
+    start_fetch, wait_fetch = make_fetch_fns(
+        pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems, pages_per_block,
+        shared_kv)
+
+    seq_ids = [gi * gsz + g for g in range(gsz)]
+    kv_lens = [kv_lens_ref[s] for s in seq_ids]
+    n_blocks = [pl.cdiv(kv_len, bk) for kv_len in kv_lens]
+    for g in range(gsz):
+        @pl.when(n_blocks[g] > 0)
+        def _(g=g):
+            start_fetch(g, seq_ids[g], 0)
+
+    lead = (num_kv_heads * group,) if mqa else (num_kv_heads, group)
+    qs = []
+    for g in range(gsz):
+        q = q_ref[g].astype(jnp.float32) * scale          # [Hq, D]
+        qs.append(q if mqa else q.reshape(num_kv_heads, group, head_dim))
+
+    max_nb = n_blocks[0]
+    for g in range(1, gsz):
+        max_nb = jnp.maximum(max_nb, n_blocks[g])
+
+    def body(r, carry):
+        out = list(carry)
+        for g in range(gsz):
+            m, l, acc = out[3 * g], out[3 * g + 1], out[3 * g + 2]
+            live = r < n_blocks[g]
+
+            @pl.when(live)
+            def _(g=g):
+                wait_fetch(g, seq_ids[g], r)
+
+            # NOTE: the next-block re-issue for this slot happens inside
+            # pl.when below, between the (buffered) loads attend_block
+            # performs and the rest of the round-robin — program order
+            # keeps the loads ahead of the re-issued DMA.
+            m_new, l_new, acc_new = attend_block(
+                qs[g], k_buf, v_buf, g, bk, num_kv_heads, head_dim,
+                v_dim, shared_kv, mqa, kv_lens[g], r, m, l, acc)
+
+            @pl.when(live & (r + 1 < n_blocks[g]))
+            def _(g=g):
+                start_fetch(g, seq_ids[g], r + 1)
+
+            out[3 * g] = jnp.where(live, m_new, m)
+            out[3 * g + 1] = jnp.where(live, l_new, l)
+            out[3 * g + 2] = jnp.where(live, acc_new, acc)
+        return tuple(out)
+
+    init = []
+    for _ in range(gsz):
+        init += [jnp.full((*lead, 1), -jnp.inf, jnp.float32),
+                 jnp.zeros((*lead, 1), jnp.float32),
+                 jnp.zeros((*lead, v_dim), jnp.float32)]
+    final = jax.lax.fori_loop(0, max_nb, body, tuple(init))
+    for g in range(gsz):
+        l, acc = final[3 * g + 1], final[3 * g + 2]
+        out = acc / jnp.maximum(l, 1e-30)                # padded seqs → 0
+        o_ref[g] = out.reshape(num_kv_heads * group,
+                               v_dim).astype(o_ref.dtype)
 
 
 def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
@@ -66,7 +150,6 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
     # MQA (Hkv == 1): keep everything 2-D — scores [Hq, BK] from one
     # q @ kᵀ MXU dot; the caches arrive 3-D with the head axis squeezed.
     qh = q if mqa else q.reshape(num_kv_heads, group, head_dim)
-    kv_axis = 1 if mqa else 2
 
     def body(i, carry):
         m, l, acc = carry
@@ -77,39 +160,9 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
             start_fetch(1 - slot, s, i + 1)
 
         wait_fetch(slot, s, i)
-        k, v = block_kv(k_buf, v_buf, slot, bk, num_kv_heads, head_dim,
-                        v_dim, shared_kv, mqa=mqa)
-        if mqa:
-            kt = k.astype(jnp.float32)                  # [BK, D]
-            vt = v.astype(jnp.float32)                  # [BK, Dv]
-            scores = jax.lax.dot_general(               # [Hq, BK]
-                qh, kt, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-        else:
-            kt = k.astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, BK, D]
-            vt = v.astype(jnp.float32).transpose(1, 0, 2)  # [Hkv, BK, Dv]
-            scores = jax.lax.dot_general(               # [Hkv, G, BK]
-                qh, kt, (((2,), (2,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)
-        kv_pos = i * bk + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, kv_axis)
-        scores = jnp.where(kv_pos < kv_len, scores, -jnp.inf)
-
-        m_blk = jnp.max(scores, axis=kv_axis, keepdims=True)
-        m_new = jnp.maximum(m, m_blk)
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(scores - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=kv_axis, keepdims=True)
-        if mqa:
-            pv = jax.lax.dot_general(                   # [Hq, Dv]
-                p, vt, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-        else:
-            pv = jax.lax.dot_general(                   # [Hkv, G, Dv]
-                p, vt, (((2,), (1,)), ((0,), (0,))),
-                preferred_element_type=jnp.float32)
-        acc_new = acc * alpha + pv
-        return m_new, l_new, acc_new
+        return attend_block(qh, k_buf, v_buf, slot, bk, num_kv_heads,
+                            head_dim, v_dim, shared_kv, mqa, kv_len, i,
+                            m, l, acc)
 
     lead = (num_kv_heads * group,) if mqa else (num_kv_heads, group)
     m0 = jnp.full((*lead, 1), -jnp.inf, jnp.float32)
@@ -124,7 +177,7 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
 
 @functools.partial(jax.jit,
                    static_argnames=("scale", "kv_block", "interpret",
-                                    "v_dim"))
+                                    "v_dim", "group_size"))
 def paged_decode_attention(
     q: jnp.ndarray,            # [S, Hq, D]
     k_cache: jnp.ndarray,      # [num_pages, page_size, Hkv, D]
@@ -136,6 +189,7 @@ def paged_decode_attention(
     kv_block: int = DEFAULT_KV_BLOCK,
     interpret: bool = False,
     v_dim: Optional[int] = None,
+    group_size: int = 1,       # seqs per grid program (see _kernel_grouped)
 ) -> jnp.ndarray:
     S, num_q_heads, head_dim = q.shape
     num_pages, page_size, num_kv_heads, _ = k_cache.shape
@@ -165,37 +219,57 @@ def paged_decode_attention(
                              ((0, 0), (0, pages_per_block - rem)))
         max_pages += pages_per_block - rem
 
-    kernel = functools.partial(
-        _kernel, page_size=page_size, pages_per_block=pages_per_block,
-        scale=scale, num_kv_heads=num_kv_heads, group=group,
-        head_dim=head_dim, v_dim=v_dim, shared_kv=shared_kv, mqa=mqa)
+    gsz = max(1, group_size)
+    if gsz > 1:
+        # pad the seq axis to a whole number of groups; padded rows have
+        # kv_len 0 (skip every round) and dummy page-table rows
+        s_pad = -(-S // gsz) * gsz
+        if s_pad != S:
+            q = jnp.pad(q, ((0, s_pad - S), (0, 0), (0, 0)))
+            kv_lens = jnp.pad(kv_lens, (0, s_pad - S))
+            page_table = jnp.pad(page_table, ((0, s_pad - S), (0, 0)))
+        kernel = functools.partial(
+            _kernel_grouped, page_size=page_size,
+            pages_per_block=pages_per_block, scale=scale,
+            num_kv_heads=num_kv_heads, group=group, head_dim=head_dim,
+            v_dim=v_dim, shared_kv=shared_kv, mqa=mqa, gsz=gsz)
+        slots, n_prog, blk = gsz, s_pad // gsz, gsz
+    else:
+        kernel = functools.partial(
+            _kernel, page_size=page_size, pages_per_block=pages_per_block,
+            scale=scale, num_kv_heads=num_kv_heads, group=group,
+            head_dim=head_dim, v_dim=v_dim, shared_kv=shared_kv, mqa=mqa)
+        slots, n_prog, blk = 2, S, 1
+        s_pad = S
 
     kv_specs, scratch_shapes, kv_inputs = kv_stream_specs(
         k_cache, v_cache, pages_per_block, page_size, num_kv_heads,
-        head_dim, v_dim, mqa=mqa)
+        head_dim, v_dim, mqa=mqa, slots=slots)
     in_specs = [
-        pl.BlockSpec((1, num_q_heads, head_dim), lambda s, *_: (s, 0, 0),
+        pl.BlockSpec((blk, num_q_heads, head_dim), lambda s, *_: (s, 0, 0),
                      memory_space=pltpu.VMEM),
     ] + kv_specs
     inputs = [kv_lens, page_table, q] + kv_inputs
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(S,),
+        grid=(n_prog,),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, num_q_heads, v_dim),
+        out_specs=pl.BlockSpec((blk, num_q_heads, v_dim),
                                lambda s, *_: (s, 0, 0),
                                memory_space=pltpu.VMEM),
         scratch_shapes=scratch_shapes,
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, num_q_heads, v_dim), q.dtype),
-        # Sequences are independent → let Mosaic split the grid across
-        # Megacore TensorCores.
+        out_shape=jax.ShapeDtypeStruct((s_pad, num_q_heads, v_dim),
+                                       q.dtype),
+        # Sequences/groups are independent → let Mosaic split the grid
+        # across Megacore TensorCores.
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)) if interpret else
         pltpu.CompilerParams(dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*inputs)
+    return out[:S] if s_pad != S else out

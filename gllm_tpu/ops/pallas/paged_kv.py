@@ -63,25 +63,72 @@ def block_kv(k_buf, v_buf, slot, bk: int, num_kv_heads: int,
     return k, v
 
 
+def attend_block(qh, k_buf, v_buf, slot, bk: int, num_kv_heads: int,
+                 head_dim: int, v_dim: int, shared_kv: bool, mqa: bool,
+                 kv_len, blk_idx, m, l, acc):
+    """One kv-block online-softmax update, shared by the decode kernels.
+
+    ``qh`` is the pre-scaled query ([Hq, D] in mqa mode, else
+    [Hkv, G, D]); (m, l, acc) is the running flash-attention state.
+    Returns the updated (m, l, acc). Keys past ``kv_len`` are masked."""
+    import jax
+    import jax.numpy as jnp
+    kv_axis = 1 if mqa else 2
+    k, v = block_kv(k_buf, v_buf, slot, bk, num_kv_heads, head_dim,
+                    v_dim, shared_kv, mqa=mqa)
+    if mqa:
+        kt = k.astype(jnp.float32)                      # [BK, D]
+        vt = v.astype(jnp.float32)                      # [BK, Dv]
+        scores = jax.lax.dot_general(                   # [Hq, BK]
+            qh, kt, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        kt = k.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, D]
+        vt = v.astype(jnp.float32).transpose(1, 0, 2)   # [Hkv, BK, Dv]
+        scores = jax.lax.dot_general(                   # [Hkv, G, BK]
+            qh, kt, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+    kv_pos = blk_idx * bk + jax.lax.broadcasted_iota(
+        jnp.int32, scores.shape, kv_axis)
+    scores = jnp.where(kv_pos < kv_len, scores, -jnp.inf)
+
+    m_blk = jnp.max(scores, axis=kv_axis, keepdims=True)
+    m_new = jnp.maximum(m, m_blk)
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=kv_axis, keepdims=True)
+    if mqa:
+        pv = jax.lax.dot_general(                       # [Hq, Dv]
+            p, vt, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:
+        pv = jax.lax.dot_general(                       # [Hkv, G, Dv]
+            p, vt, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+    return m_new, l_new, acc * alpha + pv
+
+
 def kv_stream_specs(k_cache, v_cache, pages_per_block: int, page_size: int,
                     num_kv_heads: int, head_dim: int, v_dim: int,
-                    mqa: bool = False):
+                    mqa: bool = False, slots: int = 2):
     """(in_specs_tail, scratch_shapes, inputs_tail) for the KV streams.
 
     Appends the v stream only when a distinct v cache exists; the DMA
     semaphore array always comes last in scratch. ``mqa`` expects 3-D
-    caches [P, page, D] (head axis squeezed by the caller).
+    caches [P, page, D] (head axis squeezed by the caller). ``slots`` is
+    the buffer-slot count: 2 for the double-buffer kernels, the seq
+    group size for the grouped decode kernel (one slot per sequence).
     """
     shared_kv = v_cache is None
     head_shape = () if mqa else (num_kv_heads,)
     in_specs = [pl.BlockSpec(memory_space=pl.ANY)]
-    scratch = [pltpu.VMEM((2, pages_per_block, page_size, *head_shape,
+    scratch = [pltpu.VMEM((slots, pages_per_block, page_size, *head_shape,
                            head_dim), k_cache.dtype)]
     inputs = [k_cache]
     if not shared_kv:
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
-        scratch.append(pltpu.VMEM((2, pages_per_block, page_size,
+        scratch.append(pltpu.VMEM((slots, pages_per_block, page_size,
                                    *head_shape, v_dim), v_cache.dtype))
         inputs.append(v_cache)
-    scratch.append(pltpu.SemaphoreType.DMA((2, pages_per_block, 2)))
+    scratch.append(pltpu.SemaphoreType.DMA((slots, pages_per_block, 2)))
     return in_specs, scratch, inputs

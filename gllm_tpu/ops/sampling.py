@@ -150,10 +150,22 @@ def _topk_topp_mask(logits: jnp.ndarray, top_k: jnp.ndarray,
 
 
 def sample(logits: jnp.ndarray, md: SamplingMetadata,
-           token_counts: Optional[jnp.ndarray] = None) -> jnp.ndarray:
-    """logits: [S, V] → sampled token ids [S] int32."""
+           token_counts: Optional[jnp.ndarray] = None, *,
+           all_greedy: bool = False) -> jnp.ndarray:
+    """logits: [S, V] → sampled token ids [S] int32.
+
+    ``all_greedy`` is a STATIC flag (part of the step program's jit key):
+    when every live request in the batch has temperature 0, the whole
+    sampled branch — a [S, V] descending sort for the top-k/top-p/min-p
+    mask plus per-row Gumbel draws — compiles away and the program ends
+    at the argmax. On the r5 chip that branch was ~88 ms of a ~96 ms
+    decode step (jnp.sort over [256, 128256] lowers to an XLA sort+while
+    pair); greedy rows of a MIXED batch take the same jnp.where below,
+    so the two programs agree bit-for-bit on greedy rows."""
     logits = adjust_logits(logits, token_counts, md)
     greedy_tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if all_greedy:
+        return greedy_tokens
 
     temp = jnp.maximum(md.temperature, 1e-6)[:, None]
     scaled = _topk_topp_mask(logits / temp, md.top_k, md.top_p, md.min_p)

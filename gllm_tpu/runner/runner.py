@@ -45,6 +45,13 @@ _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "fp8": jnp.float8_e4m3fn}
 
 
+
+def _all_greedy(items) -> bool:
+    """Static greedy flag for the step programs (see ops/sampling.sample):
+    True compiles the sampled branch away for this batch."""
+    return all(it.seq.sampling_params.temperature == 0.0 for it in items)
+
+
 def _start_host_copy(tree) -> None:
     """Begin the device→host copy of every array ``collect`` will fetch,
     at DISPATCH time. Under the axon tunnel a synchronous fetch pays the
@@ -466,20 +473,21 @@ class ModelRunner:
         @functools.partial(jax.jit,
                            static_argnames=("max_q_len", "logprobs_k",
                                             "prompt_lp", "ring",
-                                            "spec_sampled"),
+                                            "spec_sampled", "all_greedy"),
                            donate_argnums=(1,),
                            compiler_options=tpu_compiler_options())
         def step(params, kv, batch: StepBatch, cos_sin, token_counts,
                  *, max_q_len: int, logprobs_k: int = -1,
                  prompt_lp: bool = False, ring: bool = False,
-                 spec_sampled: bool = False):
+                 spec_sampled: bool = False, all_greedy: bool = False):
             hidden, residual, kv = fwd(params, kv, batch, cfg,
                                        cos_sin=cos_sin,
                                        attn_impl=("ring" if ring
                                                   else attn_impl),
                                        max_q_len=max_q_len)
             logits = logits_fn(params, hidden, residual, batch, cfg)
-            tokens = sample(logits, batch.sampling, token_counts)
+            tokens = sample(logits, batch.sampling, token_counts,
+                            all_greedy=all_greedy)
             aux = lp_aux(params, cfg, logits, tokens, hidden, residual,
                          batch, token_counts, logprobs_k, prompt_lp)
             if batch.spec_rows is not None:
@@ -497,14 +505,15 @@ class ModelRunner:
 
             def one(kv_r, batch_r, counts_r, params, cos_sin, *,
                     max_q_len, logprobs_k, prompt_lp,
-                    spec_sampled=False):
+                    spec_sampled=False, all_greedy=False):
                 hidden, residual, kv_r = fwd(params, kv_r, batch_r,
                                              cfg_dp, cos_sin=cos_sin,
                                              attn_impl=attn_impl,
                                              max_q_len=max_q_len)
                 logits = logits_fn(params, hidden, residual, batch_r,
                                    cfg_dp)
-                tokens = sample(logits, batch_r.sampling, counts_r)
+                tokens = sample(logits, batch_r.sampling, counts_r,
+                                all_greedy=all_greedy)
                 aux = lp_aux(params, cfg_dp, logits, tokens, hidden,
                              residual, batch_r, counts_r, logprobs_k,
                              prompt_lp)
@@ -519,15 +528,18 @@ class ModelRunner:
             @functools.partial(jax.jit,
                                static_argnames=("max_q_len", "logprobs_k",
                                                 "prompt_lp",
-                                                "spec_sampled"),
+                                                "spec_sampled",
+                                                "all_greedy"),
                                donate_argnums=(1,),
                                compiler_options=tpu_compiler_options())
             def step_dp(params, kv, batch, cos_sin, token_counts, *,
                         max_q_len: int, logprobs_k: int = -1,
                         prompt_lp: bool = False,
-                        spec_sampled: bool = False):
+                        spec_sampled: bool = False,
+                        all_greedy: bool = False):
                 kw = dict(max_q_len=max_q_len, logprobs_k=logprobs_k,
-                          prompt_lp=prompt_lp, spec_sampled=spec_sampled)
+                          prompt_lp=prompt_lp, spec_sampled=spec_sampled,
+                          all_greedy=all_greedy)
                 if attn_impl != "pallas" or mesh is None:
                     # XLA attention: plain vmap over stacked replicas —
                     # GSPMD partitions the batched program over the
@@ -769,7 +781,8 @@ class ModelRunner:
             tokens, self.kv, aux = self._step_fn_dp(
                 self.params, self.kv, stacked, self.cos_sin, token_counts,
                 max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp,
-                spec_sampled=any(_spec_sampled(b.items) for b in live))
+                spec_sampled=any(_spec_sampled(b.items) for b in live),
+                all_greedy=all(_all_greedy(b.items) for b in live))
         _start_host_copy((tokens, aux))
         return tokens, aux, [b.num_seqs if b is not None else 0
                              for b in sched_batches]
@@ -803,7 +816,8 @@ class ModelRunner:
                 max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp,
                 ring=self._use_ring(sched_batch,
                                     batch.token_ids.shape[0]),
-                spec_sampled=_spec_sampled(sched_batch.items))
+                spec_sampled=_spec_sampled(sched_batch.items),
+                all_greedy=_all_greedy(sched_batch.items))
         _start_host_copy((tokens, aux))
         return tokens, aux, sched_batch.num_seqs
 
@@ -851,7 +865,8 @@ class ModelRunner:
         with mesh_context(self.mesh):
             tokens, self.kv, aux = self._step_fn(
                 self.params, self.kv, batch, self.cos_sin, token_counts,
-                max_q_len=1, logprobs_k=lp_k)
+                max_q_len=1, logprobs_k=lp_k,
+                all_greedy=_all_greedy(sched_batch.items))
         _start_host_copy((tokens, aux))
         return tokens, aux, sched_batch.num_seqs
 
@@ -900,7 +915,8 @@ class ModelRunner:
         with mesh_context(self.mesh):
             tokens, self.kv = self._multi_step_fn(
                 self.params, self.kv, batch, self.cos_sin, keys,
-                jnp.asarray(au_np), num_steps=K)
+                jnp.asarray(au_np), num_steps=K,
+                all_greedy=_all_greedy(chain[0].items))
         _start_host_copy(tokens)
         return tokens, {}, chain[0].num_seqs
 
@@ -911,11 +927,13 @@ class ModelRunner:
         attn_impl = self.attn_impl
         page = self.config.cache.page_size
 
-        @functools.partial(jax.jit, static_argnames=("num_steps",),
+        @functools.partial(jax.jit, static_argnames=("num_steps",
+                                                     "all_greedy"),
                            compiler_options=tpu_compiler_options(),
                            donate_argnums=(1,))
         def step_multi(params, kv, batch: StepBatch, cos_sin, keys,
-                       active_until, *, num_steps: int):
+                       active_until, *, num_steps: int,
+                       all_greedy: bool = False):
             def body(carry, xs):
                 k, key = xs
                 kv, tokens = carry
@@ -959,7 +977,8 @@ class ModelRunner:
                                            attn_impl=attn_impl,
                                            max_q_len=1)
                 logits = logits_fn(params, hidden, residual, b, cfg)
-                toks = sample(logits, b.sampling, None)
+                toks = sample(logits, b.sampling, None,
+                              all_greedy=all_greedy)
                 return (kv, toks), toks
 
             (kv, _), all_tokens = jax.lax.scan(
@@ -1016,25 +1035,33 @@ class ModelRunner:
 
         page = self.config.cache.page_size
         _t_warm = time.monotonic()
+        # Each combo warms BOTH sampler program variants: temperature=0
+        # compiles the all_greedy=True fast path (the common serving/
+        # eval/bench case) and temperature=1 the sampled path — so
+        # neither a greedy nor a sampled first request pays a mid-serving
+        # XLA compile stall (every compile lands in the persistent cache,
+        # so the doubled warmup is a one-time cost per machine).
         for nseq, npages in combos:
-            items = []
-            for i in range(nseq):
-                ctx = npages * page - 1   # context filling npages pages
-                seq = Sequence(i, [1] * (ctx + 1),
-                               SamplingParams(max_tokens=4))
-                # All warmup rows may share pages: decode only READS pages
-                # and writes one fresh slot; sharing keeps warmup within any
-                # pool size.
-                seq.page_table = [1 + (j % max(1, self.num_pages - 1))
-                                  for j in range(npages)]
-                seq.num_computed_tokens = ctx
-                items.append(ScheduledSeq(seq, 1, ctx))
-            if items:
-                t0 = time.monotonic()
-                self.step(ScheduledBatch(items))
-                logger.info("[startup] phase=warmup_bucket seqs=%d "
-                            "pages=%d seconds=%.2f", nseq, npages,
-                            time.monotonic() - t0)
+            for temp in (0.0, 1.0):
+                items = []
+                for i in range(nseq):
+                    ctx = npages * page - 1  # context filling npages pages
+                    seq = Sequence(i, [1] * (ctx + 1),
+                                   SamplingParams(temperature=temp,
+                                                  max_tokens=4))
+                    # All warmup rows may share pages: decode only READS
+                    # pages and writes one fresh slot; sharing keeps
+                    # warmup within any pool size.
+                    seq.page_table = [1 + (j % max(1, self.num_pages - 1))
+                                      for j in range(npages)]
+                    seq.num_computed_tokens = ctx
+                    items.append(ScheduledSeq(seq, 1, ctx))
+                if items:
+                    t0 = time.monotonic()
+                    self.step(ScheduledBatch(items))
+                    logger.info("[startup] phase=warmup_bucket seqs=%d "
+                                "pages=%d temp=%g seconds=%.2f", nseq,
+                                npages, temp, time.monotonic() - t0)
 
         # Mixed prefill+decode signatures — the shapes a newly admitted
         # request hits mid-serving (chunked prefill riding with the decode

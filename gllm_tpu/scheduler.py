@@ -473,8 +473,8 @@ class Scheduler:
         for seq in reversed(deferred_disagg):
             self.waiting.appendleft(seq)
 
-    def schedule_chain(self, prev: ScheduledBatch,
-                       k_max: int) -> List[ScheduledBatch]:
+    def schedule_chain(self, prev: ScheduledBatch, k_max: int,
+                       include_prev: bool = False) -> List[ScheduledBatch]:
         """Atomically schedule up to ``k_max`` chained decode steps off
         ``prev``, before ``prev``'s sampled tokens have reached the host.
 
@@ -569,8 +569,16 @@ class Scheduler:
             feasible += 1
         if not feasible:
             return []
-        # quantize to a power of two so fused-block compiles stay bounded
-        k = 1 << (feasible.bit_length() - 1)
+        # quantize to a power of two so fused-block compiles stay bounded;
+        # with ``include_prev`` the caller fuses ``prev`` itself as the
+        # block's first step (a freshly re-formed sync decode batch), so
+        # it is prev PLUS the links that must total a power of two
+        if include_prev:
+            k = (1 << ((feasible + 1).bit_length() - 1)) - 1
+            if not k:
+                return []
+        else:
+            k = 1 << (feasible.bit_length() - 1)
         chain: List[ScheduledBatch] = []
         for j in range(k):
             # dead links freeze computed_before at the death position —

@@ -75,8 +75,8 @@ def test_run_through_blocks_form(ckpt, monkeypatch):
     from gllm_tpu import scheduler as sched_mod
     orig = sched_mod.Scheduler.schedule_chain
 
-    def spy(self, prev, k_max):
-        chain = orig(self, prev, k_max)
+    def spy(self, prev, k_max, *a, **kw):
+        chain = orig(self, prev, k_max, *a, **kw)
         if chain and chain[0].active_until is not None:
             seen.append(list(chain[0].active_until))
         return chain
@@ -97,8 +97,8 @@ def test_no_zombie_chains_after_eos(ckpt, monkeypatch):
     from gllm_tpu import scheduler as sched_mod
     orig = sched_mod.Scheduler.schedule_chain
 
-    def spy(self, prev, k_max):
-        chain = orig(self, prev, k_max)
+    def spy(self, prev, k_max, *a, **kw):
+        chain = orig(self, prev, k_max, *a, **kw)
         for b in chain:
             assert all(it.seq.status is SequenceStatus.RUNNING
                        for it in b.items)
