@@ -188,7 +188,7 @@ class EngineConfig:
                 "(choices: ngram)")
         if self.spec_decode is not None:
             # May be combined with overlap_scheduling/multi_step_decode:
-            # speculation then OWNS decode dispatch (schedule_chained
+            # speculation then OWNS decode dispatch (schedule_chain
             # defers — drafting needs committed token values a chained
             # step leaves on device), each accepted draft replacing the
             # dispatch round trip a chain would have hidden; prefill

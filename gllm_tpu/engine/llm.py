@@ -144,7 +144,7 @@ class LLM:
             # Works under every topology: single runner, pp pipelines
             # (the last stage verifies), dp replicas (per-replica verify
             # in the stacked program), and overlap scheduling — there
-            # speculation owns decode dispatch (schedule_chained defers;
+            # speculation owns decode dispatch (schedule_chain defers;
             # drafting needs committed token VALUES a chained step leaves
             # on device). Hybrid (GDN) speculates via snapshot-rollback:
             # the pre-draft recurrent state is checkpointed into an SSM

@@ -17,28 +17,31 @@ def make_fetch_fns(pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems,
                    pages_per_block: int, shared_kv: bool):
     """(start_fetch, wait_fetch), each taking (slot, seq, kv_block_idx).
 
-    Copies ``pages_per_block`` whole pages per block; semaphore layout is
-    [slot, page_in_block, k_or_v]. Start/wait pairs must match 1:1 — the
-    callers' double-buffer loops guarantee it.
+    Copies ``pages_per_block`` whole pages per block. Semaphore layout is
+    [slot, k_or_v]: ONE DMA semaphore per slot per stream — every page
+    copy of a block signals it and wait_fetch consumes the same count
+    (a per-page sem array blew the sflag scratch budget at
+    group_size ≥ 8: slots × pages × 2 × 4 B > 2 KiB). Start/wait pairs
+    must match 1:1 — the callers' buffer loops guarantee it.
     """
 
     def start_fetch(slot, s, blk):
         for j in range(pages_per_block):
             page_idx = pt_ref[s, blk * pages_per_block + j]
             pltpu.make_async_copy(k_hbm.at[page_idx], k_buf.at[slot, j],
-                                  sems.at[slot, j, 0]).start()
+                                  sems.at[slot, 0]).start()
             if not shared_kv:
                 pltpu.make_async_copy(v_hbm.at[page_idx], v_buf.at[slot, j],
-                                      sems.at[slot, j, 1]).start()
+                                      sems.at[slot, 1]).start()
 
     def wait_fetch(slot, s, blk):
         for j in range(pages_per_block):
             page_idx = pt_ref[s, blk * pages_per_block + j]
             pltpu.make_async_copy(k_hbm.at[page_idx], k_buf.at[slot, j],
-                                  sems.at[slot, j, 0]).wait()
+                                  sems.at[slot, 0]).wait()
             if not shared_kv:
                 pltpu.make_async_copy(v_hbm.at[page_idx], v_buf.at[slot, j],
-                                      sems.at[slot, j, 1]).wait()
+                                      sems.at[slot, 1]).wait()
 
     return start_fetch, wait_fetch
 
@@ -130,5 +133,5 @@ def kv_stream_specs(k_cache, v_cache, pages_per_block: int, page_size: int,
         scratch.append(pltpu.VMEM((slots, pages_per_block, page_size,
                                    *head_shape, v_dim), v_cache.dtype))
         inputs.append(v_cache)
-    scratch.append(pltpu.SemaphoreType.DMA((slots, pages_per_block, 2)))
+    scratch.append(pltpu.SemaphoreType.DMA((slots, 2)))
     return in_specs, scratch, inputs

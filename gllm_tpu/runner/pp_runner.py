@@ -364,12 +364,14 @@ class PPModelRunner(ModelRunner):
 
         @functools.partial(jax.jit,
                            static_argnames=("max_q_len", "logprobs_k",
-                                            "prompt_lp", "spec_sampled"),
+                                            "prompt_lp", "spec_sampled",
+                                            "all_greedy"),
                            compiler_options=tpu_compiler_options(),
                            donate_argnums=(1,))
         def stage(params, kv, batch, cos_sin, hidden, residual,
                   token_counts, *, max_q_len: int, logprobs_k: int = -1,
-                  prompt_lp: bool = False, spec_sampled: bool = False):
+                  prompt_lp: bool = False, spec_sampled: bool = False,
+                  all_greedy: bool = False):
             hidden, residual, kv = fwd(params, kv, batch, scfg,
                                        cos_sin=cos_sin,
                                        attn_impl=attn_impl,
@@ -378,7 +380,8 @@ class PPModelRunner(ModelRunner):
                                        residual_in=residual)
             if scfg.is_last_stage:
                 logits = logits_fn(params, hidden, residual, batch, scfg)
-                tokens = sample(logits, batch.sampling, token_counts)
+                tokens = sample(logits, batch.sampling, token_counts,
+                                all_greedy=all_greedy)
                 aux = {}
                 if logprobs_k >= 0:
                     # same shapes as the single-runner step (reference
@@ -456,8 +459,10 @@ class PPModelRunner(ModelRunner):
             # lp flags are static jit args — only the last stage reads
             # them, so earlier stages keep their (-1, False) cache entry
             # for every logprobs pattern (no pipeline-wide recompiles)
+            from gllm_tpu.runner.runner import _all_greedy
             lp_kw = (dict(logprobs_k=lp_k, prompt_lp=want_plp,
-                          spec_sampled=spec_sampled)
+                          spec_sampled=spec_sampled,
+                          all_greedy=_all_greedy(sched_batch.items))
                      if stage.cfg.is_last_stage else {})
             with mesh_context(stage.mesh):
                 out, stage.kv = stage.fn(stage.params, stage.kv, sb,

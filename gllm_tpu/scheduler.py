@@ -152,7 +152,7 @@ class Scheduler:
         self.num_preemptions = 0
         # (ngram_n, k) when the ENGINE enabled speculative decoding —
         # set after construction for every topology (incl. overlap, where
-        # spec owns decode dispatch and schedule_chained defers, and
+        # spec owns decode dispatch and schedule_chain defers, and
         # hybrid GDN via SSM snapshot-rollback); None disables proposals
         self.spec_cfg = None
         self.spec_stats = {"proposed": 0, "accepted": 0}
