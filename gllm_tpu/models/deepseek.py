@@ -505,24 +505,34 @@ def forward(params, kv: LatentKVCache, batch: StepBatch, cfg: ModelConfig,
             h, res, cache, icache, iscale, li = carry
             normed, res = fused_add_rms_norm(h, res, lp["input_norm"],
                                              cfg.rms_norm_eps)
-            lc = jax.lax.dynamic_index_in_dim(cache, li, 0, keepdims=False)
-            ic = (jax.lax.dynamic_index_in_dim(icache, li, 0,
-                                               keepdims=False)
+            # Flat-view stacked-cache addressing (same re-design as
+            # dense._attention): the layer offset rides the slot mapping
+            # (+li·P·page) and page table (+li·P) against [L·P, ...]
+            # reshape VIEWS of the scan carries, so no full layer slice
+            # is ever materialized — the earlier dynamic_index/update
+            # round-trip copied the whole layer cache twice per layer per
+            # step. All MLA helpers (latent scatter, paged MQA, DSA
+            # indexer/sparse gather) are shape-generic over the flat
+            # leading axis; every layer's page 0 is its own dummy page.
+            L, P, page = cache.shape[0], cache.shape[1], cache.shape[2]
+            batch_l = batch._replace(
+                slot_mapping=batch.slot_mapping + li * (P * page),
+                attn=batch.attn._replace(
+                    page_table=batch.attn.page_table + li * P))
+            lc = cache.reshape((L * P,) + cache.shape[2:])
+            ic = (icache.reshape((L * P,) + icache.shape[2:])
                   if cfg.use_dsa else None)
-            isc = (jax.lax.dynamic_index_in_dim(iscale, li, 0,
-                                                keepdims=False)
+            isc = (iscale.reshape((L * P,) + iscale.shape[2:])
                    if has_iscale else None)
             attn_out, lc, ic, isc = _mla_attention(
-                lp, normed, batch, lc, cfg, cos_sin, max_q_len=max_q_len,
-                scale=scale, attn_impl=attn_impl, index_cache=ic,
-                index_scale=isc)
-            cache = jax.lax.dynamic_update_index_in_dim(cache, lc, li, 0)
+                lp, normed, batch_l, lc, cfg, cos_sin,
+                max_q_len=max_q_len, scale=scale, attn_impl=attn_impl,
+                index_cache=ic, index_scale=isc)
+            cache = lc.reshape(cache.shape)
             if cfg.use_dsa:
-                icache = jax.lax.dynamic_update_index_in_dim(icache, ic,
-                                                             li, 0)
+                icache = ic.reshape(icache.shape)
             if has_iscale:
-                iscale = jax.lax.dynamic_update_index_in_dim(iscale, isc,
-                                                             li, 0)
+                iscale = isc.reshape(iscale.shape)
             normed2, res = fused_add_rms_norm(attn_out, res,
                                               lp["post_attn_norm"],
                                               cfg.rms_norm_eps)

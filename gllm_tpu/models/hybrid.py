@@ -325,17 +325,24 @@ def forward(params: Params, kv: HybridKV, batch: StepBatch,
                                              cfg.rms_norm_eps)
             if ltype == "full_attention":
                 lp = jax.tree.map(lambda a: a[a_j], attn_p)
-                kc = jax.lax.dynamic_index_in_dim(k_all, ai + a_j, 0,
-                                                  keepdims=False)
-                vc = jax.lax.dynamic_index_in_dim(v_all, ai + a_j, 0,
-                                                  keepdims=False)
+                # flat-view stacked-cache addressing (see
+                # dense._attention): layer offset in the slot mapping /
+                # page table against [La*P, ...] reshape views — no full
+                # layer-slice copies through the scan carry
+                li = ai + a_j
+                La, P, page = (k_all.shape[0], k_all.shape[1],
+                               k_all.shape[2])
+                batch_l = batch._replace(
+                    slot_mapping=batch.slot_mapping + li * (P * page),
+                    attn=batch.attn._replace(
+                        page_table=batch.attn.page_table + li * P))
+                kc = k_all.reshape((La * P,) + k_all.shape[2:])
+                vc = v_all.reshape((La * P,) + v_all.shape[2:])
                 mix_out, kc, vc = _gated_attention(
-                    lp, normed, batch, kc, vc, cfg, cos_sin,
+                    lp, normed, batch_l, kc, vc, cfg, cos_sin,
                     attn_impl=attn_impl, max_q_len=max_q_len)
-                k_all = jax.lax.dynamic_update_index_in_dim(
-                    k_all, kc, ai + a_j, 0)
-                v_all = jax.lax.dynamic_update_index_in_dim(
-                    v_all, vc, ai + a_j, 0)
+                k_all = kc.reshape(k_all.shape)
+                v_all = vc.reshape(v_all.shape)
                 a_j += 1
             else:
                 lp = jax.tree.map(lambda a: a[g_j], gdn_p)
