@@ -420,10 +420,45 @@ def main():
     llm.generate(prompt_token_ids=prompts, sampling_params=params)
     log(f"warmup pass: {time.monotonic() - t0:.1f}s")
 
+    # Bracket the measured pass in the obs layer: steptrace mark +
+    # request-histogram snapshots so the summary excludes warmup.
+    from gllm_tpu.obs import metrics as obs_metrics
+    from gllm_tpu.obs.steptrace import TRACE, summarize
+    trace_mark = TRACE.mark()
+    hist_names = ("gllm_request_ttft_seconds", "gllm_request_itl_seconds",
+                  "gllm_request_e2e_seconds", "gllm_request_tpot_seconds")
+    hist_before = {n: obs_metrics.REGISTRY.get(n).snapshot()
+                   for n in hist_names}
+
     phase("measured_pass")
     t0 = time.monotonic()
     outs = llm.generate(prompt_token_ids=prompts, sampling_params=params)
     dt = time.monotonic() - t0
+
+    # Machine-readable measured-pass attribution (step-kind wall time,
+    # fused/unfused decode split, compile events, request latency
+    # percentiles) — the "18/59 unfused steps" class of finding reads
+    # straight out of BENCH_r*.json now instead of log archaeology.
+    events = TRACE.events(since=trace_mark)
+    step_summary = summarize(events)
+    # no silent caps: the ring holds GLLM_OBS_TRACE_CAP events — report
+    # how many measured-pass iterations rolled off before the dump
+    lost = max(0, TRACE.mark() - TRACE.capacity - trace_mark)
+    if lost:
+        step_summary["trace_dropped"] = lost
+        log(f"[bench] steptrace ring dropped {lost} measured-pass "
+            f"events (raise GLLM_OBS_TRACE_CAP for full attribution)")
+    lat = {}
+    for name in hist_names:
+        h = obs_metrics.REGISTRY.get(name)
+        short = name[len("gllm_request_"):-len("_seconds")]
+        pcts = {q: obs_metrics.percentile(h, q / 100.0,
+                                          before=hist_before[name])
+                for q in (50, 90, 99)}
+        if any(v is not None for v in pcts.values()):
+            lat[short] = {f"p{q}": (round(v, 4) if v is not None else None)
+                          for q, v in pcts.items()}
+    metrics_snapshot = {"steps": step_summary, "request_latency_s": lat}
 
     phase("report")
     out_tokens = sum(o.num_output_tokens for o in outs)
@@ -445,6 +480,7 @@ def main():
         "unit": "tok/s",
         "vs_baseline": round(value / 2000.0, 4),
         "mfu": mfu,
+        "metrics": metrics_snapshot,
     }))
 
 

@@ -277,7 +277,13 @@ def main():
                 table = json.load(f)
         dev = table.setdefault(tag, {})
         for kern, params in best.items():
-            dev.setdefault(kern, {}).update(params)
+            entry = dev.setdefault(kern, {})
+            entry.update(params)
+            # provenance: which sweep artifact produced this entry
+            # (tuning.get() strips the field before kernel kwargs)
+            entry["comment"] = (
+                f"benchmarks/kernel_tune.py sweep on {tag} "
+                f"({time.strftime('%Y-%m-%d')})")
         with open(_TABLES_PATH, "w") as f:
             json.dump(table, f, indent=1, sort_keys=True)
         print(f"[tune] wrote {_TABLES_PATH} for {tag}",

@@ -25,12 +25,53 @@ from typing import Callable, List, Optional, Sequence as Seq, Union
 from gllm_tpu.config import EngineConfig
 from gllm_tpu.memory_manager import make_memory_manager
 from gllm_tpu.models.config import ModelConfig, from_hf_config
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.obs.steptrace import TRACE
 from gllm_tpu.sampling_params import SamplingParams
 from gllm_tpu.scheduler import Scheduler, SeqOutput
 from gllm_tpu.sequence import Sequence
 from gllm_tpu.engine.detokenizer import detokenize_incrementally
 
 logger = logging.getLogger(__name__)
+
+# Engine-step metrics (docs/observability.md). Step kind: "prefill" =
+# batch carries at least one prefill chunk, "decode" = single-step pure
+# decode (the UNfused path), "fused_block" = K chained decode steps in
+# one dispatch. All timing is host wall clock around the collect — the
+# device program is untouched.
+_M_STEP_LAT = obs.histogram(
+    "gllm_step_latency_seconds",
+    "engine-iteration collect latency (host blocked on device tokens)",
+    ("kind",), buckets=obs.FAST_LATENCY_BUCKETS)
+_M_RTT = obs.histogram(
+    "gllm_dispatch_rtt_seconds",
+    "dispatch-to-collect round trip per engine iteration",
+    ("kind",), buckets=obs.FAST_LATENCY_BUCKETS)
+_M_STEPS = obs.counter("gllm_steps_total",
+                       "engine iterations by step kind", ("kind",))
+_M_STEP_TOKENS = obs.counter("gllm_step_tokens_total",
+                             "tokens computed by step kind", ("kind",))
+_M_DECODE_STEPS = obs.counter(
+    "gllm_decode_steps_total",
+    "decode steps by fusion (fused counts each sub-step of a block)",
+    ("fused",))
+# Request-latency histograms (OpenAI-serving vocabulary): TTFT = arrival
+# to first sampled token, TPOT = mean inter-token time after the first,
+# ITL = per-token inter-arrival, queue = arrival to first schedule.
+_M_TTFT = obs.histogram("gllm_request_ttft_seconds",
+                        "time to first token per request")
+_M_TPOT = obs.histogram("gllm_request_tpot_seconds",
+                        "mean time per output token after the first",
+                        buckets=obs.FAST_LATENCY_BUCKETS)
+_M_ITL = obs.histogram("gllm_request_itl_seconds",
+                       "inter-token latency per sampled token",
+                       buckets=obs.FAST_LATENCY_BUCKETS)
+_M_E2E = obs.histogram("gllm_request_e2e_seconds",
+                       "arrival-to-finish latency per request")
+_M_QUEUE = obs.histogram("gllm_request_queue_seconds",
+                         "arrival-to-first-schedule wait per request")
+_M_FINISHED = obs.counter("gllm_requests_finished_total",
+                          "requests finished by reason", ("reason",))
 
 
 @dataclasses.dataclass
@@ -139,6 +180,8 @@ class LLM:
         self.schedulers = [Scheduler(config, mm,
                                      pp_size=config.parallel.pp)
                            for mm in self.memory_managers]
+        for r, s in enumerate(self.schedulers):
+            s.dp_rank = r               # metric label (see scheduler.py)
         self.scheduler = self.schedulers[0]
         if config.spec_decode == "ngram":
             # Works under every topology: single runner, pp pipelines
@@ -329,19 +372,27 @@ class LLM:
             if overlap and self._in_flight and not self.scheduler.waiting:
                 # chain the next decode step(s) off the in-flight batch's
                 # on-device tokens (overlap scheduling)
-                prev_batch, prev_handle = self._in_flight[-1]
+                prev_batch, prev_handle = self._in_flight[-1][:2]
                 if isinstance(prev_batch, list):
                     prev_batch = prev_batch[-1]
                 chain = self._schedule_multi(prev_batch, multi)
                 if not chain:
+                    # the sync path re-forms the batch next iteration —
+                    # each break is a dispatch round trip the chain
+                    # would have hidden (step-kind attribution reads
+                    # these next to the decode/fused_block split)
+                    TRACE.record("chain_break",
+                                 num_seqs=prev_batch.num_seqs)
                     break
                 if len(chain) > 1:
                     handle = self.runner.step_multi(chain, prev_handle)
-                    self._in_flight.append((chain, handle))
+                    self._in_flight.append((chain, handle,
+                                            time.monotonic()))
                 else:
                     handle = self.runner.step_async_chained(chain[0],
                                                             prev_handle)
-                    self._in_flight.append((chain[0], handle))
+                    self._in_flight.append((chain[0], handle,
+                                            time.monotonic()))
                 continue
             batch = self.scheduler.schedule_once()
             if batch is None:
@@ -367,27 +418,20 @@ class LLM:
                             if au is not None else None))
                     chain = [first] + links
                     self._in_flight.append(
-                        (chain, self.runner.step_multi(chain)))
+                        (chain, self.runner.step_multi(chain),
+                         time.monotonic()))
                     continue
-            self._in_flight.append((batch, self.runner.step_async(batch)))
+            self._in_flight.append((batch, self.runner.step_async(batch),
+                                    time.monotonic()))
         if not self._in_flight:
             if self.disagg_coordinator is not None:
                 # gate-B-blocked seqs park in waiting; don't spin hot
                 time.sleep(0.002)
             return []
-        batch, handle = self._in_flight.popleft()
-        timer = self._step_timer
-        if timer is not None:
-            t0 = time.monotonic()
+        batch, handle, t_dispatch = self._in_flight.popleft()
+        t0 = time.monotonic()
         tokens, aux = self.runner.collect(handle)
-        if timer is not None:
-            b = batch[-1] if isinstance(batch, list) else batch
-            kind = (f"decode_block{len(batch)}" if isinstance(batch, list)
-                    else "decode" if b.num_decode == b.num_seqs
-                    else "prefill_mixed")
-            timer.append((time.monotonic() - t0, kind,
-                          sum(x.total_tokens for x in batch)
-                          if isinstance(batch, list) else b.total_tokens))
+        self._record_step(batch, t0, t_dispatch)
         if isinstance(batch, list):
             # multi-step block: tokens [K, S]; advance K scheduler steps
             outs = []
@@ -395,6 +439,7 @@ class LLM:
                 outs.extend(self.scheduler.process_output(
                     b, row.tolist(), self.eos_token_ids))
             self._check_stop_strings(outs)
+            self._observe_outputs(outs)
             return outs
         spec = aux.pop("spec", None) if aux else None
         spec_lp = aux.pop("spec_lp", None) if aux else None
@@ -421,7 +466,74 @@ class LLM:
             outs = self.scheduler.process_output(batch, tokens.tolist(),
                                                  self.eos_token_ids)
         self._check_stop_strings(outs)
+        self._observe_outputs(outs)
         return outs
+
+    def _record_step(self, batch, t0: float, t_dispatch: float) -> None:
+        """Step-kind attribution for one collected engine iteration:
+        latency/RTT histograms, per-kind counters, one steptrace event.
+        Host wall clock only — the handle was already collected."""
+        now = time.monotonic()
+        fused = isinstance(batch, list)
+        b = batch[-1] if fused else batch
+        if fused:
+            kind = "fused_block"
+            tokens = sum(x.total_tokens for x in batch)
+        else:
+            kind = ("decode" if b.num_decode == b.num_seqs
+                    else "prefill")
+            tokens = b.total_tokens
+        wall = now - t0
+        _M_STEP_LAT.observe(wall, kind=kind)
+        _M_RTT.observe(now - t_dispatch, kind=kind)
+        _M_STEPS.inc(kind=kind)
+        _M_STEP_TOKENS.inc(tokens, kind=kind)
+        if kind == "decode":
+            _M_DECODE_STEPS.inc(fused="false")
+        elif fused:
+            _M_DECODE_STEPS.inc(len(batch), fused="true")
+        ev = dict(num_seqs=b.num_seqs, tokens=tokens,
+                  wall_ms=round(wall * 1e3, 3),
+                  rtt_ms=round((now - t_dispatch) * 1e3, 3))
+        if fused:
+            ev["k"] = len(batch)
+        TRACE.record(kind, **ev)
+        timer = self._step_timer
+        if timer is not None:
+            timer.append((wall,
+                          f"decode_block{len(batch)}" if fused
+                          else "decode" if kind == "decode"
+                          else "prefill_mixed", tokens))
+
+    def _observe_outputs(self, outs) -> None:
+        """Per-request latency bookkeeping over one iteration's outputs
+        (after stop-string trimming so finish reasons are final). Tokens
+        that commit together (fused blocks, accepted drafts) observe
+        near-zero ITL — truthful: the client receives them together."""
+        if not outs:
+            return
+        now = time.monotonic()
+        for out in outs:
+            seq = out.seq
+            if out.new_token_id is not None:
+                if not seq.first_token_time:
+                    seq.first_token_time = now
+                    if seq.arrival_time:
+                        _M_TTFT.observe(now - seq.arrival_time)
+                        if seq.first_sched_time:
+                            _M_QUEUE.observe(seq.first_sched_time
+                                             - seq.arrival_time)
+                elif seq.last_token_time:
+                    _M_ITL.observe(now - seq.last_token_time)
+                seq.last_token_time = now
+            if out.finish_reason is not None:
+                _M_FINISHED.inc(reason=out.finish_reason)
+                if seq.arrival_time:
+                    _M_E2E.observe(now - seq.arrival_time)
+                n = seq.num_output_tokens
+                if n > 1 and seq.first_token_time:
+                    _M_TPOT.observe((seq.last_token_time
+                                     - seq.first_token_time) / (n - 1))
 
     def _schedule_multi(self, prev_batch, multi: int):
         """Chain up to ``multi`` decode steps off ``prev_batch`` for one
@@ -466,8 +578,26 @@ class LLM:
         batches = [s.schedule_once() for s in self.schedulers]
         if all(b is None for b in batches):
             return []
+        t_dispatch = time.monotonic()
         handle = self.runner.step_async_dp(batches)
+        t0 = time.monotonic()
         rows, auxes = self.runner.collect_dp(handle)
+        live = [b for b in batches if b is not None]
+        # one step event for the stacked program (all replicas run in it)
+        now = time.monotonic()
+        kind = ("decode" if all(b.num_decode == b.num_seqs for b in live)
+                else "prefill")
+        tokens = sum(b.total_tokens for b in live)
+        _M_STEP_LAT.observe(now - t0, kind=kind)
+        _M_RTT.observe(now - t_dispatch, kind=kind)
+        _M_STEPS.inc(kind=kind)
+        _M_STEP_TOKENS.inc(tokens, kind=kind)
+        if kind == "decode":
+            _M_DECODE_STEPS.inc(fused="false")
+        TRACE.record(kind, num_seqs=sum(b.num_seqs for b in live),
+                     tokens=tokens, wall_ms=round((now - t0) * 1e3, 3),
+                     rtt_ms=round((now - t_dispatch) * 1e3, 3),
+                     dp=len(live))
         outs: List[SeqOutput] = []
         for sched, b, row, aux in zip(self.schedulers, batches, rows,
                                       auxes):
@@ -495,6 +625,7 @@ class LLM:
                 outs.extend(sched.process_output(b, row.tolist(),
                                                  self.eos_token_ids))
         self._check_stop_strings(outs)
+        self._observe_outputs(outs)
         return outs
 
     def _record_logprobs(self, batch, aux) -> None:

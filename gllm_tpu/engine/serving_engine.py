@@ -20,9 +20,17 @@ import time
 from typing import List, Optional
 
 from gllm_tpu.engine.llm import LLM
+from gllm_tpu.obs import metrics as obs
 from gllm_tpu.sampling_params import SamplingParams
 
 logger = logging.getLogger(__name__)
+
+_M_SUBMITTED = obs.counter("gllm_requests_submitted_total",
+                           "requests submitted to the serving engine")
+_M_ACTIVE = obs.gauge("gllm_requests_active",
+                      "requests with an open output stream")
+_M_ABORTED = obs.counter("gllm_requests_aborted_total",
+                         "requests aborted (client disconnect or error)")
 
 
 @dataclasses.dataclass
@@ -139,6 +147,8 @@ class ServingEngine:
             handle = RequestHandle(seq.seq_id, len(token_ids))
             self._handles[seq.seq_id] = handle
             self._seqs[seq.seq_id] = seq
+            _M_SUBMITTED.inc()
+            _M_ACTIVE.set(len(self._handles))
         self._intake.put(seq)
         self._wake.set()
         return handle
@@ -192,6 +202,7 @@ class ServingEngine:
                     with self._lock:
                         self._handles.pop(out.seq.seq_id, None)
                         self._seqs.pop(out.seq.seq_id, None)
+                        _M_ACTIVE.set(len(self._handles))
                     self._emitted.pop(out.seq.seq_id, None)
             # aborted sequences never produce a SeqOutput → close their
             # streams here
@@ -209,12 +220,17 @@ class ServingEngine:
     def _deliver_error(self, seq_id: int, reason: str) -> None:
         with self._lock:
             handle = self._handles.pop(seq_id, None)
+            _M_ACTIVE.set(len(self._handles))
         if handle is not None:
+            _M_ABORTED.inc()
             handle.chunks.put(StreamChunk(None, "", reason or "error"))
 
     def _fail_all(self) -> None:
         with self._lock:
             handles = list(self._handles.values())
             self._handles.clear()
+            _M_ACTIVE.set(0)
+        if handles:
+            _M_ABORTED.inc(len(handles))
         for h in handles:
             h.chunks.put(StreamChunk(None, "", "error"))

@@ -163,6 +163,14 @@ class Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _text(self, body: str, content_type: str, code=200):
+        raw = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(raw)))
+        self.end_headers()
+        self.wfile.write(raw)
+
     def _read_json(self) -> dict:
         length = int(self.headers.get("Content-Length", 0))
         raw = self.rfile.read(length) if length else b"{}"
@@ -191,6 +199,32 @@ class Handler(BaseHTTPRequestHandler):
         st = self.state
         if self.path == "/health":
             self._json({"status": "ok"})
+        elif self.path == "/metrics":
+            # Prometheus text exposition (gllm_tpu/obs/metrics.py):
+            # request-latency histograms (TTFT/TPOT/ITL/e2e/queue),
+            # per-step-kind counters, scheduler/KV gauges. Pure host
+            # state — scraping never touches the device.
+            from gllm_tpu.obs import metrics as obs_metrics
+            self._text(obs_metrics.render(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path.split("?", 1)[0] == "/steptrace":
+            # JSON dump of the step-trace ring (pipe into
+            # ``python -m gllm_tpu.obs.dump -`` for a readable table);
+            # ?since=N resumes from a previous dump's last seq.
+            from urllib.parse import parse_qs, urlparse
+            from gllm_tpu.obs.steptrace import TRACE, summarize
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                since = int(q.get("since", ["0"])[0])
+            except ValueError:
+                self._json(proto.error_response(
+                    "since must be an integer"), code=400)
+                return
+            events = TRACE.events(since=since)
+            self._json({"events": events,
+                        "dropped": TRACE.dropped,
+                        "next_since": TRACE.mark(),
+                        "summary": summarize(events)})
         elif self.path == "/version":
             self._json({"version": gllm_tpu.__version__})
         elif self.path == "/v1/models":

@@ -29,8 +29,17 @@ import hashlib
 from typing import Dict, List, Optional, Tuple
 
 from gllm_tpu.id_allocator import IDAllocator
+from gllm_tpu.obs import metrics as obs
 from gllm_tpu.sequence import Sequence
 from gllm_tpu.utils import cdiv
+
+# Prefix-cache metrics (docs/observability.md): lifetime token counters —
+# rate(hit)/rate(query) gives the windowed hit rate in any scraper; the
+# scheduler's gllm_prefix_cache_hit_rate gauge mirrors the lifetime ratio.
+_M_PFX_QUERY = obs.counter("gllm_prefix_cache_query_tokens_total",
+                           "prompt tokens probed against the prefix cache")
+_M_PFX_HIT = obs.counter("gllm_prefix_cache_hit_tokens_total",
+                         "prompt tokens served from cached KV pages")
 
 # Tokens stored per cached page to verify against hash collisions
 # (reference memory_manager.py:920-935).
@@ -300,6 +309,7 @@ class PrefixMemoryManager(MemoryManager):
         """
         assert seq.num_computed_tokens == 0 and not seq.page_table
         self.query_tokens += seq.prompt_len
+        _M_PFX_QUERY.inc(seq.prompt_len)
         matched_digest = b"root"
         matched = 0
         digests: List[bytes] = []
@@ -339,6 +349,7 @@ class PrefixMemoryManager(MemoryManager):
         if matched:
             self._seq_chain[seq.seq_id] = (matched, matched_digest)
         self.hit_tokens += seq.num_computed_tokens
+        _M_PFX_HIT.inc(seq.num_computed_tokens)
         return seq.num_computed_tokens
 
     def register_computed_pages(self, seq: Sequence, extra_key: bytes = b"") -> None:

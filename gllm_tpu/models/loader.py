@@ -394,7 +394,13 @@ def load_moe_params(model_dir: str, cfg: ModelConfig,
                     ) -> dict:
     from gllm_tpu.models import moe
     template = jax.eval_shape(lambda: moe.init_params(cfg, dtype=dtype))
-    return _load_params(model_dir, template, moe_rules(cfg), progress_cb)
+    params = _load_params(model_dir, template, moe_rules(cfg), progress_cb)
+    if "moe_mask" in params.get("layers", {}):
+        # derived, not a checkpoint tensor — _load_params zero-fills
+        # template leaves, which would make every layer dense
+        params["layers"]["moe_mask"] = np.asarray(
+            moe.moe_layer_mask(cfg), bool)
+    return params
 
 
 def deepseek_rules(cfg: ModelConfig) -> Callable[[str], Optional[Rule]]:
@@ -542,6 +548,7 @@ def load_params_ep(model_dir: str, cfg: ModelConfig, dtype, mesh, specs,
     """
     from jax.sharding import NamedSharding
 
+    sparse_mask = None
     if family == "deepseek":
         from gllm_tpu.models import deepseek as model_mod
         rules = deepseek_rules(cfg)
@@ -554,6 +561,12 @@ def load_params_ep(model_dir: str, cfg: ModelConfig, dtype, mesh, specs,
         fmts = _MOE_EXPERT_FMTS
         first, _ = cfg.stage_layers
         layer_of = lambda li: li + first                  # noqa: E731
+        mask = model_mod.moe_layer_mask(cfg)
+        if not all(mask):
+            # mixed dense/sparse stack: dense layers have no expert
+            # tensors in the checkpoint; their stack rows stay zero
+            # (the per-layer flag routes around them at run time)
+            sparse_mask = mask
 
     template = jax.eval_shape(
         lambda: model_mod.init_params(cfg, dtype=dtype))
@@ -566,6 +579,9 @@ def load_params_ep(model_dir: str, cfg: ModelConfig, dtype, mesh, specs,
         return r
 
     host = _load_params(model_dir, template, rules_no_experts, progress_cb)
+    if sparse_mask is not None and "moe_mask" in host.get("layers", {}):
+        # derived flag, zero-filled by the template loader — rebuild it
+        host["layers"]["moe_mask"] = np.asarray(sparse_mask, bool)
     lazy = LazySafetensors(model_dir)
 
     def place(path_keys, leaf, spec):
@@ -591,7 +607,7 @@ def load_params_ep(model_dir: str, cfg: ModelConfig, dtype, mesh, specs,
             shape, ldtype = leaf.shape, leaf.dtype
 
             def cb(index, _fmts=name_fmts, _shape=shape, _dtype=ldtype,
-                   _layer_of=layer_of):
+                   _layer_of=layer_of, _sparse=sparse_mask):
                 # index: per-dim slices of the requested shard
                 li_sl, e_sl = index[0], index[1]
                 li_range = range(*li_sl.indices(_shape[0]))
@@ -601,6 +617,8 @@ def load_params_ep(model_dir: str, cfg: ModelConfig, dtype, mesh, specs,
                 ep_load_stats["max_chunk_bytes"] = max(
                     ep_load_stats["max_chunk_bytes"], buf.nbytes)
                 for a, li in enumerate(li_range):
+                    if _sparse is not None and not _sparse[li]:
+                        continue        # dense layer: no experts to read
                     for b, e in enumerate(e_range):
                         t = None
                         for fmt in _fmts:

@@ -36,6 +36,19 @@ from gllm_tpu.ops.quant import deq, qmm, qragged_dot
 Params = dict
 
 
+def moe_layer_mask(cfg: ModelConfig) -> Tuple[bool, ...]:
+    """Per-stage-layer sparse/dense flag, HF Qwen2/Qwen3-MoE semantics:
+    a layer runs the routed-expert MLP unless it is listed in
+    ``mlp_only_layers`` or falls off the ``decoder_sparse_step`` stride
+    ((layer_idx + 1) % step != 0)."""
+    first, last = cfg.stage_layers
+    step = cfg.decoder_sparse_step
+    return tuple(
+        i not in cfg.mlp_only_layers
+        and (step <= 1 or (i + 1) % step == 0)
+        for i in range(first, last))
+
+
 def select_experts(router_logits: jnp.ndarray, top_k: int,
                    norm_topk_prob: bool) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """softmax → top-k → optional renormalize (HF/reference semantics).
@@ -115,10 +128,6 @@ def moe_mlp(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
 
 def init_params(cfg: ModelConfig, seed: int = 0,
                 dtype=jnp.bfloat16) -> Params:
-    if cfg.mlp_only_layers:
-        raise NotImplementedError("mixed dense/MoE layer stacks")
-    if cfg.decoder_sparse_step not in (0, 1):
-        raise NotImplementedError("decoder_sparse_step > 1")
     params = dense.init_params(cfg, seed=seed, dtype=dtype)
     L = cfg.num_stage_layers
     H, E = cfg.hidden_size, cfg.num_experts
@@ -131,8 +140,23 @@ def init_params(cfg: ModelConfig, seed: int = 0,
                 * scale).astype(dtype)
 
     lp = params["layers"]
-    for name in ("gate_proj", "up_proj", "down_proj"):
-        del lp[name]
+    mask = moe_layer_mask(cfg)
+    if all(mask):
+        # pure-MoE stack: no dense MLP leaves at all (the common case —
+        # don't carry dead [L, H, I] stacks)
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            del lp[name]
+    else:
+        # Mixed dense/sparse stack (Qwen2/Qwen3-MoE mlp_only_layers /
+        # decoder_sparse_step): the layer scan needs structurally uniform
+        # per-layer params, so BOTH MLP variants are stacked for every
+        # layer and a per-layer flag routes between them at run time
+        # (lax.cond in forward — only the live branch executes). The
+        # off-variant rows are dead weight; real mixed checkpoints keep
+        # them rare (a handful of dense layers), so the overhead is
+        # bounded and the alternative — heterogeneous scan segments —
+        # would fork every KV-offset path in dense.forward.
+        lp["moe_mask"] = jnp.asarray(mask, jnp.bool_)
     scale = H ** -0.5
     lp["router"] = w(next(ks), (L, H, E), scale)
     lp["w_gate"] = w(next(ks), (L, E, H, I), scale)
@@ -150,10 +174,22 @@ def init_params(cfg: ModelConfig, seed: int = 0,
 def forward(params, kv: KVCache, batch: StepBatch, cfg: ModelConfig, *,
             cos_sin, attn_impl: str = "xla", max_q_len: int,
             hidden_in=None, residual_in=None):
+    if all(moe_layer_mask(cfg)):
+        mlp_fn = lambda lp, x: moe_mlp(lp, x, cfg)   # noqa: E731
+    else:
+        # mixed stack: the scanned per-layer flag picks routed-expert vs
+        # dense MLP; under scan only the selected branch runs (cond
+        # lowers to a real branch — vmap'd DP replicas degrade to
+        # select, which is still correct, just runs both)
+        def mlp_fn(lp, x):
+            return jax.lax.cond(
+                lp["moe_mask"],
+                lambda v: moe_mlp(lp, v, cfg),
+                lambda v: dense._mlp(lp, v).astype(v.dtype), x)
     return dense.forward(
         params, kv, batch, cfg, cos_sin=cos_sin, attn_impl=attn_impl,
         max_q_len=max_q_len, hidden_in=hidden_in, residual_in=residual_in,
-        mlp_fn=lambda lp, x: moe_mlp(lp, x, cfg))
+        mlp_fn=mlp_fn)
 
 
 compute_logits = dense.compute_logits

@@ -1,0 +1,20 @@
+"""Dependency-free observability layer (metrics + step traces).
+
+Two pillars, both pure-host bookkeeping (no jax import, no device work,
+no effect on jit cache keys):
+
+- ``gllm_tpu.obs.metrics``: a Prometheus-style registry (Counter / Gauge /
+  Histogram with fixed buckets, thread-safe, text-exposition renderer)
+  served by the api_server's ``GET /metrics``.
+- ``gllm_tpu.obs.steptrace``: a ring buffer of per-step records (kind,
+  batch size, token counts, wall ms, ...) dumped by ``GET /steptrace``
+  and summarized into bench.py's metrics snapshot. ``python -m
+  gllm_tpu.obs.dump trace.jsonl`` pretty-prints a saved trace.
+
+Every round-5 finding (unfused decode steps at 8x the fused latency, the
+sampled-path sort, the tuning-table regression) had to be excavated from
+ad-hoc stderr logs; this layer makes the same questions one HTTP GET or
+one JSON blob.
+"""
+
+from gllm_tpu.obs import metrics, steptrace  # noqa: F401

@@ -1,0 +1,74 @@
+"""Pretty-print a steptrace JSONL for bench post-mortems.
+
+Usage:
+  python -m gllm_tpu.obs.dump trace.jsonl            # event table + summary
+  python -m gllm_tpu.obs.dump trace.jsonl --summary  # summary only
+  curl -s host:8000/steptrace | python -m gllm_tpu.obs.dump -  # live dump
+
+The input is one JSON event per line (``StepTrace.to_jsonl``) or a single
+JSON object with an ``events`` list (the ``GET /steptrace`` payload).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from gllm_tpu.obs.steptrace import summarize
+
+_COLS = ("seq", "t", "kind", "num_seqs", "tokens", "k", "wall_ms")
+
+
+def load_events(stream) -> list:
+    text = stream.read()
+    text = text.strip()
+    if not text:
+        return []
+    if text.startswith("{") and "\n" not in text.split("}", 1)[0]:
+        try:
+            obj = json.loads(text)
+            if isinstance(obj, dict) and "events" in obj:
+                return obj["events"]
+        except json.JSONDecodeError:
+            pass
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line:
+            events.append(json.loads(line))
+    return events
+
+
+def format_table(events: list) -> str:
+    rows = [[str(e.get(c, "")) for c in _COLS] for e in events]
+    widths = [max([len(c)] + [len(r[i]) for r in rows])
+              for i, c in enumerate(_COLS)]
+    lines = ["  ".join(c.ljust(w) for c, w in zip(_COLS, widths))]
+    for r in rows:
+        lines.append("  ".join(v.ljust(w) for v, w in zip(r, widths)))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gllm_tpu.obs.dump",
+        description="pretty-print a steptrace JSONL")
+    ap.add_argument("path", help="JSONL file, or - for stdin")
+    ap.add_argument("--summary", action="store_true",
+                    help="print only the by-kind wall-time summary")
+    args = ap.parse_args(argv)
+    if args.path == "-":
+        events = load_events(sys.stdin)
+    else:
+        with open(args.path) as f:
+            events = load_events(f)
+    if not args.summary:
+        print(format_table(events))
+        print()
+    print(json.dumps(summarize(events), indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

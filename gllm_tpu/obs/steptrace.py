@@ -1,0 +1,157 @@
+"""Ring-buffer step-trace event log (stdlib only).
+
+Every engine iteration appends one small dict (kind, batch size, token
+counts, wall ms, ...) to a fixed-capacity ring; compile events, chain
+breaks, and pp stage dispatches ride the same ring. The api_server dumps
+it as JSON (``GET /steptrace``), bench.py summarizes the measured-pass
+window into its metrics snapshot, and ``python -m gllm_tpu.obs.dump``
+pretty-prints a saved JSONL for post-mortems.
+
+The round-5 "18/59 decode steps running unfused at 90.8 ms vs 11.2 ms"
+finding took an afternoon of grepping ``docs/onchip_r05/*.out``; with
+this ring it is ``summarize(TRACE.events())`` — one call.
+
+Overhead: one dict + one list slot assignment per ENGINE iteration (not
+per token, not per layer), behind a lock only the host ever takes. No jax
+import anywhere in this module.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+__all__ = ["StepTrace", "TRACE", "summarize"]
+
+# Step-event kinds recorded by the engine/runner instrumentation:
+#   prefill      - step whose batch carries at least one prefill chunk
+#   decode       - single-step pure-decode dispatch (the UNfused path)
+#   fused_block  - multi-step decode block (one dispatch, K sub-steps)
+#   pp_stage     - one pipeline-stage dispatch of a microbatch
+#   compile      - first dispatch of a new (shape-bucket, static-flag)
+#                  signature (an XLA compile unless the persistent cache
+#                  already held it)
+#   chain_break  - overlap scheduling failed to extend a decode chain
+STEP_KINDS = ("prefill", "decode", "fused_block", "pp_stage", "compile",
+              "chain_break")
+
+
+class StepTrace:
+    """Fixed-capacity ring of event dicts with monotonically increasing
+    sequence numbers (``mark()``/``events(since=...)`` bracket a window
+    even across rollover)."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(os.environ.get("GLLM_OBS_TRACE_CAP", "8192"))
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._buf: List[Optional[dict]] = [None] * capacity
+        self._next_seq = 0               # total events ever recorded
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+
+    def record(self, kind: str, **fields) -> None:
+        ev = {"seq": 0, "t": 0.0, "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            ev["seq"] = self._next_seq
+            ev["t"] = round(time.monotonic() - self._t0, 6)
+            self._buf[self._next_seq % self.capacity] = ev
+            self._next_seq += 1
+
+    def mark(self) -> int:
+        """Current sequence number; pass to ``events(since=...)`` to read
+        only what was recorded after this point."""
+        with self._lock:
+            return self._next_seq
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._next_seq, self.capacity)
+
+    @property
+    def dropped(self) -> int:
+        """Events lost to rollover since construction/clear."""
+        with self._lock:
+            return max(0, self._next_seq - self.capacity)
+
+    def events(self, since: int = 0, kinds: Optional[Iterable[str]] = None
+               ) -> List[dict]:
+        with self._lock:
+            first = max(since, self._next_seq - self.capacity)
+            out = [self._buf[s % self.capacity]
+                   for s in range(first, self._next_seq)]
+        if kinds is not None:
+            ks = set(kinds)
+            out = [e for e in out if e["kind"] in ks]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf = [None] * self.capacity
+            self._next_seq = 0
+            self._t0 = time.monotonic()
+
+    def to_jsonl(self, path: str, since: int = 0) -> int:
+        evs = self.events(since)
+        with open(path, "w") as f:
+            for e in evs:
+                f.write(json.dumps(e) + "\n")
+        return len(evs)
+
+
+TRACE = StepTrace()
+
+
+def summarize(events: List[dict]) -> dict:
+    """Attribute wall time by step kind over a window of events.
+
+    Returns a machine-readable blob answering "where did the measured
+    pass go": per-kind {steps, wall_ms, tokens, ms_per_step}, fused
+    decode sub-step totals, the unfused share of decode wall time (the
+    round-5 18/59 class of finding), and compile/chain-break counts.
+    """
+    kinds: Dict[str, dict] = {}
+    fused_steps = unfused_steps = 0
+    fused_ms = unfused_ms = 0.0
+    compiles = chain_breaks = 0
+    for e in events:
+        k = e["kind"]
+        if k == "compile":
+            compiles += 1
+            continue
+        if k == "chain_break":
+            chain_breaks += 1
+            continue
+        if k == "pp_stage":
+            continue                     # dispatch-side only; no wall
+        row = kinds.setdefault(k, {"steps": 0, "wall_ms": 0.0,
+                                   "tokens": 0})
+        row["steps"] += 1
+        wall = float(e.get("wall_ms", 0.0))
+        row["wall_ms"] += wall
+        row["tokens"] += int(e.get("tokens", 0))
+        if k == "decode":
+            unfused_steps += 1
+            unfused_ms += wall
+        elif k == "fused_block":
+            fused_steps += int(e.get("k", 1))
+            fused_ms += wall
+    for row in kinds.values():
+        row["wall_ms"] = round(row["wall_ms"], 2)
+        row["ms_per_step"] = round(row["wall_ms"] / row["steps"], 2)
+    decode_ms = fused_ms + unfused_ms
+    return {
+        "by_kind": kinds,
+        "decode_steps_unfused": unfused_steps,
+        "decode_substeps_fused": fused_steps,
+        "unfused_decode_wall_frac": (round(unfused_ms / decode_ms, 4)
+                                     if decode_ms else None),
+        "compiles": compiles,
+        "chain_breaks": chain_breaks,
+    }

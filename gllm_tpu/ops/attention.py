@@ -129,7 +129,8 @@ def _pallas_sharded(q, k_cache, v_cache, metadata, *, scale, max_q_len,
     # context) the concrete mesh is bound fully-manual — partial-manual
     # over a concrete multi-axis mesh trips spec normalization on
     # replicated in_specs.
-    am = jax.sharding.get_abstract_mesh()
+    from gllm_tpu.parallel.mesh import active_mesh
+    am = active_mesh()
     if am is not None and am.shape_tuple:
         kw = dict(mesh=None, axis_names={axis})
     else:

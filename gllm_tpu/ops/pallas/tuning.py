@@ -75,8 +75,11 @@ def device_tag() -> str:
 
 def get(kernel: str) -> dict:
     """Tuned params for ``kernel`` on the current device (device-specific
-    entries layered over ``default``)."""
+    entries layered over ``default``). ``comment`` entries are provenance
+    annotations (which sweep artifact produced the value) — stripped here
+    so they never reach kernel kwargs."""
     t = _table()
     out = dict(t.get("default", {}).get(kernel, {}))
     out.update(t.get(device_tag(), {}).get(kernel, {}))
+    out.pop("comment", None)
     return out

@@ -39,12 +39,35 @@ def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
     return Mesh(arr, (AXIS_DP, AXIS_SP, AXIS_TP))
 
 
+def active_mesh():
+    """The mesh bound by the innermost ``mesh_context`` (or None).
+
+    Version shim: newer jax exposes ``jax.sharding.get_abstract_mesh``;
+    0.4.x tracks the ``with mesh:`` context in thread_resources. Both
+    returns carry ``.shape_tuple``."""
+    get_am = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_am is not None:
+        return get_am()
+    from jax._src import mesh as _mesh_lib
+    m = _mesh_lib.thread_resources.env.physical_mesh
+    if m is None or m.empty:
+        return None
+    return m
+
+
 @contextlib.contextmanager
 def mesh_context(mesh: Optional[Mesh]):
     if mesh is None:
         yield
+        return
+    set_mesh = getattr(jax.sharding, "set_mesh", None)
+    if set_mesh is not None:
+        with set_mesh(mesh):
+            yield
     else:
-        with jax.sharding.set_mesh(mesh):
+        # jax 0.4.x: Mesh itself is the context manager binding the
+        # active mesh that bare-PartitionSpec sharding constraints read
+        with mesh:
             yield
 
 
@@ -57,7 +80,7 @@ def shard_hint(x, *spec):
       divisibility gating in parallel/shardings.py — e.g. 4 kv heads on
       tp=8 stay replicated instead of forcing reshard collectives)
     """
-    mesh = jax.sharding.get_abstract_mesh()
+    mesh = active_mesh()
     if mesh is None or not mesh.shape_tuple:
         return x
     sizes = dict(mesh.shape_tuple)

@@ -28,6 +28,25 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 
+def outbound_ip(target_host: str = "10.255.255.255") -> Optional[str]:
+    """IP of the local interface that routes toward ``target_host`` —
+    a UDP connect performs no traffic but binds the socket to the
+    outbound interface. ``gethostbyname(gethostname())`` commonly
+    resolves to loopback in containers, so every advertised address
+    goes through this scheme instead. Returns None when no route
+    exists (isolated host)."""
+    import socket
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            probe.connect((target_host, 1))
+            return probe.getsockname()[0]
+        finally:
+            probe.close()
+    except OSError:
+        return None
+
+
 def broadcast_payload(obj) -> object:
     """Broadcast a picklable object from process 0 to all processes.
 
@@ -359,6 +378,13 @@ class MultihostEngine:
         if self.is_host0 and jax.process_count() > 1:
             self._blob_store = BlobStore()
             if advertise_host is None:
+                # default-route interface via the getsockname() scheme
+                # (same as the follower peer-advertise path below);
+                # gethostbyname(gethostname()) is loopback on many
+                # container /etc/hosts layouts and followers on other
+                # machines could never reach it
+                advertise_host = outbound_ip()
+            if advertise_host is None:
                 import socket as _s
                 try:
                     advertise_host = _s.gethostbyname(_s.gethostname())
@@ -580,16 +606,7 @@ class MultihostEngine:
                 # loopback in containers). A UDP connect performs no
                 # traffic but binds the socket to the outbound interface.
                 host0_ip = addr.rpartition(":")[0]
-                import socket as _s
-                try:
-                    probe = _s.socket(_s.AF_INET, _s.SOCK_DGRAM)
-                    try:
-                        probe.connect((host0_ip, 1))
-                        my_ip = probe.getsockname()[0]
-                    finally:
-                        probe.close()
-                except OSError:
-                    my_ip = None
+                my_ip = outbound_ip(host0_ip)
                 # Loopback is only usable when host 0 itself is loopback
                 # (single-machine topology); across machines it would point
                 # the child at itself.

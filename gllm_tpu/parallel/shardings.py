@@ -80,8 +80,14 @@ def moe_param_specs(cfg: ModelConfig, tp: int) -> dict:
     reference's dp_gather_hidden/ep_all_reduce perform by hand."""
     specs = dense_param_specs(cfg, tp)
     layers = specs["layers"]
-    for name in ("gate_proj", "up_proj", "down_proj"):
-        layers.pop(name, None)
+    from gllm_tpu.models.moe import moe_layer_mask
+    if all(moe_layer_mask(cfg)):
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            layers.pop(name, None)
+    else:
+        # mixed dense/sparse stack keeps the dense MLP leaves (their
+        # dense_param_specs tp shardings apply) plus the per-layer flag
+        layers["moe_mask"] = P(None)
     ep_ok = cfg.num_experts % tp == 0
     ep = _tp_if(ep_ok)
     layers["router"] = P(None, None, None)

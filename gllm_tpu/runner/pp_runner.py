@@ -46,12 +46,18 @@ import numpy as np
 
 from gllm_tpu.config import EngineConfig
 from gllm_tpu.models import ModelConfig, get_model_def
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.obs.steptrace import TRACE
 from gllm_tpu.ops.sampling import sample
 from gllm_tpu.runner.runner import (ModelRunner, _DTYPES,
                                     pick_kv_pack)
 from gllm_tpu.utils import cdiv, tpu_compiler_options
 
 logger = logging.getLogger(__name__)
+
+_M_MICROBATCH = obs.counter(
+    "gllm_pp_microbatches_total",
+    "microbatches dispatched through the stage pipeline")
 
 
 def split_layers(num_layers: int, pp: int,
@@ -158,6 +164,7 @@ class PPModelRunner(ModelRunner):
             self._mm_cache = LRUBytesCache()
         self.rng_key = jax.random.key(config.seed)
         self._step_count = 0
+        self._seen_sigs = set()          # see ModelRunner._note_dispatch
 
         if model_cfg.use_hybrid:
             from gllm_tpu.models.hybrid import period_pattern
@@ -437,6 +444,15 @@ class PPModelRunner(ModelRunner):
                                                     device=False)
         lp_k, want_plp = self._lp_flags(sched_batch)
         spec_sampled = _spec_sampled(sched_batch.items)
+        from gllm_tpu.runner.runner import _all_greedy as _ag
+        self._note_dispatch("pp", batch,
+                            (max_q, lp_k, want_plp, spec_sampled,
+                             _ag(sched_batch.items)),
+                            _ag(sched_batch.items))
+        _M_MICROBATCH.inc()
+        TRACE.record("pp_stage", stages=len(stages),
+                     num_seqs=sched_batch.num_seqs,
+                     tokens=sched_batch.total_tokens)
         hidden = residual = None
         out = None
         # one batched host→device transfer fans the step batch out to

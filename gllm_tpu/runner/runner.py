@@ -30,6 +30,8 @@ import numpy as np
 from gllm_tpu.batching import StepBatch
 from gllm_tpu.config import EngineConfig
 from gllm_tpu.models import ModelConfig, get_model_def
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.obs.steptrace import TRACE
 from gllm_tpu.ops.sampling import sample
 from gllm_tpu.runner.prepare import BatchBuilder
 from gllm_tpu.scheduler import ScheduledBatch
@@ -37,6 +39,18 @@ from gllm_tpu.utils import (bucket_size, cdiv, next_pow2,
                             tpu_compiler_options)
 
 logger = logging.getLogger(__name__)
+
+# Dispatch-side metrics (docs/observability.md). All pure host counters
+# on values the dispatch path already computes — the jit cache key set is
+# untouched (nothing here feeds a static argument).
+_M_SAMPLER = obs.counter(
+    "gllm_sampler_program_total",
+    "step dispatches by compiled sampler variant (greedy compiles the "
+    "sampled branch away; see ops/sampling.sample)", ("program",))
+_M_NEW_SHAPE = obs.counter(
+    "gllm_jit_new_shape_signatures_total",
+    "first dispatch of a (shape-bucket, static-flag) signature this "
+    "process — an XLA compile unless the persistent cache held it")
 
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "float16": jnp.float16,
@@ -218,6 +232,9 @@ class ModelRunner:
             self._mm_cache = LRUBytesCache()
         self.rng_key = jax.random.key(config.seed)
         self._step_count = 0
+        # (shape-bucket, static-flag) signatures already dispatched —
+        # first sightings count as compile events (obs layer)
+        self._seen_sigs = set()
 
         ep_loaded = False
         _t_load = time.monotonic()
@@ -677,6 +694,24 @@ class ModelRunner:
                                        s_dst, z, r_src, r_dst)
             self.kv = self.kv._replace(conv=conv, rec=rec)
 
+    def _note_dispatch(self, kind: str, batch, static_flags: tuple,
+                       all_greedy: bool) -> None:
+        """Host-side dispatch bookkeeping: sampler-variant counter + a
+        compile event on the first sighting of a (padded-shape,
+        static-flag) signature. Reads only shapes of already-built host
+        arrays — never forces a device sync."""
+        _M_SAMPLER.inc(program="greedy" if all_greedy else "sampled")
+        key = (kind, batch.token_ids.shape,
+               batch.attn.page_table.shape) + static_flags
+        if key not in self._seen_sigs:
+            self._seen_sigs.add(key)
+            _M_NEW_SHAPE.inc()
+            TRACE.record("compile", dispatch=kind,
+                         tokens_pad=int(batch.token_ids.shape[-1]),
+                         seqs_pad=int(batch.attn.page_table.shape[-2]),
+                         pages_pad=int(batch.attn.page_table.shape[-1]),
+                         flags=repr(static_flags))
+
     @staticmethod
     def _lp_flags(sched_batch: ScheduledBatch):
         """(logprobs_k, prompt_lp) static flags for this batch."""
@@ -776,13 +811,19 @@ class ModelRunner:
             k, plp = self._lp_flags(b)
             lp_k, want_plp = max(lp_k, k), want_plp or plp
 
+        all_greedy_dp = all(_all_greedy(b.items) for b in live)
+        spec_sampled_dp = any(_spec_sampled(b.items) for b in live)
+        self._note_dispatch("dp_step", stacked,
+                            (max_q, lp_k, want_plp, spec_sampled_dp,
+                             all_greedy_dp),
+                            all_greedy_dp)
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
             tokens, self.kv, aux = self._step_fn_dp(
                 self.params, self.kv, stacked, self.cos_sin, token_counts,
                 max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp,
-                spec_sampled=any(_spec_sampled(b.items) for b in live),
-                all_greedy=all(_all_greedy(b.items) for b in live))
+                spec_sampled=spec_sampled_dp,
+                all_greedy=all_greedy_dp)
         _start_host_copy((tokens, aux))
         return tokens, aux, [b.num_seqs if b is not None else 0
                              for b in sched_batches]
@@ -809,15 +850,20 @@ class ModelRunner:
         batch, max_q, token_counts = self.builder.build(sched_batch,
                                                         step_key)
         lp_k, want_plp = self._lp_flags(sched_batch)
+        ring = self._use_ring(sched_batch, batch.token_ids.shape[0])
+        spec_sampled = _spec_sampled(sched_batch.items)
+        all_greedy = _all_greedy(sched_batch.items)
+        self._note_dispatch("step", batch,
+                            (max_q, lp_k, want_plp, ring, spec_sampled,
+                             all_greedy), all_greedy)
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
             tokens, self.kv, aux = self._step_fn(
                 self.params, self.kv, batch, self.cos_sin, token_counts,
                 max_q_len=max_q, logprobs_k=lp_k, prompt_lp=want_plp,
-                ring=self._use_ring(sched_batch,
-                                    batch.token_ids.shape[0]),
-                spec_sampled=_spec_sampled(sched_batch.items),
-                all_greedy=_all_greedy(sched_batch.items))
+                ring=ring,
+                spec_sampled=spec_sampled,
+                all_greedy=all_greedy)
         _start_host_copy((tokens, aux))
         return tokens, aux, sched_batch.num_seqs
 
@@ -861,12 +907,16 @@ class ModelRunner:
             (prev_tokens.shape, batch.token_ids.shape)
         batch = batch._replace(token_ids=prev_tokens)
         lp_k, _ = self._lp_flags(sched_batch)
+        all_greedy = _all_greedy(sched_batch.items)
+        self._note_dispatch("step", batch,
+                            (1, lp_k, False, False, False, all_greedy),
+                            all_greedy)
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
             tokens, self.kv, aux = self._step_fn(
                 self.params, self.kv, batch, self.cos_sin, token_counts,
                 max_q_len=1, logprobs_k=lp_k,
-                all_greedy=_all_greedy(sched_batch.items))
+                all_greedy=all_greedy)
         _start_host_copy((tokens, aux))
         return tokens, aux, sched_batch.num_seqs
 
@@ -911,12 +961,15 @@ class ModelRunner:
             au_np[:n] = chain[0].active_until
         else:
             au_np[:n] = K
+        all_greedy = _all_greedy(chain[0].items)
+        self._note_dispatch("multi_step", batch, (K, all_greedy),
+                            all_greedy)
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
             tokens, self.kv = self._multi_step_fn(
                 self.params, self.kv, batch, self.cos_sin, keys,
                 jnp.asarray(au_np), num_steps=K,
-                all_greedy=_all_greedy(chain[0].items))
+                all_greedy=all_greedy)
         _start_host_copy(tokens)
         return tokens, {}, chain[0].num_seqs
 

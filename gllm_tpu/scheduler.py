@@ -36,10 +36,39 @@ from typing import Deque, List, Optional, Tuple
 
 from gllm_tpu.config import EngineConfig
 from gllm_tpu.memory_manager import MemoryManager
+from gllm_tpu.obs import metrics as obs
 from gllm_tpu.sequence import Sequence, SequenceStatus
 from gllm_tpu.utils import cdiv
 
 logger = logging.getLogger(__name__)
+
+# Scheduler metrics (docs/observability.md): pure-host gauges/counters —
+# set from numbers the scheduler already computes, nothing extra touches
+# the device or the jit cache keys. Gauges are labeled by DP replica
+# (``dp``): with dp>1 each replica owns a scheduler and unlabeled gauges
+# would flap between replicas; counters sum meaningfully and stay bare.
+_M_WAITING = obs.gauge("gllm_sched_waiting_seqs",
+                       "sequences queued waiting for admission", ("dp",))
+_M_RUNNING = obs.gauge("gllm_sched_running_seqs",
+                       "sequences admitted and holding KV pages", ("dp",))
+_M_DECODE = obs.gauge("gllm_sched_decode_seqs",
+                      "running sequences in the decode phase", ("dp",))
+_M_KV_UTIL = obs.gauge("gllm_sched_kv_util",
+                       "fraction of KV pages in use (0..1)", ("dp",))
+_M_CACHE_HIT = obs.gauge("gllm_prefix_cache_hit_rate",
+                         "lifetime prefix-cache hit rate in tokens (0..1)",
+                         ("dp",))
+_M_PREEMPT = obs.counter("gllm_sched_preemptions_total",
+                         "sequences preempted under memory pressure")
+_M_ADMIT = obs.counter("gllm_sched_admitted_total",
+                       "sequences admitted from the waiting queue")
+_M_BUDGET = obs.gauge("gllm_sched_prefill_token_budget",
+                      "prefill token budget of the latest schedule pass",
+                      ("dp",))
+_M_THROTTLE = obs.counter(
+    "gllm_sched_throttle_clips_total",
+    "token_throttling passes whose prefill budget was clipped below "
+    "max_prefill_tokens by the KV ramp / waiting-token smoothing")
 
 
 @dataclasses.dataclass
@@ -133,6 +162,9 @@ class Scheduler:
         self.sched_cfg = config.scheduler
         self.mm = memory_manager
         self.pp_size = max(1, pp_size)
+        # DP replica rank for metric labels (set by the engine; replica
+        # gauges must not overwrite each other under dp>1)
+        self.dp_rank = 0
 
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []
@@ -208,7 +240,15 @@ class Scheduler:
                            if s.num_remaining_tokens > 1)
         smooth = wait_tokens // max(1, cfg.iter_smooth)
         budget = min(budget, max(smooth, cfg.min_prefill_tokens))
-        return max(cfg.min_prefill_tokens, min(budget, cfg.max_prefill_tokens))
+        budget = max(cfg.min_prefill_tokens,
+                     min(budget, cfg.max_prefill_tokens))
+        _M_BUDGET.set(budget, dp=self.dp_rank)
+        if budget < cfg.max_prefill_tokens and wait_tokens > 0:
+            # only count a clip when there was prefill work to throttle —
+            # an idle/decode-only pass trivially floors the budget and
+            # must not read as continuous throttling
+            _M_THROTTLE.inc()
+        return budget
 
     def _decode_budget(self) -> int:
         cfg = self.sched_cfg
@@ -238,6 +278,7 @@ class Scheduler:
         victim.preempt()
         self.waiting.appendleft(victim)
         self.num_preemptions += 1
+        _M_PREEMPT.inc()
         self.new_token_ratio = self.sched_cfg.init_new_token_ratio
         logger.debug("preempted seq %d (%d tokens)", victim.seq_id,
                      victim.num_tokens)
@@ -325,6 +366,7 @@ class Scheduler:
                     seq.preempt()
                     self.waiting.appendleft(seq)
                     self.num_preemptions += 1
+                    _M_PREEMPT.inc()
                     self.new_token_ratio = self.sched_cfg.init_new_token_ratio
                 continue
             if drafts and self.mm.use_ssm:
@@ -466,6 +508,11 @@ class Scheduler:
             self.mm.prepare_seq(seq)
             self.waiting.popleft()
             seq.status = SequenceStatus.RUNNING
+            if not seq.first_sched_time:
+                # queue-time anchor (request histograms, engine/llm.py);
+                # a preempted seq keeps its original admission time
+                seq.first_sched_time = time.monotonic()
+            _M_ADMIT.inc()
             self.running.append(seq)
             items.append(ScheduledSeq(seq, n, seq.num_computed_tokens))
             token_budget -= n
@@ -764,6 +811,12 @@ class Scheduler:
         n_prefill = len(self.running) - n_decode
         util = 1.0 - self.mm.free_ratio
         hit = getattr(self.mm, "cache_hit_rate", None)
+        _M_WAITING.set(len(self.waiting), dp=self.dp_rank)
+        _M_RUNNING.set(len(self.running), dp=self.dp_rank)
+        _M_DECODE.set(n_decode, dp=self.dp_rank)
+        _M_KV_UTIL.set(util, dp=self.dp_rank)
+        if hit is not None:
+            _M_CACHE_HIT.set(hit, dp=self.dp_rank)
         spec = ""
         if self.spec_cfg is not None and self.spec_stats["proposed"]:
             spec = (" spec_accept={:.1f}%".format(

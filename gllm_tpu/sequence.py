@@ -40,6 +40,14 @@ class Sequence:
         self.prompt_len = len(prompt_token_ids)
         self.sampling_params = sampling_params or SamplingParams()
         self.arrival_time = arrival_time
+        # Request-latency anchors (gllm_tpu/obs request histograms —
+        # TTFT/TPOT/ITL/queue-time/e2e): set by the scheduler on first
+        # admission and by the engine as sampled tokens commit. 0.0 =
+        # not yet reached. Preemption keeps them (re-admission must not
+        # reset a request's clock).
+        self.first_sched_time = 0.0
+        self.first_token_time = 0.0
+        self.last_token_time = 0.0
 
         self.status = SequenceStatus.WAITING
         self.num_computed_tokens = 0

@@ -270,3 +270,51 @@ def test_chat_min_p_and_logit_bias(server):
         "messages": [{"role": "user", "content": "hey"}],
         "max_tokens": 2, "min_p": -0.5})
     assert status == 400
+
+
+def test_metrics_exposition_after_generate(server):
+    """GET /metrics returns valid Prometheus text exposition carrying
+    request-latency histograms (TTFT/TPOT/e2e) and per-step-kind
+    counters once a generate has run."""
+    from gllm_tpu.obs.metrics import parse_exposition
+
+    status, body = request(server, "POST", "/v1/completions", {
+        "prompt": [9, 8, 7], "max_tokens": 5, "temperature": 0,
+        "ignore_eos": True})
+    assert status == 200, body
+
+    conn = http.client.HTTPConnection("127.0.0.1", server, timeout=60)
+    conn.request("GET", "/metrics")
+    resp = conn.getresponse()
+    ctype = resp.getheader("Content-Type", "")
+    text = resp.read().decode()
+    conn.close()
+    assert resp.status == 200 and ctype.startswith("text/plain")
+
+    typed, samples, dupes = parse_exposition(text)
+    assert not dupes
+    for name in ("gllm_request_ttft_seconds",
+                 "gllm_request_tpot_seconds",
+                 "gllm_request_e2e_seconds"):
+        assert typed.get(name) == "histogram", name
+    assert samples[("gllm_request_ttft_seconds_count", "")] >= 1
+    assert samples[("gllm_request_e2e_seconds_count", "")] >= 1
+    assert samples[("gllm_steps_total", '{kind="prefill"}')] >= 1
+    assert samples[("gllm_decode_steps_total", '{fused="false"}')] >= 1
+    assert samples[("gllm_requests_submitted_total", "")] >= 1
+
+
+def test_steptrace_endpoint_after_generate(server):
+    status, body = request(server, "POST", "/v1/completions", {
+        "prompt": [4, 4, 4], "max_tokens": 3, "temperature": 0,
+        "ignore_eos": True})
+    assert status == 200, body
+    conn = http.client.HTTPConnection("127.0.0.1", server, timeout=60)
+    conn.request("GET", "/steptrace")
+    resp = conn.getresponse()
+    d = json.loads(resp.read())
+    conn.close()
+    assert resp.status == 200
+    assert d["events"] and "by_kind" in d["summary"]
+    assert {e["kind"] for e in d["events"]} & {"prefill", "decode",
+                                              "fused_block"}

@@ -78,6 +78,57 @@ def test_moe_checkpoint_greedy_equivalence(arch, tmp_path):
                                               want)
 
 
+def make_mixed_ckpt(tmp_path, mlp_only_layers, decoder_sparse_step):
+    """Qwen2-MoE with a mixed dense/sparse layer stack (4 layers so the
+    stride patterns are non-trivial)."""
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+    torch.manual_seed(17)
+    tiny = dict(MOE_TINY, num_hidden_layers=4)
+    cfg = Qwen2MoeConfig(**tiny, num_experts=4, num_experts_per_tok=2,
+                         moe_intermediate_size=32,
+                         shared_expert_intermediate_size=48,
+                         norm_topk_prob=False,
+                         decoder_sparse_step=decoder_sparse_step,
+                         mlp_only_layers=list(mlp_only_layers))
+    model = Qwen2MoeForCausalLM(cfg)
+    model.eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+    return model
+
+
+@pytest.mark.parametrize("mlp_only,stride", [((0,), 1), ((), 2),
+                                             ((1, 3), 1)])
+def test_moe_mixed_dense_sparse_stack(mlp_only, stride, tmp_path):
+    """mlp_only_layers / decoder_sparse_step route those layers through a
+    dense MLP (HF semantics: sparse iff not mlp_only and (i+1) % step ==
+    0) — greedy tokens must match HF exactly."""
+    from gllm_tpu.models.config import from_hf_config
+    from gllm_tpu.models.loader import load_hf_config
+    from gllm_tpu.models.moe import moe_layer_mask
+
+    hf = make_mixed_ckpt(tmp_path, mlp_only, stride)
+    mc = from_hf_config(load_hf_config(str(tmp_path)))
+    mask = moe_layer_mask(mc)
+    assert len(mask) == 4 and not all(mask), mask   # genuinely mixed
+    for i, sparse in enumerate(mask):
+        want = (i not in mlp_only) and ((i + 1) % stride == 0)
+        assert sparse == want, (i, mask)
+
+    cfg = EngineConfig(model=str(tmp_path), dtype="float32",
+                       max_model_len=128,
+                       cache=CacheConfig(page_size=4, num_pages=128))
+    llm = LLM(config=cfg)
+    prompts = [[7, 3, 56, 21], [99, 14, 5]]
+    outs = llm.generate(
+        prompt_token_ids=prompts,
+        sampling_params=SamplingParams(temperature=0.0, max_tokens=8,
+                                       ignore_eos=True))
+    for p, out in zip(prompts, outs):
+        want = hf_greedy(hf, p, 8)
+        assert out.output_token_ids == want, (mlp_only, stride,
+                                              out.output_token_ids, want)
+
+
 def test_moe_ep_sharded_matches_single(tmp_path):
     make_ckpt("Qwen3MoeForCausalLM", tmp_path)
 
