@@ -45,6 +45,26 @@ class CacheConfig:
     # Hybrid (GDN) models: cached-prefix SSM state slots (reference
     # --max-snapshot-ssm-slots; 0 disables the SSM half of prefix caching)
     ssm_snapshot_slots: int = 64
+    # Host-RAM KV tier size in GiB (gllm_tpu/kvswap, --kv-host-pool-gb):
+    # pinned host pages mirroring the device paged layout. Preemption
+    # victims swap out instead of recomputing, and evicted prefix-cache
+    # pages spill here so match_prefix can restore them. 0 = tier
+    # disabled (the pre-offload recompute behavior, byte for byte).
+    kv_host_pool_gb: float = 0.0
+    # Explicit host page count override (tests / benchmarks); wins over
+    # the GB sizing when set.
+    kv_host_pool_pages: Optional[int] = None
+    # --swap-policy: "auto" enables the tier iff a host pool is
+    # configured; "swap" requires one (config error otherwise);
+    # "recompute" forces the legacy free-and-recompute preemption even
+    # with a pool configured.
+    swap_policy: str = "auto"
+
+    @property
+    def host_pool_configured(self) -> bool:
+        return (self.swap_policy != "recompute"
+                and (self.kv_host_pool_gb > 0
+                     or bool(self.kv_host_pool_pages)))
 
 
 @dataclasses.dataclass
@@ -200,3 +220,13 @@ class EngineConfig:
             raise ValueError(
                 "sp (sequence parallelism) composes with tp only; "
                 "set pp = dp = 1")
+        if self.cache.swap_policy not in ("auto", "swap", "recompute"):
+            raise ValueError(
+                f"unknown swap_policy {self.cache.swap_policy!r} "
+                "(choices: auto, swap, recompute)")
+        if self.cache.swap_policy == "swap" \
+                and self.cache.kv_host_pool_gb <= 0 \
+                and not self.cache.kv_host_pool_pages:
+            raise ValueError(
+                "swap_policy='swap' needs a host pool: set "
+                "kv_host_pool_gb (--kv-host-pool-gb) > 0")

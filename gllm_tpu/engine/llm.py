@@ -177,6 +177,7 @@ class LLM:
         if self.dp > 1:
             # per-replica SSM intents apply to the stacked pools by index
             self.runner.memory_managers = self.memory_managers
+        self.swap_manager = self._maybe_init_kvswap()
         self.schedulers = [Scheduler(config, mm,
                                      pp_size=config.parallel.pp)
                            for mm in self.memory_managers]
@@ -219,6 +220,46 @@ class LLM:
         # Encoder disaggregation (gllm_tpu/disagg/): set by init_disagg on
         # LM nodes; monolith engines leave it None.
         self.disagg_coordinator = None
+
+    def _maybe_init_kvswap(self):
+        """Attach the host-RAM KV tier (gllm_tpu/kvswap) when configured
+        and the topology supports it. Gated to the single-program runner
+        (pp = dp = 1) and paged-only KV layouts (hybrid GDN state lives
+        in slot pools, not pages — swapping its KV without the recurrent
+        state would corrupt the recurrence)."""
+        cache = self.config.cache
+        if not cache.host_pool_configured:
+            return None
+        import jax
+        why = None
+        if self.config.parallel.pp > 1 or self.dp > 1:
+            why = "pp/dp > 1"
+        elif self.model_cfg.use_hybrid:
+            why = "hybrid (GDN) models"
+        elif jax.process_count() > 1:
+            # host fetches of a non-addressable global array can't work;
+            # each host would also need its own pool + deterministic drains
+            why = "multi-host meshes"
+        if why is not None:
+            logger.warning(
+                "kv host pool configured but unsupported for %s; "
+                "falling back to recompute preemption", why)
+            return None
+        from gllm_tpu.kvswap import KVSwapManager
+        n = cache.kv_host_pool_pages or KVSwapManager.host_pages_for(
+            self.runner.kv, cache.kv_host_pool_gb)
+        if n < 1:
+            logger.warning(
+                "kv host pool of %.2f GiB holds no page for this model; "
+                "tier disabled", cache.kv_host_pool_gb)
+            return None
+        sw = KVSwapManager(self.runner.kv, cache.page_size, n)
+        self.memory_manager.swap = sw
+        self.runner.swap_manager = sw
+        logger.info("KV host tier: %d pages x %d tokens (%.2f GiB)",
+                    n, cache.page_size,
+                    n * sw.pool.bytes_per_page / (1 << 30))
+        return sw
 
     def init_disagg(self, disagg_cfg) -> None:
         """Become a disagg LM node: start the coordinator (slot pool,

@@ -631,6 +631,8 @@ def build_engine_config(args) -> EngineConfig:
             num_pages=args.num_pages,
             kv_cache_dtype=args.kv_cache_dtype,
             enable_prefix_caching=args.enable_prefix_caching,
+            kv_host_pool_gb=args.kv_host_pool_gb,
+            swap_policy=args.swap_policy,
         ),
         parallel=ParallelConfig(
             pp=args.pp, tp=args.tp, dp=args.dp,
@@ -683,6 +685,16 @@ def make_parser() -> argparse.ArgumentParser:
                    choices=["int8", "fp8", "int4", "w8a8", "fp8_block"],
                    help="weight-only quantization")
     p.add_argument("--enable-prefix-caching", action="store_true")
+    p.add_argument("--kv-host-pool-gb", type=float, default=0.0,
+                   help="host-RAM KV tier size in GiB (gllm_tpu/kvswap):"
+                        " preemption victims swap out instead of "
+                        "recomputing, evicted prefix pages spill here; "
+                        "0 disables the tier (docs/kv_offload.md)")
+    p.add_argument("--swap-policy", default="auto",
+                   choices=["auto", "swap", "recompute"],
+                   help="auto: swap iff a host pool is configured; "
+                        "swap: require the pool; recompute: legacy "
+                        "free-and-recompute preemption")
     p.add_argument("--allow-hub-download", action="store_true",
                    help="resolve a non-local model id via HF-hub snapshot "
                         "download (file-lock serialized); default is "

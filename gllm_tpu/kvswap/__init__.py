@@ -1,0 +1,31 @@
+"""Host-RAM KV offload tier.
+
+Device HBM is the top of a two-level KV hierarchy: under memory pressure
+the scheduler swaps a preemption victim's computed pages out to a pinned
+host pool instead of discarding them (preemption becomes a transfer, not
+a re-prefill — vAttention 2405.04437 / "LLM in a flash" 2312.11514), and
+refcount-0 prefix-cache pages evicted from HBM spill to the same pool so
+``match_prefix`` can hit host-resident prefixes and restore them.
+
+Three parts (docs/kv_offload.md):
+
+- :class:`~gllm_tpu.kvswap.host_pool.HostKVPool` — numpy page pool
+  mirroring the device paged layout, with its own free list, LRU
+  eviction for spilled prefix pages, and the same chained-hash digests
+  (+ canary) as ``PrefixMemoryManager``;
+- :class:`~gllm_tpu.kvswap.engine.SwapEngine` — jit gather/scatter of
+  pages device<->host, batched per step and double-buffered off the hot
+  path (gathers start an async device->host copy and materialize one
+  drain later);
+- :class:`~gllm_tpu.kvswap.manager.KVSwapManager` — the bridge: the
+  scheduler / memory manager record swap intents host-side, the runner
+  drains them at dispatch time, BEFORE the step program, so device
+  execution order guarantees gathers read pre-overwrite pages and
+  scatters land before the forward reads them.
+"""
+
+from gllm_tpu.kvswap.host_pool import HostKVPool
+from gllm_tpu.kvswap.engine import SwapEngine
+from gllm_tpu.kvswap.manager import KVSwapManager
+
+__all__ = ["HostKVPool", "SwapEngine", "KVSwapManager"]

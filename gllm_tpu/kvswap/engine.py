@@ -1,0 +1,103 @@
+"""Async device<->host KV page mover.
+
+Two tiny jit programs over the runner's whole KV pytree (leaves
+``[L, P, page_size, ...]``; the page axis is axis 1 for every model
+family — dense K/V stacks, MLA latent + DSA index caches):
+
+- **gather**: ``kv[:, idx]`` → a fresh ``[L, n, page_size, ...]`` batch
+  per leaf. Dispatched BEFORE the step program that may overwrite the
+  source pages; per-device program order guarantees it reads
+  pre-overwrite data, so the scheduler may free+remint the device pages
+  the moment the intent is recorded.
+- **scatter**: ``kv.at[:, idx].set(data)`` with buffer donation — an
+  in-place page restore dispatched before the forward that reads it.
+  Padding columns target page 0 (the dummy page, which absorbs garbage
+  writes by design).
+
+Transfers are batched per drain and padded to power-of-two page counts
+so the jit cache stays logarithmic. Gathers are double-buffered: the
+device->host copy starts async at dispatch and materializes into the
+host pool one drain later (or on demand when the data is needed
+earlier), keeping the fetch off the hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Set
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gllm_tpu.utils import next_pow2
+
+
+@jax.jit
+def _gather_pages(kv, idx):
+    return jax.tree.map(lambda a: a[:, idx], kv)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_pages(kv, idx, data):
+    return jax.tree.map(lambda a, d: a.at[:, idx].set(d.astype(a.dtype)),
+                        kv, data)
+
+
+def _pad_idx(pages: Sequence[int]) -> np.ndarray:
+    n = len(pages)
+    idx = np.zeros(next_pow2(n, 1), np.int32)   # pad → dummy page 0
+    idx[:n] = pages
+    return idx
+
+
+class SwapEngine:
+    """Stateless transfer programs + the pending-gather double buffer."""
+
+    def __init__(self):
+        # [(device leaves [L, n_pad, ...], host page ids, n)]
+        self._pending: List[tuple] = []
+
+    # ---- device -> host ---------------------------------------------------
+
+    def gather(self, kv, dev_pages: Sequence[int],
+               host_pages: Sequence[int]) -> None:
+        """Dispatch a page gather and start its async host copy; the data
+        lands in the pool at the next :meth:`materialize`."""
+        out = _gather_pages(kv, jnp.asarray(_pad_idx(dev_pages)))
+        leaves = jax.tree.leaves(out)
+        for leaf in leaves:
+            try:
+                leaf.copy_to_host_async()
+            except (AttributeError, RuntimeError, TypeError):
+                pass   # backend without async copies: np.asarray later
+        self._pending.append((leaves, list(host_pages), len(dev_pages)))
+
+    def pending_host_pages(self) -> Set[int]:
+        return {h for _, hosts, _ in self._pending for h in hosts}
+
+    def materialize(self, pool, skip_free: Optional[Set[int]] = None) -> int:
+        """Land every pending gather in the host pool; returns the number
+        of pages written. ``skip_free``: host pages released while their
+        fetch was in flight — their slots may already belong to a new
+        tenant, so the stale data is dropped instead of written."""
+        moved = 0
+        pending, self._pending = self._pending, []
+        for leaves, host_pages, n in pending:
+            np_leaves = [np.asarray(leaf) for leaf in leaves]
+            for col, page in enumerate(host_pages[:n]):
+                if skip_free and page in skip_free:
+                    continue
+                pool.write_page(page, np_leaves, col)
+                moved += 1
+        return moved
+
+    # ---- host -> device ---------------------------------------------------
+
+    def scatter(self, kv, dev_pages: Sequence[int], pool,
+                host_pages: Sequence[int]):
+        """Restore host pages into device pages; returns the new kv."""
+        idx = _pad_idx(dev_pages)
+        data = pool.read_pages(host_pages, pad_to=len(idx))
+        tree = jax.tree.unflatten(jax.tree.structure(kv), data)
+        return _scatter_pages(kv, jnp.asarray(idx), tree)

@@ -1,0 +1,184 @@
+"""Host-RAM KV page pool.
+
+A numpy mirror of the device paged-KV layout: device leaf ``[L, P,
+page_size, ...]`` maps to a host store ``[H, L, page_size, ...]`` per
+leaf, where one host page holds ALL layers of one device page — the
+natural transfer unit (a sequence swap moves whole pages; the per-layer
+axis rides along in one gather/scatter).
+
+Two tenant classes share the pool:
+
+- **sequence pages** (swap-based preemption): pinned for the life of the
+  swapped-out sequence; freed on resume or abort. Never evicted.
+- **prefix pages** (HBM prefix-cache spill): keyed by the same chained
+  hash digests as ``PrefixMemoryManager`` with the same 8-token canary
+  guard, LRU-evictable whenever unpinned. A canary mismatch on probe is
+  treated as a miss and the poisoned entry dropped — the host tier can
+  serve stale/garbage data to nobody.
+
+Pure host bookkeeping — no jax imports; device transfers live in
+``kvswap/engine.py``.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# The host tier verifies with the SAME collision guard as the device
+# prefix cache — one constant, so the two can never drift apart and
+# silently miss (or under-verify) on every probe.
+from gllm_tpu.memory_manager import _CANARY_TOKENS as CANARY_TOKENS
+
+
+class HostKVPool:
+    def __init__(self, page_shapes: Sequence[Tuple[tuple, object]],
+                 num_pages: int):
+        """``page_shapes``: one ``(shape, dtype)`` per paged KV leaf,
+        where ``shape`` is the per-page slab ``(L, page_size, *tail)``."""
+        if num_pages < 1:
+            raise ValueError("host pool needs at least one page")
+        self.num_pages = num_pages
+        self.page_shapes = [(tuple(s), np.dtype(d)) for s, d in page_shapes]
+        # Lazily-touched backing store: np.zeros is virtual until written,
+        # so an oversized pool costs address space, not resident RAM.
+        self.store: List[np.ndarray] = [
+            np.zeros((num_pages,) + s, d) for s, d in self.page_shapes]
+        self._free: OrderedDict[int, None] = OrderedDict(
+            (i, None) for i in range(num_pages))
+        self._pins: Dict[int, int] = {}
+        # Prefix tier (mirrors PrefixMemoryManager's maps).
+        self.hash_to_page: Dict[bytes, int] = {}
+        self.page_meta: Dict[int, Tuple[bytes, Tuple[int, ...]]] = {}
+        # Unpinned prefix pages in recency order (oldest first) —
+        # the eviction frontier.
+        self._lru: OrderedDict[int, None] = OrderedDict()
+
+    # ---- sizing -----------------------------------------------------------
+
+    @property
+    def bytes_per_page(self) -> int:
+        return sum(int(np.prod(s)) * d.itemsize for s, d in self.page_shapes)
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return self.num_pages - len(self._free)
+
+    # ---- allocation / eviction -------------------------------------------
+
+    def allocate(self, n: int) -> Optional[List[int]]:
+        """``n`` host pages, LRU-evicting unpinned prefix pages to make
+        room; None (nothing changed) when even eviction can't cover."""
+        if n <= 0:
+            return []
+        can_evict = sum(1 for p in self._lru if not self._pins.get(p))
+        if len(self._free) + can_evict < n:
+            return None
+        while len(self._free) < n:
+            self._evict_one()
+        out = []
+        for _ in range(n):
+            page, _ = self._free.popitem(last=False)
+            out.append(page)
+        return out
+
+    def _evict_one(self) -> None:
+        for page in self._lru:
+            if not self._pins.get(page):
+                del self._lru[page]
+                self.drop_prefix(page)
+                self._free[page] = None
+                return
+        raise RuntimeError("no evictable host page")  # guarded by caller
+
+    def free(self, pages) -> None:
+        for page in pages:
+            if page in self._free:
+                raise RuntimeError(f"double free of host page {page}")
+            self._pins.pop(page, None)
+            self._lru.pop(page, None)
+            self.drop_prefix(page)
+            self._free[page] = None
+
+    def pin(self, pages) -> None:
+        """In-flight / ownership guard: pinned pages are never evicted
+        (and the manager defers their free until the transfer lands)."""
+        for page in pages:
+            self._pins[page] = self._pins.get(page, 0) + 1
+
+    def unpin(self, pages) -> None:
+        for page in pages:
+            left = self._pins.get(page, 0) - 1
+            if left > 0:
+                self._pins[page] = left
+            else:
+                self._pins.pop(page, None)
+
+    def is_pinned(self, page: int) -> bool:
+        return bool(self._pins.get(page))
+
+    # ---- page data --------------------------------------------------------
+
+    def write_page(self, page: int, gathered: Sequence[np.ndarray],
+                   col: int) -> None:
+        """Store column ``col`` of a gathered batch (leaves
+        ``[L, n, page_size, ...]``) as host page ``page``."""
+        for store, src in zip(self.store, gathered):
+            store[page] = src[:, col]
+
+    def read_pages(self, pages: Sequence[int],
+                   pad_to: Optional[int] = None) -> List[np.ndarray]:
+        """Stack host pages into scatter-shaped leaves
+        ``[L, n(_pad), page_size, ...]``; padding columns are zeros (they
+        scatter into the dummy page)."""
+        n = len(pages)
+        idx = list(pages) + [0] * (max(pad_to or n, n) - n)
+        out = []
+        for store in self.store:
+            stacked = np.moveaxis(store[np.asarray(idx, np.int64)], 0, 1)
+            if len(idx) > n:
+                stacked = stacked.copy()
+                stacked[:, n:] = 0
+            out.append(stacked)
+        return out
+
+    # ---- prefix tier ------------------------------------------------------
+
+    def put_prefix(self, page: int, digest: bytes,
+                   canary: Tuple[int, ...]) -> None:
+        old = self.hash_to_page.get(digest)
+        if old is not None and old != page:
+            # newer copy wins; the old page keeps its data but loses the
+            # key (it will age out of the LRU)
+            self.page_meta.pop(old, None)
+        self.hash_to_page[digest] = page
+        self.page_meta[page] = (digest, tuple(canary))
+        self._lru[page] = None
+        self._lru.move_to_end(page)
+
+    def match_prefix(self, digest: bytes, tokens) -> Optional[int]:
+        """Host page for this chained digest, canary-verified; a mismatch
+        (hash collision / corruption) drops the entry and misses."""
+        page = self.hash_to_page.get(digest)
+        if page is None:
+            return None
+        _, canary = self.page_meta[page]
+        if tuple(tokens[:CANARY_TOKENS]) != canary:
+            # collision / corruption: poison the entry, never serve it.
+            # The page stays in the LRU (metaless) and ages out normally.
+            self.drop_prefix(page)
+            return None
+        self._lru[page] = None
+        self._lru.move_to_end(page)
+        return page
+
+    def drop_prefix(self, page: int) -> None:
+        meta = self.page_meta.pop(page, None)
+        if meta is not None and self.hash_to_page.get(meta[0]) == page:
+            del self.hash_to_page[meta[0]]

@@ -1,0 +1,253 @@
+"""KV swap manager: the scheduler<->runner bridge for the host tier.
+
+The scheduler and memory manager are pure host bookkeeping — they must
+never touch the device. So, exactly like the hybrid models' SSM slot
+intents, swap decisions are recorded here as **intents** and the runner
+drains them at dispatch time via :meth:`KVSwapManager.apply`, BEFORE the
+step program:
+
+- gathers (swap-out / prefix spill) read their source pages ahead of the
+  forward that may overwrite them — device program order makes the copy
+  consistent even though the scheduler already freed (and possibly
+  re-minted) the page ids;
+- scatters (swap-in / prefix restore) land their pages before the
+  forward reads them.
+
+In-flight tracking: host pages belonging to a dispatched-but-not-landed
+gather are pinned (never evicted, frees deferred), and device pages with
+a queued restore are remembered so a re-mint of such a page can never
+spill its not-yet-written content to the host tier.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Set, Tuple
+
+import numpy as np
+
+from gllm_tpu.kvswap.engine import SwapEngine
+from gllm_tpu.kvswap.host_pool import HostKVPool
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.utils import cdiv
+
+logger = logging.getLogger(__name__)
+
+# Host-tier metrics (docs/kv_offload.md, docs/observability.md).
+_M_SWAP_OUT = obs.counter(
+    "gllm_kvswap_swap_out_total",
+    "sequences preempted by swapping their KV to the host tier")
+_M_SWAP_IN = obs.counter(
+    "gllm_kvswap_swap_in_total",
+    "sequences resumed by swapping KV back in (zero re-prefill)")
+_M_PAGES = obs.counter("gllm_kvswap_pages_total",
+                       "KV pages transferred device<->host", ("dir",))
+_M_SPILL = obs.counter(
+    "gllm_kvswap_prefix_spill_pages_total",
+    "refcount-0 prefix pages spilled host-side on HBM eviction")
+_M_RESTORE = obs.counter(
+    "gllm_kvswap_prefix_restore_pages_total",
+    "host-tier prefix pages restored into HBM by match_prefix")
+_M_FALLBACK = obs.counter(
+    "gllm_kvswap_recompute_fallbacks_total",
+    "preemptions that fell back to free-and-recompute (host pool full)")
+_M_CANARY = obs.counter(
+    "gllm_kvswap_host_canary_misses_total",
+    "host-tier digest hits rejected by the canary check (treated as miss)")
+_M_HOST = obs.gauge("gllm_kvswap_host_pool_pages",
+                    "host KV pool pages by state", ("state",))
+_M_XFER = obs.histogram(
+    "gllm_kvswap_transfer_seconds",
+    "host wall time of drained swap transfers per step",
+    ("dir",), buckets=obs.FAST_LATENCY_BUCKETS)
+
+
+class KVSwapManager:
+    def __init__(self, kv_tree, page_size: int, num_host_pages: int):
+        import jax
+        leaves = jax.tree.leaves(kv_tree)
+        if not leaves:
+            raise ValueError("empty KV tree")
+        num_dev_pages = {leaf.shape[1] for leaf in leaves}
+        if len(num_dev_pages) != 1:
+            raise ValueError(
+                f"KV leaves disagree on the page axis: {num_dev_pages} — "
+                "this model family cannot use the host tier")
+        self.page_size = page_size
+        self.pool = HostKVPool(
+            [((leaf.shape[0],) + leaf.shape[2:], np.dtype(leaf.dtype))
+             for leaf in leaves], num_host_pages)
+        self.engine = SwapEngine()
+        # queued intents, drained by the runner at dispatch time
+        self._out: List[Tuple[List[int], List[int]]] = []   # (dev, host)
+        self._in: List[Tuple[List[int], List[int], str]] = []  # +kind
+        # device pages whose restore scatter hasn't drained: a re-mint of
+        # one must not spill its (not yet written) content
+        self._pending_restore_dev: Set[int] = set()
+        # host pages released while their gather was in flight: freed
+        # only after the fetch lands (their slot must not be re-tenanted
+        # under a pending write)
+        self._free_after_fetch: Set[int] = set()
+        self._update_gauges()
+
+    # ---- sizing -----------------------------------------------------------
+
+    @staticmethod
+    def host_pages_for(kv_tree, gib: float) -> int:
+        """How many host pages fit in ``gib`` GiB for this KV layout."""
+        import jax
+        per = sum(
+            int(np.prod((leaf.shape[0],) + leaf.shape[2:]))
+            * np.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree.leaves(kv_tree))
+        return int(gib * (1 << 30) // per) if per else 0
+
+    # ---- scheduler API: swap-based preemption -----------------------------
+
+    def try_swap_out(self, seq, mm) -> bool:
+        """Swap ``seq``'s computed pages to the host tier instead of
+        recomputing. On success the seq is SWAPPED with its host pages
+        recorded; on failure (pool full / nothing computed) nothing
+        changed and the caller falls back to free-and-recompute."""
+        n = cdiv(seq.num_computed_tokens, self.page_size)
+        if n <= 0 or n > len(seq.page_table):
+            return False
+        host = self.pool.allocate(n)
+        if host is None:
+            _M_FALLBACK.inc()
+            return False
+        dev = list(seq.page_table[:n])
+        self.pool.pin(host)              # in-flight until the fetch lands
+        self._out.append((dev, host))
+        mm.free_seq(seq)                 # device refcounts / page reuse
+        seq.swap_out(host)
+        _M_SWAP_OUT.inc()
+        _M_PAGES.inc(n, dir="out")
+        self._update_gauges()
+        return True
+
+    def record_swap_in(self, seq) -> None:
+        """Called at re-admission, after fresh device pages were
+        allocated: queue the host->device restore covering the swapped
+        prefix of ``seq.page_table``."""
+        host = seq.swap_host_pages
+        seq.swap_host_pages = None
+        dev = list(seq.page_table[:len(host)])
+        assert len(dev) == len(host), (len(dev), len(host))
+        self._in.append((host, dev, "seq"))
+        self._pending_restore_dev.update(dev)
+        _M_SWAP_IN.inc()
+        _M_PAGES.inc(len(host), dir="in")
+
+    def release_seq(self, seq) -> None:
+        """Free a swapped-out seq's host pages (abort / finish without
+        resume)."""
+        host = seq.swap_host_pages
+        seq.swap_host_pages = None
+        if host:
+            self._free_host_pages(host)
+            self._update_gauges()
+
+    # ---- memory-manager API: prefix spill tier ----------------------------
+
+    def spill_prefix(self, dev_page: int, digest: bytes, canary) -> None:
+        """A refcount-0 cached page is being re-minted for new content —
+        copy it to the host tier keyed by the same digest."""
+        if dev_page in self._pending_restore_dev:
+            return   # its content hasn't landed on device yet
+        host = self.pool.allocate(1)
+        if host is None:
+            return   # pool full of pinned pages; drop the spill
+        self.pool.pin(host)
+        self._out.append(([dev_page], host))
+        self.pool.put_prefix(host[0], digest, canary)
+        _M_SPILL.inc()
+        _M_PAGES.inc(dir="out")
+        self._update_gauges()
+
+    def match_host_prefix(self, digest: bytes, tokens) -> Optional[int]:
+        """Host page for this chained digest, canary-verified; a
+        mismatch counts and misses (the entry is dropped)."""
+        if self.pool.hash_to_page.get(digest) is None:
+            return None
+        page = self.pool.match_prefix(digest, tokens)
+        if page is None:
+            _M_CANARY.inc()
+        return page
+
+    def restore_prefix(self, host_page: int, dev_page: int) -> None:
+        """Queue a host->device copy of a cached prefix page into a
+        freshly minted device page (the host copy stays cached)."""
+        self.pool.pin([host_page])       # survive eviction until drained
+        self._in.append(([host_page], [dev_page], "prefix"))
+        self._pending_restore_dev.add(dev_page)
+        _M_RESTORE.inc()
+        _M_PAGES.inc(dir="in")
+
+    # ---- runner API --------------------------------------------------------
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._out or self._in or self.engine._pending)
+
+    def apply(self, kv):
+        """Drain queued intents against the runner's KV; returns the new
+        KV pytree. Must run at dispatch time, before the step program."""
+        if self.engine._pending:
+            # land the PREVIOUS drain's gathers (double buffer)
+            t0 = time.monotonic()
+            self._materialize()
+            _M_XFER.observe(time.monotonic() - t0, dir="out")
+        outs, self._out = self._out, []
+        ins, self._in = self._in, []
+        if outs:
+            dev = [p for d, _ in outs for p in d]
+            host = [p for _, h in outs for p in h]
+            self.engine.gather(kv, dev, host)
+        if ins:
+            needed = {p for h, _, _ in ins for p in h}
+            if needed & self.engine.pending_host_pages():
+                # swap-out and swap-in of the same page in one pass
+                # (admission thrash): block on the fetch so the scatter
+                # reads real data — this is the SLOW outbound case, so
+                # it must land in the dir="out" histogram too
+                t0 = time.monotonic()
+                self._materialize()
+                _M_XFER.observe(time.monotonic() - t0, dir="out")
+            t0 = time.monotonic()
+            host = [p for h, _, _ in ins for p in h]
+            dev = [p for _, d, _ in ins for p in d]
+            kv = self.engine.scatter(kv, dev, self.pool, host)
+            _M_XFER.observe(time.monotonic() - t0, dir="in")
+            for h_pages, d_pages, kind in ins:
+                self._pending_restore_dev.difference_update(d_pages)
+                if kind == "seq":
+                    # the resumed seq's host copy is dead weight now
+                    self._free_host_pages(h_pages)
+                else:
+                    self.pool.unpin(h_pages)
+        self._update_gauges()
+        return kv
+
+    # ---- internals ---------------------------------------------------------
+
+    def _materialize(self) -> None:
+        pending = [hp for _, hp, n in self.engine._pending for hp in hp[:n]]
+        self.engine.materialize(self.pool,
+                                skip_free=self._free_after_fetch)
+        self.pool.unpin(pending)
+        if self._free_after_fetch:
+            self.pool.free(list(self._free_after_fetch))
+            self._free_after_fetch.clear()
+
+    def _free_host_pages(self, pages) -> None:
+        pending = self.engine.pending_host_pages()
+        now = [p for p in pages if p not in pending]
+        self._free_after_fetch.update(p for p in pages if p in pending)
+        if now:
+            self.pool.free(now)
+
+    def _update_gauges(self) -> None:
+        _M_HOST.set(self.pool.num_free, state="free")
+        _M_HOST.set(self.pool.num_used, state="used")

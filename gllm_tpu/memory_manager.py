@@ -92,6 +92,10 @@ class MemoryManager:
         # Page 0 reserved for padding writes.
         self.allocator = IDAllocator(num_pages - 1, start=1)
         self.ref_count: Dict[int, int] = {}
+        # Host-RAM KV tier (gllm_tpu/kvswap.KVSwapManager) — attached by
+        # the engine when a host pool is configured; None keeps every
+        # code path byte-for-byte the pre-offload behavior.
+        self.swap = None
 
         self.ssm_working_slots = ssm_working_slots
         self.ssm_snapshot_slots = ssm_snapshot_slots
@@ -213,6 +217,10 @@ class MemoryManager:
             self._release_page(page)
         seq.page_table = []
         seq._pt_np = None      # see Sequence.preempt: shrink ⇒ drop cache
+        if self.swap is not None and seq.swap_host_pages:
+            # SWAPPED seq freed without resuming (abort / shutdown):
+            # return its host-tier pages too
+            self.swap.release_seq(seq)
         self._free_ssm(seq)
 
     def _release_page(self, page: int) -> None:
@@ -249,10 +257,31 @@ class PrefixMemoryManager(MemoryManager):
         page = self.allocator.allocate()
         meta = self.page_meta.pop(page, None)
         if meta is not None:
-            digest = meta[0]
+            digest, canary = meta
             if self.hash_to_page.get(digest) == page:
                 del self.hash_to_page[digest]
+                if self.swap is not None:
+                    # this was the canonical copy of its content — spill
+                    # it to the host tier instead of losing it (eviction
+                    # becomes a transfer, not a future re-prefill)
+                    self.swap.spill_prefix(page, digest, canary)
         self._release_snapshot_for(page)
+        return page
+
+    def _restore_from_host(self, digest: bytes, tokens) -> Optional[int]:
+        """Host-tier prefix probe for match_prefix: on a (canary-verified)
+        hit, mint a fresh device page, queue the host->device restore,
+        and re-register the digest device-side. None = miss / no device
+        page to restore into."""
+        if self.swap is None:
+            return None
+        host_page = self.swap.match_host_prefix(digest, tokens)
+        if host_page is None or not self.can_allocate(1):
+            return None
+        page = self._mint_page()
+        self.swap.restore_prefix(host_page, page)
+        self.hash_to_page[digest] = page
+        self.page_meta[page] = (digest, tuple(tokens[:_CANARY_TOKENS]))
         return page
 
     def _release_snapshot_for(self, page: int) -> None:
@@ -317,6 +346,11 @@ class PrefixMemoryManager(MemoryManager):
                 seq.cache_token_ids, seq.prompt_len, self.page_size,
                 extra_key):
             page = self._probe_page(digest, tokens)
+            if page is None:
+                # HBM miss → host spill tier (gllm_tpu/kvswap): a hit
+                # mints a fresh device page and queues the restore copy,
+                # which the runner drains before the step that reads it.
+                page = self._restore_from_host(digest, tokens)
             if page is None:
                 break
             if self.allocator.is_free(page):

@@ -254,6 +254,12 @@ class BatchBuilder:
         # them. Semantics byte-identical (engine identity tests).
         items = batch.items
         K = len(items)
+        # Host-tier invariant (gllm_tpu/kvswap): a seq that reaches the
+        # builder must have had its swap-in recorded at admission — its
+        # restore intent drains before this batch's forward, so building
+        # rows over still-host-resident KV here would read garbage.
+        assert not any(it.seq.swap_host_pages for it in items), \
+            "SWAPPED seq scheduled without a recorded swap-in"
         # speculative drafts add verify rows after each item's committed
         # chunk; everything downstream (positions, slots, kv_lens, causal
         # attention) treats them as ordinary chunk rows

@@ -329,6 +329,9 @@ class ModelRunner:
                 lambda a: jnp.zeros((self.dp,) + a.shape, a.dtype),
                 self.kv)
         self.memory_manager = None   # attached by the engine (SSM intents)
+        # Host-RAM KV tier (gllm_tpu/kvswap) — attached by the engine
+        # when configured; drained at dispatch time on every step path.
+        self.swap_manager = None
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec
             kspecs = self.model_def.kv_specs(model_cfg, config.parallel.tp)
@@ -694,6 +697,18 @@ class ModelRunner:
                                        s_dst, z, r_src, r_dst)
             self.kv = self.kv._replace(conv=conv, rec=rec)
 
+    def _apply_swap_intents(self) -> None:
+        """Drain queued host-tier swap intents (gllm_tpu/kvswap) against
+        the KV cache. MUST run before the step program is dispatched:
+        per-device program order then guarantees swap-out/spill gathers
+        read their pages before the forward overwrites them, and
+        swap-in/restore scatters land before the forward reads them —
+        that ordering is the whole correctness argument for letting the
+        scheduler free and re-mint a swapped-out page immediately."""
+        sw = self.swap_manager
+        if sw is not None and sw.has_work:
+            self.kv = sw.apply(self.kv)
+
     def _note_dispatch(self, kind: str, batch, static_flags: tuple,
                        all_greedy: bool) -> None:
         """Host-side dispatch bookkeeping: sampler-variant counter + a
@@ -742,6 +757,7 @@ class ModelRunner:
         from jax.sharding import NamedSharding, PartitionSpec as P
         assert len(sched_batches) == self.dp
         self._apply_ssm_intents()
+        self._apply_swap_intents()   # no-op under dp>1 (tier is gated)
         self._step_count += 1
         base_key = jax.random.fold_in(self.rng_key, self._step_count)
 
@@ -845,6 +861,7 @@ class ModelRunner:
         if self.model_cfg.use_mm:
             self._prepare_mm(sched_batch)
         self._apply_ssm_intents()
+        self._apply_swap_intents()
         self._step_count += 1
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
         batch, max_q, token_counts = self.builder.build(sched_batch,
@@ -898,6 +915,7 @@ class ModelRunner:
             prev_tokens = prev_tokens[-1]   # preceding multi-step block
         assert prev_n == sched_batch.num_seqs
         self._apply_ssm_intents()
+        self._apply_swap_intents()
         self._step_count += 1
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
         batch, max_q, token_counts = self.builder.build(sched_batch,
@@ -932,6 +950,9 @@ class ModelRunner:
         Returns a handle whose collect() yields tokens [K, n]; chainable
         (the last step's on-device tokens feed the next block)."""
         K = len(chain)
+        # chain scheduling may have minted prefix-cached pages (spill
+        # intents) — drain before the block overwrites them
+        self._apply_swap_intents()
         # per-sub-step keys matching the single-step schedule exactly
         # (fold_in of consecutive step counts) → byte-identical sampling
         # across multi/single scheduling modes

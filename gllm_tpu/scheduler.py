@@ -263,6 +263,26 @@ class Scheduler:
 
     # ---- preemption -------------------------------------------------------
 
+    def _do_preempt(self, victim: Sequence) -> None:
+        """Evict ``victim`` (already removed from running) to the head of
+        the waiting queue. With a host KV tier attached, the victim's
+        computed pages swap out instead of being discarded — re-admission
+        swaps them back in with zero re-prefill; the recompute path is
+        the fallback (no tier configured, or its pool is full)."""
+        swap = getattr(self.mm, "swap", None)
+        if swap is not None and swap.try_swap_out(victim, self.mm):
+            logger.debug("swapped out seq %d (%d tokens)", victim.seq_id,
+                         victim.num_tokens)
+        else:
+            self.mm.free_seq(victim)
+            victim.preempt()
+            logger.debug("preempted seq %d (%d tokens)", victim.seq_id,
+                         victim.num_tokens)
+        self.waiting.appendleft(victim)
+        self.num_preemptions += 1
+        _M_PREEMPT.inc()
+        self.new_token_ratio = self.sched_cfg.init_new_token_ratio
+
     def _preempt_one(self, protect: set[int]) -> bool:
         """Free memory by preempting the largest unprotected running seq.
 
@@ -274,14 +294,7 @@ class Scheduler:
             return False
         victim = max(victims, key=lambda s: s.num_tokens)
         self.running.remove(victim)
-        self.mm.free_seq(victim)
-        victim.preempt()
-        self.waiting.appendleft(victim)
-        self.num_preemptions += 1
-        _M_PREEMPT.inc()
-        self.new_token_ratio = self.sched_cfg.init_new_token_ratio
-        logger.debug("preempted seq %d (%d tokens)", victim.seq_id,
-                     victim.num_tokens)
+        self._do_preempt(victim)
         return True
 
     def _allocate_with_preemption(self, seq: Sequence, n_tokens: int,
@@ -290,7 +303,7 @@ class Scheduler:
         while not self.mm.can_allocate(need):
             if not self._preempt_one(protect):
                 return False
-            if seq.status == SequenceStatus.PREEMPTED:
+            if seq.status is not SequenceStatus.RUNNING:
                 return False  # preempted ourselves — nothing left to take
         self.mm.allocate_seq_pages(seq, n_tokens)
         return True
@@ -362,12 +375,7 @@ class Scheduler:
                     # system always makes progress (last-resort
                     # self-preemption, reference scheduler.py:254-314).
                     self.running.remove(seq)
-                    self.mm.free_seq(seq)
-                    seq.preempt()
-                    self.waiting.appendleft(seq)
-                    self.num_preemptions += 1
-                    _M_PREEMPT.inc()
-                    self.new_token_ratio = self.sched_cfg.init_new_token_ratio
+                    self._do_preempt(seq)
                 continue
             if drafts and self.mm.use_ssm:
                 # checkpoint the pre-draft SSM state (the snapshot intent
@@ -507,6 +515,13 @@ class Scheduler:
             self.mm.allocate_seq_pages(seq, n)
             self.mm.prepare_seq(seq)
             self.waiting.popleft()
+            if seq.status is SequenceStatus.SWAPPED:
+                # Resume via swap-in: the fresh pages covering the
+                # swapped-out KV are restored from the host tier (the
+                # runner drains the copy before this batch's forward),
+                # so the chunk continues exactly where preemption hit —
+                # zero re-prefill.
+                self.mm.swap.record_swap_in(seq)
             seq.status = SequenceStatus.RUNNING
             if not seq.first_sched_time:
                 # queue-time anchor (request histograms, engine/llm.py);

@@ -20,6 +20,10 @@ class SequenceStatus(enum.Enum):
     WAITING = enum.auto()
     RUNNING = enum.auto()
     PREEMPTED = enum.auto()
+    # Preempted with KV intact in the host tier (gllm_tpu/kvswap): sits
+    # in the waiting queue like PREEMPTED, but re-admission swaps the
+    # pages back in instead of re-prefilling.
+    SWAPPED = enum.auto()
     FINISHED = enum.auto()
     ABORTED = enum.auto()
 
@@ -60,6 +64,9 @@ class Sequence:
         self.num_in_flight = 0
         self.page_table: List[int] = []
         self._pt_np = None   # np cache of page_table (builder fast path)
+        # Host-tier page ids holding this seq's KV while SWAPPED
+        # (gllm_tpu/kvswap); num_computed_tokens keeps counting that KV.
+        self.swap_host_pages: Optional[List[int]] = None
         # Pages whose contents came from the prefix cache (KV already valid).
         self.num_cached_tokens = 0
         self.finish_reason: Optional[str] = None
@@ -140,6 +147,17 @@ class Sequence:
         # the batch builder caches the np form of the page table with
         # length-only invalidation (append-only growth); every shrink
         # site must drop it or a same-length regrow serves stale page ids
+        self._pt_np = None
+
+    def swap_out(self, host_pages: List[int]) -> None:
+        """Preempt WITHOUT discarding KV: the pages covering
+        ``num_computed_tokens`` now live in the host tier (caller already
+        released the device pages). The computed count is kept — on
+        re-admission the scheduler allocates fresh device pages and the
+        swap manager restores into them, so no token is recomputed."""
+        self.status = SequenceStatus.SWAPPED
+        self.swap_host_pages = list(host_pages)
+        self.page_table = []
         self._pt_np = None
 
     def check_finish(self, eos_token_ids) -> Optional[str]:

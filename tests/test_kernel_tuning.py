@@ -54,3 +54,47 @@ def test_device_tag_cpu():
     # lookup falls back to default cleanly
     assert tuning.device_tag()
     assert tuning.get("nonexistent_kernel") == {}
+
+
+def test_committed_table_entries_carry_provenance():
+    """Every committed tables.json entry must say which sweep artifact
+    produced it (guards against a repeat of the round-5 silent
+    tuning-table regression, where a hand-edited value shipped with no
+    trail back to a measurement)."""
+    with open(tuning._TABLES_PATH) as f:
+        table = json.load(f)
+    assert table, "committed tables.json is empty"
+    for dev, kernels in table.items():
+        for kern, params in kernels.items():
+            comment = params.get("comment")
+            assert isinstance(comment, str) and comment.strip(), (
+                f"tables.json entry {dev}/{kern} lacks a provenance "
+                f"'comment' naming the sweep artifact behind it")
+            # provenance must point somewhere checkable, not just vibes
+            assert any(tok in comment for tok in ("docs/", "r0", "sweep",
+                                                  "kernel_tune")), (
+                f"{dev}/{kern} comment names no artifact: {comment!r}")
+            # and the entry must carry actual kernel params besides it
+            assert any(k != "comment" for k in params), (dev, kern)
+
+
+def test_get_strips_provenance_from_kwargs(monkeypatch, tmp_path):
+    """tuning.get() must never leak the provenance annotation into
+    kernel kwargs — on any layer, device-specific or default."""
+    _reset_caches()
+    table = {"default": {"ragged": {"kv_block": 512,
+                                    "comment": "sweep artifact X"}},
+             tuning.device_tag(): {"ragged": {"q_block": 64,
+                                              "comment": "sweep Y"}}}
+    p = tmp_path / "tune.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv("GLLM_TPU_TUNE_TABLE", str(p))
+    tuning._table.cache_clear()
+    got = tuning.get("ragged")
+    assert "comment" not in got
+    assert got == {"q_block": 64, "kv_block": 512}
+    monkeypatch.delenv("GLLM_TPU_TUNE_TABLE")
+    tuning._table.cache_clear()
+    # the COMMITTED table must also come out comment-free
+    for kern in ("ragged", "decode"):
+        assert "comment" not in tuning.get(kern)

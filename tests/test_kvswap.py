@@ -1,0 +1,320 @@
+"""Host-RAM KV offload tier (gllm_tpu/kvswap).
+
+Three layers of coverage, all CPU-deterministic:
+
+- HostKVPool unit semantics (free list, LRU eviction, pinning, canary);
+- scheduler-level swap flows against a real KVSwapManager + fake model
+  loop (swap-out on preemption, swap-in at re-admission, pool-full
+  fallback, abort releasing host pages, zero re-prefill accounting);
+- engine e2e: preempt-swap-resume is TOKEN-IDENTICAL to uninterrupted
+  decode, every preemption resumes via swap-in
+  (gllm_kvswap_swap_in_total == gllm_sched_preemptions_total — the
+  acceptance criterion), a disabled pool reproduces recompute behavior,
+  and host-tier prefix restore is digest-verified end to end.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.kvswap import HostKVPool, KVSwapManager
+from gllm_tpu.memory_manager import make_memory_manager
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.sampling_params import SamplingParams
+from gllm_tpu.scheduler import Scheduler
+from gllm_tpu.sequence import Sequence, SequenceStatus
+
+EOS = 2
+
+
+# ---- HostKVPool unit semantics --------------------------------------------
+
+def _pool(n=8):
+    return HostKVPool([((2, 4, 3), np.float32), ((2, 4), np.int32)], n)
+
+
+def test_pool_alloc_free_roundtrip():
+    pool = _pool(4)
+    pages = pool.allocate(3)
+    assert sorted(pages) == [0, 1, 2] and pool.num_free == 1
+    pool.free(pages)
+    assert pool.num_free == 4
+    with pytest.raises(RuntimeError):
+        pool.free([0])            # double free
+
+
+def test_pool_lru_eviction_prefers_oldest_unpinned():
+    pool = _pool(3)
+    pages = pool.allocate(3)
+    for i, p in enumerate(pages):
+        pool.put_prefix(p, bytes([i]), (i,))
+    pool.pin([pages[0]])
+    # full pool: allocating must evict the OLDEST UNPINNED prefix page
+    got = pool.allocate(1)
+    assert got == [pages[1]]
+    assert pool.match_prefix(bytes([1]), (1,)) is None   # evicted
+    assert pool.match_prefix(bytes([0]), (0,)) == pages[0]  # pinned kept
+    # pinned pages alone can't be evicted
+    pool.pin([pages[2]])
+    assert pool.allocate(1) is None
+
+
+def test_pool_canary_mismatch_is_poisoned_miss():
+    pool = _pool()
+    (p,) = pool.allocate(1)
+    pool.put_prefix(p, b"d", (1, 2, 3))
+    assert pool.match_prefix(b"d", [9, 9, 9]) is None      # collision
+    # entry dropped: even the right canary misses now
+    assert pool.match_prefix(b"d", [1, 2, 3]) is None
+
+
+def test_pool_write_read_pages():
+    pool = _pool()
+    pages = pool.allocate(2)
+    gathered = [np.arange(2 * 2 * 4 * 3, dtype=np.float32)
+                .reshape(2, 2, 4, 3),
+                np.arange(2 * 2 * 4, dtype=np.int32).reshape(2, 2, 4)]
+    pool.write_page(pages[0], gathered, 0)
+    pool.write_page(pages[1], gathered, 1)
+    out = pool.read_pages(pages, pad_to=4)
+    for leaf, src in zip(out, gathered):
+        assert leaf.shape[1] == 4
+        np.testing.assert_array_equal(leaf[:, :2], src)
+        assert (np.asarray(leaf[:, 2:]) == 0).all()
+
+
+# ---- scheduler-level flows ------------------------------------------------
+
+def _kv_tree(num_pages, page_size):
+    shape = (2, num_pages, page_size, 3)
+    return (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+
+
+def make_swap_engine(num_pages=9, page_size=4, host_pages=32, maxp=32,
+                     maxd=8, prefix=False):
+    cfg = EngineConfig(
+        max_model_len=num_pages * page_size,
+        max_num_seqs=8,
+        scheduler=SchedulerConfig(max_prefill_tokens=maxp,
+                                  min_prefill_tokens=4,
+                                  max_decode_seqs=maxd),
+        cache=CacheConfig(page_size=page_size, num_pages=num_pages,
+                          enable_prefix_caching=prefix,
+                          kv_host_pool_pages=host_pages))
+    mm = make_memory_manager(num_pages, page_size, prefix)
+    kv = _kv_tree(num_pages, page_size)
+    sw = KVSwapManager(kv, page_size, host_pages)
+    mm.swap = sw
+    return cfg, mm, sw, kv, Scheduler(cfg, mm)
+
+
+def run_steps(sched, sw, kv, n_steps, sample_token=7):
+    """Fake model loop: drain swap intents at 'dispatch' like the runner,
+    then commit a constant sampled token."""
+    for _ in range(n_steps):
+        batch = sched.schedule_once()
+        if batch is None:
+            break
+        kv = sw.apply(kv)
+        sched.process_output(batch, [sample_token] * batch.num_seqs, EOS)
+    return kv
+
+
+def test_preemption_swaps_out_and_resumes_with_zero_reprefill():
+    _, mm, sw, kv, sched = make_swap_engine()
+    pre0 = obs.REGISTRY.get("gllm_sched_preemptions_total").get()
+    out0 = obs.REGISTRY.get("gllm_kvswap_swap_out_total").get()
+    in0 = obs.REGISTRY.get("gllm_kvswap_swap_in_total").get()
+    a = Sequence(0, list(range(10, 14)), SamplingParams(max_tokens=16))
+    b = Sequence(1, list(range(20, 24)), SamplingParams(max_tokens=16))
+    sched.add_seq(a)
+    sched.add_seq(b)
+    frontier, violations = {}, []
+    orig = sched.schedule_once
+
+    def tracked():
+        batch = orig()
+        if batch is not None:
+            for it in batch.items:
+                f = frontier.get(it.seq.seq_id, 0)
+                if it.computed_before < f:
+                    violations.append((it.seq.seq_id, it.computed_before))
+                frontier[it.seq.seq_id] = max(
+                    f, it.computed_before + it.num_new_tokens)
+        return batch
+
+    sched.schedule_once = tracked
+    kv = run_steps(sched, sw, kv, 80)
+    assert a.status is SequenceStatus.FINISHED
+    assert b.status is SequenceStatus.FINISHED
+    pre = obs.REGISTRY.get("gllm_sched_preemptions_total").get() - pre0
+    sout = obs.REGISTRY.get("gllm_kvswap_swap_out_total").get() - out0
+    sin = obs.REGISTRY.get("gllm_kvswap_swap_in_total").get() - in0
+    assert pre > 0, "workload did not create memory pressure"
+    # every preemption swapped out and every victim resumed via swap-in:
+    # zero re-prefill (the frontier tracker double-checks token-level)
+    assert sout == pre and sin == pre
+    assert not violations, violations
+    # all device and host pages returned
+    assert mm.num_free_pages == mm.allocator.num_total
+    assert sw.pool.num_free == sw.pool.num_pages
+
+
+def test_pool_full_falls_back_to_recompute():
+    _, mm, sw, kv, sched = make_swap_engine(host_pages=1)
+    fb0 = obs.REGISTRY.get("gllm_kvswap_recompute_fallbacks_total").get()
+    a = Sequence(0, list(range(4)), SamplingParams(max_tokens=16))
+    b = Sequence(1, list(range(4)), SamplingParams(max_tokens=16))
+    sched.add_seq(a)
+    sched.add_seq(b)
+    kv = run_steps(sched, sw, kv, 80)
+    assert a.status is SequenceStatus.FINISHED
+    assert b.status is SequenceStatus.FINISHED
+    fb = obs.REGISTRY.get("gllm_kvswap_recompute_fallbacks_total").get() - fb0
+    assert fb > 0, "tiny host pool never forced the recompute fallback"
+    assert sw.pool.num_free == sw.pool.num_pages
+
+
+def test_abort_of_swapped_seq_releases_host_pages():
+    _, mm, sw, kv, sched = make_swap_engine()
+    a = Sequence(0, list(range(8)), SamplingParams(max_tokens=16))
+    sched.add_seq(a)
+    kv = run_steps(sched, sw, kv, 3)
+    assert a.status is SequenceStatus.RUNNING
+    # force a swap-out directly (the unit under test is the release path)
+    sched.running.remove(a)
+    assert sw.try_swap_out(a, mm)
+    assert a.status is SequenceStatus.SWAPPED
+    assert sw.pool.num_used > 0
+    sched.waiting.appendleft(a)
+    sched.abort_seq(0)
+    sched.schedule_once()
+    kv = sw.apply(kv)          # fetch lands; deferred frees resolve
+    assert a.status is SequenceStatus.ABORTED
+    assert sw.pool.num_free == sw.pool.num_pages
+    assert mm.num_free_pages == mm.allocator.num_total
+
+
+def test_host_pages_sizing():
+    kv = _kv_tree(16, 4)
+    per_page = 2 * (2 * 4 * 3) * 4          # two f32 leaves
+    n = KVSwapManager.host_pages_for(kv, per_page * 10 / (1 << 30))
+    assert n == 10
+
+
+# ---- engine e2e (dummy-weight tiny model) ---------------------------------
+
+MODEL_KW = dict(architecture="LlamaForCausalLM", vocab_size=512,
+                hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+                head_dim=16, intermediate_size=128, max_position=256)
+
+
+def _make_llm(num_pages, host_pages, prefix=False, swap_policy="auto"):
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.models.config import ModelConfig
+    cfg = EngineConfig(
+        load_format="dummy", dtype="float32", max_model_len=128,
+        max_num_seqs=8,
+        scheduler=SchedulerConfig(max_prefill_tokens=32,
+                                  max_decode_seqs=8),
+        cache=CacheConfig(page_size=4, num_pages=num_pages,
+                          enable_prefix_caching=prefix,
+                          kv_host_pool_pages=host_pages,
+                          swap_policy=swap_policy))
+    return LLM(config=cfg, model_cfg=ModelConfig(**MODEL_KW))
+
+
+def _workload(seed=0, n=4):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(1, 500, size=int(k)).tolist()
+               for k in rng.integers(12, 28, size=n)]
+    mk = lambda: [SamplingParams(temperature=0.0, max_tokens=20,  # noqa
+                                 ignore_eos=True) for _ in prompts]
+    return prompts, mk
+
+
+@pytest.fixture(scope="module")
+def reference_tokens():
+    """Uninterrupted decode (ample pages, no tier) — ground truth."""
+    prompts, mk = _workload()
+    llm = _make_llm(num_pages=128, host_pages=None)
+    outs = llm.generate(prompt_token_ids=[list(p) for p in prompts],
+                        sampling_params=mk())
+    return [o.output_token_ids for o in outs]
+
+
+def test_e2e_swap_resume_token_identical(reference_tokens):
+    prompts, mk = _workload()
+    pre0 = obs.REGISTRY.get("gllm_sched_preemptions_total").get()
+    in0 = obs.REGISTRY.get("gllm_kvswap_swap_in_total").get()
+    llm = _make_llm(num_pages=17, host_pages=64)
+    assert llm.swap_manager is not None
+    outs = llm.generate(prompt_token_ids=[list(p) for p in prompts],
+                        sampling_params=mk())
+    pre = obs.REGISTRY.get("gllm_sched_preemptions_total").get() - pre0
+    sin = obs.REGISTRY.get("gllm_kvswap_swap_in_total").get() - in0
+    assert pre > 0, "no memory pressure — the test lost its teeth"
+    # acceptance criterion: preempted seqs resume via swap-in, zero
+    # re-prefill steps
+    assert sin == pre
+    assert [o.output_token_ids for o in outs] == reference_tokens
+    sw = llm.swap_manager
+    assert sw.pool.num_free == sw.pool.num_pages   # no host-page leak
+
+
+def test_e2e_disabled_pool_reproduces_recompute(reference_tokens):
+    prompts, mk = _workload()
+    pre0 = obs.REGISTRY.get("gllm_sched_preemptions_total").get()
+    out0 = obs.REGISTRY.get("gllm_kvswap_swap_out_total").get()
+    llm = _make_llm(num_pages=17, host_pages=None)
+    assert llm.swap_manager is None
+    assert llm.memory_manager.swap is None
+    outs = llm.generate(prompt_token_ids=[list(p) for p in prompts],
+                        sampling_params=mk())
+    assert obs.REGISTRY.get("gllm_sched_preemptions_total").get() > pre0
+    assert obs.REGISTRY.get("gllm_kvswap_swap_out_total").get() == out0
+    # greedy decode: recompute must reproduce the same tokens
+    assert [o.output_token_ids for o in outs] == reference_tokens
+
+
+def test_e2e_swap_policy_recompute_disables_pool(reference_tokens):
+    prompts, mk = _workload()
+    llm = _make_llm(num_pages=17, host_pages=64, swap_policy="recompute")
+    assert llm.swap_manager is None
+    outs = llm.generate(prompt_token_ids=[list(p) for p in prompts],
+                        sampling_params=mk())
+    assert [o.output_token_ids for o in outs] == reference_tokens
+
+
+def test_e2e_prefix_spill_restore_digest_verified():
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(1, 500, size=40).tolist()
+    sp = lambda: SamplingParams(temperature=0.0, max_tokens=8,  # noqa
+                                ignore_eos=True)
+    ref = _make_llm(num_pages=128, host_pages=None, prefix=True)
+    want = ref.generate(prompt_token_ids=[list(prompt)],
+                        sampling_params=sp())[0].output_token_ids
+
+    llm = _make_llm(num_pages=40, host_pages=128, prefix=True)
+    got1 = llm.generate(prompt_token_ids=[list(prompt)],
+                        sampling_params=sp())[0].output_token_ids
+    assert got1 == want
+    # churn the HBM prefix cache until the prompt's pages are re-minted
+    # (each re-mint spills the page host-side)
+    for _ in range(6):
+        filler = rng.integers(1, 500, size=60).tolist()
+        llm.generate(prompt_token_ids=[filler], sampling_params=sp())
+    spill = obs.REGISTRY.get(
+        "gllm_kvswap_prefix_spill_pages_total").get()
+    assert spill > 0
+    rest0 = obs.REGISTRY.get(
+        "gllm_kvswap_prefix_restore_pages_total").get()
+    got2 = llm.generate(prompt_token_ids=[list(prompt)],
+                        sampling_params=sp())[0].output_token_ids
+    rest = obs.REGISTRY.get(
+        "gllm_kvswap_prefix_restore_pages_total").get() - rest0
+    assert rest > 0, "prompt replay never hit the host tier"
+    # the digest-verified restore must reproduce the uninterrupted output
+    # (garbage KV would change the continuation)
+    assert got2 == want

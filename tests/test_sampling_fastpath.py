@@ -1,0 +1,109 @@
+"""Bounded top-k/top-p mask fast path vs the full-sort reference.
+
+``_topk_topp_mask`` routes through ``jax.lax.top_k(k=min(vocab, 4096))``
+when every row's nucleus provably ends inside the truncation, falling
+back to the sort-based ``_topk_topp_mask_sort`` otherwise. These tests
+pin exact equivalence on both branches (the guarantee the sampled decode
+path relies on) by shrinking the bound so small vocabularies exercise
+the truncation logic.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from gllm_tpu.ops import sampling
+
+
+VOCAB = 97
+
+
+def _rows(seed=0, S=9, vocab=VOCAB):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=(S, vocab)) * 3.0, jnp.float32)
+
+
+def _params(S, vocab, rng):
+    top_k = rng.choice([-1, 1, 3, 10, vocab], size=S).astype(np.int32)
+    top_p = rng.choice([0.1, 0.5, 0.9, 1.0], size=S).astype(np.float32)
+    min_p = rng.choice([0.0, 0.05, 0.3], size=S).astype(np.float32)
+    return jnp.asarray(top_k), jnp.asarray(top_p), jnp.asarray(min_p)
+
+
+@pytest.mark.parametrize("bound", [8, 16, 64])
+def test_fast_path_matches_sort_reference(monkeypatch, bound):
+    monkeypatch.setattr(sampling, "_TOPK_FAST_BOUND", bound)
+    rng = np.random.default_rng(bound)
+    for seed in range(4):
+        logits = _rows(seed)
+        tk, tp, mp = _params(logits.shape[0], VOCAB, rng)
+        ref = sampling._topk_topp_mask_sort(logits, tk, tp, mp)
+        got = sampling._topk_topp_mask(logits, tk, tp, mp)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        # no-min_p variant shares the dispatch
+        ref2 = sampling._topk_topp_mask_sort(logits, tk, tp, None)
+        got2 = sampling._topk_topp_mask(logits, tk, tp, None)
+        np.testing.assert_array_equal(np.asarray(got2), np.asarray(ref2))
+
+
+def test_fallback_branch_taken_for_wide_nucleus(monkeypatch):
+    """top_p ~ 1 over near-uniform logits keeps the nucleus wider than
+    the truncation — the fallback must produce the reference exactly."""
+    monkeypatch.setattr(sampling, "_TOPK_FAST_BOUND", 8)
+    logits = jnp.asarray(
+        np.random.default_rng(7).normal(size=(4, VOCAB)) * 0.01,
+        jnp.float32)
+    tk = jnp.full((4,), -1, jnp.int32)
+    tp = jnp.full((4,), 0.999, jnp.float32)
+    ref = sampling._topk_topp_mask_sort(logits, tk, tp, None)
+    got = sampling._topk_topp_mask(logits, tk, tp, None)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+    # sanity: the nucleus really is wider than the bound, i.e. this case
+    # NEEDED the fallback
+    assert int(np.isfinite(np.asarray(ref)).sum(axis=-1).max()) > 8
+
+
+def test_topk_only_rows_use_fast_threshold(monkeypatch):
+    """A pure top-k batch (top_p = 1 disabled) must stay on the fast
+    branch and still match; counts pin the mask width."""
+    monkeypatch.setattr(sampling, "_TOPK_FAST_BOUND", 8)
+    logits = _rows(3)
+    S = logits.shape[0]
+    tk = jnp.full((S,), 5, jnp.int32)
+    tp = jnp.ones((S,), jnp.float32)
+    got = np.asarray(sampling._topk_topp_mask(logits, tk, tp, None))
+    assert (np.isfinite(got).sum(axis=-1) == 5).all()
+    ref = np.asarray(sampling._topk_topp_mask_sort(logits, tk, tp, None))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_sample_end_to_end_identical(monkeypatch):
+    """sample() draws the same tokens whichever mask implementation runs
+    (same key, same thresholds -> same Gumbel argmax)."""
+    rng = np.random.default_rng(11)
+    S = 8
+    logits = _rows(5, S=S)
+    md = sampling.SamplingMetadata(
+        temperature=jnp.asarray(rng.uniform(0.5, 1.5, S), jnp.float32),
+        top_p=jnp.asarray(rng.choice([0.5, 0.9], S), jnp.float32),
+        top_k=jnp.asarray(rng.choice([4, 7], S), jnp.int32),
+        repetition_penalty=jnp.ones(S, jnp.float32),
+        step_key=jax.random.key(0),
+        min_p=jnp.zeros(S, jnp.float32))
+    monkeypatch.setattr(sampling, "_TOPK_FAST_BOUND", 0)   # force sort
+    ref = np.asarray(sampling.sample(logits, md))
+    monkeypatch.setattr(sampling, "_TOPK_FAST_BOUND", 16)  # fast path
+    got = np.asarray(sampling.sample(logits, md))
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_full_vocab_bound_short_circuits():
+    """vocab <= bound skips the truncation machinery entirely (the
+    default 4096 bound with a small test vocab)."""
+    logits = _rows(1)
+    tk = jnp.asarray([3] * logits.shape[0], jnp.int32)
+    tp = jnp.asarray([0.8] * logits.shape[0], jnp.float32)
+    ref = sampling._topk_topp_mask_sort(logits, tk, tp, None)
+    got = sampling._topk_topp_mask(logits, tk, tp, None)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
