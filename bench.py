@@ -345,10 +345,15 @@ def main():
             architecture="LlamaForCausalLM", vocab_size=2048,
             hidden_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
             head_dim=32, intermediate_size=256, max_position=512)
+        # same A/B lever as the on-chip full profile: GLLM_BENCH_SLOTS=0
+        # reverts to legacy chain membership on the CPU pass
+        slots = os.environ.get("GLLM_BENCH_SLOTS", "1") not in ("", "0")
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="float32", max_model_len=512,
             max_num_seqs=32,
             overlap_scheduling=full, multi_step_decode=8 if full else 1,
+            decode_slot_batching=full and slots,
+            chain_under_prefill=8 if full and slots else 0,
             scheduler=SchedulerConfig(max_prefill_tokens=128,
                                       max_decode_seqs=16),
             cache=CacheConfig(page_size=4, num_pages=512))
@@ -376,6 +381,10 @@ def main():
         msd = int(os.environ.get("GLLM_BENCH_MSD", "32"))
         depth = int(os.environ.get("GLLM_BENCH_DEPTH", "4"))
         chunk = int(os.environ.get("GLLM_BENCH_PREFILL", "2048"))
+        # persistent-slot decode chains (A/B lever: GLLM_BENCH_SLOTS=0
+        # reverts the full profile to legacy chain membership)
+        slots = os.environ.get("GLLM_BENCH_SLOTS", "1") not in ("", "0")
+        cup = int(os.environ.get("GLLM_BENCH_CUP", str(msd)))
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="bfloat16", max_model_len=2048,
             # conservative halves the decode width: fewer/smaller decode
@@ -385,6 +394,10 @@ def main():
             overlap_scheduling=full,
             overlap_depth=depth if full else 1,
             multi_step_decode=msd if full else 1,
+            decode_slot_batching=full and slots,
+            # gated on slots too: the GLLM_BENCH_SLOTS=0 arm must be the
+            # byte-identical legacy baseline, not legacy-with-ramp-policy
+            chain_under_prefill=cup if full and slots else 0,
             scheduler=SchedulerConfig(max_prefill_tokens=chunk,
                                       max_decode_seqs=256 if full
                                       else 128),
@@ -513,6 +526,13 @@ def main():
         "unit": "tok/s",
         "vs_baseline": round(value / 2000.0, 4),
         "mfu": mfu,
+        # First-class regression tracker (ISSUE 4): fraction of
+        # measured-pass wall time spent in plain (UNfused) decode
+        # iterations — the r5 "18/59 steps at 90.8 ms" class. The
+        # trajectory watches this directly instead of digging through
+        # metrics.steps.by_kind.
+        "unfused_frac": step_summary.get("unfused_frac"),
+        "chain_breaks": step_summary.get("chain_breaks_by_reason") or {},
         "metrics": metrics_snapshot,
     }
     if sampled_result is not None:

@@ -89,15 +89,36 @@ def _time_reps(run, q, iters, *args, reps=3):
     return best
 
 
-def time_ragged(q_block, kv_block, iters=12):
+def build_ragged(q_block, kv_block, **workload):
+    """Jitted ragged-sweep body + its buffers, as ``(run, (q, kc, vc))``.
+
+    The KV caches ride as ARGUMENTS (device-buffer handles), never
+    closure constants: axon's remote_compile ships captured constants in
+    the request body, and a GB-scale cache gets HTTP 413 / an upload
+    that outlives the config timeout (the r5 decode-sweep "hang").
+    tests/test_kernel_tuning.py traces this body (on a shrunken
+    ``workload``) and asserts no buffer-sized constant rides in its
+    jaxpr."""
     import jax
     from gllm_tpu.ops.pallas.ragged_attention import ragged_paged_attention
     from gllm_tpu.utils import tpu_compiler_options
-    q, kc, vc, cu, kl, pt, scale = _mixed_workload()
+    q, kc, vc, cu, kl, pt, scale = _mixed_workload(**workload)
 
     # same scoped-VMEM compile options the serving step jit uses, so the
     # sweep measures what the runner will actually run
     interp = _interp()
+
+    @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
+    def run(qq, kc, vc):
+        return ragged_paged_attention(qq, kc, vc, cu, kl, pt, scale=scale,
+                                      q_block=q_block, kv_block=kv_block,
+                                      interpret=interp)
+
+    return run, (q, kc, vc)
+
+
+def time_ragged(q_block, kv_block, iters=12):
+    run, (q, kc, vc) = build_ragged(q_block, kv_block)
 
     # the VMEM clamp can alias two requested configs to one program; name
     # the program actually compiled so the parent dedupes the ranking
@@ -105,24 +126,16 @@ def time_ragged(q_block, kv_block, iters=12):
     bq = effective_q_block(q_block, kv_block, q.shape[1], q.shape[0])
     print(f"EFFECTIVE ragged:{bq}:{kv_block}", flush=True)
 
-    # KV caches ride as ARGUMENTS (device-buffer handles), never closure
-    # constants: axon's remote_compile ships captured constants in the
-    # request body, and a GB-scale cache gets HTTP 413 / an upload that
-    # outlives the config timeout (the r5 decode-sweep "hang")
-    @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
-    def run(qq, kc, vc):
-        return ragged_paged_attention(qq, kc, vc, cu, kl, pt, scale=scale,
-                                      q_block=q_block, kv_block=kv_block,
-                                      interpret=interp)
-
     return _time_reps(run, q, iters, kc, vc)
 
 
-def time_decode(kv_block, gsz=1, iters=25):
+def build_decode(kv_block, gsz=1, S=128, ctx=2048):
+    """Jitted decode-sweep body + its buffers (caches as args, not
+    closure constants — see build_ragged)."""
     import jax
     import jax.numpy as jnp
     from gllm_tpu.ops.pallas.decode_attention import paged_decode_attention
-    S, Hq, Hkv, D, page, ctx = 128, 32, 8, 128, 16, 2048
+    Hq, Hkv, D, page = 32, 8, 128, 16
     P = S * (ctx // page) + 1
     key = jax.random.key(0)
     q = jax.random.normal(key, (S, Hq, D), jnp.bfloat16)
@@ -135,13 +148,17 @@ def time_decode(kv_block, gsz=1, iters=25):
 
     interp = _interp()
 
-    # caches as args, not closure constants (see time_ragged)
     @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
     def run(qq, kc, vc):
         return paged_decode_attention(qq, kc, vc, kl, pt, scale=D ** -0.5,
                                       kv_block=kv_block, interpret=interp,
                                       group_size=gsz)
 
+    return run, (q, kc, vc)
+
+
+def time_decode(kv_block, gsz=1, iters=25):
+    run, (q, kc, vc) = build_decode(kv_block, gsz)
     return _time_reps(run, q, iters, kc, vc)
 
 

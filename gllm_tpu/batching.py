@@ -22,6 +22,12 @@ from gllm_tpu.ops.sampling import SamplingMetadata
 
 
 class StepBatch(NamedTuple):
+    # Dead-row convention (shared by bucket padding, fused-block
+    # active_until masking, and persistent-slot HOLE rows): position 0,
+    # slot 0 — KV writes land in the dummy page and the sampled token is
+    # discarded host-side, so a dead row costs one attention row and
+    # nothing else. Persistent-slot decode batching leans on this to keep
+    # a chain's shape signature alive across sequence finishes.
     token_ids: jnp.ndarray       # [T] int32, padded with 0
     positions: jnp.ndarray       # [T] int32 (absolute position in sequence)
     slot_mapping: jnp.ndarray    # [T] int32 flat KV slots (padding → dummy)

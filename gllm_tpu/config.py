@@ -115,6 +115,24 @@ class EngineConfig:
     # tokens/seq. The decisive lever when dispatch latency is high
     # (remote-attached TPUs); trades up to K-1 wasted steps per EOS.
     multi_step_decode: int = 1
+    # Persistent-slot decode batching (--decode-slot-batching, overlap
+    # scheduling only): chain membership becomes slot-based, so fused
+    # decode chains survive sequence finishes — a finished row is masked
+    # dead (a HOLE: position frozen, KV writes to the dummy page, sampled
+    # tokens discarded) instead of forcing a sync re-form, newly
+    # decode-ready sequences JOIN vacant slots at chain boundaries
+    # without a shape-signature change, and the batch compacts only when
+    # live occupancy drops below its pow2 seq bucket. False = legacy
+    # all-or-nothing chain membership, byte-identical token streams.
+    decode_slot_batching: bool = False
+    # Ramp policy (--chain-under-prefill): with prefill work waiting,
+    # chain up to this many decode steps before yielding ONE sync pass to
+    # prefill (the chain then resumes off its on-device tokens). 0 =
+    # legacy: any waiting arrival forces every subsequent step through
+    # the unfused sync path until the queue empties. Only meaningful with
+    # overlap_scheduling; the token-throttling decode budget bounds how
+    # much decode each yielded pass carries.
+    chain_under_prefill: int = 0
     # In-flight microbatches for pp>1 (None → pp, the reference's depth:
     # pp_size batches running, scheduler.py:358-364). 1 forces serialized
     # launch-collect — the control arm for measuring pipeline overlap.
@@ -180,6 +198,10 @@ class EngineConfig:
                     self.multi_step_decode)
             self.overlap_scheduling = False
             self.multi_step_decode = 1
+            self.decode_slot_batching = False
+            self.chain_under_prefill = 0
+        if self.chain_under_prefill < 0:
+            raise ValueError("chain_under_prefill must be >= 0")
         if self.parallel.assigned_layers is not None \
                 and len(self.parallel.assigned_layers) != self.parallel.pp:
             # catch --assigned-layers with a forgotten/mismatched --pp at

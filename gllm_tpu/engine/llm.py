@@ -72,6 +72,20 @@ _M_QUEUE = obs.histogram("gllm_request_queue_seconds",
                          "arrival-to-first-schedule wait per request")
 _M_FINISHED = obs.counter("gllm_requests_finished_total",
                           "requests finished by reason", ("reason",))
+# Overlap decode-chain breaks by reason (docs/overlap_scheduling.md):
+#   waiting - prefill pressure (ramp yield) or ready seqs the chain's
+#             slots can't seat (batch must grow)
+#   pages   - no chain link fits the KV pool without preemption
+#   shape   - batch not pure-decode / compaction below the seq bucket /
+#             client abort / per-seq features needing host work
+#             between steps
+#   spec    - speculative decoding owns decode dispatch
+#   finish  - a sequence finish forced the sync re-form (legacy
+#             membership; zero under --decode-slot-batching)
+_M_CHAIN_BREAKS = obs.counter(
+    "gllm_chain_breaks_total",
+    "overlap decode-chain breaks by reason "
+    "(waiting/pages/shape/spec/finish)", ("reason",))
 
 
 @dataclasses.dataclass
@@ -201,6 +215,22 @@ class LLM:
                 s.spec_cfg = (config.spec_ngram, config.spec_k)
         self._rr = 0
         self._seq_replica: dict = {}
+        # Persistent-slot decode batching (config.decode_slot_batching):
+        # the current chain's newest (batch, handle) — unlike
+        # _in_flight[-1] it survives interleaved prefill dispatches, so
+        # a chain keeps extending off its own on-device tokens while a
+        # ramp yield's prefill batch rides the pipeline between links.
+        # None = no chain rooted (next sync pure-decode batch roots one).
+        self._chain_tip = None
+        # Decode steps chained while prefill work waited — the
+        # chain_under_prefill ramp policy yields one sync pass every
+        # config.chain_under_prefill steps instead of unfusing everything.
+        self._chained_under_pressure = 0
+        # One 'waiting' chain_break per chain interruption: set when the
+        # yield is recorded, cleared when a chain extends/roots again —
+        # a backed-up queue must not count every fill-loop pass as a
+        # separate break of the same chain.
+        self._yield_noted = False
         self.eos_token_ids = frozenset(model_cfg.eos_token_ids)
         if not self.eos_token_ids and self.tokenizer is not None \
                 and self.tokenizer.eos_token_id is not None:
@@ -409,32 +439,74 @@ class LLM:
         if overlap:
             depth = max(2, self.config.overlap_depth)
         multi = self.config.multi_step_decode if overlap else 1
+        slot_mode = overlap and self.config.decode_slot_batching
+        cup = self.config.chain_under_prefill if overlap else 0
         while len(self._in_flight) < depth:
-            if overlap and self._in_flight and not self.scheduler.waiting:
-                # chain the next decode step(s) off the in-flight batch's
-                # on-device tokens (overlap scheduling)
-                prev_batch, prev_handle = self._in_flight[-1][:2]
-                if isinstance(prev_batch, list):
-                    prev_batch = prev_batch[-1]
-                chain = self._schedule_multi(prev_batch, multi)
-                if not chain:
-                    # the sync path re-forms the batch next iteration —
-                    # each break is a dispatch round trip the chain
-                    # would have hidden (step-kind attribution reads
-                    # these next to the decode/fused_block split)
-                    TRACE.record("chain_break",
-                                 num_seqs=prev_batch.num_seqs)
-                    break
-                if len(chain) > 1:
-                    handle = self.runner.step_multi(chain, prev_handle)
-                    self._in_flight.append((chain, handle,
-                                            time.monotonic()))
-                else:
-                    handle = self.runner.step_async_chained(chain[0],
-                                                            prev_handle)
-                    self._in_flight.append((chain[0], handle,
-                                            time.monotonic()))
-                continue
+            if overlap and self._in_flight:
+                # chain the next decode step(s) off the chain's newest
+                # on-device tokens (overlap scheduling). Slot mode tracks
+                # the chain tip explicitly so it survives interleaved
+                # prefill dispatches; legacy chains off _in_flight[-1].
+                tip = (self._chain_tip if slot_mode
+                       else self._in_flight[-1][:2])
+                pressure = bool(self.scheduler.waiting)
+                if not pressure:
+                    # pressure subsided without a yield: a later burst
+                    # starts its ramp budget from zero, not a stale count
+                    self._chained_under_pressure = 0
+                allow = tip is not None and (
+                    not pressure
+                    or (cup > 0 and self._chained_under_pressure < cup))
+                if tip is not None and not allow:
+                    # ramp yield: prefill pressure sends this pass to the
+                    # sync path (schedule_once below admits/advances the
+                    # waiting work). With chain_under_prefill the chain
+                    # RESUMES afterwards — only the yielded pass is
+                    # unfused; legacy (cup=0) stays unfused until the
+                    # queue drains. Record ONE break per interruption,
+                    # and only when a decode chain actually exists — a
+                    # prefill tip (legacy _in_flight[-1]) has no chain
+                    # to yield.
+                    prev = (tip[0][-1] if isinstance(tip[0], list)
+                            else tip[0])
+                    if (not self._yield_noted
+                            and prev.num_decode == prev.num_seqs
+                            and not prev.has_drafts):
+                        self._note_chain_break(tip[0], "waiting")
+                        self._yield_noted = True
+                    self._chained_under_pressure = 0
+                if allow:
+                    prev_batch, prev_handle = tip
+                    if isinstance(prev_batch, list):
+                        prev_batch = prev_batch[-1]
+                    chain = self._schedule_multi(prev_batch, multi)
+                    if not chain:
+                        # the sync path re-forms the batch next iteration
+                        # — each break is a dispatch round trip the chain
+                        # would have hidden (step-kind attribution reads
+                        # these next to the decode/fused_block split)
+                        self._note_chain_break(
+                            prev_batch,
+                            self.scheduler.chain_break_reason or "shape")
+                        self._chain_tip = None
+                        self._chained_under_pressure = 0
+                        break
+                    if pressure:
+                        self._chained_under_pressure += len(chain)
+                    self._yield_noted = False
+                    if len(chain) > 1:
+                        entry = (chain,
+                                 self.runner.step_multi(chain, prev_handle),
+                                 time.monotonic())
+                    else:
+                        entry = (chain[0],
+                                 self.runner.step_async_chained(
+                                     chain[0], prev_handle),
+                                 time.monotonic())
+                    self._in_flight.append(entry)
+                    if slot_mode:
+                        self._chain_tip = entry[:2]
+                    continue
             batch = self.scheduler.schedule_once()
             if batch is None:
                 break
@@ -458,18 +530,32 @@ class LLM:
                             [min(d + 1, k) for d in au]
                             if au is not None else None))
                     chain = [first] + links
-                    self._in_flight.append(
-                        (chain, self.runner.step_multi(chain),
-                         time.monotonic()))
+                    entry = (chain, self.runner.step_multi(chain),
+                             time.monotonic())
+                    self._in_flight.append(entry)
+                    self._yield_noted = False
+                    if slot_mode:
+                        self._chain_tip = entry[:2]
                     continue
-            self._in_flight.append((batch, self.runner.step_async(batch),
-                                    time.monotonic()))
+            entry = (batch, self.runner.step_async(batch),
+                     time.monotonic())
+            self._in_flight.append(entry)
+            if batch.num_decode == batch.num_seqs and not batch.has_drafts:
+                self._yield_noted = False
+                if slot_mode:
+                    # a sync pure-decode batch roots a new persistent chain
+                    self._chain_tip = entry[:2]
         if not self._in_flight:
             if self.disagg_coordinator is not None:
                 # gate-B-blocked seqs park in waiting; don't spin hot
                 time.sleep(0.002)
             return []
         batch, handle, t_dispatch = self._in_flight.popleft()
+        if not self._in_flight:
+            # pipeline drained: the tip (this very batch, or older) is
+            # collected — a future burst must root a fresh chain, not
+            # retain the old batch/handle or fail a stale extension
+            self._chain_tip = None
         t0 = time.monotonic()
         tokens, aux = self.runner.collect(handle)
         self._record_step(batch, t0, t_dispatch)
@@ -509,6 +595,16 @@ class LLM:
         self._check_stop_strings(outs)
         self._observe_outputs(outs)
         return outs
+
+    def _note_chain_break(self, batch, reason: str) -> None:
+        """One overlap chain break: steptrace event + labeled counter.
+        ``batch`` is the chain tip (a ScheduledBatch or a fused chain
+        list) whose extension failed or was yielded."""
+        if isinstance(batch, list):
+            batch = batch[-1]
+        TRACE.record("chain_break", num_seqs=batch.num_seqs,
+                     reason=reason)
+        _M_CHAIN_BREAKS.inc(reason=reason)
 
     def _record_step(self, batch, t0: float, t_dispatch: float) -> None:
         """Step-kind attribution for one collected engine iteration:

@@ -608,6 +608,8 @@ def build_engine_config(args) -> EngineConfig:
         allow_hub_download=args.allow_hub_download,
         attention_impl=args.attention_impl,
         overlap_scheduling=args.overlap_scheduling,
+        decode_slot_batching=args.decode_slot_batching,
+        chain_under_prefill=args.chain_under_prefill,
         spec_decode=args.spec_decode,
         spec_k=args.spec_k,
         spec_ngram=args.spec_ngram,
@@ -702,6 +704,17 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--overlap-scheduling", action="store_true",
                    help="chain decode steps on-device (no host round trip "
                         "between decode iterations)")
+    p.add_argument("--decode-slot-batching", action="store_true",
+                   help="persistent-slot decode chains (needs "
+                        "--overlap-scheduling): finished rows become "
+                        "masked holes instead of breaking the fused "
+                        "chain, decode-ready seqs join vacant slots at "
+                        "chain boundaries (docs/overlap_scheduling.md)")
+    p.add_argument("--chain-under-prefill", type=int, default=0,
+                   help="with prefill work waiting, chain up to K decode "
+                        "steps before yielding one sync pass to prefill; "
+                        "0 = legacy, any waiting arrival unfuses every "
+                        "step until the queue drains")
     p.add_argument("--spec-decode", default=None, choices=["ngram"],
                    help="prompt-lookup speculative decoding: verify up to "
                         "--spec-k n-gram drafts per decode step (greedy "

@@ -17,7 +17,10 @@ import sys
 
 from gllm_tpu.obs.steptrace import summarize
 
-_COLS = ("seq", "t", "kind", "num_seqs", "tokens", "k", "wall_ms")
+# ``reason`` is carried by chain_break events (waiting/pages/shape/
+# spec/finish — docs/overlap_scheduling.md); blank for step events
+_COLS = ("seq", "t", "kind", "reason", "num_seqs", "tokens", "k",
+         "wall_ms")
 
 
 def load_events(stream) -> list:

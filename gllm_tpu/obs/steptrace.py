@@ -34,9 +34,16 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 #   compile      - first dispatch of a new (shape-bucket, static-flag)
 #                  signature (an XLA compile unless the persistent cache
 #                  already held it)
-#   chain_break  - overlap scheduling failed to extend a decode chain
+#   chain_break  - overlap scheduling failed to extend a decode chain;
+#                  carries a ``reason`` field (docs/overlap_scheduling.md
+#                  taxonomy): waiting (prefill pressure / unseated ready
+#                  seqs), pages (KV pool), shape (compaction, non-decode
+#                  batch, host-work features), spec (speculation owns
+#                  dispatch), finish (legacy membership loss — zero under
+#                  --decode-slot-batching)
 STEP_KINDS = ("prefill", "decode", "fused_block", "pp_stage", "compile",
               "chain_break")
+CHAIN_BREAK_REASONS = ("waiting", "pages", "shape", "spec", "finish")
 
 
 class StepTrace:
@@ -119,7 +126,9 @@ def summarize(events: List[dict]) -> dict:
     kinds: Dict[str, dict] = {}
     fused_steps = unfused_steps = 0
     fused_ms = unfused_ms = 0.0
+    total_ms = 0.0
     compiles = chain_breaks = 0
+    break_reasons: Dict[str, int] = {}
     for e in events:
         k = e["kind"]
         if k == "compile":
@@ -127,6 +136,8 @@ def summarize(events: List[dict]) -> dict:
             continue
         if k == "chain_break":
             chain_breaks += 1
+            r = e.get("reason", "unknown")
+            break_reasons[r] = break_reasons.get(r, 0) + 1
             continue
         if k == "pp_stage":
             continue                     # dispatch-side only; no wall
@@ -135,6 +146,7 @@ def summarize(events: List[dict]) -> dict:
         row["steps"] += 1
         wall = float(e.get("wall_ms", 0.0))
         row["wall_ms"] += wall
+        total_ms += wall
         row["tokens"] += int(e.get("tokens", 0))
         if k == "decode":
             unfused_steps += 1
@@ -152,6 +164,11 @@ def summarize(events: List[dict]) -> dict:
         "decode_substeps_fused": fused_steps,
         "unfused_decode_wall_frac": (round(unfused_ms / decode_ms, 4)
                                      if decode_ms else None),
+        # unfused share of the WHOLE window's wall (prefill included) —
+        # the regression class bench.py promotes to its result JSON
+        "unfused_frac": (round(unfused_ms / total_ms, 4)
+                         if total_ms else None),
         "compiles": compiles,
         "chain_breaks": chain_breaks,
+        "chain_breaks_by_reason": break_reasons,
     }

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from gllm_tpu.ops.pallas.paged_kv import (attend_block,
+from gllm_tpu.ops.pallas.paged_kv import (CompilerParams, attend_block,
                                           kv_stream_specs,
                                           make_fetch_fns)
 
@@ -267,9 +267,9 @@ def paged_decode_attention(
                                        q.dtype),
         # Sequences/groups are independent → let Mosaic split the grid
         # across Megacore TensorCores.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)) if interpret else
-        pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        CompilerParams(dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*inputs)
     return out[:S] if s_pad != S else out

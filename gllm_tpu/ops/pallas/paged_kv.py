@@ -12,6 +12,11 @@ from __future__ import annotations
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# jax<0.5 ships pltpu.TPUCompilerParams; newer jax renamed it to
+# CompilerParams — alias so the kernels run on both
+CompilerParams = getattr(pltpu, "CompilerParams",
+                         getattr(pltpu, "TPUCompilerParams", None))
+
 
 def make_fetch_fns(pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems,
                    pages_per_block: int, shared_kv: bool):

@@ -35,8 +35,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from gllm_tpu.ops.pallas.paged_kv import (block_kv, kv_stream_specs,
-                                          make_fetch_fns)
+from gllm_tpu.ops.pallas.paged_kv import (CompilerParams, block_kv,
+                                          kv_stream_specs, make_fetch_fns)
 
 DEFAULT_KV_BLOCK = 256
 DEFAULT_Q_BLOCK = 128
@@ -297,9 +297,9 @@ def ragged_paged_attention(
         out_shape=jax.ShapeDtypeStruct((t_pad, num_q_heads, v_dim),
                                        q.dtype),
         # q blocks are independent → Megacore may split the grid.
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary",)) if interpret else
-        pltpu.CompilerParams(dimension_semantics=("parallel",)),
+        CompilerParams(dimension_semantics=("parallel",)),
         interpret=interpret,
     )(*inputs)
     return out[:T]

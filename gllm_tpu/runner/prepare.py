@@ -146,6 +146,18 @@ class BatchBuilder:
         )
 
     @staticmethod
+    def host_row_mask(host_rows, s_bucket: int) -> np.ndarray:
+        """[S_bucket] bool slot map for chained-step token splicing: True
+        rows (sequences that JOINED the persistent chain through a vacant
+        slot) keep the host-built token value, False rows take the
+        previous step's on-device sampled token. Padding rows stay False
+        — their device token is garbage either way and their slot maps
+        to the dummy page."""
+        mask = np.zeros(s_bucket, bool)
+        mask[np.asarray(host_rows, np.int64)] = True
+        return mask
+
+    @staticmethod
     def penalty_len_bucket(lens) -> int:
         """Shared penalty id-list length bucket (build + dp wrapper must
         agree on the jit-signature L)."""

@@ -904,6 +904,26 @@ class ModelRunner:
                 and it.num_new_tokens >= self.config.sp_ring_threshold
                 and t_pad % sp == 0)
 
+    def _splice_chain_tokens(self, batch: StepBatch, prev_tokens,
+                             host_rows):
+        """Input tokens for a chained step: the previous step's on-device
+        sampled tokens, except rows JOINING the chain through a vacant
+        slot this boundary (ScheduledBatch.host_rows) — their last token
+        is host-known and the device array has no row for them, so those
+        rows keep the host-built value. One tiny [S] select on device;
+        no new jit-step variant."""
+        if prev_tokens.ndim == 2:
+            prev_tokens = prev_tokens[-1]   # preceding multi-step block
+        assert prev_tokens.shape[0] == batch.token_ids.shape[0], \
+            (prev_tokens.shape, batch.token_ids.shape)
+        if host_rows:
+            from_host = self.builder.host_row_mask(
+                host_rows, batch.token_ids.shape[0])
+            return batch._replace(token_ids=jnp.where(
+                jnp.asarray(from_host), jnp.asarray(batch.token_ids),
+                prev_tokens))
+        return batch._replace(token_ids=prev_tokens)
+
     def step_async_chained(self, sched_batch: ScheduledBatch, prev_handle):
         """Launch a chained decode step whose input tokens are the PREVIOUS
         step's on-device sampled tokens (overlap scheduling: the reference's
@@ -911,8 +931,6 @@ class ModelRunner:
         negative-id dance — the sampled-token array is simply spliced in as
         the next step's token_ids)."""
         prev_tokens, _, prev_n = prev_handle
-        if prev_tokens.ndim == 2:
-            prev_tokens = prev_tokens[-1]   # preceding multi-step block
         assert prev_n == sched_batch.num_seqs
         self._apply_ssm_intents()
         self._apply_swap_intents()
@@ -921,9 +939,8 @@ class ModelRunner:
         batch, max_q, token_counts = self.builder.build(sched_batch,
                                                         step_key)
         assert max_q == 1 and token_counts is None
-        assert prev_tokens.shape[0] == batch.token_ids.shape[0], \
-            (prev_tokens.shape, batch.token_ids.shape)
-        batch = batch._replace(token_ids=prev_tokens)
+        batch = self._splice_chain_tokens(batch, prev_tokens,
+                                          sched_batch.host_rows)
         lp_k, _ = self._lp_flags(sched_batch)
         all_greedy = _all_greedy(sched_batch.items)
         self._note_dispatch("step", batch,
@@ -967,10 +984,8 @@ class ModelRunner:
             chain[0], keys[0], force_signature=sig)
         assert max_q == 1 and token_counts is None
         if prev_handle is not None:
-            prev_tokens = prev_handle[0]
-            if prev_tokens.ndim == 2:       # previous multi block
-                prev_tokens = prev_tokens[-1]
-            batch = batch._replace(token_ids=prev_tokens)
+            batch = self._splice_chain_tokens(batch, prev_handle[0],
+                                              chain[0].host_rows)
         # Per-row alive-link count: rows whose seq dies (length cap)
         # inside the block freeze their position and write KV to the
         # dummy page from their death step on; bucket-padding rows are

@@ -37,8 +37,9 @@ from typing import Deque, List, Optional, Tuple
 from gllm_tpu.config import EngineConfig
 from gllm_tpu.memory_manager import MemoryManager
 from gllm_tpu.obs import metrics as obs
-from gllm_tpu.sequence import Sequence, SequenceStatus
-from gllm_tpu.utils import cdiv
+from gllm_tpu.sequence import (HOLE_SEQ_ID, Sequence, SequenceStatus,
+                               make_hole_seq)
+from gllm_tpu.utils import bucket_size, cdiv
 
 logger = logging.getLogger(__name__)
 
@@ -127,7 +128,17 @@ class ScheduledBatch:
     # its position and redirects its KV writes to the dummy page; the
     # host discards its later sampled tokens. None = every item alive
     # for the whole block. Set on the FIRST batch of a chain only.
+    # Persistent-slot mode extends this across block boundaries: a HOLE
+    # row (finished seq's slot, sequence.HOLE_SEQ_ID sentinel) carries
+    # active_until 0 — dead for the whole block.
     active_until: Optional[List[int]] = None
+    # Persistent-slot mode: row indices whose link-0 input token must be
+    # taken from the HOST-built batch instead of the previous step's
+    # on-device sampled tokens — sequences JOINING the chain through a
+    # vacant slot this boundary (the chain's device tokens carry no row
+    # for them). Set on the FIRST batch of a chain only; None = every
+    # row chains off the device tokens.
+    host_rows: Optional[List[int]] = None
 
     @property
     def num_seqs(self) -> int:
@@ -188,6 +199,17 @@ class Scheduler:
         # hybrid GDN via SSM snapshot-rollback); None disables proposals
         self.spec_cfg = None
         self.spec_stats = {"proposed": 0, "accepted": 0}
+        # Persistent-slot decode batching (config.decode_slot_batching):
+        # shared dead-row sentinel for holes, the seq-bucket cap the
+        # compaction check shares with BatchBuilder.max_seqs, and the
+        # reason ("waiting"/"pages"/"shape"/"spec"/"finish") set whenever
+        # schedule_chain returns [] (read by the engine's chain_break
+        # event + gllm_chain_breaks_total counter).
+        self._hole_seq = make_hole_seq()
+        self._seq_bucket_cap = min(config.max_num_seqs,
+                                   self.sched_cfg.max_decode_seqs
+                                   + self.sched_cfg.max_prefill_tokens)
+        self.chain_break_reason: Optional[str] = None
 
     # ---- intake -----------------------------------------------------------
 
@@ -554,9 +576,18 @@ class Scheduler:
         (jit-static per K) compiles for K ∈ {2,4,8,...} per bucket
         instead of every length the workload's nearest-finish distance
         happens to produce, without any allocator-unwind bookkeeping.
-        Returns [] (caller falls back to the synchronous path) unless
-        every prev item samples from a RUNNING seq and pages are
-        available without preemption."""
+        Returns [] (caller falls back to the synchronous path; the reason
+        is left in ``chain_break_reason``) unless every prev item samples
+        from a live slot and pages are available without preemption.
+
+        With ``config.decode_slot_batching`` membership is SLOT-based: a
+        FINISHED row becomes a HOLE (kept in the batch, masked dead via
+        active_until=0) so the pow2 shape signature survives the finish;
+        decode-ready sequences join vacant holes at this boundary (their
+        link-0 token comes from the host — ``host_rows``); the chain
+        only re-forms when live occupancy drops below the seq bucket
+        (compaction) or ready sequences can't fit the current slots."""
+        self.chain_break_reason = None
         if self.spec_cfg is not None:
             # Speculation and chaining are competing dispatch-hiding
             # mechanisms, and drafting needs the committed token VALUES
@@ -565,9 +596,23 @@ class Scheduler:
             # every decode schedules synchronously with drafts, each
             # accepted draft removing a dispatch round trip the chain
             # would have hidden.
-            return []
-        for it in prev.items:
+            return self._chain_fail("spec")
+        slots = self.config.decode_slot_batching
+        base: List[Tuple[Sequence, int]] = []
+        hole_rows: List[int] = []
+        for i, it in enumerate(prev.items):
             seq = it.seq
+            if slots and (seq.seq_id == HOLE_SEQ_ID
+                          or seq.status is SequenceStatus.FINISHED):
+                # Slot mode: a finished row keeps its SLOT as a hole —
+                # the fused program masks it (active_until 0: frozen
+                # position, dummy-page KV writes) and the shape
+                # signature survives the finish. The finished seq's own
+                # pages drain through the existing deferred-free path;
+                # the hole references only the shared sentinel.
+                base.append((self._hole_seq, 0))
+                hole_rows.append(i)
+                continue
             # A non-RUNNING seq (EOS/stop finish committed while later
             # links were in flight, abort, preemption) must force the
             # sync re-form: without this gate a FINISHED seq whose
@@ -576,10 +621,16 @@ class Scheduler:
             # pages toward its max_tokens frontier and burning a batch
             # slot on discarded tokens. (The pre-run-through code's
             # strict == chunk-end check refused this case as a side
-            # effect.)
-            if (seq.status is not SequenceStatus.RUNNING
-                    or seq.seq_id in self._aborted_ids):
-                return []
+            # effect.) Slot mode turned the FINISHED case into a hole
+            # above.
+            if seq.status is not SequenceStatus.RUNNING:
+                return self._chain_fail("finish")
+            if seq.seq_id in self._aborted_ids:
+                # client abort: _process_aborts reaps the pages on the
+                # sync pass — host work a chain can't carry in either
+                # membership mode, so it's a 'shape' break, keeping
+                # reason='finish' strictly zero under slot batching
+                return self._chain_fail("shape")
             # Mid-prompt prefill chunks don't sample — nothing to chain
             # off. A chunk at-or-past the end of HOST-known tokens does:
             # ``prev`` may itself be a chained step whose sampled token
@@ -589,32 +640,53 @@ class Scheduler:
             # step — r5 on-chip: profile=full ran msd=8 as single-token
             # dispatches).
             if it.computed_before + it.num_new_tokens < seq.num_tokens:
-                return []
+                return self._chain_fail("shape")
             sp = seq.sampling_params
             if (sp.repetition_penalty != 1.0 or sp.presence_penalty != 0.0
                     or sp.frequency_penalty != 0.0):
-                return []  # needs host-built token counts
+                return self._chain_fail("shape")  # host-built counts
+            base.append((seq, it.computed_before + it.num_new_tokens))
+        host_rows: List[int] = []
+        if slots:
+            host_rows = self._join_ready_into_holes(base, hole_rows)
+            if self.chain_break_reason is not None:
+                return []        # unjoined ready seqs: batch must grow
+            live = sum(1 for seq, _ in base if seq.seq_id != HOLE_SEQ_ID)
+            if live == 0:
+                # fully drained batch — nothing left to run; the sync
+                # pass re-forms from whatever is schedulable
+                return self._chain_fail("shape")
+            if (bucket_size(live, 8, self._seq_bucket_cap)
+                    < bucket_size(len(base), 8, self._seq_bucket_cap)):
+                # occupancy fell below the next bucket boundary: compact
+                # (the re-formed batch compiles to an already-warm
+                # smaller signature)
+                return self._chain_fail("shape")
         # Per-seq DEATH step: link j processes token index cn0 + j and
         # samples index cn0+j+1; seq s can take links j < d_s, where d_s
         # caps at both its max_tokens and the model length. Link 0 needs
-        # EVERY seq alive (a batch already carrying finished rows forces
-        # the sync path, which re-forms a clean batch) — but a block may
-        # RUN THROUGH deaths that happen inside it: the dead row's device
-        # writes go to the dummy page and its later sampled tokens are
-        # discarded by process_output's not-RUNNING branch, while the
-        # other rows keep their fused block (the all-or-nothing refusal
-        # collapsed most blocks to 1-2 steps on the r5 ShareGPT bench —
-        # with ~150 live seqs SOME row is nearly always one step from
-        # finishing).
+        # EVERY seq alive in legacy mode (a batch already carrying
+        # finished rows forces the sync path, which re-forms a clean
+        # batch) — but a block may RUN THROUGH deaths that happen inside
+        # it: the dead row's device writes go to the dummy page and its
+        # later sampled tokens are discarded by process_output's
+        # not-RUNNING branch, while the other rows keep their fused
+        # block (the all-or-nothing refusal collapsed most blocks to 1-2
+        # steps on the r5 ShareGPT bench — with ~150 live seqs SOME row
+        # is nearly always one step from finishing). Slot mode extends
+        # the same masking across block boundaries: holes are rows whose
+        # death already passed (active_until 0).
         page = self.mm.page_size
-        base = [(it.seq, it.computed_before + it.num_new_tokens)
-                for it in prev.items]
-        deaths = [min(seq.sampling_params.max_tokens
+        deaths = [0 if seq.seq_id == HOLE_SEQ_ID else
+                  min(seq.sampling_params.max_tokens
                       + seq.prompt_len - cn0 - 1,
                       self.config.max_model_len - cn0)
                   for seq, cn0 in base]
-        if min(deaths) < 1:
-            return []
+        if not slots and min(deaths) < 1:
+            # a row dies the moment prev lands — the sync path re-forms
+            return self._chain_fail("finish")
+        if slots and max(deaths) < 1:
+            return self._chain_fail("shape")  # nothing can take a link
         feasible = 0
         while feasible < min(k_max, max(deaths)):
             j = feasible
@@ -630,7 +702,7 @@ class Scheduler:
                 break
             feasible += 1
         if not feasible:
-            return []
+            return self._chain_fail("pages")
         # quantize to a power of two so fused-block compiles stay bounded;
         # with ``include_prev`` the caller fuses ``prev`` itself as the
         # block's first step (a freshly re-formed sync decode batch), so
@@ -638,7 +710,7 @@ class Scheduler:
         if include_prev:
             k = (1 << ((feasible + 1).bit_length() - 1)) - 1
             if not k:
-                return []
+                return self._chain_fail("pages")
         else:
             k = 1 << (feasible.bit_length() - 1)
         chain: List[ScheduledBatch] = []
@@ -657,10 +729,67 @@ class Scheduler:
                     self.mm.allocate_seq_pages(seq, cover)
                 seq.num_in_flight += 1
             chain.append(ScheduledBatch(items))
-        if any(d < k for d in deaths):
+        if any(d < k for d in deaths) or host_rows:
             chain[0] = dataclasses.replace(
-                chain[0], active_until=[min(d, k) for d in deaths])
+                chain[0],
+                active_until=([min(d, k) for d in deaths]
+                              if any(d < k for d in deaths) else None),
+                host_rows=host_rows or None)
         return chain
+
+    def _chain_fail(self, reason: str) -> list:
+        """Record why this chain attempt failed (the engine labels the
+        chain_break steptrace event and gllm_chain_breaks_total with it:
+        waiting / pages / shape / spec / finish) and refuse the chain."""
+        self.chain_break_reason = reason
+        return []
+
+    def _join_ready_into_holes(self, base: List[Tuple[Sequence, int]],
+                               hole_rows: List[int]) -> List[int]:
+        """Admit decode-ready running seqs into vacant (hole) slots at
+        this chain boundary — membership changes without a shape change.
+
+        A joining row's link-0 input token is HOST-known (its last
+        sampled token landed before it went decode-ready) while the
+        chain's on-device token array has no row for it, so the filled
+        row indices are returned for ``ScheduledBatch.host_rows``: the
+        runner splices those rows' tokens from the host-built batch.
+
+        Ready seqs that can't join — no vacant slot, or per-seq features
+        a fused chain can't carry (penalties, logit_bias, logprobs, stop
+        strings) — set ``chain_break_reason='waiting'`` so the caller
+        re-forms a grown batch... unless the batch is already at the
+        decode budget, where a re-form couldn't seat them either (they
+        wait for a natural break, as in legacy rotation)."""
+        chain_ids = {seq.seq_id for seq, _ in base
+                     if seq.seq_id != HOLE_SEQ_ID}
+        ready = [s for s in self.running
+                 if s.num_remaining_tokens == 1 and not s.num_in_flight
+                 and s.seq_id not in chain_ids
+                 and s.seq_id not in self._aborted_ids]
+        if not ready:
+            return []
+
+        def fusable(s: Sequence) -> bool:
+            sp = s.sampling_params
+            return (sp.repetition_penalty == 1.0
+                    and sp.presence_penalty == 0.0
+                    and sp.frequency_penalty == 0.0
+                    and not sp.logit_bias and sp.logprobs is None
+                    and not sp.stop)
+
+        joins = list(zip(hole_rows, (s for s in ready if fusable(s))))
+        if (len(joins) < len(ready)
+                and len(base) < self.sched_cfg.max_decode_seqs):
+            # ready work the current slots can't seat — the batch must
+            # grow past its signature; caller falls back to the sync
+            # re-form (this is the ONLY growth path: joins never widen
+            # the bucket)
+            self.chain_break_reason = "waiting"
+            return []
+        for row, seq in joins:
+            base[row] = (seq, seq.num_computed_tokens)
+        return [row for row, _ in joins]
 
     # ---- output path ------------------------------------------------------
 

@@ -28,6 +28,30 @@ class SequenceStatus(enum.Enum):
     ABORTED = enum.auto()
 
 
+# Sentinel seq_id for HOLE rows in persistent-slot decode batches
+# (scheduler.schedule_chain): a finished sequence's slot keeps its row in
+# the fused chain so the shape signature survives the finish, but the row
+# is dead — the device program freezes its position and redirects its KV
+# writes to the dummy page, and the host discards its sampled tokens.
+HOLE_SEQ_ID = -1
+
+
+def make_hole_seq() -> "Sequence":
+    """A dead placeholder Sequence backing hole rows. One instance can be
+    shared by every hole row of every batch: the batch builder only reads
+    per-row constants from it (token [0], position 0, page table [0] → the
+    dummy page, greedy sampling), ``num_in_flight`` bumps stay symmetric
+    with ``process_output``'s decrements, and nothing else ever reads it —
+    it is never in ``running``/``waiting`` and owns no allocator pages."""
+    from gllm_tpu.sampling_params import SamplingParams as _SP
+    seq = Sequence(HOLE_SEQ_ID, [0], _SP(temperature=0.0, max_tokens=1))
+    seq.status = SequenceStatus.FINISHED
+    # looks post-prefill so hole rows count as decode (step-kind metrics)
+    seq.num_computed_tokens = 1
+    seq.page_table = [0]          # dummy page: dead KV writes land there
+    return seq
+
+
 class Sequence:
     def __init__(
         self,

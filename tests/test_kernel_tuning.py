@@ -6,9 +6,20 @@ The attention dispatch reads block sizes from
 BUILTIN defaults < committed tables.json < GLLM_TPU_TUNE_TABLE override.
 """
 
+import importlib.util
 import json
+import os
 
 from gllm_tpu.ops.pallas import tuning
+
+
+def _load_kernel_tune():
+    path = os.path.join(os.path.dirname(__file__), os.pardir,
+                        "benchmarks", "kernel_tune.py")
+    spec = importlib.util.spec_from_file_location("_kernel_tune", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def _reset_caches():
@@ -98,3 +109,80 @@ def test_get_strips_provenance_from_kwargs(monkeypatch, tmp_path):
     # the COMMITTED table must also come out comment-free
     for kern in ("ragged", "decode"):
         assert "comment" not in tuning.get(kern)
+
+
+# ---------------------------------------------------------------------------
+# sweep-body closure hygiene (the r5 HTTP-413 regression class)
+# ---------------------------------------------------------------------------
+
+_CONST_CAP_BYTES = 128 * 1024
+
+
+def _jaxpr_consts(fn, *args):
+    """Every constant the traced computation closes over, including
+    constants of nested sub-jaxprs (jit bodies land inside a pjit eqn's
+    ClosedJaxpr param, not the outer jaxpr's consts)."""
+    import jax
+    closed = jax.make_jaxpr(fn)(*args)
+    consts = list(closed.consts)
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            for p in eqn.params.values():
+                stack = [p]
+                while stack:
+                    x = stack.pop()
+                    if isinstance(x, jax.core.ClosedJaxpr):
+                        consts.extend(x.consts)
+                        walk(x.jaxpr)
+                    elif isinstance(x, (list, tuple)):
+                        stack.extend(x)
+
+    walk(closed.jaxpr)
+    return consts
+
+
+def _big_consts(fn, *args):
+    import numpy as np
+    out = []
+    for c in _jaxpr_consts(fn, *args):
+        arr = np.asarray(c)
+        if arr.nbytes > _CONST_CAP_BYTES:
+            out.append((arr.shape, arr.dtype, arr.nbytes))
+    return out
+
+
+def test_const_detector_flags_closure_capture():
+    """Self-check: a body that DOES capture a buffer must be flagged,
+    so a jax upgrade that moves constants somewhere the walker misses
+    fails loudly instead of hollowing out the guard below."""
+    import jax
+    import jax.numpy as jnp
+    big = jnp.ones((512, 512), jnp.float32)          # 1 MiB
+
+    @jax.jit
+    def bad(q):
+        return q @ big
+
+    assert _big_consts(bad, jnp.ones((4, 512), jnp.float32))
+
+
+def test_sweep_bodies_close_over_no_buffers():
+    """The compiled sweep bodies must take the KV caches as ARGUMENTS,
+    never closure constants: axon's remote_compile ships captured
+    constants in the request body, and a GB-scale cache gets HTTP 413 /
+    an upload that outlives the config timeout (the diagnosed r5
+    decode-sweep "hang"). Traced on a shrunken workload — capture is a
+    structural property, not a size one."""
+    kt = _load_kernel_tune()
+    run_r, args_r = kt.build_ragged(64, 64, T=128, S=4, ctx=256)
+    run_d, args_d = kt.build_decode(64, gsz=2, S=8, ctx=256)
+    for name, run, args in (("ragged", run_r, args_r),
+                            ("decode", run_d, args_d)):
+        # the caches must be in the argument list...
+        assert len(args) == 3, name
+        # ...and nothing buffer-sized may ride the jaxpr as a constant
+        big = _big_consts(run, *args)
+        assert not big, (
+            f"{name} sweep body closes over buffer-sized constants "
+            f"{big}; pass them as arguments (HTTP-413 guard)")

@@ -1,0 +1,278 @@
+"""Persistent-slot decode batching: fused chains survive churn.
+
+With ``decode_slot_batching`` a sequence finish no longer breaks the
+fused decode chain — the finished row stays in the batch as a masked
+HOLE (same pow2 shape signature, ``active_until=0``), newly decode-ready
+sequences JOIN vacant holes at chain boundaries (``host_rows`` token
+splice), and ``chain_under_prefill`` lets the chain yield one sync pass
+to waiting prefill instead of unfusing until the queue drains. Oracle
+throughout: byte-identity with the plain synchronous engine on the same
+saved checkpoint, under mid-stream finishes AND arrivals.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.memory_manager import make_memory_manager
+from gllm_tpu.obs.steptrace import TRACE
+from gllm_tpu.sampling_params import SamplingParams
+from gllm_tpu.scheduler import Scheduler
+from gllm_tpu.sequence import HOLE_SEQ_ID, Sequence
+
+EOS = 2
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(47)
+    d = tmp_path_factory.mktemp("slot_llama")
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+        max_position_embeddings=256, eos_token_id=0,
+        attention_bias=False)).save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def _cfg(model, overlap, slot, cup, msd=8, depth=2):
+    return EngineConfig(
+        model=model, dtype="float32", max_model_len=128, max_num_seqs=16,
+        overlap_scheduling=overlap, overlap_depth=depth,
+        multi_step_decode=msd,
+        decode_slot_batching=slot, chain_under_prefill=cup,
+        scheduler=SchedulerConfig(max_prefill_tokens=64,
+                                  max_decode_seqs=16),
+        cache=CacheConfig(page_size=4, num_pages=256))
+
+
+# mid-stream churn: wave 1 has staggered finishes (3 lands first, then
+# 9, 14, ... while 40 keeps running); wave 2 arrives once every wave-1
+# seq has a few output tokens — so finishes AND arrivals both land while
+# chains are in flight
+_W1_LENS, _W1_MAX = (12, 33, 7, 21, 5, 17), (23, 40, 9, 31, 3, 14)
+_W2_LENS, _W2_MAX = (9, 6, 11, 8), (12, 18, 7, 10)
+
+
+def _seqs(llm, lens, maxs, rng):
+    return [llm._allocate_seq(
+        rng.integers(1, 120, size=int(n)).tolist(),
+        SamplingParams(temperature=0.0, max_tokens=m, ignore_eos=True))
+        for n, m in zip(lens, maxs)]
+
+
+def _run_churn(model_dir, overlap, slot, cup):
+    llm = LLM(config=_cfg(model_dir, overlap, slot, cup))
+    rng = np.random.default_rng(7)
+    wave1 = _seqs(llm, _W1_LENS, _W1_MAX, rng)
+    wave2 = _seqs(llm, _W2_LENS, _W2_MAX, rng)
+    for s in wave1:
+        llm.add_seq(s)
+    mark = TRACE.mark()
+    added = False
+    while llm.has_unfinished or not added:
+        llm.step()
+        if not added and min(s.num_output_tokens for s in wave1) >= 3:
+            for s in wave2:
+                llm.add_seq(s)
+            added = True
+    breaks = [e for e in TRACE.events(since=mark)
+              if e["kind"] == "chain_break"]
+    mm = llm.memory_manager
+    assert mm.num_free_pages == mm.allocator.num_total
+    assert not llm._in_flight
+    toks = [s.output_token_ids for s in wave1 + wave2]
+    assert [len(t) for t in toks] == list(_W1_MAX + _W2_MAX)
+    return toks, breaks
+
+
+def test_churn_byte_identity_and_break_accounting(ckpt):
+    """Overlap under finishes+arrivals must match sync byte-for-byte in
+    BOTH membership modes, and slot mode must break strictly less often
+    than legacy: zero breaks blamed on a finish (holes absorb them) and
+    at most one break per arrival (the grow/yield class) — legacy
+    instead breaks on (at least) every mid-chain finish."""
+    sync, _ = _run_churn(ckpt, False, False, 0)
+    legacy, leg_breaks = _run_churn(ckpt, True, False, 0)
+    slot, slot_breaks = _run_churn(ckpt, True, True, 8)
+    assert legacy == sync          # flag off: byte-identical to current
+    assert slot == sync            # slot mode: same tokens, fewer breaks
+    assert len(slot_breaks) < len(leg_breaks)
+    reasons = [b.get("reason") for b in slot_breaks]
+    assert "finish" not in reasons, reasons
+    # bounded by arrivals, not finishes: wave-2 admission may cost a
+    # grow re-form and a ramp yield, dead rows must cost nothing
+    assert len(slot_breaks) <= 2 * len(_W2_LENS), reasons
+    assert any(b.get("reason") == "finish" for b in leg_breaks)
+    # and every break is labeled with a taxonomy reason, both modes
+    from gllm_tpu.obs.steptrace import CHAIN_BREAK_REASONS
+    assert all(b.get("reason") in CHAIN_BREAK_REASONS
+               for b in leg_breaks + slot_breaks)
+
+
+def test_join_fills_hole_without_reform(ckpt):
+    """A wave-2 arrival small enough to seat in an existing hole must
+    JOIN the live chain (host_rows token splice) instead of forcing a
+    sync re-form: the spy sees at least one chained dispatch whose
+    host_rows is non-empty, and outputs still match sync."""
+    # prompts small enough to prefill in ONE pass, so all four decode in
+    # the same chain (staggered prefills would put the finisher in its
+    # own batch and no hole would ever face the arrival); depth 3 keeps
+    # the chain tip un-collected across the arrival's prefill yield
+    llm = LLM(config=_cfg(ckpt, True, True, 8, depth=3))
+    rng = np.random.default_rng(7)
+    # one quick finisher (creates the hole) + three long runners (keep
+    # the chain alive), then ONE late arrival to take the hole
+    wave1 = _seqs(llm, (8, 9, 10, 5), (40, 40, 40, 3), rng)
+    late = _seqs(llm, (6,), (10,), rng)
+    joined = []
+    orig = llm.runner._splice_chain_tokens
+
+    def spy(batch, prev_tokens, host_rows):
+        if host_rows:
+            joined.append(list(host_rows))
+        return orig(batch, prev_tokens, host_rows)
+
+    llm.runner._splice_chain_tokens = spy
+    for s in wave1:
+        llm.add_seq(s)
+    added = False
+    while llm.has_unfinished or not added:
+        llm.step()
+        if not added and wave1[3].finish_reason is not None:
+            llm.add_seq(late[0])   # the hole already exists when this
+            added = True           # seq becomes decode-ready
+    toks = [s.output_token_ids for s in wave1 + late]
+    assert joined, "arrival never joined a vacant slot"
+
+    sync = LLM(config=_cfg(ckpt, False, False, 0))
+    rng = np.random.default_rng(7)
+    w1 = _seqs(sync, (8, 9, 10, 5), (40, 40, 40, 3), rng)
+    l2 = _seqs(sync, (6,), (10,), rng)
+    outs = sync.generate(
+        prompt_token_ids=[s.token_ids[:s.prompt_len] for s in w1 + l2],
+        sampling_params=[s.sampling_params for s in w1 + l2])
+    assert toks == [o.output_token_ids for o in outs]
+
+
+# ---------------------------------------------------------------------------
+# scheduler-level slot accounting (no model, pure host)
+# ---------------------------------------------------------------------------
+
+
+def _sched(slot=True, maxd=8, num_pages=128, max_num_seqs=32):
+    cfg = EngineConfig(
+        max_model_len=num_pages * 4,
+        max_num_seqs=max_num_seqs,
+        overlap_scheduling=True,
+        decode_slot_batching=slot,
+        scheduler=SchedulerConfig(max_prefill_tokens=256,
+                                  max_decode_seqs=maxd),
+        cache=CacheConfig(page_size=4, num_pages=num_pages))
+    mm = make_memory_manager(num_pages, 4, False)
+    return Scheduler(cfg, mm)
+
+
+def _to_decode(sched, n, max_tokens=50, first_id=0):
+    """Admit n seqs and run their prefill; returns them decode-ready."""
+    seqs = [Sequence(first_id + i, [1, 3, 4, 5],
+                     SamplingParams(max_tokens=max_tokens))
+            for i in range(n)]
+    for s in seqs:
+        sched.add_seq(s)
+    b = sched.schedule_once()
+    assert b.num_seqs == n and b.items[0].samples
+    sched.process_output(b, [7] * n, EOS)
+    return seqs
+
+
+def test_finish_becomes_hole_not_break():
+    sched = _sched()
+    _to_decode(sched, 3)
+    b0 = sched.schedule_once()           # decode over all 3, in flight
+    c1 = sched.schedule_chain(b0, 1)
+    assert len(c1) == 1
+    # seq 2 hits EOS while c1 is still in flight
+    sched.process_output(b0, [7, 7, EOS], EOS)
+    c2 = sched.schedule_chain(c1[0], 1)
+    assert len(c2) == 1 and c2[0].num_seqs == 3     # signature survives
+    assert c2[0].items[2].seq.seq_id == HOLE_SEQ_ID
+    assert c2[0].active_until == [1, 1, 0]          # hole dead all block
+    assert c2[0].host_rows is None
+    sched.process_output(c1[0], [7, 7, 9], EOS)     # dead token dropped
+    sched.process_output(c2[0], [7, 7, 9], EOS)
+    # prefill + b0 + c1 + c2 samples for the two survivors; the dead
+    # row's c1/c2 tokens were discarded
+    assert [len(s.output_token_ids) for s in sched.running] == [4, 4]
+
+
+def test_legacy_finish_breaks_chain():
+    sched = _sched(slot=False)
+    _to_decode(sched, 3)
+    b0 = sched.schedule_once()
+    c1 = sched.schedule_chain(b0, 1)
+    sched.process_output(b0, [7, 7, EOS], EOS)
+    assert sched.schedule_chain(c1[0], 1) == []
+    assert sched.chain_break_reason == "finish"
+
+
+def test_ready_seq_joins_hole_with_host_tokens():
+    sched = _sched()
+    _to_decode(sched, 3)
+    b0 = sched.schedule_once()
+    c1 = sched.schedule_chain(b0, 1)
+    sched.process_output(b0, [7, 7, EOS], EOS)      # row 2 → hole
+    c2 = sched.schedule_chain(c1[0], 1)
+    sched.process_output(c1[0], [7, 7, 9], EOS)
+    late = _to_decode(sched, 1, first_id=10)[0]     # decode-ready joiner
+    c3 = sched.schedule_chain(c2[0], 1)
+    assert len(c3) == 1 and c3[0].num_seqs == 3
+    assert c3[0].host_rows == [2]                   # spliced from host
+    assert c3[0].items[2].seq is late
+    assert c3[0].active_until is None               # everyone alive again
+    sched.process_output(c2[0], [7, 7, 9], EOS)
+    sched.process_output(c3[0], [7, 7, 7], EOS)
+    assert late.output_token_ids == [7, 7]
+
+
+def test_unseatable_arrival_breaks_with_waiting():
+    """More ready seqs than holes: the batch must grow past its shape
+    signature — refuse with reason=waiting so the engine re-forms."""
+    sched = _sched()
+    _to_decode(sched, 3)
+    b0 = sched.schedule_once()
+    c1 = sched.schedule_chain(b0, 1)
+    sched.process_output(b0, [7, 7, 7], EOS)        # nobody finished
+    _to_decode(sched, 2, first_id=10)               # 2 ready, 0 holes
+    assert sched.schedule_chain(c1[0], 1) == []
+    assert sched.chain_break_reason == "waiting"
+
+
+def test_drained_batch_compacts_below_bucket():
+    """Occupancy under the next pow2 seq bucket boundary → the chain
+    re-forms (compaction) instead of dragging dead rows forever."""
+    sched = _sched(maxd=16, num_pages=256)
+    _to_decode(sched, 16)
+    b0 = sched.schedule_once()
+    assert b0.num_seqs == 16
+    c1 = sched.schedule_chain(b0, 1)
+    # 9 of 16 finish while c1 is in flight → live 7 < bucket 8
+    toks = [EOS] * 9 + [7] * 7
+    sched.process_output(b0, toks, EOS)
+    assert sched.schedule_chain(c1[0], 1) == []
+    assert sched.chain_break_reason == "shape"
+    sched.process_output(c1[0], [7] * 16, EOS)
+    # at 10 live (bucket 16 with 16 slots... still >= boundary 8 after
+    # only 6 finish) the chain would have survived: recheck the boundary
+    sched2 = _sched(maxd=16, num_pages=256)
+    _to_decode(sched2, 16, first_id=100)
+    b0 = sched2.schedule_once()
+    c1 = sched2.schedule_chain(b0, 1)
+    sched2.process_output(b0, [EOS] * 6 + [7] * 10, EOS)
+    c2 = sched2.schedule_chain(c1[0], 1)
+    assert c2 and c2[0].num_seqs == 16
+    assert sum(1 for it in c2[0].items
+               if it.seq.seq_id == HOLE_SEQ_ID) == 6
