@@ -281,6 +281,18 @@ def phase(name):
     print(PHASE_TAG + name, flush=True)
 
 
+def kv_bytes_per_step(kv_read: float, summary: dict):
+    """Effective KV bytes streamed per engine step over a measured
+    window: the runner's gllm_kv_bytes_read_total delta divided by the
+    window's step count (fused blocks count their sub-steps — each
+    sub-step re-reads the context). This is the decode bandwidth-floor
+    numerator the int8 cache halves; per-device estimate."""
+    steps = sum(r["steps"] for k, r in summary.get("by_kind", {}).items()
+                if k != "fused_block")
+    steps += summary.get("decode_substeps_fused", 0)
+    return round(kv_read / steps) if steps else None
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true",
@@ -340,6 +352,10 @@ def main():
 
     full = args.profile == "full"
     minimal = args.profile == "minimal"
+    # KV-cache dtype A/B lever (same discipline as GLLM_BENCH_SLOTS):
+    # GLLM_BENCH_KV_DTYPE=int8 stores quantized KV with in-kernel dequant
+    # on every rung; the default arm stays byte-identical legacy.
+    kv_dtype = os.environ.get("GLLM_BENCH_KV_DTYPE", "auto") or "auto"
     if args.tiny:
         model_cfg = ModelConfig(
             architecture="LlamaForCausalLM", vocab_size=2048,
@@ -356,7 +372,8 @@ def main():
             chain_under_prefill=8 if full and slots else 0,
             scheduler=SchedulerConfig(max_prefill_tokens=128,
                                       max_decode_seqs=16),
-            cache=CacheConfig(page_size=4, num_pages=512))
+            cache=CacheConfig(page_size=4, num_pages=512,
+                              kv_cache_dtype=kv_dtype))
         n_requests = args.requests or 8
     elif minimal:
         # Same Llama-3.2-1B model, smallest serviceable bucket surface:
@@ -372,7 +389,8 @@ def main():
             max_num_seqs=64, overlap_scheduling=False, multi_step_decode=1,
             scheduler=SchedulerConfig(max_prefill_tokens=512,
                                       max_decode_seqs=64),
-            cache=CacheConfig(page_size=16, num_pages=4096))
+            cache=CacheConfig(page_size=16, num_pages=4096,
+                              kv_cache_dtype=kv_dtype))
         n_requests = args.requests or 64
     else:
         model_cfg = flagship_model_cfg()
@@ -401,9 +419,11 @@ def main():
             scheduler=SchedulerConfig(max_prefill_tokens=chunk,
                                       max_decode_seqs=256 if full
                                       else 128),
-            # explicit pool (4 GB KV): the axon-attached chip advertises
-            # no memory_stats and over-allocating hangs device init
-            cache=CacheConfig(page_size=16, num_pages=8192))
+            # explicit pool (4 GB KV bf16; int8 halves the bytes at the
+            # same page count): the axon-attached chip advertises no
+            # memory_stats and over-allocating hangs device init
+            cache=CacheConfig(page_size=16, num_pages=8192,
+                              kv_cache_dtype=kv_dtype))
         n_requests = args.requests or 160
 
     phase("backend_init")
@@ -442,6 +462,8 @@ def main():
                   "gllm_request_e2e_seconds", "gllm_request_tpot_seconds")
     hist_before = {n: obs_metrics.REGISTRY.get(n).snapshot()
                    for n in hist_names}
+    kv_read_metric = obs_metrics.REGISTRY.get("gllm_kv_bytes_read_total")
+    kv_read0 = kv_read_metric.get() if kv_read_metric else 0.0
 
     phase("measured_pass")
     t0 = time.monotonic()
@@ -454,6 +476,7 @@ def main():
     # straight out of BENCH_r*.json now instead of log archaeology.
     events = TRACE.events(since=trace_mark)
     step_summary = summarize(events)
+    kv_read = (kv_read_metric.get() - kv_read0) if kv_read_metric else 0.0
     # no silent caps: the ring holds GLLM_OBS_TRACE_CAP events — report
     # how many measured-pass iterations rolled off before the dump
     lost = max(0, TRACE.mark() - TRACE.capacity - trace_mark)
@@ -491,16 +514,26 @@ def main():
         llm.generate(prompt_token_ids=s_prompts, sampling_params=s_params)
         phase("sampled_pass")
         s_mark = TRACE.mark()
+        s_kv0 = kv_read_metric.get() if kv_read_metric else 0.0
         t0 = time.monotonic()
         s_outs = llm.generate(prompt_token_ids=s_prompts,
                               sampling_params=s_params)
         s_dt = time.monotonic() - t0
         s_tokens = sum(o.num_output_tokens for o in s_outs)
         s_summary = summarize(TRACE.events(since=s_mark))
+        s_kv = (kv_read_metric.get() - s_kv0) if kv_read_metric else 0.0
+        s_flops = model_flops(model_cfg, s_prompts, s_params,
+                              engine_cfg.scheduler.max_prefill_tokens)
+        s_peak = chip_peak_flops()
         sampled_result = {
             "output_tok_s": round(s_tokens / s_dt, 2),
             "wall_s": round(s_dt, 2),
             "requests": n_sampled,
+            # rung-comparable efficiency fields (same definitions as the
+            # greedy headline): MFU + effective KV bytes per step
+            "mfu": (round(s_flops / s_dt / s_peak, 4) if s_peak
+                    else None),
+            "kv_bytes_per_step": kv_bytes_per_step(s_kv, s_summary),
             "steps": s_summary,
         }
         log(f"sampled pass: {s_dt:.2f}s → {s_tokens / s_dt:.1f} "
@@ -526,6 +559,12 @@ def main():
         "unit": "tok/s",
         "vs_baseline": round(value / 2000.0, 4),
         "mfu": mfu,
+        # KV-cache efficiency (ISSUE 5): the active storage dtype and
+        # the effective KV bytes streamed per step over the measured
+        # pass — the int8 A/B (GLLM_BENCH_KV_DTYPE) halves the latter
+        # against the decode HBM-bandwidth floor.
+        "kv_cache_dtype": kv_dtype,
+        "kv_bytes_per_step": kv_bytes_per_step(kv_read, step_summary),
         # First-class regression tracker (ISSUE 4): fraction of
         # measured-pass wall time spent in plain (UNfused) decode
         # iterations — the r5 "18/59 steps at 90.8 ms" class. The

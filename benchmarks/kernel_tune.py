@@ -51,21 +51,45 @@ def _interp() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def _mixed_workload(T=1024, S=8, Hq=32, Hkv=8, D=128, page=16, ctx=1024):
-    """Representative prefill batch: S seqs, T packed tokens, ctx KV."""
+def _quant_caches(key, shape):
+    """int8 cache + per-page per-head scale buffers for the --kv-dtype
+    int8 sweep arm (kv_cache_dtype=int8 serving): contents are random —
+    timing only cares about the DMA/dequant pattern, not the values."""
+    import jax
+    import jax.numpy as jnp
+    P, page, Hkv, D = shape
+    cache = jax.random.randint(key, shape, -127, 128, jnp.int8)
+    scale = jax.random.uniform(key, (P, Hkv), jnp.float32, 0.01, 0.02)
+    return cache, scale
+
+
+def _mixed_workload(T=1024, S=8, Hq=32, Hkv=8, D=128, page=16, ctx=1024,
+                    kv_dtype="auto"):
+    """Representative prefill batch: S seqs, T packed tokens, ctx KV.
+
+    Returns ``(q, caches, cu, kv_lens, pt, scale)`` where ``caches`` is
+    ``(kc, vc)`` for a full-precision cache or ``(kc, vc, ks, vs)`` for
+    the int8 arm — only the requested dtype's buffers are allocated."""
     import jax
     import jax.numpy as jnp
     P = S * (ctx // page) + 1
     key = jax.random.key(0)
     q = jax.random.normal(key, (T, Hq, D), jnp.bfloat16)
-    k_cache = jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16)
-    v_cache = jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16)
+    if kv_dtype == "int8":
+        kq = jax.random.key(1)
+        kc, ks = _quant_caches(kq, (P, page, Hkv, D))
+        vc, vs = _quant_caches(jax.random.fold_in(kq, 1),
+                               (P, page, Hkv, D))
+        caches = (kc, vc, ks, vs)
+    else:
+        caches = (jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16),
+                  jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16))
     per = T // S
     cu = jnp.asarray([i * per for i in range(S)] + [T], jnp.int32)
     kv_lens = jnp.full((S,), ctx, jnp.int32)
     pt = (jnp.arange(S * (ctx // page), dtype=jnp.int32)
           .reshape(S, ctx // page) + 1)
-    return q, k_cache, v_cache, cu, kv_lens, pt, D ** -0.5
+    return q, caches, cu, kv_lens, pt, D ** -0.5
 
 
 def _time_reps(run, q, iters, *args, reps=3):
@@ -89,8 +113,9 @@ def _time_reps(run, q, iters, *args, reps=3):
     return best
 
 
-def build_ragged(q_block, kv_block, **workload):
-    """Jitted ragged-sweep body + its buffers, as ``(run, (q, kc, vc))``.
+def build_ragged(q_block, kv_block, kv_dtype="auto", **workload):
+    """Jitted ragged-sweep body + its buffers, as ``(run, (q, kc, vc))``
+    (int8 arm appends the scale buffers: ``(q, kc, vc, ks, vs)``).
 
     The KV caches ride as ARGUMENTS (device-buffer handles), never
     closure constants: axon's remote_compile ships captured constants in
@@ -102,11 +127,24 @@ def build_ragged(q_block, kv_block, **workload):
     import jax
     from gllm_tpu.ops.pallas.ragged_attention import ragged_paged_attention
     from gllm_tpu.utils import tpu_compiler_options
-    q, kc, vc, cu, kl, pt, scale = _mixed_workload(**workload)
+    q, caches, cu, kl, pt, scale = _mixed_workload(kv_dtype=kv_dtype,
+                                                   **workload)
 
     # same scoped-VMEM compile options the serving step jit uses, so the
     # sweep measures what the runner will actually run
     interp = _interp()
+
+    if kv_dtype == "int8":
+        @functools.partial(jax.jit,
+                           compiler_options=tpu_compiler_options())
+        def run(qq, kc, vc, ks, vs):
+            return ragged_paged_attention(
+                qq, kc, vc, cu, kl, pt, scale=scale, q_block=q_block,
+                kv_block=kv_block, interpret=interp, k_scale=ks,
+                v_scale=vs)
+
+        return run, (q, *caches)
+    kc, vc = caches
 
     @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
     def run(qq, kc, vc):
@@ -117,8 +155,16 @@ def build_ragged(q_block, kv_block, **workload):
     return run, (q, kc, vc)
 
 
-def time_ragged(q_block, kv_block, iters=12):
-    run, (q, kc, vc) = build_ragged(q_block, kv_block)
+def time_ragged(q_block, kv_block, iters=12, kv_dtype="auto"):
+    # Interpret mode (CPU smoke) runs each grid program as traced
+    # python — the silicon-shaped workload would take hours per point.
+    # Shrink so every point times standalone in seconds; the silicon
+    # workload is untouched.
+    wl, reps = ({"T": 256, "S": 4, "ctx": 256}, 2) if _interp() \
+        else ({}, 3)
+    iters = 2 if _interp() else iters
+    run, (q, *args) = build_ragged(q_block, kv_block, kv_dtype=kv_dtype,
+                                   **wl)
 
     # the VMEM clamp can alias two requested configs to one program; name
     # the program actually compiled so the parent dedupes the ranking
@@ -126,10 +172,10 @@ def time_ragged(q_block, kv_block, iters=12):
     bq = effective_q_block(q_block, kv_block, q.shape[1], q.shape[0])
     print(f"EFFECTIVE ragged:{bq}:{kv_block}", flush=True)
 
-    return _time_reps(run, q, iters, kc, vc)
+    return _time_reps(run, q, iters, *args, reps=reps)
 
 
-def build_decode(kv_block, gsz=1, S=128, ctx=2048):
+def build_decode(kv_block, gsz=1, S=128, ctx=2048, kv_dtype="auto"):
     """Jitted decode-sweep body + its buffers (caches as args, not
     closure constants — see build_ragged)."""
     import jax
@@ -139,14 +185,29 @@ def build_decode(kv_block, gsz=1, S=128, ctx=2048):
     P = S * (ctx // page) + 1
     key = jax.random.key(0)
     q = jax.random.normal(key, (S, Hq, D), jnp.bfloat16)
-    kc = jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16)
-    vc = jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16)
     kl = jnp.full((S,), ctx, jnp.int32)
     pt = (jnp.arange(S * (ctx // page), dtype=jnp.int32)
           .reshape(S, ctx // page) + 1)
     from gllm_tpu.utils import tpu_compiler_options
 
     interp = _interp()
+
+    if kv_dtype == "int8":
+        kc, ks = _quant_caches(key, (P, page, Hkv, D))
+        vc, vs = _quant_caches(jax.random.fold_in(key, 1),
+                               (P, page, Hkv, D))
+
+        @functools.partial(jax.jit,
+                           compiler_options=tpu_compiler_options())
+        def run(qq, kc, vc, ks, vs):
+            return paged_decode_attention(
+                qq, kc, vc, kl, pt, scale=D ** -0.5, kv_block=kv_block,
+                interpret=interp, group_size=gsz, k_scale=ks, v_scale=vs)
+
+        return run, (q, kc, vc, ks, vs)
+
+    kc = jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16)
+    vc = jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16)
 
     @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
     def run(qq, kc, vc):
@@ -157,9 +218,23 @@ def build_decode(kv_block, gsz=1, S=128, ctx=2048):
     return run, (q, kc, vc)
 
 
-def time_decode(kv_block, gsz=1, iters=25):
-    run, (q, kc, vc) = build_decode(kv_block, gsz)
-    return _time_reps(run, q, iters, kc, vc)
+def time_decode(kv_block, gsz=1, iters=25, kv_dtype="auto"):
+    # The r5/r6 "every decode point FAILed to time standalone" class had
+    # two legs: on axon, GB-scale caches riding the remote-compile body
+    # as closure constants (fixed — caches are arguments now); on the
+    # CPU smoke path, a silicon-shaped workload (S=128, ctx=2048,
+    # 75 timed interpret-mode calls) that runs for hours. Shrink the
+    # interpret workload and announce the geometry up front so a
+    # timeout names where it died instead of leaving a bare TIMEOUT.
+    if _interp():
+        S, ctx, iters, reps = 8, 256, 1, 2
+    else:
+        S, ctx, reps = 128, 2048, 3
+    print(f"EFFECTIVE decode:{kv_block}:{gsz}:{kv_dtype} "
+          f"S={S} ctx={ctx} iters={iters}", flush=True)
+    run, (q, *args) = build_decode(kv_block, gsz, S=S, ctx=ctx,
+                                   kv_dtype=kv_dtype)
+    return _time_reps(run, q, iters, *args, reps=reps)
 
 
 VMEM_PROBE_CONFIGS = ((128, 256), (256, 256), (256, 512), (512, 512),
@@ -178,7 +253,7 @@ def vmem_probe_one(qb: int, kb: int):
     import jax
     from gllm_tpu.ops.pallas.ragged_attention import ragged_paged_attention
     from gllm_tpu.utils import tpu_compiler_options
-    q, kc, vc, cu, kl, pt, scale = _mixed_workload(T=2048, ctx=2048)
+    q, (kc, vc), cu, kl, pt, scale = _mixed_workload(T=2048, ctx=2048)
     # binary MB: the consumer (vmem_tile_limit_b) multiplies by 1024²
     tile_mb = q.shape[1] * qb * kb * 4 / (1024 * 1024)
 
@@ -218,7 +293,18 @@ def run_inner(spec: str):
                     return float(line.split()[1]), out
         return None, out
     except subprocess.TimeoutExpired as e:
-        return None, "TIMEOUT\n" + str(e.stdout or "")[-500:]
+        # A child may finish its measurement and still blow the deadline
+        # on teardown (interpret-mode interpreter exit, tunnel device
+        # release) — salvage the RESULT it already printed rather than
+        # discarding a completed timing.
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        for line in reversed((out or "").strip().splitlines()):
+            if line.startswith("RESULT "):
+                return float(line.split()[1]), "TIMEOUT(after result)\n" \
+                    + (out or "")[-500:]
+        return None, "TIMEOUT\n" + str(out or "")[-500:]
 
 
 def effective_spec(out: str, fallback: str) -> str:
@@ -235,6 +321,11 @@ def main():
                     help="merge winners into gllm_tpu/ops/pallas/tables.json")
     ap.add_argument("--vmem-probe", action="store_true")
     ap.add_argument("--kernel", choices=("ragged", "decode"), default=None)
+    ap.add_argument("--kv-dtype", choices=("auto", "int8"), default="auto",
+                    help="sweep the kernels against an int8 quantized "
+                         "cache (kv_cache_dtype=int8 serving shape); "
+                         "informational A/B — winners are only written "
+                         "for the default dtype")
     args = ap.parse_args()
 
     if args.inner:
@@ -242,10 +333,14 @@ def main():
         enable_compilation_cache(os.path.join(REPO, ".jax_cache"))
         parts = args.inner.split(":")
         if parts[0] == "ragged":
-            ms = time_ragged(int(parts[1]), int(parts[2]))
+            ms = time_ragged(int(parts[1]), int(parts[2]),
+                             kv_dtype=(parts[3] if len(parts) > 3
+                                       else "auto"))
         elif parts[0] == "decode":
             ms = time_decode(int(parts[1]),
-                             int(parts[2]) if len(parts) > 2 else 1)
+                             int(parts[2]) if len(parts) > 2 else 1,
+                             kv_dtype=(parts[3] if len(parts) > 3
+                                       else "auto"))
         elif parts[0] == "vmem":
             vmem_probe_one(int(parts[1]), int(parts[2]))
             print("RESULT 0.0", flush=True)
@@ -277,6 +372,12 @@ def main():
         timeout killing the rest of the sweep must not forfeit results
         already measured."""
         if not (args.write and best):
+            return
+        if args.kv_dtype != "auto":
+            # the committed table keys by kernel only; an int8-workload
+            # winner must not overwrite the default-dtype entry
+            print("[tune] not writing table: --kv-dtype sweep is "
+                  "informational", file=sys.stderr)
             return
         tag = probe_dev_tag()
         if tag.startswith("cpu") or tag in ("unknown", "default"):
@@ -355,7 +456,7 @@ def main():
         # compiled, and share the min of their timings
         eff_ms = {}
         for qb, kb in itertools.product(BLOCKS, BLOCKS):
-            ms, out = run_inner(f"ragged:{qb}:{kb}")
+            ms, out = run_inner(f"ragged:{qb}:{kb}:{args.kv_dtype}")
             eff = effective_spec(out, f"ragged:{qb}:{kb}")
             if ms is not None:
                 eff_ms[eff] = min(ms, eff_ms.get(eff, ms))
@@ -374,7 +475,7 @@ def main():
         # the decode kernel's cost is a chain of DMA latencies, so the
         # group dimension matters more than the block size
         for kb, gsz in itertools.product(BLOCKS, (1, 2, 4, 8, 16)):
-            ms, out = run_inner(f"decode:{kb}:{gsz}")
+            ms, out = run_inner(f"decode:{kb}:{gsz}:{args.kv_dtype}")
             results["decode"][f"{kb}g{gsz}"] = ms
             report("decode", f"kv={kb} group={gsz}", ms, out)
         ok_d = {k: v for k, v in results["decode"].items() if v}

@@ -40,7 +40,14 @@ class CacheConfig:
     page_size: int = 16
     memory_util: float = 0.9              # fraction of free HBM given to KV
     num_pages: Optional[int] = None       # explicit override (tests/benchmarks)
-    kv_cache_dtype: str = "auto"          # auto | bfloat16 | float32 | fp8
+    # Paged-KV storage dtype (--kv-cache-dtype). "auto" stores the model
+    # dtype (byte-identical legacy). "int8" stores quantized K/V with
+    # running per-page per-head f32 scales, dequantized inside the
+    # attention kernels — halves KV read bandwidth and roughly doubles
+    # page capacity from the same HBM budget at a bounded numerics cost
+    # (docs/kv_quantization.md; unsupported for MLA/hybrid models).
+    kv_cache_dtype: str = "auto"   # auto | bfloat16 | float16 | float32
+                                   # | fp8 | int8
     enable_prefix_caching: bool = False
     # Hybrid (GDN) models: cached-prefix SSM state slots (reference
     # --max-snapshot-ssm-slots; 0 disables the SSM half of prefix caching)
@@ -212,6 +219,12 @@ class EngineConfig:
                 f" entries but pp={self.parallel.pp}")
         if self.cache.page_size <= 0:
             raise ValueError("page_size must be positive")
+        if self.cache.kv_cache_dtype not in (
+            "auto", "bfloat16", "float16", "float32", "fp8", "int8",
+        ):
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.cache.kv_cache_dtype!r} "
+                "(choices: auto, bfloat16, float16, float32, fp8, int8)")
         if self.scheduler.max_prefill_tokens < self.cache.page_size:
             raise ValueError("max_prefill_tokens must cover at least one page")
         if self.scheduler.schedule_method not in (

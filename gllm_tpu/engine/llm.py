@@ -187,6 +187,13 @@ class LLM:
                                            "ssm_snapshot_slots", 0))
             for _ in range(self.dp)]
         self.memory_manager = self.memory_managers[0]
+        if getattr(self.runner, "kv_quant", False):
+            # int8 KV cache: minted pages queue a device-side scale
+            # reset (drained by the runner at dispatch time) so a
+            # recycled page quantizes exactly like a fresh one —
+            # numerics never depend on page-reuse history.
+            for mm in self.memory_managers:
+                mm.track_scale_resets = True
         self.runner.memory_manager = self.memory_manager
         if self.dp > 1:
             # per-replica SSM intents apply to the stacked pools by index

@@ -682,7 +682,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--memory-util", type=float, default=0.9,
                    help="fraction of device memory for the KV cache")
     p.add_argument("--num-pages", type=int, default=None)
-    p.add_argument("--kv-cache-dtype", default="auto")
+    p.add_argument("--kv-cache-dtype", default="auto",
+                   choices=("auto", "bfloat16", "float16", "float32",
+                            "fp8", "int8"),
+                   help="paged-KV storage dtype; int8 stores quantized "
+                        "K/V with per-page per-head scales dequantized "
+                        "in-kernel (halves KV reads, ~2x page capacity; "
+                        "docs/kv_quantization.md). auto = model dtype")
     p.add_argument("--quantization", default=None,
                    choices=["int8", "fp8", "int4", "w8a8", "fp8_block"],
                    help="weight-only quantization")

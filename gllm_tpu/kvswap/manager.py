@@ -43,6 +43,11 @@ _M_SWAP_IN = obs.counter(
     "sequences resumed by swapping KV back in (zero re-prefill)")
 _M_PAGES = obs.counter("gllm_kvswap_pages_total",
                        "KV pages transferred device<->host", ("dir",))
+_M_BYTES = obs.counter(
+    "gllm_kvswap_transfer_bytes_total",
+    "KV bytes transferred device<->host (page count x the pool's "
+    "per-page bytes, which reflect the cache storage dtype — int8 "
+    "pages move half the bf16 bytes plus their scale rows)", ("dir",))
 _M_SPILL = obs.counter(
     "gllm_kvswap_prefix_spill_pages_total",
     "refcount-0 prefix pages spilled host-side on HBM eviction")
@@ -89,6 +94,12 @@ class KVSwapManager:
         # only after the fetch lands (their slot must not be re-tenanted
         # under a pending write)
         self._free_after_fetch: Set[int] = set()
+        # device pages the LAST apply() scattered host data into — their
+        # scales came from the host tier, so the runner's int8
+        # minted-page scale reset must skip them (consumed once, so a
+        # dispatch with no swap work never skips on page ids recycled
+        # from an older drain)
+        self.last_scatter_dev: Set[int] = set()
         self._update_gauges()
 
     # ---- sizing -----------------------------------------------------------
@@ -124,6 +135,7 @@ class KVSwapManager:
         seq.swap_out(host)
         _M_SWAP_OUT.inc()
         _M_PAGES.inc(n, dir="out")
+        _M_BYTES.inc(n * self.pool.bytes_per_page, dir="out")
         self._update_gauges()
         return True
 
@@ -139,6 +151,7 @@ class KVSwapManager:
         self._pending_restore_dev.update(dev)
         _M_SWAP_IN.inc()
         _M_PAGES.inc(len(host), dir="in")
+        _M_BYTES.inc(len(host) * self.pool.bytes_per_page, dir="in")
 
     def release_seq(self, seq) -> None:
         """Free a swapped-out seq's host pages (abort / finish without
@@ -164,6 +177,7 @@ class KVSwapManager:
         self.pool.put_prefix(host[0], digest, canary)
         _M_SPILL.inc()
         _M_PAGES.inc(dir="out")
+        _M_BYTES.inc(self.pool.bytes_per_page, dir="out")
         self._update_gauges()
 
     def match_host_prefix(self, digest: bytes, tokens) -> Optional[int]:
@@ -184,12 +198,17 @@ class KVSwapManager:
         self._pending_restore_dev.add(dev_page)
         _M_RESTORE.inc()
         _M_PAGES.inc(dir="in")
+        _M_BYTES.inc(self.pool.bytes_per_page, dir="in")
 
     # ---- runner API --------------------------------------------------------
 
     @property
     def has_work(self) -> bool:
         return bool(self._out or self._in or self.engine._pending)
+
+    def consume_last_scatter_dev(self) -> Set[int]:
+        out, self.last_scatter_dev = self.last_scatter_dev, set()
+        return out
 
     def apply(self, kv):
         """Drain queued intents against the runner's KV; returns the new
@@ -201,6 +220,7 @@ class KVSwapManager:
             _M_XFER.observe(time.monotonic() - t0, dir="out")
         outs, self._out = self._out, []
         ins, self._in = self._in, []
+        self.last_scatter_dev = {p for _, d, _ in ins for p in d}
         if outs:
             dev = [p for d, _ in outs for p in d]
             host = [p for _, h in outs for p in h]

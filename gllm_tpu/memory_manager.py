@@ -96,6 +96,15 @@ class MemoryManager:
         # the engine when a host pool is configured; None keeps every
         # code path byte-for-byte the pre-offload behavior.
         self.swap = None
+        # int8 KV cache (kv_cache_dtype=int8): minted pages queue a
+        # device-side SCALE RESET (drained by the runner before the next
+        # step, ordered between the host tier's gathers and scatters) so
+        # a recycled page quantizes like a fresh one — quantization
+        # never depends on page-reuse history, and the running absmax
+        # cannot ratchet across tenants. Off (flag False) this list
+        # stays empty and no reset program ever dispatches.
+        self.track_scale_resets = False
+        self.scale_resets: List[int] = []
 
         self.ssm_working_slots = ssm_working_slots
         self.ssm_snapshot_slots = ssm_snapshot_slots
@@ -185,7 +194,14 @@ class MemoryManager:
         return self.num_free_pages >= num_pages
 
     def _mint_page(self) -> int:
-        return self.allocator.allocate()
+        page = self.allocator.allocate()
+        if self.track_scale_resets:
+            self.scale_resets.append(page)
+        return page
+
+    def drain_scale_resets(self) -> List[int]:
+        out, self.scale_resets = self.scale_resets, []
+        return out
 
     def allocate_seq_pages(self, seq: Sequence, num_new_tokens: int) -> None:
         """Extend ``seq.page_table`` to cover computed+num_new_tokens tokens.
@@ -254,7 +270,7 @@ class PrefixMemoryManager(MemoryManager):
     # A page in the free list may still carry cache metadata; minting it for
     # new content must drop the stale key (reference :1254-1262).
     def _mint_page(self) -> int:
-        page = self.allocator.allocate()
+        page = super()._mint_page()   # keeps the int8 scale-reset queue
         meta = self.page_meta.pop(page, None)
         if meta is not None:
             digest, canary = meta

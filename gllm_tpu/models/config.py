@@ -56,6 +56,12 @@ class ModelConfig:
     # Set by the DP runner: route MoE through the dense masked path (the
     # ragged grouped GEMM doesn't batch under vmap).
     moe_force_dense: bool = False
+    # Set by the runner when cache.kv_cache_dtype == "int8": the paged
+    # KV cache stores int8 payload + per-page per-head f32 scales
+    # (dense.init_kv_cache / ops/kv_cache.write_kv_quant). Spec builders
+    # (parallel/shardings.kv_cache_specs) read it so the spec pytree
+    # mirrors the cache's scale leaves.
+    kv_cache_quant: bool = False
     decoder_sparse_step: int = 1      # every Nth layer is MoE (qwen2-moe)
     mlp_only_layers: Tuple[int, ...] = ()
     shared_expert_intermediate_size: int = 0

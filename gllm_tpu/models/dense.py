@@ -30,7 +30,7 @@ from gllm_tpu.batching import StepBatch
 from gllm_tpu.models.config import ModelConfig
 from gllm_tpu.ops import (apply_rope, compute_rope_cos_sin,
                           fused_add_rms_norm, paged_attention, rms_norm,
-                          silu_and_mul, write_kv)
+                          silu_and_mul, write_kv, write_kv_quant)
 from gllm_tpu.ops.rope import apply_mrope, apply_rope_interleaved
 from gllm_tpu.ops.quant import qmm
 from gllm_tpu.parallel.mesh import shard_hint
@@ -39,19 +39,37 @@ Params = Dict[str, Any]
 
 
 class KVCache(NamedTuple):
-    """Stacked per-stage KV cache: [L, num_pages, page_size, Hkv, D]."""
+    """Stacked per-stage KV cache: [L, num_pages, page_size, Hkv, D].
+
+    ``kv_cache_dtype=int8`` stores k/v as int8 and adds the running
+    per-page per-kv-head f32 scales ([L, num_pages, Hkv]; dequant is
+    q * scale — ops/kv_cache.write_kv_quant owns the write-side
+    contract). The scale leaves keep the page axis at position 1 like
+    every other leaf, so the kvswap host tier and the DP stacking treat
+    them as ordinary cache payload. None = full-precision legacy cache.
+    """
     k: jnp.ndarray
     v: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
 
 
 def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int,
                   dtype=jnp.bfloat16, kv_pack: int = 1) -> KVCache:
     """kv_pack > 1 packs that many adjacent kv heads into the lane dim
     ([.., Hkv/pack, D*pack]) so head_dim < 128 models meet Mosaic's
-    128-lane tiling on the Pallas path (ops/attention.py pack handling)."""
+    128-lane tiling on the Pallas path (ops/attention.py pack handling).
+    An int8 ``dtype`` builds the quantized cache (scales ride along; a
+    zero scale marks a never-written page)."""
     assert cfg.num_kv_heads % kv_pack == 0
     shape = (cfg.num_stage_layers, num_pages, page_size,
              cfg.num_kv_heads // kv_pack, cfg.head_dim * kv_pack)
+    if jnp.dtype(dtype) == jnp.int8:
+        sshape = shape[:2] + (shape[3],)     # [L, P, Hkv/pack]
+        return KVCache(jnp.zeros(shape, jnp.int8),
+                       jnp.zeros(shape, jnp.int8),
+                       jnp.zeros(sshape, jnp.float32),
+                       jnp.zeros(sshape, jnp.float32))
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
 
@@ -111,7 +129,8 @@ def init_params(cfg: ModelConfig, seed: int = 0,
 # ---------------------------------------------------------------------------
 
 def _attention(lp, x, batch: StepBatch, k_all, v_all, cfg: ModelConfig,
-               cos_sin, *, attn_impl: str, max_q_len: int, li):
+               cos_sin, *, attn_impl: str, max_q_len: int, li,
+               ks_all=None, vs_all=None):
     """One layer's attention against the STACKED [L, P, ...] cache.
 
     The cache is addressed through a flat [L*P, ...] view with the layer
@@ -121,12 +140,21 @@ def _attention(lp, x, batch: StepBatch, k_all, v_all, cfg: ModelConfig,
     earlier per-layer dynamic_index/dynamic_update_index round-trip
     materialized TWO full layer-slice copies per layer per step (~26 ms
     of a ~38 ms decode step on the r5 chip). Page 0 of every layer is
-    that layer's dummy page, so offset padding entries stay harmless."""
+    that layer's dummy page, so offset padding entries stay harmless.
+
+    ``ks_all``/``vs_all`` present marks the int8 quantized cache
+    (kv_cache_dtype=int8): new rows quantize at write time against the
+    running per-page absmax scale and the kernels dequantize in VMEM —
+    the flat [L*P, Hkv] scale view is indexed by the same offset page
+    ids as the cache itself."""
     T = x.shape[0]
     Hq, Hkv, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     L, P, page_size = k_all.shape[0], k_all.shape[1], k_all.shape[2]
+    quant = ks_all is not None
     k_cache = k_all.reshape((L * P,) + k_all.shape[2:])
     v_cache = v_all.reshape((L * P,) + v_all.shape[2:])
+    k_scale = ks_all.reshape((L * P,) + ks_all.shape[2:]) if quant else None
+    v_scale = vs_all.reshape((L * P,) + vs_all.shape[2:]) if quant else None
 
     q = qmm(x, lp["q_proj"])
     k = qmm(x, lp["k_proj"])
@@ -150,8 +178,14 @@ def _attention(lp, x, batch: StepBatch, k_all, v_all, cfg: ModelConfig,
         rope_fn = (apply_rope_interleaved if cfg.rope_interleaved
                    else apply_rope)
         q, k = rope_fn(q, k, batch.positions, cos_sin)
-    k_cache, v_cache = write_kv(k_cache, v_cache, k, v,
-                                batch.slot_mapping + li * (P * page_size))
+    if quant:
+        k_cache, v_cache, k_scale, v_scale = write_kv_quant(
+            k_cache, v_cache, k_scale, v_scale, k, v,
+            batch.slot_mapping + li * (P * page_size), page_size)
+    else:
+        k_cache, v_cache = write_kv(
+            k_cache, v_cache, k, v,
+            batch.slot_mapping + li * (P * page_size))
     if attn_impl == "ring":
         # Sequence-parallel prefill (sp mesh axis): the runner routes a
         # single-seq from-position-0 chunk here — self-attention over the
@@ -169,10 +203,13 @@ def _attention(lp, x, batch: StepBatch, k_all, v_all, cfg: ModelConfig,
             page_table=batch.attn.page_table + li * P)
         attn = paged_attention(q, k_cache, v_cache, md,
                                scale=D ** -0.5, max_q_len=max_q_len,
-                               impl=attn_impl)
+                               impl=attn_impl,
+                               k_scale=k_scale, v_scale=v_scale)
     out = qmm(attn.reshape(T, Hq * D), lp["o_proj"])
     return (out, k_cache.reshape(k_all.shape),
-            v_cache.reshape(v_all.shape))
+            v_cache.reshape(v_all.shape),
+            k_scale.reshape(ks_all.shape) if quant else None,
+            v_scale.reshape(vs_all.shape) if quant else None)
 
 
 def _mlp(lp, x):
@@ -221,12 +258,13 @@ def forward(
         hidden, residual = hidden_in, residual_in
 
     def layer_step(carry, lp):
-        h, res, k_all, v_all, li = carry
+        h, res, k_all, v_all, ks_all, vs_all, li = carry
         normed, res = fused_add_rms_norm(h, res, lp["input_norm"],
                                          cfg.rms_norm_eps)
-        attn_out, k_all, v_all = _attention(
+        attn_out, k_all, v_all, ks_all, vs_all = _attention(
             lp, normed, batch, k_all, v_all, cfg, cos_sin,
-            attn_impl=attn_impl, max_q_len=max_q_len, li=li)
+            attn_impl=attn_impl, max_q_len=max_q_len, li=li,
+            ks_all=ks_all, vs_all=vs_all)
         if cfg.sandwich_norms:
             attn_out = rms_norm(attn_out, lp["post_self_attn_norm"],
                                 cfg.rms_norm_eps)
@@ -247,12 +285,13 @@ def forward(
                 deepstack, jnp.minimum(gl, nds - 1), 0, keepdims=False)
             mlp_out = mlp_out + jnp.where(gl < nds, ds,
                                           jnp.zeros_like(ds))
-        return (mlp_out, res, k_all, v_all, li + 1), None
+        return (mlp_out, res, k_all, v_all, ks_all, vs_all, li + 1), None
 
-    init = (hidden, residual, kv.k, kv.v, jnp.int32(0))
-    (hidden, residual, k_all, v_all, _), _ = jax.lax.scan(
+    init = (hidden, residual, kv.k, kv.v, kv.k_scale, kv.v_scale,
+            jnp.int32(0))
+    (hidden, residual, k_all, v_all, ks_all, vs_all, _), _ = jax.lax.scan(
         layer_step, init, params["layers"])
-    return hidden, residual, KVCache(k_all, v_all)
+    return hidden, residual, KVCache(k_all, v_all, ks_all, vs_all)
 
 
 def compute_full_logits(params: Params, hidden: jnp.ndarray,

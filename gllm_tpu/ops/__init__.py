@@ -11,7 +11,7 @@ Pallas TPU kernel, selected via :func:`gllm_tpu.ops.attention.paged_attention`.
 from gllm_tpu.ops.layers import (fused_add_rms_norm, rms_norm, silu_and_mul,
                                  gelu_and_mul)
 from gllm_tpu.ops.rope import apply_rope, compute_rope_cos_sin
-from gllm_tpu.ops.kv_cache import write_kv
+from gllm_tpu.ops.kv_cache import write_kv, write_kv_quant
 from gllm_tpu.ops.attention import paged_attention
 
 __all__ = [
@@ -23,4 +23,5 @@ __all__ = [
     "rms_norm",
     "silu_and_mul",
     "write_kv",
+    "write_kv_quant",
 ]

@@ -71,29 +71,34 @@ def pallas_tp_compatible(num_q_heads: int, num_kv_heads: int,
 
 
 def paged_attention(q, k_cache, v_cache, metadata, *, scale, max_q_len,
-                    impl="xla", v_dim=None):
+                    impl="xla", v_dim=None, k_scale=None, v_scale=None):
     """Public entry: dispatch to the (jitted) single-shard implementation,
-    wrapping the Pallas path in shard_map when a TP shard context is set."""
+    wrapping the Pallas path in shard_map when a TP shard context is set.
+    ``k_scale``/``v_scale`` ([num_pages, Hkv] f32) mark an int8 quantized
+    cache — both implementations dequantize on the read path (in-kernel
+    for Pallas, on the gathered pages for XLA)."""
     if impl == "pallas" and _SHARD_CTX is not None:
         mesh, axis = _SHARD_CTX
         tp = mesh.shape[axis]
         if tp > 1:
             return _pallas_sharded(q, k_cache, v_cache, metadata,
                                    scale=scale, max_q_len=max_q_len,
-                                   v_dim=v_dim, mesh=mesh, axis=axis)
-    return _paged_attention(q, k_cache, v_cache, metadata, scale=scale,
-                            max_q_len=max_q_len, impl=impl, v_dim=v_dim)
+                                   v_dim=v_dim, mesh=mesh, axis=axis,
+                                   k_scale=k_scale, v_scale=v_scale)
+    return _paged_attention(q, k_cache, v_cache, metadata, k_scale,
+                            v_scale, scale=scale, max_q_len=max_q_len,
+                            impl=impl, v_dim=v_dim)
 
 
 def _pallas_sharded(q, k_cache, v_cache, metadata, *, scale, max_q_len,
-                    v_dim, mesh, axis):
+                    v_dim, mesh, axis, k_scale=None, v_scale=None):
     """Run the Pallas kernels per TP shard: q sharded on its head axis, KV
     sharded on the kv-head axis when divisible (else replicated — small-Hkv
     and MLA-MQA caches are replicated by kv_cache_specs), metadata
     replicated. The per-shard call sees plain smaller arrays, so the
     kernels run untouched; GSPMD moves nothing (shardings already match
     the layer's activation/cache placement)."""
-    from jax import shard_map
+    from gllm_tpu.parallel.mesh import compat_shard_map as shard_map
     from jax.sharding import PartitionSpec as P
 
     tp = mesh.shape[axis]
@@ -104,12 +109,19 @@ def _pallas_sharded(q, k_cache, v_cache, metadata, *, scale, max_q_len,
             f"pallas tp={tp} incompatible with Hq={num_q_heads} "
             f"Hkv={num_kv_heads}")
     kv_sharded = num_kv_heads % tp == 0
+    if k_scale is not None and not kv_sharded:
+        # the replicated-KV MQA-slice path below is gated off for int8
+        # (runner._check_kv_quant rejects the topology up front)
+        raise NotImplementedError(
+            "int8 KV cache needs num_kv_heads % tp == 0 on the pallas "
+            "path")
     qs = P(None, axis, None)
     ks = P(None, None, axis, None) if kv_sharded else P(None, None, None,
                                                         None)
+    ss = P(None, axis)          # scales shard with the kv-head axis
     md_specs = AttentionMetadata(P(None), P(None), P(None, None), P())
 
-    def inner(q, k, v, md):
+    def inner(q, k, v, md, ksc=None, vsc=None):
         if not kv_sharded and num_kv_heads > 1:
             # KV replicated with tp % Hkv == 0: this shard's contiguous
             # q-head slice belongs to exactly one kv head (kv-head-major
@@ -118,7 +130,7 @@ def _pallas_sharded(q, k_cache, v_cache, metadata, *, scale, max_q_len,
             k = jax.lax.dynamic_slice_in_dim(k, head, 1, axis=2)
             if v is not None:
                 v = jax.lax.dynamic_slice_in_dim(v, head, 1, axis=2)
-        return _paged_attention(q, k, v, md, scale=scale,
+        return _paged_attention(q, k, v, md, ksc, vsc, scale=scale,
                                 max_q_len=max_q_len, impl="pallas",
                                 v_dim=v_dim)
 
@@ -140,6 +152,10 @@ def _pallas_sharded(q, k_cache, v_cache, metadata, *, scale, max_q_len,
                        in_specs=(qs, ks, md_specs), out_specs=qs,
                        check_vma=False, **kw)
         return fn(q, k_cache, metadata)
+    if k_scale is not None:
+        fn = shard_map(inner, in_specs=(qs, ks, ks, md_specs, ss, ss),
+                       out_specs=qs, check_vma=False, **kw)
+        return fn(q, k_cache, v_cache, metadata, k_scale, v_scale)
     fn = shard_map(inner, in_specs=(qs, ks, ks, md_specs),
                    out_specs=qs, check_vma=False, **kw)
     return fn(q, k_cache, v_cache, metadata)
@@ -155,6 +171,8 @@ def _paged_attention(
                                # prefix of the keys — one cache, one DMA
                                # stream)
     metadata: AttentionMetadata,
+    k_scale=None,              # [num_pages, Hkv] f32: int8 cache scales
+    v_scale=None,              # (per page per kv head; None = fp cache)
     *,
     scale: float,
     max_q_len: int,
@@ -178,8 +196,14 @@ def _paged_attention(
             hkv = k_cache.shape[2] * pack
             k_cache = k_cache.reshape(P_, ps, hkv, q.shape[-1])
             v_cache = v_cache.reshape(P_, ps, hkv, q.shape[-1])
+            if k_scale is not None:
+                # packed row [h_p, D*pack] unpacks to heads h_p*pack+j —
+                # repeat each packed-group scale over its pack members
+                k_scale = jnp.repeat(k_scale, pack, axis=1)
+                v_scale = jnp.repeat(v_scale, pack, axis=1)
         return _xla_paged_attention(q, k_cache, v_cache, metadata,
-                                    scale=scale, max_q_len=max_q_len)
+                                    scale=scale, max_q_len=max_q_len,
+                                    k_scale=k_scale, v_scale=v_scale)
     if impl == "pallas":
         backend = jax.default_backend()
         if backend == "cpu":
@@ -222,7 +246,8 @@ def _paged_attention(
                 q, k_cache, v_cache, metadata.kv_lens, metadata.page_table,
                 scale=scale, interpret=interpret, v_dim=v_dim,
                 kv_block=cfg["kv_block"],
-                group_size=int(cfg.get("group", 1)))
+                group_size=int(cfg.get("group", 1)),
+                k_scale=k_scale, v_scale=v_scale)
         else:
             from gllm_tpu.ops.pallas.ragged_attention import (
                 ragged_paged_attention)
@@ -232,7 +257,8 @@ def _paged_attention(
                 q, k_cache, v_cache, metadata.cu_q_lens, metadata.kv_lens,
                 metadata.page_table, scale=scale, interpret=interpret,
                 v_dim=v_dim, q_block=blocks["q_block"],
-                kv_block=blocks["kv_block"])
+                kv_block=blocks["kv_block"],
+                k_scale=k_scale, v_scale=v_scale)
         if pack > 1:
             # The packed p·v_packed dot produced every lane block; keep
             # each head's own block (the rest mixed other heads' values).
@@ -246,7 +272,8 @@ def _paged_attention(
 
 
 def _xla_paged_attention(q, k_cache, v_cache, md: AttentionMetadata, *,
-                         scale: float, max_q_len: int):
+                         scale: float, max_q_len: int,
+                         k_scale=None, v_scale=None):
     T, num_q_heads, head_dim = q.shape
     num_pages, page_size, num_kv_heads, _ = k_cache.shape
     v_dim = v_cache.shape[-1]     # may differ from head_dim (MLA: values
@@ -262,9 +289,18 @@ def _xla_paged_attention(q, k_cache, v_cache, md: AttentionMetadata, *,
     q_valid = local_q[None, :] < q_lens[:, None]                     # [S, Qmax]
     qg = q[q_idx]                                                    # [S,Qmax,Hq,D]
 
-    # Gather per-seq KV pages → [S, max_kv, Hkv, D]
-    kg = k_cache[md.page_table].reshape(S, max_kv, num_kv_heads, head_dim)
-    vg = v_cache[md.page_table].reshape(S, max_kv, num_kv_heads, v_dim)
+    # Gather per-seq KV pages → [S, max_kv, Hkv, D]. int8 caches
+    # dequantize on the GATHERED pages (page-granular scales gathered by
+    # the same table) — the full-precision cache never materializes.
+    kg = k_cache[md.page_table]         # [S, MP, ps, Hkv, D]
+    vg = v_cache[md.page_table]
+    if k_scale is not None:
+        kg = kg.astype(jnp.float32) * \
+            k_scale[md.page_table][:, :, None, :, None]
+        vg = vg.astype(jnp.float32) * \
+            v_scale[md.page_table][:, :, None, :, None]
+    kg = kg.reshape(S, max_kv, num_kv_heads, head_dim)
+    vg = vg.reshape(S, max_kv, num_kv_heads, v_dim)
 
     # Causal+context mask: query at local index t has absolute position
     # kv_len - q_len + t; key j is visible iff j <= that position.

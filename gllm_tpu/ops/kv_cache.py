@@ -13,7 +13,13 @@ dummy page (page 0) and absorb writes from padded tokens.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+
+# int8 KV quantization grid: symmetric, scale = absmax / QMAX, dequant
+# x' = q * scale. -128 is never produced (clip to ±127) so the grid is
+# symmetric and the rescale-on-grow pass cannot overflow.
+QMAX = 127.0
 
 
 def write_kv(k_cache: jnp.ndarray, v_cache: jnp.ndarray,
@@ -38,3 +44,72 @@ def write_kv(k_cache: jnp.ndarray, v_cache: jnp.ndarray,
     flat_v = flat_v.at[slot_mapping].set(
         v.reshape(T, hkv, d).astype(flat_v.dtype))
     return (flat_k.reshape(k_cache.shape), flat_v.reshape(v_cache.shape))
+
+
+def _quant_write_one(cache, scale, rows, slot_mapping, pages):
+    """Quantized scatter for one stream (K or V).
+
+    cache: [num_pages, page_size, H, D] int8; scale: [num_pages, H] f32
+    (scale s means a stored q dequantizes to q * s); rows: [T, H, D] f32.
+
+    The per-page per-head scale is a RUNNING absmax: it only grows. When
+    a write grows a page's scale, rows already stored in that page were
+    quantized against the smaller scale, so the touched pages are
+    re-quantized in place (gather → scale by old/new → round → scatter)
+    before the new rows land. The rescale gather/scatter is wrapped in a
+    ``lax.cond``: in steady-state decode scales almost never grow, so the
+    hot path pays only the scatter-max and the row quantization.
+
+    A never-written page has scale 0 and rescales by ratio 0 on its
+    first write, which zero-fills the stale slots as a side effect.
+    Recycled pages keep their old tenant's scale, so a new tenant
+    quantizes against max(stale, own) — a bounded precision cost, never
+    a correctness one (see docs/kv_quantization.md).
+    """
+    num_pages, ps, h, d = cache.shape
+    amax = jnp.max(jnp.abs(rows), axis=-1) / QMAX            # [T, H]
+    old = scale[pages]                                       # [T, H]
+    new_scale = scale.at[pages].max(amax)
+    new = new_scale[pages]                                   # [T, H]
+
+    def rescale(c):
+        # duplicate page ids gather/scatter identical values — exact
+        blk = c[pages].astype(jnp.float32)                   # [T, ps, H, D]
+        ratio = jnp.where(new > 0.0, old / jnp.maximum(new, 1e-30), 0.0)
+        blk = jnp.round(blk * ratio[:, None, :, None])
+        return c.at[pages].set(blk.astype(cache.dtype))
+
+    cache = jax.lax.cond(jnp.any(new > old), rescale, lambda c: c, cache)
+    q = jnp.round(rows / jnp.maximum(new, 1e-30)[:, :, None])
+    q = jnp.clip(q, -QMAX, QMAX).astype(cache.dtype)
+    flat = cache.reshape(num_pages * ps, h, d)
+    flat = flat.at[slot_mapping].set(q)
+    return flat.reshape(cache.shape), new_scale
+
+
+def write_kv_quant(k_cache, v_cache, k_scale, v_scale,
+                   k: jnp.ndarray, v: jnp.ndarray,
+                   slot_mapping: jnp.ndarray, page_size: int):
+    """Quantizing scatter into an int8 paged cache (kv_cache_dtype=int8).
+
+    k_cache/v_cache: [num_pages, page_size, H, D] int8 (H/D are the
+    CACHE's trailing dims — under kv_pack > 1 that is the packed layout,
+    so the scale is shared by the packed head group).
+    k_scale/v_scale: [num_pages, H] f32 running per-page per-head scales.
+    k/v:             [T, Hkv, D'] new rows (any float dtype).
+    slot_mapping:    [T] int32 flat slots (padding → dummy-page slots).
+
+    Returns (k_cache, v_cache, k_scale, v_scale). Attention dequantizes
+    in-kernel (ops/pallas/*) or on the gathered pages (the XLA oracle) —
+    the full-precision cache never exists in HBM.
+    """
+    num_pages, ps, h, d = k_cache.shape
+    T = k.shape[0]
+    pages = slot_mapping // page_size
+    k_cache, k_scale = _quant_write_one(
+        k_cache, k_scale, k.reshape(T, h, d).astype(jnp.float32),
+        slot_mapping, pages)
+    v_cache, v_scale = _quant_write_one(
+        v_cache, v_scale, v.reshape(T, h, d).astype(jnp.float32),
+        slot_mapping, pages)
+    return k_cache, v_cache, k_scale, v_scale

@@ -34,8 +34,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from gllm_tpu.ops.pallas.paged_kv import (CompilerParams, attend_block,
-                                          kv_stream_specs,
-                                          make_fetch_fns)
+                                          kv_stream_specs, make_fetch_fns,
+                                          unpack_refs)
 
 DEFAULT_KV_BLOCK = 256
 
@@ -44,7 +44,8 @@ def _kernel_grouped(kv_lens_ref, pt_ref,    # scalar prefetch
                     *refs,
                     page_size: int, pages_per_block: int, scale: float,
                     num_kv_heads: int, group: int, head_dim: int,
-                    v_dim: int, shared_kv: bool, mqa: bool, gsz: int):
+                    v_dim: int, shared_kv: bool, mqa: bool, gsz: int,
+                    quant: bool):
     """``gsz`` sequences per grid program, ONE buffer slot each, fetched
     round-robin so up to ``gsz`` page DMAs are in flight at once.
 
@@ -54,16 +55,14 @@ def _kernel_grouped(kv_lens_ref, pt_ref,    # scalar prefetch
     measured; × S/2 programs per core × num_layers ≈ the whole decode
     step). Interleaving ``gsz`` sequences divides that latency chain by
     ``gsz`` without paying any padded-extent HBM traffic."""
-    if shared_kv:
-        q_ref, k_hbm, o_ref, k_buf, sems = refs
-        v_hbm = v_buf = None
-    else:
-        q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems = refs
+    (q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf,
+     vs_buf, sems) = unpack_refs(refs, shared_kv, quant)
     gi = pl.program_id(0)
     bk = pages_per_block * page_size
     start_fetch, wait_fetch = make_fetch_fns(
         pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems, pages_per_block,
-        shared_kv)
+        shared_kv, ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf,
+        vs_buf=vs_buf)
 
     seq_ids = [gi * gsz + g for g in range(gsz)]
     kv_lens = [kv_lens_ref[s] for s in seq_ids]
@@ -99,7 +98,8 @@ def _kernel_grouped(kv_lens_ref, pt_ref,    # scalar prefetch
             # keeps the loads ahead of the re-issued DMA.
             m_new, l_new, acc_new = attend_block(
                 qs[g], k_buf, v_buf, g, bk, num_kv_heads, head_dim,
-                v_dim, shared_kv, mqa, kv_lens[g], r, m, l, acc)
+                v_dim, shared_kv, mqa, kv_lens[g], r, m, l, acc,
+                ks_buf=ks_buf, vs_buf=vs_buf)
 
             @pl.when(live & (r + 1 < n_blocks[g]))
             def _(g=g):
@@ -127,12 +127,9 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
             *refs,
             page_size: int, pages_per_block: int, scale: float,
             num_kv_heads: int, group: int, head_dim: int, v_dim: int,
-            shared_kv: bool, mqa: bool):
-    if shared_kv:
-        q_ref, k_hbm, o_ref, k_buf, sems = refs
-        v_hbm = v_buf = None
-    else:
-        q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems = refs
+            shared_kv: bool, mqa: bool, quant: bool):
+    (q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf,
+     vs_buf, sems) = unpack_refs(refs, shared_kv, quant)
     s = pl.program_id(0)
     kv_len = kv_lens_ref[s]
     bk = pages_per_block * page_size
@@ -140,7 +137,8 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
 
     start_fetch, wait_fetch = make_fetch_fns(
         pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems, pages_per_block,
-        shared_kv)
+        shared_kv, ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf,
+        vs_buf=vs_buf)
 
     @pl.when(n_blocks > 0)
     def _():
@@ -162,7 +160,7 @@ def _kernel(kv_lens_ref, pt_ref,            # scalar prefetch
         wait_fetch(slot, s, i)
         return attend_block(qh, k_buf, v_buf, slot, bk, num_kv_heads,
                             head_dim, v_dim, shared_kv, mqa, kv_len, i,
-                            m, l, acc)
+                            m, l, acc, ks_buf=ks_buf, vs_buf=vs_buf)
 
     lead = (num_kv_heads * group,) if mqa else (num_kv_heads, group)
     m0 = jnp.full((*lead, 1), -jnp.inf, jnp.float32)
@@ -190,12 +188,15 @@ def paged_decode_attention(
     interpret: bool = False,
     v_dim: Optional[int] = None,
     group_size: int = 1,       # seqs per grid program (see _kernel_grouped)
+    k_scale: Optional[jnp.ndarray] = None,   # [num_pages, Hkv] f32 (int8)
+    v_scale: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     S, num_q_heads, head_dim = q.shape
     num_pages, page_size, num_kv_heads, _ = k_cache.shape
     max_pages = page_table.shape[1]
     group = num_q_heads // num_kv_heads
     shared_kv = v_cache is None
+    quant = k_scale is not None
     if shared_kv:
         if v_dim is None:
             raise ValueError("v_dim required when v_cache is None")
@@ -206,6 +207,9 @@ def paged_decode_attention(
     # sublane tiling rejects slicing a size-1 second-minor dim — and run
     # the kernel's 2-D path.
     mqa = num_kv_heads == 1
+    if quant and (mqa or shared_kv):
+        raise NotImplementedError(
+            "int8 KV cache unsupported for MQA/MLA decode kernels")
     if mqa:
         k_cache = k_cache.reshape(num_pages, page_size, head_dim)
         if v_cache is not None:
@@ -232,19 +236,22 @@ def paged_decode_attention(
             _kernel_grouped, page_size=page_size,
             pages_per_block=pages_per_block, scale=scale,
             num_kv_heads=num_kv_heads, group=group, head_dim=head_dim,
-            v_dim=v_dim, shared_kv=shared_kv, mqa=mqa, gsz=gsz)
+            v_dim=v_dim, shared_kv=shared_kv, mqa=mqa, gsz=gsz,
+            quant=quant)
         slots, n_prog, blk = gsz, s_pad // gsz, gsz
     else:
         kernel = functools.partial(
             _kernel, page_size=page_size, pages_per_block=pages_per_block,
             scale=scale, num_kv_heads=num_kv_heads, group=group,
-            head_dim=head_dim, v_dim=v_dim, shared_kv=shared_kv, mqa=mqa)
+            head_dim=head_dim, v_dim=v_dim, shared_kv=shared_kv, mqa=mqa,
+            quant=quant)
         slots, n_prog, blk = 2, S, 1
         s_pad = S
 
     kv_specs, scratch_shapes, kv_inputs = kv_stream_specs(
         k_cache, v_cache, pages_per_block, page_size, num_kv_heads,
-        head_dim, v_dim, mqa=mqa, slots=slots)
+        head_dim, v_dim, mqa=mqa, slots=slots, k_scale=k_scale,
+        v_scale=v_scale)
     in_specs = [
         pl.BlockSpec((blk, num_q_heads, head_dim), lambda s, *_: (s, 0, 0),
                      memory_space=pltpu.VMEM),

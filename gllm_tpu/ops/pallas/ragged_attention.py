@@ -36,7 +36,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from gllm_tpu.ops.pallas.paged_kv import (CompilerParams, block_kv,
-                                          kv_stream_specs, make_fetch_fns)
+                                          kv_stream_specs, make_fetch_fns,
+                                          unpack_refs)
 
 DEFAULT_KV_BLOCK = 256
 DEFAULT_Q_BLOCK = 128
@@ -85,12 +86,9 @@ def _kernel(cu_ref, kv_lens_ref, pt_ref, first_ref, last_ref,  # prefetch
             *refs,
             page_size: int, pages_per_block: int, scale: float,
             num_kv_heads: int, group: int, head_dim: int, v_dim: int,
-            q_blk: int, shared_kv: bool, mqa: bool):
-    if shared_kv:
-        q_ref, k_hbm, o_ref, k_buf, sems = refs
-        v_hbm = v_buf = None
-    else:
-        q_ref, k_hbm, v_hbm, o_ref, k_buf, v_buf, sems = refs
+            q_blk: int, shared_kv: bool, mqa: bool, quant: bool):
+    (q_ref, k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf,
+     vs_buf, sems) = unpack_refs(refs, shared_kv, quant)
     b = pl.program_id(0)
     t_start = b * q_blk
     s0 = first_ref[b]
@@ -117,7 +115,8 @@ def _kernel(cu_ref, kv_lens_ref, pt_ref, first_ref, last_ref,  # prefetch
 
     start_fetch, wait_fetch = make_fetch_fns(
         pt_ref, k_hbm, v_hbm, k_buf, v_buf, sems, pages_per_block,
-        shared_kv)
+        shared_kv, ks_hbm=ks_hbm, vs_hbm=vs_hbm, ks_buf=ks_buf,
+        vs_buf=vs_buf)
 
     def seq_body(s, carry):
         m, l, acc = carry
@@ -148,7 +147,8 @@ def _kernel(cu_ref, kv_lens_ref, pt_ref, first_ref, last_ref,  # prefetch
 
             wait_fetch(slot, s, i)
             k, v = block_kv(k_buf, v_buf, slot, bk, num_kv_heads,
-                            head_dim, v_dim, shared_kv, mqa=mqa)
+                            head_dim, v_dim, shared_kv, mqa=mqa,
+                            ks_buf=ks_buf, vs_buf=vs_buf)
             if mqa:
                 kt = k.astype(jnp.float32)              # [BK, D]
                 vt = v.astype(jnp.float32)              # [BK, Dv]
@@ -222,10 +222,13 @@ def ragged_paged_attention(
     kv_block: int = DEFAULT_KV_BLOCK,
     interpret: bool = False,
     v_dim=None,
+    k_scale=None,              # [num_pages, Hkv] f32 (int8 cache)
+    v_scale=None,
 ) -> jnp.ndarray:
     T, num_q_heads, head_dim = q.shape
     _, page_size, num_kv_heads, _ = k_cache.shape
     shared_kv = v_cache is None
+    quant = k_scale is not None
     if shared_kv:
         if v_dim is None:
             raise ValueError("v_dim required when v_cache is None")
@@ -238,6 +241,9 @@ def ragged_paged_attention(
     # sublane tiling rejects slicing a size-1 second-minor dim.
     num_pages = k_cache.shape[0]
     mqa = num_kv_heads == 1
+    if quant and (mqa or shared_kv):
+        raise NotImplementedError(
+            "int8 KV cache unsupported for MQA/MLA ragged kernels")
     if mqa:
         k_cache = k_cache.reshape(num_pages, page_size, head_dim)
         if v_cache is not None:
@@ -270,11 +276,11 @@ def ragged_paged_attention(
         _kernel, page_size=page_size, pages_per_block=pages_per_block,
         scale=scale, num_kv_heads=num_kv_heads, group=group,
         head_dim=head_dim, v_dim=v_dim, q_blk=bq, shared_kv=shared_kv,
-        mqa=mqa)
+        mqa=mqa, quant=quant)
 
     kv_specs, scratch_shapes, kv_inputs = kv_stream_specs(
         k_cache, v_cache, pages_per_block, page_size, num_kv_heads,
-        head_dim, v_dim, mqa=mqa)
+        head_dim, v_dim, mqa=mqa, k_scale=k_scale, v_scale=v_scale)
     in_specs = [
         pl.BlockSpec((bq, num_q_heads, head_dim),
                      lambda b, *_: (b, 0, 0),

@@ -55,6 +55,31 @@ def active_mesh():
     return m
 
 
+def compat_shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                     axis_names=None):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax: pass through (``mesh=None`` + ``axis_names`` binds the
+    context abstract mesh with only those axes manual). 0.4.x (this
+    image): translate onto ``jax.experimental.shard_map`` — ``check_vma``
+    → ``check_rep``, partial-manual via ``auto`` = the mesh axes NOT in
+    ``axis_names``, and ``mesh=None`` resolves to the context mesh."""
+    try:
+        from jax import shard_map as sm
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return sm(f, **kw)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+        m = mesh if mesh is not None else active_mesh()
+        auto = (frozenset(m.axis_names) - frozenset(axis_names)
+                if axis_names is not None else frozenset())
+        return sm(f, mesh=m, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma, auto=auto)
+
+
 @contextlib.contextmanager
 def mesh_context(mesh: Optional[Mesh]):
     if mesh is None:

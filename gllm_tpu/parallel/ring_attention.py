@@ -68,7 +68,8 @@ def _merge(acc, m, l, out_b, m_b, l_b):
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    kv_valid=None, *, axis_name: str,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   axis_size: Optional[int] = None):
     """Causal ring attention inside shard_map.
 
     q: [C, Hq, D] local query shard (global seq sharded over axis_name)
@@ -82,7 +83,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     C, Hq, D = q.shape
     if scale is None:
         scale = D ** -0.5
-    n = jax.lax.axis_size(axis_name)
+    if axis_size is not None:                  # static size from the mesh
+        n = axis_size
+    else:                                      # jax >= 0.6 only
+        n = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
 
     pos_q = my * C + jnp.arange(C)
@@ -93,9 +97,12 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     m = jnp.full((C, Hq), -1e30, jnp.float32)
     l = jnp.zeros((C, Hq), jnp.float32)
     # mark the device-constant init values as varying over the ring axis so
-    # the fori_loop carry type matches the per-shard results
-    acc, m, l = (jax.lax.pcast(x, (axis_name,), to="varying")
-                 for x in (acc, m, l))
+    # the fori_loop carry type matches the per-shard results (pcast is the
+    # vma-era API — 0.4.x shard_map has no vma tracking, nothing to mark)
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        acc, m, l = (pcast(x, (axis_name,), to="varying")
+                     for x in (acc, m, l))
 
     def hop(i, carry):
         acc, m, l, k_cur, v_cur = carry
@@ -129,13 +136,16 @@ def ring_attention_sharded(q, k, v, mesh: Optional[Mesh] = None,
     other mesh axes stay GSPMD-auto); a concrete mesh is bound fully
     (standalone / unit-test use). ``kv_valid``: optional replicated scalar
     masking padded keys (see ring_attention)."""
-    from jax import shard_map
+    from gllm_tpu.parallel.mesh import (active_mesh,
+                                        compat_shard_map as shard_map)
 
     spec = P(axis_name, None, None)
     kw = (dict(mesh=None, axis_names={axis_name}) if mesh is None
           else dict(mesh=mesh))
+    m = mesh if mesh is not None else active_mesh()
+    sizes = dict(getattr(m, "shape_tuple", None) or m.shape)
     part = functools.partial(ring_attention, axis_name=axis_name,
-                             scale=scale)
+                             scale=scale, axis_size=sizes[axis_name])
     if kv_valid is None:
         fn = shard_map(part, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False, **kw)

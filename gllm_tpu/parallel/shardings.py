@@ -107,6 +107,10 @@ def kv_cache_specs(cfg: ModelConfig, tp: int):
     from gllm_tpu.models.dense import KVCache
     kv_heads_ok = cfg.num_kv_heads % tp == 0
     spec = P(None, None, None, _tp_if(kv_heads_ok), None)
+    if cfg.kv_cache_quant:
+        # int8 cache: [L, P, Hkv] scales shard with the kv-head axis
+        sspec = P(None, None, _tp_if(kv_heads_ok))
+        return KVCache(spec, spec, sspec, sspec)
     return KVCache(spec, spec)
 
 

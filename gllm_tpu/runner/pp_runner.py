@@ -49,8 +49,8 @@ from gllm_tpu.models import ModelConfig, get_model_def
 from gllm_tpu.obs import metrics as obs
 from gllm_tpu.obs.steptrace import TRACE
 from gllm_tpu.ops.sampling import sample
-from gllm_tpu.runner.runner import (ModelRunner, _DTYPES,
-                                    pick_kv_pack)
+from gllm_tpu.runner.runner import (ModelRunner, _DTYPES, pick_kv_pack,
+                                    reset_page_scales, resolve_kv_quant)
 from gllm_tpu.utils import cdiv, tpu_compiler_options
 
 logger = logging.getLogger(__name__)
@@ -119,6 +119,7 @@ class PPModelRunner(ModelRunner):
             raise NotImplementedError(
                 "PPModelRunner builds its own per-stage params/meshes")
         self.config = config
+        self.kv_quant, model_cfg = resolve_kv_quant(config, model_cfg)
         self.model_cfg = model_cfg
         self.mesh = None
         self.dtype = _DTYPES[config.dtype]
@@ -152,6 +153,8 @@ class PPModelRunner(ModelRunner):
                     "KV layout (head_dim ×pack % 128 == 0)")
         self.kv_pack = pack if impl == "pallas" else 1
         self.attn_impl = impl
+        if self.kv_quant:
+            self._check_kv_quant()
         from gllm_tpu.runner.prepare import BatchBuilder
         self.builder = BatchBuilder(config, config.cache.page_size,
                                     vocab_size=model_cfg.vocab_size,
@@ -313,6 +316,13 @@ class PPModelRunner(ModelRunner):
             # the inherited _prepare_mm embeds on stage 0 (visual tower)
             self.params = self.stages[0].params
         self.memory_manager = None     # attached by the engine
+        from gllm_tpu.runner.runner import _M_KV_DTYPE
+        _M_KV_DTYPE.set(1, dtype=jnp.dtype(kv_dtype).name)
+        # gllm_kv_bytes_read_total estimate: per-context-token cache
+        # bytes across the WHOLE layer stack (self.model_cfg is the full
+        # model, so the base per-page pricing already sums every stage)
+        self._kv_rd_tok_bytes = (self._kv_bytes_per_page()
+                                 / config.cache.page_size)
         logger.info("pipeline: dp=%d × %d stages %s × tp=%d, "
                     "%d KV pages/stage", dp, pp, bounds, tp,
                     self.num_pages)
@@ -450,6 +460,7 @@ class PPModelRunner(ModelRunner):
                              _ag(sched_batch.items)),
                             _ag(sched_batch.items))
         _M_MICROBATCH.inc()
+        self._note_kv_read(sched_batch.items)
         TRACE.record("pp_stage", stages=len(stages),
                      num_seqs=sched_batch.num_seqs,
                      tokens=sched_batch.total_tokens)
@@ -489,12 +500,23 @@ class PPModelRunner(ModelRunner):
         tokens, aux = out
         return tokens, aux, sched_batch.num_seqs
 
+    def _apply_scale_resets(self) -> None:
+        """int8 KV cache under pp: zero minted-page scales on EVERY
+        stage's cache (pages are logical across stages — each stage owns
+        the same page id for its own layers)."""
+        for r, idx in self._drained_scale_resets() or ():
+            for stage in self.replicas[r]:
+                ks, vs = reset_page_scales(stage.kv.k_scale,
+                                           stage.kv.v_scale, idx)
+                stage.kv = stage.kv._replace(k_scale=ks, v_scale=vs)
+
     def step_async(self, sched_batch):
         self._step_count += 1
         if self.model_cfg.use_mm:
             # ViT embedding on stage 0's params (visual tower lives there)
             self._prepare_mm(sched_batch)
         self._apply_ssm_intents()
+        self._apply_scale_resets()
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
         return self._run_pipeline(self.stages, sched_batch, step_key)
 
@@ -521,6 +543,7 @@ class PPModelRunner(ModelRunner):
                 if b is not None:
                     self._prepare_mm(b)
         self._apply_ssm_intents()
+        self._apply_scale_resets()
         base_key = jax.random.fold_in(self.rng_key, self._step_count)
         handles = []
         for r, b in enumerate(sched_batches):

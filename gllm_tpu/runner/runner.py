@@ -51,12 +51,26 @@ _M_NEW_SHAPE = obs.counter(
     "gllm_jit_new_shape_signatures_total",
     "first dispatch of a (shape-bucket, static-flag) signature this "
     "process — an XLA compile unless the persistent cache held it")
+# KV-cache dtype observability (docs/observability.md): an info gauge
+# naming the active storage dtype, and a host-side ESTIMATE of KV bytes
+# the attention kernels stream per step (context tokens × per-token
+# cache bytes on device 0) — the decode bandwidth-floor denominator.
+_M_KV_DTYPE = obs.gauge(
+    "gllm_kv_cache_dtype",
+    "info gauge: 1 for the active paged-KV storage dtype", ("dtype",))
+_M_KV_READ = obs.counter(
+    "gllm_kv_bytes_read_total",
+    "estimated KV cache bytes read by attention (context tokens x "
+    "per-token cache bytes incl. int8 scales; per-device estimate)")
 
 _DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
            "float16": jnp.float16,
            # fp8 KV storage (MLA latent / dense KV) — reference
            # concat_and_cache_mla_fp8 packed cache, cache_kernels.py
-           "fp8": jnp.float8_e4m3fn}
+           "fp8": jnp.float8_e4m3fn,
+           # int8 KV storage with per-page per-head scales — only valid
+           # as cache.kv_cache_dtype (ops/kv_cache.write_kv_quant)
+           "int8": jnp.int8}
 
 
 
@@ -116,6 +130,26 @@ def _ssm_apply_replica(conv, rec, r, snap_src, snap_dst, zero_slots,
                        rest_src, rest_dst):
     return _ssm_update(conv, rec, (r,), snap_src, snap_dst, zero_slots,
                        rest_src, rest_dst)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def reset_page_scales(k_scale, v_scale, pages):
+    """Zero the quantization scales of freshly MINTED pages (int8 KV
+    cache): a zero scale is the fresh-page mark — the first write
+    zero-fills the stale payload and starts a new running absmax, so a
+    recycled page quantizes exactly like a never-used one. ``pages`` is
+    pow2-padded with the dummy page 0 (whose scale is meaningless).
+    Leaves are [L, P, H]; the dp-stacked [dp, L, P, H] layout goes
+    through :func:`reset_page_scales_replica` instead."""
+    return (k_scale.at[:, pages].set(0.0), v_scale.at[:, pages].set(0.0))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def reset_page_scales_replica(k_scale, v_scale, r, pages):
+    """dp-stacked variant: zero replica ``r``'s minted-page scales on
+    [dp, L, P, H] leaves (each replica drains its own memory manager)."""
+    return (k_scale.at[r, :, pages].set(0.0),
+            v_scale.at[r, :, pages].set(0.0))
 
 
 def pallas_tp_ok(cfg: ModelConfig, tp: int) -> bool:
@@ -199,10 +233,24 @@ def _spec_sampled(items) -> bool:
                for it in items)
 
 
+def resolve_kv_quant(config: EngineConfig, model_cfg: ModelConfig):
+    """(kv_quant, model_cfg) for a runner: spec builders
+    (kv_cache_specs) mirror the cache's scale leaves off
+    ``model_cfg.kv_cache_quant``; the forward detects quant structurally
+    (KVCache.k_scale is not None). Shared by ModelRunner and
+    PPModelRunner so the propagation can never diverge."""
+    kv_quant = config.cache.kv_cache_dtype == "int8"
+    if kv_quant and not model_cfg.kv_cache_quant:
+        import dataclasses as _dc
+        model_cfg = _dc.replace(model_cfg, kv_cache_quant=True)
+    return kv_quant, model_cfg
+
+
 class ModelRunner:
     def __init__(self, config: EngineConfig, model_cfg: ModelConfig,
                  params=None, mesh=None):
         self.config = config
+        self.kv_quant, model_cfg = resolve_kv_quant(config, model_cfg)
         self.model_cfg = model_cfg
         if mesh is None and config.parallel.world_size > 1:
             from gllm_tpu.parallel.mesh import make_mesh
@@ -212,7 +260,15 @@ class ModelRunner:
         self.dtype = _DTYPES[config.dtype]
         self.model_def = get_model_def(model_cfg)
         self.kv_pack = 1   # may be raised by _pick_attn_impl (lane packing)
+        if (config.parallel.sp > 1 and config.parallel.tp > 1
+                and not hasattr(jax, "shard_map")):
+            # jax 0.4.x cannot nest the partial-manual sp ring inside a
+            # tp-auto program (XLA: ambiguous PartitionId under SPMD)
+            raise NotImplementedError(
+                "sp>1 with tp>1 needs jax.shard_map (jax >= 0.5)")
         self.attn_impl = self._pick_attn_impl()
+        if self.kv_quant:
+            self._check_kv_quant()
         # (Re)set the module-level TP shard context the attention dispatch
         # reads at trace time — cleared when this runner doesn't need it so
         # a later runner in the same process never sees a stale mesh.
@@ -344,6 +400,12 @@ class ModelRunner:
                 self.kv, kspecs)
         logger.info("KV cache: %d pages × %d tokens (%s)", self.num_pages,
                     config.cache.page_size, self._kv_dtype().__name__)
+        _M_KV_DTYPE.set(1, dtype=jnp.dtype(self._kv_dtype()).name)
+        # per-context-token cache bytes (per device 0) for the
+        # gllm_kv_bytes_read_total estimate — amortizes scales and the
+        # layer stack through the same sizing arithmetic
+        self._kv_rd_tok_bytes = (self._kv_bytes_per_page()
+                                 / config.cache.page_size)
         self._step_fn = self._build_step_fn()
         self._multi_step_fn = self._build_multi_step_fn()
 
@@ -360,12 +422,22 @@ class ModelRunner:
         # replicas (manual shard_map), so only a tp kv-head split forces
         # native alignment.
         pack = pick_kv_pack(cfg, self.mesh is not None and tp > 1)
+        nested_dp_tp = self.config.parallel.dp > 1 and tp > 1
+        old_shard_map = not hasattr(jax, "shard_map")
         if impl != "auto":
             if impl == "pallas":
                 if tp_sharded and not pallas_tp_ok(cfg, tp):
                     raise NotImplementedError(
                         "attention_impl='pallas' needs head counts "
                         "divisible over tp; use attention_impl='xla'")
+                if nested_dp_tp and old_shard_map:
+                    # jax 0.4.x cannot nest a partial-manual tp
+                    # shard_map inside the dp-manual region (the XLA CPU
+                    # backend aborts on the nested manual program)
+                    raise NotImplementedError(
+                        "attention_impl='pallas' with dp>1 AND tp>1 "
+                        "needs jax.shard_map (jax >= 0.5); use "
+                        "attention_impl='xla' on this jax")
                 if not pack:
                     raise NotImplementedError(
                         "attention_impl='pallas' needs a 128-lane-"
@@ -376,10 +448,39 @@ class ModelRunner:
             return impl
         if not pack or (tp_sharded and not pallas_tp_ok(cfg, tp)):
             return "xla"
+        if nested_dp_tp and old_shard_map:
+            return "xla"
         if jax.default_backend() in ("tpu", "axon"):
             self.kv_pack = pack
             return "pallas"
         return "xla"
+
+    def _check_kv_quant(self) -> None:
+        """Reject model/topology combos the int8 KV cache does not
+        support — explicitly, instead of silently degrading (the auto |
+        bfloat16 | fp8 cache dtypes remain available everywhere)."""
+        cfg, config = self.model_cfg, self.config
+        if cfg.use_mla:
+            raise NotImplementedError(
+                "kv_cache_dtype='int8' unsupported for MLA latent "
+                "caches (DeepSeek/Kimi); use kv_cache_dtype='auto' "
+                "or 'fp8'")
+        if cfg.use_hybrid:
+            raise NotImplementedError(
+                "kv_cache_dtype='int8' unsupported for hybrid (GDN) "
+                "models; use kv_cache_dtype='auto'")
+        if self.attn_impl == "pallas":
+            if cfg.num_kv_heads // max(self.kv_pack, 1) == 1:
+                raise NotImplementedError(
+                    "kv_cache_dtype='int8' unsupported on the pallas "
+                    "MQA kernel path (num_kv_heads == 1); use "
+                    "attention_impl='xla'")
+            if (config.parallel.tp > 1
+                    and cfg.num_kv_heads % config.parallel.tp != 0):
+                raise NotImplementedError(
+                    "kv_cache_dtype='int8' on the pallas path needs "
+                    "num_kv_heads % tp == 0 (the replicated-KV slice "
+                    "path is gated); use attention_impl='xla'")
 
     def _kv_dtype(self):
         kd = self.config.cache.kv_cache_dtype
@@ -410,8 +511,13 @@ class ModelRunner:
         # Hybrid: only the full-attention layers hold paged KV.
         n_kv_layers = n_layers or (cfg.num_attn_layers if cfg.use_hybrid
                                    else cfg.num_stage_layers)
-        return (2 * n_kv_layers * page * cfg.num_kv_heads
-                * cfg.head_dim * itemsize) // shards
+        per_page = (2 * n_kv_layers * page * cfg.num_kv_heads
+                    * cfg.head_dim * itemsize) // shards
+        if self.kv_quant:
+            # int8 cache rides per-page per-head f32 scales (k and v) —
+            # ~0.2% of the page, but sizing must not over-promise
+            per_page += (2 * n_kv_layers * cfg.num_kv_heads * 4) // shards
+        return per_page
 
     def _ssm_pool_bytes(self) -> int:
         cfg = self.model_cfg
@@ -580,7 +686,8 @@ class ModelRunner:
                 # TPU answer to the reference's per-replica worker
                 # processes each calling FA3 (worker.py:750-829,
                 # layers/attention.py:92-140).
-                from jax import shard_map
+                from gllm_tpu.parallel.mesh import (
+                    compat_shard_map as shard_map)
                 dp_s = lambda t: jax.tree.map(lambda _: P(AXIS_DP), t)
                 rep = lambda t: jax.tree.map(lambda _: P(), t)
                 aux_spec = {}
@@ -708,6 +815,55 @@ class ModelRunner:
         sw = self.swap_manager
         if sw is not None and sw.has_work:
             self.kv = sw.apply(self.kv)
+        self._apply_scale_resets()
+
+    def _drained_scale_resets(self):
+        """Per-replica minted-page lists queued by the memory manager(s)
+        since the last dispatch, minus pages whose scales the swap drain
+        just scattered in from the host tier (restore targets carry the
+        host scale — zeroing it would corrupt the restored page).
+        Ordering: runs AFTER :meth:`_apply_swap_intents` dispatched its
+        gathers, so a spill still reads the outgoing tenant's scale."""
+        mm0 = getattr(self, "memory_manager", None)
+        if not self.kv_quant or mm0 is None:
+            return
+        sw = getattr(self, "swap_manager", None)
+        skip = sw.consume_last_scatter_dev() if sw is not None else ()
+        mms = (getattr(self, "memory_managers", None) or [mm0])
+        for r, mm in enumerate(mms):
+            if not mm.track_scale_resets:
+                continue
+            pages = [p for p in mm.drain_scale_resets() if p not in skip]
+            if pages:
+                idx = np.zeros(next_pow2(len(pages), 1), np.int32)
+                idx[:len(pages)] = pages     # pad → dummy page 0
+                yield r, jnp.asarray(idx)
+
+    def _apply_scale_resets(self) -> None:
+        """int8 KV cache: zero the scales of pages minted since the last
+        dispatch so a recycled page quantizes exactly like a fresh one
+        (quantization never depends on page-reuse history)."""
+        for r, idx in self._drained_scale_resets() or ():
+            if self.dp > 1:
+                ks, vs = reset_page_scales_replica(
+                    self.kv.k_scale, self.kv.v_scale, jnp.int32(r), idx)
+            else:
+                ks, vs = reset_page_scales(self.kv.k_scale,
+                                           self.kv.v_scale, idx)
+            self.kv = self.kv._replace(k_scale=ks, v_scale=vs)
+
+    def _note_kv_read(self, items, steps: int = 1) -> None:
+        """Estimate of the KV bytes this dispatch streams through
+        attention: each row reads its whole context (kv_len after this
+        step's writes); a K-step fused block re-reads the growing
+        context every sub-step. Pure host arithmetic on scheduler state
+        — never touches the device."""
+        tok_bytes = getattr(self, "_kv_rd_tok_bytes", 0)
+        if not tok_bytes:
+            return
+        ctx = sum(it.computed_before + it.num_new_tokens for it in items)
+        grow = len(items) * steps * (steps - 1) // 2
+        _M_KV_READ.inc(int((ctx * steps + grow) * tok_bytes))
 
     def _note_dispatch(self, kind: str, batch, static_flags: tuple,
                        all_greedy: bool) -> None:
@@ -829,6 +985,7 @@ class ModelRunner:
 
         all_greedy_dp = all(_all_greedy(b.items) for b in live)
         spec_sampled_dp = any(_spec_sampled(b.items) for b in live)
+        self._note_kv_read([it for b in live for it in b.items])
         self._note_dispatch("dp_step", stacked,
                             (max_q, lp_k, want_plp, spec_sampled_dp,
                              all_greedy_dp),
@@ -870,6 +1027,7 @@ class ModelRunner:
         ring = self._use_ring(sched_batch, batch.token_ids.shape[0])
         spec_sampled = _spec_sampled(sched_batch.items)
         all_greedy = _all_greedy(sched_batch.items)
+        self._note_kv_read(sched_batch.items)
         self._note_dispatch("step", batch,
                             (max_q, lp_k, want_plp, ring, spec_sampled,
                              all_greedy), all_greedy)
@@ -943,6 +1101,7 @@ class ModelRunner:
                                           sched_batch.host_rows)
         lp_k, _ = self._lp_flags(sched_batch)
         all_greedy = _all_greedy(sched_batch.items)
+        self._note_kv_read(sched_batch.items)
         self._note_dispatch("step", batch,
                             (1, lp_k, False, False, False, all_greedy),
                             all_greedy)
@@ -998,6 +1157,7 @@ class ModelRunner:
         else:
             au_np[:n] = K
         all_greedy = _all_greedy(chain[0].items)
+        self._note_kv_read(chain[0].items, steps=K)
         self._note_dispatch("multi_step", batch, (K, all_greedy),
                             all_greedy)
         from gllm_tpu.parallel.mesh import mesh_context

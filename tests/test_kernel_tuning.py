@@ -85,6 +85,19 @@ def test_committed_table_entries_carry_provenance():
             assert any(tok in comment for tok in ("docs/", "r0", "sweep",
                                                   "kernel_tune")), (
                 f"{dev}/{kern} comment names no artifact: {comment!r}")
+            # ... and a named docs/ artifact must actually be committed
+            repo = os.path.join(os.path.dirname(__file__), os.pardir)
+            for tok in comment.split():
+                if tok.startswith("docs/"):
+                    path = tok.rstrip(".,;:)")
+                    assert os.path.exists(os.path.join(repo, path)), (
+                        f"{dev}/{kern} cites missing artifact {path!r}")
+            # a kept-from-a-manual-A/B placeholder is not provenance —
+            # the r05/r06 decode regression class (sweep broken, value
+            # hand-carried with no measured artifact behind it)
+            assert "manual" not in comment.lower(), (
+                f"{dev}/{kern} provenance is a manual A/B placeholder: "
+                f"{comment!r}")
             # and the entry must carry actual kernel params besides it
             assert any(k != "comment" for k in params), (dev, kern)
 
