@@ -120,8 +120,30 @@ class EngineConfig:
     # Fuse up to K chained decode steps into ONE device program
     # (lax.scan over the step axis): one dispatch + one token fetch per K
     # tokens/seq. The decisive lever when dispatch latency is high
-    # (remote-attached TPUs); trades up to K-1 wasted steps per EOS.
+    # (remote-attached TPUs); trades up to K-1 wasted steps per EOS
+    # unless ondevice_finish is on. Legacy name — decode_chain_len is the
+    # canonical knob and wins when both are set.
     multi_step_decode: int = 1
+    # Canonical fused-chain length (--decode-chain-len): K decode steps
+    # per device dispatch. None defers to multi_step_decode, except that
+    # ondevice_finish (which removes the post-EOS waste that made long
+    # chains risky) raises an unset chain length to 16 — the scheduler's
+    # page-feasibility check still shortens any individual block that
+    # would not fit its page bucket.
+    decode_chain_len: Optional[int] = None
+    # On-device finish detection (--ondevice-finish, fused multi-step
+    # blocks only): the fused scan compares each sampled token against
+    # the row's EOS/stop-token set and folds the result into a carried
+    # alive mask (position frozen, KV writes to the dummy page — the
+    # same freeze machinery length deaths use), and the block driver
+    # early-exits once every row is dead instead of burning the
+    # remaining sub-steps. The precomputed active_until becomes a
+    # conservative upper bound instead of the only death mechanism; the
+    # per-row finish step returns with the token block. Token streams
+    # are byte-identical either way (the host discards post-death
+    # tokens in both modes); off = byte-identical legacy device
+    # programs. docs/overlap_scheduling.md#on-device-finish.
+    ondevice_finish: bool = False
     # Persistent-slot decode batching (--decode-slot-batching, overlap
     # scheduling only): chain membership becomes slot-based, so fused
     # decode chains survive sequence finishes — a finished row is masked
@@ -205,10 +227,30 @@ class EngineConfig:
                     self.multi_step_decode)
             self.overlap_scheduling = False
             self.multi_step_decode = 1
+            self.decode_chain_len = None
+            self.ondevice_finish = False
             self.decode_slot_batching = False
             self.chain_under_prefill = 0
         if self.chain_under_prefill < 0:
             raise ValueError("chain_under_prefill must be >= 0")
+        if self.decode_chain_len is not None:
+            if self.decode_chain_len < 1:
+                raise ValueError("decode_chain_len must be >= 1")
+            self.multi_step_decode = self.decode_chain_len
+        elif (self.ondevice_finish and self.overlap_scheduling
+                and self.multi_step_decode == 1):
+            # with post-EOS waste gone, the conservative single-step
+            # default stops paying for itself — chain 16 steps per
+            # dispatch (page feasibility still bounds each block)
+            self.multi_step_decode = 16
+        if not self.overlap_scheduling and not self.enforce_eager and (
+                self.ondevice_finish or self.decode_chain_len is not None):
+            # same silent-drop class the assigned_layers check guards:
+            # the engine only forms fused chains under overlap scheduling
+            import logging
+            logging.getLogger(__name__).warning(
+                "ondevice_finish/decode_chain_len have no effect without "
+                "overlap_scheduling — fused decode chains never form")
         if self.parallel.assigned_layers is not None \
                 and len(self.parallel.assigned_layers) != self.parallel.pp:
             # catch --assigned-layers with a forgotten/mismatched --pp at

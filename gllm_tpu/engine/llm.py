@@ -86,6 +86,21 @@ _M_CHAIN_BREAKS = obs.counter(
     "gllm_chain_breaks_total",
     "overlap decode-chain breaks by reason "
     "(waiting/pages/shape/spec/finish)", ("reason",))
+# On-device finish detection (config.ondevice_finish,
+# docs/overlap_scheduling.md#on-device-finish): finishes committed from
+# fused blocks whose death the device detected in-loop, by kind, and the
+# per-block wasted-sub-step fraction (dead rows the block still executed
+# — the quantity on-device finish + early exit drives toward 0; with
+# slot batching it also counts hole rows). Under on-device finish the
+# chain_breaks_total{reason="finish"} label is retired: finishes become
+# masked rows, never breaks.
+_M_ONDEV_FINISH = obs.counter(
+    "gllm_ondevice_finish_total",
+    "sequence finishes detected on device inside fused decode blocks",
+    ("kind",))                            # eos | stop | length
+_M_DEAD_FRAC = obs.gauge(
+    "gllm_dead_substep_frac",
+    "wasted (dead-row) sub-step fraction of the latest fused block")
 
 
 @dataclasses.dataclass
@@ -257,6 +272,20 @@ class LLM:
         # Encoder disaggregation (gllm_tpu/disagg/): set by init_disagg on
         # LM nodes; monolith engines leave it None.
         self.disagg_coordinator = None
+
+    @property
+    def eos_token_ids(self) -> frozenset:
+        return self._eos_token_ids
+
+    @eos_token_ids.setter
+    def eos_token_ids(self, ids) -> None:
+        # Mirrored into the runner on every assignment (tests and
+        # embedders set it post-init): on-device finish detection builds
+        # its per-row stop sets from the runner's copy, and the device
+        # and host checks must read the SAME set or a fused block would
+        # freeze rows the host keeps alive.
+        self._eos_token_ids = frozenset(ids)
+        self.runner.eos_token_ids = self._eos_token_ids
 
     def _maybe_init_kvswap(self):
         """Attach the host-RAM KV tier (gllm_tpu/kvswap) when configured
@@ -565,13 +594,19 @@ class LLM:
             self._chain_tip = None
         t0 = time.monotonic()
         tokens, aux = self.runner.collect(handle)
-        self._record_step(batch, t0, t_dispatch)
+        extra = None
+        if isinstance(batch, list) and aux.get("finish") is not None:
+            extra = self._ondevice_block_stats(
+                aux["finish"][0][:batch[0].num_seqs])
+        self._record_step(batch, t0, t_dispatch, extra)
         if isinstance(batch, list):
             # multi-step block: tokens [K, S]; advance K scheduler steps
             outs = []
             for b, row in zip(batch, tokens):
                 outs.extend(self.scheduler.process_output(
                     b, row.tolist(), self.eos_token_ids))
+            if extra is not None:
+                self._count_ondevice_finishes(outs)
             self._check_stop_strings(outs)
             self._observe_outputs(outs)
             return outs
@@ -613,7 +648,34 @@ class LLM:
                      reason=reason)
         _M_CHAIN_BREAKS.inc(reason=reason)
 
-    def _record_step(self, batch, t0: float, t_dispatch: float) -> None:
+    def _ondevice_block_stats(self, finish_step) -> dict:
+        """Host bookkeeping over a fused block's per-row finish steps
+        (runner aux ``finish``): executed sub-steps (the while_loop ran
+        to the latest-finishing row, possibly < the scheduled K — early
+        exit) and dead sub-steps (row frozen but the block still ran).
+        Feeds the gllm_dead_substep_frac gauge and the fused_block
+        steptrace event bench.py aggregates."""
+        k_exec = int(finish_step.max()) if finish_step.size else 0
+        dead = int((k_exec - finish_step).sum())
+        if k_exec and finish_step.size:
+            _M_DEAD_FRAC.set(dead / (k_exec * finish_step.size))
+        return {"k_exec": k_exec, "dead_substeps": dead}
+
+    def _count_ondevice_finishes(self, outs) -> None:
+        """gllm_ondevice_finish_total{kind}: finishes that committed out
+        of an on-device-finish fused block, classified the way the device
+        saw them (stop-string finishes come later, from host scanning)."""
+        for out in outs:
+            if out.finish_reason == "length":
+                _M_ONDEV_FINISH.inc(kind="length")
+            elif out.finish_reason == "stop":
+                sp = out.seq.sampling_params
+                eos = (not sp.ignore_eos
+                       and out.new_token_id in self.eos_token_ids)
+                _M_ONDEV_FINISH.inc(kind="eos" if eos else "stop")
+
+    def _record_step(self, batch, t0: float, t_dispatch: float,
+                     extra: Optional[dict] = None) -> None:
         """Step-kind attribution for one collected engine iteration:
         latency/RTT histograms, per-kind counters, one steptrace event.
         Host wall clock only — the handle was already collected."""
@@ -641,6 +703,8 @@ class LLM:
                   rtt_ms=round((now - t_dispatch) * 1e3, 3))
         if fused:
             ev["k"] = len(batch)
+        if extra:
+            ev.update(extra)
         TRACE.record(kind, **ev)
         timer = self._step_timer
         if timer is not None:

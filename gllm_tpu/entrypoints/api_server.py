@@ -610,6 +610,8 @@ def build_engine_config(args) -> EngineConfig:
         overlap_scheduling=args.overlap_scheduling,
         decode_slot_batching=args.decode_slot_batching,
         chain_under_prefill=args.chain_under_prefill,
+        decode_chain_len=args.decode_chain_len,
+        ondevice_finish=args.ondevice_finish,
         spec_decode=args.spec_decode,
         spec_k=args.spec_k,
         spec_ngram=args.spec_ngram,
@@ -721,6 +723,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "steps before yielding one sync pass to prefill; "
                         "0 = legacy, any waiting arrival unfuses every "
                         "step until the queue drains")
+    p.add_argument("--decode-chain-len", type=int, default=None,
+                   help="fused decode chain length: K decode steps per "
+                        "device dispatch (needs --overlap-scheduling); "
+                        "default 1, or 16 with --ondevice-finish")
+    p.add_argument("--ondevice-finish", action="store_true",
+                   help="detect EOS/stop-token finishes INSIDE fused "
+                        "decode blocks (carried alive mask + early block "
+                        "exit) instead of burning dead sub-steps until "
+                        "the host notices; token streams are identical "
+                        "(docs/overlap_scheduling.md)")
     p.add_argument("--spec-decode", default=None, choices=["ngram"],
                    help="prompt-lookup speculative decoding: verify up to "
                         "--spec-k n-gram drafts per decode step (greedy "

@@ -129,6 +129,10 @@ def summarize(events: List[dict]) -> dict:
     total_ms = 0.0
     compiles = chain_breaks = 0
     break_reasons: Dict[str, int] = {}
+    # on-device finish attribution (fused_block events carry k_exec /
+    # dead_substeps when config.ondevice_finish is on): wasted sub-step
+    # share of all executed row-sub-steps over the window
+    dead_rows = exec_rows = 0
     for e in events:
         k = e["kind"]
         if k == "compile":
@@ -154,6 +158,10 @@ def summarize(events: List[dict]) -> dict:
         elif k == "fused_block":
             fused_steps += int(e.get("k", 1))
             fused_ms += wall
+            if "dead_substeps" in e:
+                dead_rows += int(e["dead_substeps"])
+                exec_rows += (int(e.get("k_exec", e.get("k", 1)))
+                              * int(e.get("num_seqs", 0)))
     for row in kinds.values():
         row["wall_ms"] = round(row["wall_ms"], 2)
         row["ms_per_step"] = round(row["wall_ms"] / row["steps"], 2)
@@ -168,6 +176,10 @@ def summarize(events: List[dict]) -> dict:
         # the regression class bench.py promotes to its result JSON
         "unfused_frac": (round(unfused_ms / total_ms, 4)
                          if total_ms else None),
+        # wasted (dead-row) sub-step share of executed fused-block work;
+        # None when no block reported finish steps (ondevice_finish off)
+        "dead_substep_frac": (round(dead_rows / exec_rows, 4)
+                              if exec_rows else None),
         "compiles": compiles,
         "chain_breaks": chain_breaks,
         "chain_breaks_by_reason": break_reasons,

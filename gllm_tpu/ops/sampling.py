@@ -45,6 +45,14 @@ class SamplingMetadata(NamedTuple):
     # no mask array is needed.
     bias_ids: Optional[jnp.ndarray] = None   # [S, B] i32
     bias_vals: Optional[jnp.ndarray] = None  # [S, B] f32
+    # On-device finish detection (fused multi-step decode only): per-row
+    # EOS + stop-token-id sets, padded to a fixed pow2 bucket with -1
+    # (never equal to a sampled id ≥ 0), and the sub-step index from
+    # which the check is armed (min_tokens gating — a stop hit before
+    # this sub-step is ignored, matching Sequence.check_finish). None on
+    # every other path — these fields never enter single-step programs.
+    stop_ids: Optional[jnp.ndarray] = None   # [S, E] i32, -1 padding
+    stop_from: Optional[jnp.ndarray] = None  # [S] i32
 
 
 class PenaltyTokens(NamedTuple):
@@ -441,6 +449,24 @@ def spec_verify(logits_mat: jnp.ndarray, drafts: jnp.ndarray,
     accept = jnp.cumprod(ok.astype(jnp.int32), axis=-1).sum(axis=-1)
     tok_mat = jnp.where(greedy_rows[:, None], greedy_mat, tok_sampled)
     return tok_mat, accept
+
+
+def stop_token_hit(tokens: jnp.ndarray, md: "SamplingMetadata",
+                   sub_step) -> jnp.ndarray:
+    """[S] bool — did row s's sampled token land in its stop set?
+
+    The on-device half of ``Sequence.check_finish``: ``tokens`` [S] are
+    this sub-step's sampled ids, ``md.stop_ids`` [S, E] the padded
+    per-row EOS/stop-token sets (-1 padding never matches an id >= 0),
+    and ``md.stop_from`` the per-row arming sub-step (min_tokens gate).
+    Rows with an empty set (all -1) never hit. Returns all-False when
+    the batch carries no stop sets at all."""
+    if md.stop_ids is None:
+        return jnp.zeros(tokens.shape, bool)
+    hit = (tokens[:, None] == md.stop_ids).any(axis=-1)
+    if md.stop_from is not None:
+        hit = hit & (sub_step >= md.stop_from)
+    return hit
 
 
 def compute_logprobs(logits: jnp.ndarray, token_ids: jnp.ndarray,

@@ -157,6 +157,44 @@ class BatchBuilder:
         mask[np.asarray(host_rows, np.int64)] = True
         return mask
 
+    def stop_sets(self, items, s_bucket: int, eos_token_ids):
+        """On-device finish detection inputs for a fused multi-step
+        block: ([S, E] padded per-row EOS/stop-token-id sets, [S] arming
+        sub-step) for ``SamplingMetadata.stop_ids`` / ``stop_from``.
+
+        ``items`` are the chain's FIRST batch items (their
+        computed_before anchors the output-token indexing: the token
+        committed by sub-step k is output number
+        ``computed_before + k + 2 - prompt_len``, so min_tokens arms the
+        check from sub-step ``min_tokens + prompt_len - computed_before
+        - 2``). The id bucket E is pow2 (min 8) so the jit signature
+        stays bounded; -1 padding never matches a sampled id. Returns
+        (None, None) when no row carries any stop id (e.g. ignore_eos
+        benchmarks) — the device program then skips the compare and
+        on-device deaths come only from the active_until length bound.
+        """
+        from gllm_tpu.sequence import HOLE_SEQ_ID
+        from gllm_tpu.utils import next_pow2
+        # HOLE rows (persistent-slot mode) are dead for the whole block
+        # (alive count 0) — they must never contribute ids, or a finish
+        # in an all-ignore_eos workload would widen the id bucket and
+        # force a mid-run recompile
+        sets = [([] if it.seq.seq_id == HOLE_SEQ_ID
+                 else it.seq.device_stop_ids(eos_token_ids))
+                for it in items]
+        if not any(sets):
+            return None, None
+        E = max(8, next_pow2(max(len(s) for s in sets)))
+        stop_ids = np.full((s_bucket, E), -1, np.int32)
+        stop_from = np.zeros(s_bucket, np.int32)
+        for i, (it, ids) in enumerate(zip(items, sets)):
+            stop_ids[i, :len(ids)] = ids
+            mt = it.seq.sampling_params.min_tokens
+            if mt:
+                stop_from[i] = max(0, mt + it.seq.prompt_len
+                                   - it.computed_before - 2)
+        return stop_ids, stop_from
+
     @staticmethod
     def penalty_len_bucket(lens) -> int:
         """Shared penalty id-list length bucket (build + dp wrapper must

@@ -152,6 +152,18 @@ def reset_page_scales_replica(k_scale, v_scale, r, pages):
             v_scale.at[r, :, pages].set(0.0))
 
 
+@functools.partial(jax.jit, static_argnames=("k",))
+def _fold_in_range(key, start, *, k: int):
+    """[k] per-sub-step keys for a fused decode block:
+    fold_in(key, start + i) for i in range(k), as ONE device program. The
+    host-loop ``jnp.stack([fold_in(...) for i])`` form this replaces paid
+    K eager dispatches per block; the vmapped fold_in is bit-identical
+    (fold_in folds the integer in as data, traced or not) and keeps
+    working as chain lengths grow."""
+    steps = start + jnp.arange(k, dtype=jnp.uint32)
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(steps)
+
+
 def pallas_tp_ok(cfg: ModelConfig, tp: int) -> bool:
     """Can the Pallas attention run tp-sharded for this model? Only the
     head-count split over tp must divide (dp>1 runs the kernels per
@@ -287,6 +299,11 @@ class ModelRunner:
             from gllm_tpu.utils import LRUBytesCache
             self._mm_cache = LRUBytesCache()
         self.rng_key = jax.random.key(config.seed)
+        # Effective EOS set for ON-DEVICE finish detection in fused
+        # blocks (config.ondevice_finish). Seeded from the checkpoint
+        # config; the engine overwrites it with its tokenizer-resolved
+        # set so device and host finish checks can never diverge.
+        self.eos_token_ids = frozenset(model_cfg.eos_token_ids)
         self._step_count = 0
         # (shape-bucket, static-flag) signatures already dispatched —
         # first sightings count as compile events (obs layer)
@@ -1131,10 +1148,9 @@ class ModelRunner:
         self._apply_swap_intents()
         # per-sub-step keys matching the single-step schedule exactly
         # (fold_in of consecutive step counts) → byte-identical sampling
-        # across multi/single scheduling modes
-        keys = jnp.stack([
-            jax.random.fold_in(self.rng_key, self._step_count + 1 + i)
-            for i in range(K)])
+        # across multi/single scheduling modes; one vmapped program, not
+        # K eager fold_in dispatches
+        keys = _fold_in_range(self.rng_key, self._step_count + 1, k=K)
         self._step_count += K
         # pages allocated by the chained schedules must fit the page
         # bucket → size the signature from the LAST step's state
@@ -1156,18 +1172,34 @@ class ModelRunner:
             au_np[:n] = chain[0].active_until
         else:
             au_np[:n] = K
+        odf = self.config.ondevice_finish
+        e_bucket = 0
+        if odf:
+            # on-device EOS/stop-token detection: thread the per-row
+            # stop sets into the block's sampling metadata; active_until
+            # stays as the (length-exact, EOS-conservative) upper bound
+            stop_ids, stop_from = self.builder.stop_sets(
+                chain[0].items, s_bucket, self.eos_token_ids)
+            if stop_ids is not None:
+                e_bucket = stop_ids.shape[1]
+                batch = batch._replace(sampling=batch.sampling._replace(
+                    stop_ids=jnp.asarray(stop_ids),
+                    stop_from=jnp.asarray(stop_from)))
         all_greedy = _all_greedy(chain[0].items)
         self._note_kv_read(chain[0].items, steps=K)
-        self._note_dispatch("multi_step", batch, (K, all_greedy),
-                            all_greedy)
+        # e_bucket is part of the compile signature: stop-set presence
+        # changes the pytree structure and its pow2 width E the shapes
+        self._note_dispatch("multi_step", batch,
+                            (K, all_greedy, odf, e_bucket), all_greedy)
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
-            tokens, self.kv = self._multi_step_fn(
+            tokens, finish_step, self.kv = self._multi_step_fn(
                 self.params, self.kv, batch, self.cos_sin, keys,
                 jnp.asarray(au_np), num_steps=K,
-                all_greedy=all_greedy)
-        _start_host_copy(tokens)
-        return tokens, {}, chain[0].num_seqs
+                all_greedy=all_greedy, ondevice_finish=odf)
+        aux = {"finish": (finish_step,)} if finish_step is not None else {}
+        _start_host_copy((tokens, aux))
+        return tokens, aux, chain[0].num_seqs
 
     def _build_multi_step_fn(self):
         cfg = self.model_cfg
@@ -1177,22 +1209,24 @@ class ModelRunner:
         page = self.config.cache.page_size
 
         @functools.partial(jax.jit, static_argnames=("num_steps",
-                                                     "all_greedy"),
+                                                     "all_greedy",
+                                                     "ondevice_finish"),
                            compiler_options=tpu_compiler_options(),
                            donate_argnums=(1,))
         def step_multi(params, kv, batch: StepBatch, cos_sin, keys,
                        active_until, *, num_steps: int,
-                       all_greedy: bool = False):
-            def body(carry, xs):
-                k, key = xs
-                kv, tokens = carry
-                # rows whose seq died (length cap) earlier in the block
-                # freeze: position stops advancing (stays in-bounds of
-                # the page bucket) and KV writes land in the dummy page
-                # (slot 0) so a finished seq's — possibly prefix-cached —
-                # pages are never clobbered by its dead steps
-                adv = jnp.minimum(k, active_until)
-                alive = k < active_until
+                       all_greedy: bool = False,
+                       ondevice_finish: bool = False):
+            def substep(kv, tokens, alive_n, k, key):
+                # rows whose seq died earlier in the block (length cap
+                # via active_until; EOS/stop via the carried alive count
+                # under ondevice_finish) freeze: position stops advancing
+                # (stays in-bounds of the page bucket) and KV writes land
+                # in the dummy page (slot 0) so a finished seq's —
+                # possibly prefix-cached — pages are never clobbered by
+                # its dead steps
+                adv = jnp.minimum(k, alive_n)
+                alive = k < alive_n
                 pos = batch.positions + adv
                 # decode rows: one token per seq; recompute flat KV slots
                 # from the (pre-allocated) page table as positions advance
@@ -1228,12 +1262,58 @@ class ModelRunner:
                 logits = logits_fn(params, hidden, residual, b, cfg)
                 toks = sample(logits, b.sampling, None,
                               all_greedy=all_greedy)
-                return (kv, toks), toks
+                return kv, toks
 
-            (kv, _), all_tokens = jax.lax.scan(
-                body, (kv, batch.token_ids),
-                (jnp.arange(num_steps, dtype=jnp.int32), keys))
-            return all_tokens, kv                        # [K, S]
+            if not ondevice_finish:
+                # legacy block: fixed-trip scan, active_until is the ONLY
+                # death mechanism (byte-identical pre-ondevice program)
+                def body(carry, xs):
+                    k, key = xs
+                    kv, tokens = carry
+                    kv, toks = substep(kv, tokens, active_until, k, key)
+                    return (kv, toks), toks
+
+                (kv, _), all_tokens = jax.lax.scan(
+                    body, (kv, batch.token_ids),
+                    (jnp.arange(num_steps, dtype=jnp.int32), keys))
+                return all_tokens, None, kv              # [K, S]
+
+            # On-device finish: the block driver is a while_loop over
+            # sub-steps whose carried per-row alive count starts at the
+            # active_until upper bound and DROPS when a sampled token
+            # hits the row's EOS/stop set — the row freezes from the next
+            # sub-step (same dummy-page machinery), and once every row is
+            # dead the loop exits instead of burning the remaining
+            # sub-steps. Sub-step k's tokens land at out[k]; rows beyond
+            # a row's finish step hold garbage the host discards (legacy
+            # did too — its garbage just cost real forward work).
+            from gllm_tpu.ops.sampling import stop_token_hit
+
+            out0 = jnp.zeros((num_steps,) + batch.token_ids.shape,
+                             jnp.int32)
+
+            def cond(carry):
+                _, _, _, alive_n, k = carry
+                return (k < num_steps) & jnp.any(alive_n > k)
+
+            def wbody(carry):
+                kv, tokens, out, alive_n, k = carry
+                kv, toks = substep(kv, tokens, alive_n, k, keys[k])
+                # a live row whose token hits its stop set (past the
+                # min_tokens arming step) keeps this token and dies:
+                # finish step = k + 1. Dead rows' garbage tokens must
+                # not re-arm anything — gate on alive.
+                hit = (stop_token_hit(toks, batch.sampling, k)
+                       & (k < alive_n))
+                alive_n = jnp.where(hit, k + 1, alive_n)
+                out = jax.lax.dynamic_update_index_in_dim(out, toks, k, 0)
+                return kv, toks, out, alive_n, k + 1
+
+            kv, _, all_tokens, alive_n, _ = jax.lax.while_loop(
+                cond, wbody,
+                (kv, batch.token_ids, out0, active_until, jnp.int32(0)))
+            # [K, S] tokens + per-row finish step (== K for survivors)
+            return all_tokens, jnp.minimum(alive_n, num_steps), kv
 
         return step_multi
 

@@ -131,6 +131,12 @@ class ScheduledBatch:
     # Persistent-slot mode extends this across block boundaries: a HOLE
     # row (finished seq's slot, sequence.HOLE_SEQ_ID sentinel) carries
     # active_until 0 — dead for the whole block.
+    # Under ON-DEVICE finish (config.ondevice_finish) this is a
+    # conservative UPPER bound, not the only death mechanism: length
+    # deaths it encodes exactly, while EOS/stop-token deaths — which
+    # the host cannot know at schedule time — lower the device's
+    # carried alive count in-loop (runner step_multi), and the block
+    # early-exits once every row is dead.
     active_until: Optional[List[int]] = None
     # Persistent-slot mode: row indices whose link-0 input token must be
     # taken from the HOST-built batch instead of the previous step's

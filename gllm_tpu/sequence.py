@@ -44,7 +44,12 @@ def make_hole_seq() -> "Sequence":
     with ``process_output``'s decrements, and nothing else ever reads it —
     it is never in ``running``/``waiting`` and owns no allocator pages."""
     from gllm_tpu.sampling_params import SamplingParams as _SP
-    seq = Sequence(HOLE_SEQ_ID, [0], _SP(temperature=0.0, max_tokens=1))
+    # ignore_eos: a hole can never finish (it is already dead), so it
+    # must contribute NOTHING to on-device stop sets — otherwise the
+    # first hole in an all-ignore_eos workload would flip the fused
+    # block's stop-set compile signature mid-run
+    seq = Sequence(HOLE_SEQ_ID, [0], _SP(temperature=0.0, max_tokens=1,
+                                         ignore_eos=True))
     seq.status = SequenceStatus.FINISHED
     # looks post-prefill so hole rows count as decode (step-kind metrics)
     seq.num_computed_tokens = 1
@@ -183,6 +188,20 @@ class Sequence:
         self.swap_host_pages = list(host_pages)
         self.page_table = []
         self._pt_np = None
+
+    def device_stop_ids(self, eos_token_ids) -> List[int]:
+        """The token ids whose sampling finishes this sequence, as seen
+        by ON-DEVICE finish detection (fused multi-step blocks): the
+        engine's EOS set (unless ignore_eos) plus the request's
+        stop_token_ids — exactly the membership tests check_finish runs
+        host-side. Sorted so the padded device rows are deterministic.
+        The min_tokens gate is positional, not id-based; the batch
+        builder arms it separately (SamplingMetadata.stop_from)."""
+        sp = self.sampling_params
+        ids = set(sp.stop_token_ids)
+        if not sp.ignore_eos and eos_token_ids:
+            ids.update(int(t) for t in eos_token_ids)
+        return sorted(ids)
 
     def check_finish(self, eos_token_ids) -> Optional[str]:
         """EOS / stop-token / length check after a token was appended.
