@@ -199,6 +199,34 @@ class EngineConfig:
     # loads are local-path-only unless explicitly opted in.
     allow_hub_download: bool = False
     attention_impl: str = "auto"          # auto | pallas | xla
+    # ---- request-lifecycle robustness (docs/robustness.md) ----
+    # Admission control: cap the serving engine's intake queue and the
+    # number of resident (handle-open) requests; over-limit submits are
+    # rejected (HTTP 429 with Retry-After) instead of queueing without
+    # bound. 0 = unbounded (legacy).
+    max_queued_requests: int = 0
+    max_resident_requests: int = 0
+    # Default per-request wall-clock TTL in seconds: a request still
+    # waiting or still generating this long after submit is aborted with
+    # finish reason "deadline". Per-request SamplingParams.deadline_s /
+    # submit(deadline_s=...) override. 0 = no TTL (legacy).
+    request_deadline_s: float = 0.0
+    # Consecutive failed engine steps before the serving engine latches
+    # "unhealthy" (readiness 503, admission closed; liveness stays up).
+    # Individual failures only quarantine their own batch.
+    max_step_failures: int = 3
+    # Watchdog: flip readiness while the engine-thread heartbeat is
+    # older than this many seconds (a hung device dispatch blocks the
+    # loop inside collect). Must exceed the longest legitimate blocking
+    # operation (first-dispatch XLA compiles!). 0 = watchdog off.
+    watchdog_stall_s: float = 0.0
+    # shutdown(drain=True): how long to wait for in-flight requests
+    # before aborting them with terminal chunks.
+    drain_timeout_s: float = 5.0
+    # Deterministic fault injection spec (gllm_tpu/faults.py grammar:
+    # "point[:after_n[:count]][,...]"), armed when the serving engine
+    # starts; also armable via GLLM_FAULT_INJECT. Empty = disarmed.
+    fault_inject: str = ""
     # Disagg LM nodes: drop the vision tower from params after load —
     # visual embeddings arrive from the encoder fleet (reference
     # DisaggConfig.skip_visual). The engine can then only serve disagg
@@ -297,6 +325,17 @@ class EngineConfig:
             raise ValueError(
                 "sp (sequence parallelism) composes with tp only; "
                 "set pp = dp = 1")
+        if self.max_queued_requests < 0 or self.max_resident_requests < 0:
+            raise ValueError("admission limits must be >= 0 (0 = off)")
+        if self.request_deadline_s < 0 or self.watchdog_stall_s < 0 \
+                or self.drain_timeout_s < 0:
+            raise ValueError("robustness timeouts must be >= 0")
+        if self.max_step_failures < 1:
+            raise ValueError("max_step_failures must be >= 1")
+        if self.fault_inject:
+            # fail fast on a bad spec instead of at first fire
+            from gllm_tpu.faults import FaultInjector
+            FaultInjector().arm(self.fault_inject)
         if self.cache.swap_policy not in ("auto", "swap", "recompute"):
             raise ValueError(
                 f"unknown swap_policy {self.cache.swap_policy!r} "

@@ -22,6 +22,7 @@ import sys
 import time
 from typing import Callable, List, Optional, Sequence as Seq, Union
 
+from gllm_tpu import faults
 from gllm_tpu.config import EngineConfig
 from gllm_tpu.memory_manager import make_memory_manager
 from gllm_tpu.models.config import ModelConfig, from_hf_config
@@ -586,6 +587,12 @@ class LLM:
                 # gate-B-blocked seqs park in waiting; don't spin hot
                 time.sleep(0.002)
             return []
+        # Fault points (gllm_tpu/faults.py, docs/robustness.md): fired
+        # BEFORE the in-flight pop so quarantine_step_failure still sees
+        # the batch it must attribute the failure to; the stall mimics a
+        # hung device dispatch blocking the loop inside collect.
+        faults.FAULTS.maybe_stall("dispatch_stall")
+        faults.FAULTS.maybe_raise("step_exception")
         batch, handle, t_dispatch = self._in_flight.popleft()
         if not self._in_flight:
             # pipeline drained: the tip (this very batch, or older) is
@@ -786,6 +793,8 @@ class LLM:
         batches = [s.schedule_once() for s in self.schedulers]
         if all(b is None for b in batches):
             return []
+        faults.FAULTS.maybe_stall("dispatch_stall")
+        faults.FAULTS.maybe_raise("step_exception")
         t_dispatch = time.monotonic()
         handle = self.runner.step_async_dp(batches)
         t0 = time.monotonic()
@@ -1193,3 +1202,57 @@ class LLM:
             self.disagg_coordinator.abort([seq_id])
         r = self._seq_replica.pop(seq_id, 0)
         self.schedulers[r].abort_seq(seq_id)
+
+    # ---- fault isolation ---------------------------------------------------
+
+    def quarantine_step_failure(self, everything: bool = False
+                                ) -> List[int]:
+        """Roll the engine back to a consistent state after ``step()``
+        raised (docs/robustness.md).
+
+        The dispatched-but-uncollected batches in ``_in_flight`` are the
+        failure's blast radius: their device state is unknown, so their
+        sequences are dropped wholesale (pages freed, status ABORTED,
+        in-flight counts zeroed) while everything else — the waiting
+        queue, running sequences not in a failed dispatch — survives and
+        reschedules. When the exception fired before any dispatch (no
+        in-flight entries), the running set is the suspect: re-scheduling
+        it would retry the identical failing step forever, which is
+        exactly the hot-retry loop this path removes. ``everything=True``
+        (unhealthy escalation / shutdown) additionally drops the waiting
+        queue. Returns the dropped seq ids so the serving engine can
+        deliver terminal error chunks."""
+        from gllm_tpu.sequence import HOLE_SEQ_ID
+        failed: set = set()
+        for entry in self._in_flight:
+            batch = entry[0]
+            for b in (batch if isinstance(batch, list) else [batch]):
+                for it in b.items:
+                    if it.seq.seq_id != HOLE_SEQ_ID:
+                        failed.add(it.seq.seq_id)
+        self._in_flight.clear()
+        self._chain_tip = None
+        self._chained_under_pressure = 0
+        self._yield_noted = False
+        if everything:
+            for s in self.schedulers:
+                failed.update(x.seq_id for x in s.running)
+                failed.update(x.seq_id for x in s.waiting)
+        elif not failed:
+            for s in self.schedulers:
+                failed.update(x.seq_id for x in s.running)
+        if self.swap_manager is not None:
+            # queued transfer intents may reference pages the quarantine
+            # frees — drop them first (swap-outs revert to recompute)
+            self.swap_manager.quarantine()
+        for s in self.schedulers:
+            s.quarantine(failed)
+        for sid in failed:
+            self._seq_replica.pop(sid, None)
+        if self.disagg_coordinator is not None and failed:
+            try:
+                self.disagg_coordinator.abort(sorted(failed))
+            except Exception:
+                logger.exception("disagg abort during quarantine failed")
+        TRACE.record("quarantine", num_seqs=len(failed))
+        return sorted(failed)

@@ -8,6 +8,31 @@ batching loop; HTTP handler threads submit requests through a thread-safe
 queue and block on per-sequence output queues (SSE streams one queue item
 per token). Client disconnects abort the sequence mid-flight, matching the
 reference's disconnect→abort propagation.
+
+Request-lifecycle robustness (docs/robustness.md): the reference survives
+faults by process supervision — a crashed worker is restarted from
+outside. A single-controller engine must survive them in-process instead:
+
+- **admission control**: bounded intake queue + max-resident-requests;
+  over-limit submits raise :class:`RequestRejected` (HTTP 429/503 with
+  Retry-After in api_server) instead of growing an unbounded queue.
+- **deadlines**: per-request wall-clock budgets (``SamplingParams.
+  deadline_s`` / submit kwarg / ``config.request_deadline_s`` TTL) abort
+  requests stuck in the waiting queue or overrunning, with a terminal
+  ``deadline`` chunk.
+- **fault isolation**: a step exception quarantines only the scheduled
+  batch (``LLM.quarantine_step_failure``) — those requests get terminal
+  error chunks, everything else reschedules, and the engine returns to
+  idle instead of hot-retrying the failed step forever. N consecutive
+  failures escalate to a latched unhealthy state (readiness 503,
+  admission closed, liveness still up).
+- **watchdog**: the engine thread updates a heartbeat every loop pass; a
+  watchdog thread flips readiness while the heartbeat is stale (a hung
+  device dispatch blocks the loop inside collect) and restores it on
+  recovery.
+- **graceful drain**: ``shutdown(drain=True)`` stops admitting, lets
+  in-flight requests finish (bounded), then closes every open handle
+  with a terminal chunk before joining — no client blocks forever.
 """
 
 from __future__ import annotations
@@ -19,8 +44,10 @@ import threading
 import time
 from typing import List, Optional
 
+from gllm_tpu import faults
 from gllm_tpu.engine.llm import LLM
 from gllm_tpu.obs import metrics as obs
+from gllm_tpu.obs.steptrace import TRACE
 from gllm_tpu.sampling_params import SamplingParams
 
 logger = logging.getLogger(__name__)
@@ -31,6 +58,35 @@ _M_ACTIVE = obs.gauge("gllm_requests_active",
                       "requests with an open output stream")
 _M_ABORTED = obs.counter("gllm_requests_aborted_total",
                          "requests aborted (client disconnect or error)")
+_M_REJECTED = obs.counter(
+    "gllm_requests_rejected_total",
+    "submits rejected by admission control, by reason "
+    "(queue_full/resident_limit/unhealthy/draining)", ("reason",))
+_M_DEADLINE = obs.counter(
+    "gllm_request_deadline_exceeded_total",
+    "requests aborted because their wall-clock deadline/TTL expired")
+_M_STEP_FAIL = obs.counter(
+    "gllm_engine_step_failures_total",
+    "engine iterations that raised (each quarantines its batch)")
+_M_HEALTHY = obs.gauge(
+    "gllm_engine_healthy",
+    "1 while the engine accepts work; 0 after the unhealthy latch")
+_M_HB_AGE = obs.gauge(
+    "gllm_engine_heartbeat_age_seconds",
+    "age of the engine thread's last loop-iteration heartbeat")
+
+
+class RequestRejected(Exception):
+    """Admission control refused a submit. ``status`` is the HTTP code
+    the api_server maps it to (429 over-capacity, 503 unavailable) and
+    ``retry_after`` the Retry-After hint in seconds."""
+
+    def __init__(self, reason: str, message: str, status: int = 429,
+                 retry_after: float = 1.0):
+        super().__init__(message)
+        self.reason = reason
+        self.status = status
+        self.retry_after = retry_after
 
 
 @dataclasses.dataclass
@@ -49,17 +105,42 @@ class StreamChunk:
     # authoritative full output text on the finishing chunk (stop-string
     # truncation may shorten it relative to the streamed deltas)
     final_text: Optional[str] = None
+    # terminal failure detail (quarantine / shutdown / engine death) —
+    # the finish_reason says what class of end this is, error says why
+    error: Optional[str] = None
 
 
 class RequestHandle:
-    def __init__(self, seq_id: int, prompt_len: int):
+    # liveness poll interval for the bounded get below
+    POLL_S = 0.5
+
+    def __init__(self, seq_id: int, prompt_len: int, engine=None):
         self.seq_id = seq_id
         self.prompt_len = prompt_len
         self.chunks: "queue.Queue[StreamChunk]" = queue.Queue()
+        # when set, __iter__ polls engine liveness instead of blocking
+        # forever on a queue a dead engine thread will never feed
+        self._engine = engine
 
     def __iter__(self):
         while True:
-            chunk = self.chunks.get()
+            if self._engine is None:
+                chunk = self.chunks.get()
+            else:
+                try:
+                    chunk = self.chunks.get(timeout=self.POLL_S)
+                except queue.Empty:
+                    if not self._engine.is_alive:
+                        # drain anything that raced in before declaring
+                        # the stream dead
+                        try:
+                            chunk = self.chunks.get_nowait()
+                        except queue.Empty:
+                            yield StreamChunk(None, "", "error",
+                                              error="engine thread died")
+                            return
+                    else:
+                        continue
             yield chunk
             if chunk.finish_reason is not None:
                 return
@@ -103,27 +184,147 @@ def deliver_output(llm: LLM, out, handle: RequestHandle,
 class ServingEngine:
     """Owns the LLM on a dedicated thread; thread-safe submit/abort."""
 
-    def __init__(self, llm: LLM):
+    def __init__(self, llm: LLM, *,
+                 max_queued_requests: Optional[int] = None,
+                 max_resident_requests: Optional[int] = None,
+                 request_deadline_s: Optional[float] = None,
+                 max_step_failures: Optional[int] = None,
+                 watchdog_stall_s: Optional[float] = None,
+                 drain_timeout_s: Optional[float] = None):
         self.llm = llm
+        cfg = getattr(llm, "config", None)
+
+        def knob(override, name, default):
+            if override is not None:
+                return override
+            return getattr(cfg, name, default) if cfg is not None \
+                else default
+
+        # 0 = unbounded/disabled (byte-identical legacy behavior)
+        self.max_queued_requests = knob(max_queued_requests,
+                                        "max_queued_requests", 0)
+        self.max_resident_requests = knob(max_resident_requests,
+                                          "max_resident_requests", 0)
+        self.request_deadline_s = knob(request_deadline_s,
+                                       "request_deadline_s", 0.0)
+        self.max_step_failures = max(1, knob(max_step_failures,
+                                             "max_step_failures", 3))
+        self.watchdog_stall_s = knob(watchdog_stall_s,
+                                     "watchdog_stall_s", 0.0)
+        self.drain_timeout_s = knob(drain_timeout_s, "drain_timeout_s",
+                                    5.0)
+        if cfg is not None and getattr(cfg, "fault_inject", ""):
+            faults.FAULTS.arm(cfg.fault_inject)
+
         self._intake: "queue.Queue" = queue.Queue()
         self._handles: dict[int, RequestHandle] = {}
         self._seqs: dict[int, object] = {}
         self._emitted: dict[int, int] = {}   # seq_id → chars streamed
+        self._deadlines: dict[int, float] = {}  # seq_id → abs monotonic
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
+        self._draining = False
+        self._healthy = True
+        self._stalled = False
+        self._failed_steps = 0          # consecutive; reset on success
+        self._heartbeat = time.monotonic()
+        _M_HEALTHY.set(1)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="gllm-engine")
         self._thread.start()
+        self._watchdog: Optional[threading.Thread] = None
+        if self.watchdog_stall_s > 0:
+            self._watchdog = threading.Thread(target=self._watch,
+                                              daemon=True,
+                                              name="gllm-watchdog")
+            self._watchdog.start()
+
+    # ---- health / readiness (any thread) -----------------------------------
+
+    @property
+    def is_alive(self) -> bool:
+        """Liveness: the engine thread is running (/healthz)."""
+        return self._thread.is_alive() and not self._stop
+
+    @property
+    def heartbeat_age(self) -> float:
+        return time.monotonic() - self._heartbeat
+
+    def readiness(self) -> tuple:
+        """(ready, reason) — admission-facing readiness (/readyz). An
+        unready engine still serves liveness: a load balancer drains it,
+        the supervisor does not kill it unless /healthz also fails."""
+        if not self.is_alive:
+            return False, "dead"
+        if not self._healthy:
+            return False, "unhealthy"
+        if self._draining:
+            return False, "draining"
+        if self._stalled:
+            return False, "stalled"
+        return True, "ok"
+
+    def health(self) -> dict:
+        age = self.heartbeat_age
+        _M_HB_AGE.set(age)
+        ready, why = self.readiness()
+        with self._lock:
+            resident = len(self._handles)
+        return {"alive": self.is_alive, "ready": ready, "reason": why,
+                "healthy": self._healthy, "draining": self._draining,
+                "stalled": self._stalled,
+                "heartbeat_age_s": round(age, 3),
+                "consecutive_step_failures": self._failed_steps,
+                "resident_requests": resident,
+                "queued_requests": self._intake.qsize()}
 
     # ---- client-facing (any thread) ---------------------------------------
+
+    def _admit(self) -> None:
+        """Admission control; raises RequestRejected instead of letting
+        the intake queue grow without bound. Limits of 0 = legacy
+        unbounded behavior."""
+        if faults.FAULTS.fire("intake_burst"):
+            _M_REJECTED.inc(reason="queue_full")
+            raise RequestRejected(
+                "queue_full", "intake queue full (injected burst)",
+                status=429, retry_after=1.0)
+        if not self._healthy:
+            _M_REJECTED.inc(reason="unhealthy")
+            raise RequestRejected(
+                "unhealthy", "engine is unhealthy (latched after "
+                "repeated step failures)", status=503, retry_after=30.0)
+        if self._draining or self._stop:
+            _M_REJECTED.inc(reason="draining")
+            raise RequestRejected("draining", "engine is shutting down",
+                                  status=503, retry_after=5.0)
+        if self.max_resident_requests:
+            with self._lock:
+                resident = len(self._handles)
+            if resident >= self.max_resident_requests:
+                _M_REJECTED.inc(reason="resident_limit")
+                raise RequestRejected(
+                    "resident_limit",
+                    f"{resident} requests resident (limit "
+                    f"{self.max_resident_requests})",
+                    status=429, retry_after=1.0)
+        if self.max_queued_requests \
+                and self._intake.qsize() >= self.max_queued_requests:
+            _M_REJECTED.inc(reason="queue_full")
+            raise RequestRejected(
+                "queue_full",
+                f"intake queue full (limit {self.max_queued_requests})",
+                status=429, retry_after=1.0)
 
     def submit(self, token_ids: List[int],
                sampling_params: SamplingParams,
                mm_input: Optional[dict] = None,
                disagg_items: Optional[list] = None,
-               target_dp: Optional[int] = None) -> RequestHandle:
+               target_dp: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> RequestHandle:
         sampling_params.validate()
+        self._admit()
         mm_state = None
         if mm_input:
             # Hashing + position building over full pixel arrays is
@@ -132,6 +333,10 @@ class ServingEngine:
             from gllm_tpu.engine.mm import build_mm_state
             mm_state = build_mm_state(token_ids, self.llm.model_cfg,
                                       **mm_input)
+        ttl = (deadline_s if deadline_s is not None
+               else sampling_params.deadline_s
+               if sampling_params.deadline_s is not None
+               else self.request_deadline_s)
         with self._lock:
             seq = self.llm._allocate_seq(token_ids, sampling_params)
             seq.mm = mm_state
@@ -144,9 +349,12 @@ class ServingEngine:
             if disagg_items is not None:
                 # skeleton request → coordinator (gate A admits it later)
                 seq._disagg_items = disagg_items
-            handle = RequestHandle(seq.seq_id, len(token_ids))
+            handle = RequestHandle(seq.seq_id, len(token_ids),
+                                   engine=self)
             self._handles[seq.seq_id] = handle
             self._seqs[seq.seq_id] = seq
+            if ttl and ttl > 0:
+                self._deadlines[seq.seq_id] = time.monotonic() + ttl
             _M_SUBMITTED.inc()
             _M_ACTIVE.set(len(self._handles))
         self._intake.put(seq)
@@ -157,16 +365,45 @@ class ServingEngine:
         self.llm.abort(seq_id)
         self._wake.set()
 
-    def shutdown(self) -> None:
+    def shutdown(self, drain: bool = False,
+                 timeout: Optional[float] = None) -> None:
+        """Stop the engine. ``drain=True`` first stops admitting and
+        waits (bounded by ``timeout``/``drain_timeout_s``) for in-flight
+        requests to finish; either way every still-open handle gets a
+        terminal chunk so no HTTP thread blocks forever on a stream the
+        engine will never feed."""
+        self._draining = True
+        if drain:
+            limit = time.monotonic() + (timeout if timeout is not None
+                                        else self.drain_timeout_s)
+            while time.monotonic() < limit:
+                with self._lock:
+                    if not self._handles and self._intake.empty():
+                        break
+                time.sleep(0.01)
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=5)
+        # the loop's finally already closed the handles if the thread
+        # exited; this is the backstop for a hung/killed thread
+        self._close_open_handles("abort", "engine shutdown")
 
     # ---- engine thread ----------------------------------------------------
 
     def _run(self) -> None:
+        try:
+            self._run_loop()
+        except Exception:  # pragma: no cover - last-resort containment
+            logger.exception("engine loop died")
+            self._healthy = False
+            _M_HEALTHY.set(0)
+        finally:
+            self._close_open_handles("abort", "engine stopped")
+
+    def _run_loop(self) -> None:
         llm = self.llm
         while not self._stop:
+            self._heartbeat = time.monotonic()
             drained = False
             while True:
                 try:
@@ -182,6 +419,7 @@ class ServingEngine:
                 except ValueError as e:
                     self._deliver_error(seq.seq_id, str(e))
                 drained = True
+            self._expire_deadlines()
             if not llm.has_unfinished:
                 if not drained:
                     self._wake.wait(timeout=0.05)
@@ -189,10 +427,11 @@ class ServingEngine:
                 continue
             try:
                 outputs = llm.step()
-            except Exception:
+            except Exception as e:
                 logger.exception("engine step failed")
-                self._fail_all()
+                self._on_step_failure(e)
                 continue
+            self._failed_steps = 0
             for out in outputs:
                 handle = self._handles.get(out.seq.seq_id)
                 if handle is None:
@@ -202,35 +441,125 @@ class ServingEngine:
                     with self._lock:
                         self._handles.pop(out.seq.seq_id, None)
                         self._seqs.pop(out.seq.seq_id, None)
+                        self._deadlines.pop(out.seq.seq_id, None)
                         _M_ACTIVE.set(len(self._handles))
                     self._emitted.pop(out.seq.seq_id, None)
             # aborted sequences never produce a SeqOutput → close their
             # streams here
             self._reap_aborted()
 
+    # ---- fault isolation ---------------------------------------------------
+
+    def _on_step_failure(self, exc: BaseException) -> None:
+        """Quarantine the failed step's batch; escalate to the latched
+        unhealthy state after max_step_failures consecutive failures
+        (the old behavior failed EVERY request and then hot-retried the
+        broken step forever because the failing sequences stayed
+        scheduler-resident)."""
+        _M_STEP_FAIL.inc()
+        self._failed_steps += 1
+        detail = f"{type(exc).__name__}: {exc}"
+        try:
+            failed = self.llm.quarantine_step_failure()
+        except Exception:
+            logger.exception("quarantine after step failure failed")
+            self._latch_unhealthy(f"unrecoverable step failure: {detail}")
+            return
+        for sid in failed:
+            self._deliver_error(sid, "error", detail)
+        if self._failed_steps >= self.max_step_failures:
+            self._latch_unhealthy(
+                f"{self._failed_steps} consecutive step failures "
+                f"(last: {detail})")
+
+    def _latch_unhealthy(self, why: str) -> None:
+        if not self._healthy:
+            return
+        logger.error("engine latched unhealthy: %s", why)
+        self._healthy = False
+        _M_HEALTHY.set(0)
+        TRACE.record("fault", point="engine_unhealthy", error=why[:200])
+        try:
+            self.llm.quarantine_step_failure(everything=True)
+        except Exception:  # pragma: no cover
+            logger.exception("full quarantine failed")
+        self._close_open_handles("error", why)
+
+    def _expire_deadlines(self) -> None:
+        """Abort requests past their wall-clock budget — including ones
+        still sitting unscheduled in the waiting queue, which the
+        per-step output path would never touch."""
+        if not self._deadlines:
+            return
+        now = time.monotonic()
+        with self._lock:
+            expired = [sid for sid, t in self._deadlines.items()
+                       if now >= t]
+        for sid in expired:
+            self.llm.abort(sid)
+            _M_DEADLINE.inc()
+            self._deliver_error(sid, "deadline")
+
     def _reap_aborted(self):
         with self._lock:
             dead = [sid for sid, seq in self._seqs.items()
-                    if seq.is_finished and sid in self._handles]
+                    if seq.is_finished]
             for sid in dead:
                 self._seqs.pop(sid, None)
         for sid in dead:
             self._deliver_error(sid, "abort")
 
-    def _deliver_error(self, seq_id: int, reason: str) -> None:
+    def _deliver_error(self, seq_id: int, reason: str,
+                       detail: Optional[str] = None) -> None:
         with self._lock:
             handle = self._handles.pop(seq_id, None)
+            self._seqs.pop(seq_id, None)
+            self._deadlines.pop(seq_id, None)
             _M_ACTIVE.set(len(self._handles))
+        self._emitted.pop(seq_id, None)
         if handle is not None:
             _M_ABORTED.inc()
-            handle.chunks.put(StreamChunk(None, "", reason or "error"))
+            handle.chunks.put(StreamChunk(None, "", reason or "error",
+                                          error=detail))
 
-    def _fail_all(self) -> None:
+    def _close_open_handles(self, reason: str,
+                            detail: Optional[str] = None) -> None:
+        """Terminal chunk for every open stream (engine-wide failure or
+        shutdown) — replaces the old _fail_all, which leaked the
+        scheduler state that caused the hot-retry loop."""
         with self._lock:
             handles = list(self._handles.values())
             self._handles.clear()
+            self._seqs.clear()
+            self._emitted.clear()
+            self._deadlines.clear()
             _M_ACTIVE.set(0)
         if handles:
             _M_ABORTED.inc(len(handles))
         for h in handles:
-            h.chunks.put(StreamChunk(None, "", "error"))
+            h.chunks.put(StreamChunk(None, "", reason, error=detail))
+
+    # ---- watchdog ----------------------------------------------------------
+
+    def _watch(self) -> None:
+        """Detect a wedged engine thread (hung device dispatch blocks the
+        loop inside collect, so the heartbeat goes stale) and flip
+        readiness while it lasts. Liveness is untouched: the supervisor
+        restarts on /healthz, the balancer routes on /readyz."""
+        stall = self.watchdog_stall_s
+        interval = max(0.02, min(stall / 4.0, 1.0))
+        while not self._stop and self._thread.is_alive():
+            time.sleep(interval)
+            age = time.monotonic() - self._heartbeat
+            _M_HB_AGE.set(age)
+            if age > stall:
+                if not self._stalled:
+                    self._stalled = True
+                    TRACE.record("fault", point="dispatch_stall_detected",
+                                 age_s=round(age, 3))
+                    logger.error(
+                        "engine heartbeat stale %.2fs (> %.2fs) — "
+                        "readiness off", age, stall)
+            elif self._stalled:
+                self._stalled = False
+                logger.info("engine heartbeat recovered — readiness on")

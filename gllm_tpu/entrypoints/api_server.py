@@ -27,7 +27,7 @@ import gllm_tpu
 from gllm_tpu.config import (CacheConfig, EngineConfig, ParallelConfig,
                              SchedulerConfig)
 from gllm_tpu.engine.llm import LLM
-from gllm_tpu.engine.serving_engine import ServingEngine
+from gllm_tpu.engine.serving_engine import RequestRejected, ServingEngine
 from gllm_tpu.entrypoints import protocol as proto
 
 logger = logging.getLogger(__name__)
@@ -155,11 +155,13 @@ class Handler(BaseHTTPRequestHandler):
 
     # ---- helpers ----------------------------------------------------------
 
-    def _json(self, obj, code=200):
+    def _json(self, obj, code=200, headers=None):
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -197,8 +199,29 @@ class Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         st = self.state
-        if self.path == "/health":
-            self._json({"status": "ok"})
+        if self.path in ("/health", "/healthz"):
+            # LIVENESS (docs/robustness.md): 200 while the engine thread
+            # runs — even when unhealthy/draining (the supervisor
+            # restarts on liveness, the balancer routes on readiness).
+            # Replaces the static always-ok /health stub.
+            eng = st.engine
+            alive = bool(getattr(eng, "is_alive", True))
+            body = {"status": "ok" if alive else "dead"}
+            health = getattr(eng, "health", None)
+            if callable(health):
+                body.update(health())
+            self._json(body, code=200 if alive else 503)
+        elif self.path == "/readyz":
+            # READINESS: may this instance be sent new requests?
+            eng = st.engine
+            readiness = getattr(eng, "readiness", None)
+            ready, why = readiness() if callable(readiness) \
+                else (True, "ok")
+            if ready:
+                self._json({"status": "ok"})
+            else:
+                self._json({"status": "unavailable", "reason": why},
+                           code=503, headers={"Retry-After": "5"})
         elif self.path == "/metrics":
             # Prometheus text exposition (gllm_tpu/obs/metrics.py):
             # request-latency histograms (TTFT/TPOT/ITL/e2e/queue),
@@ -264,6 +287,13 @@ class Handler(BaseHTTPRequestHandler):
                 self._json(proto.error_response("not found", 404), code=404)
         except proto.ProtocolError as e:
             self._json(proto.error_response(str(e)), code=400)
+        except RequestRejected as e:
+            # admission control (docs/robustness.md): 429 over-capacity /
+            # 503 unavailable, always with a Retry-After hint
+            self._json(
+                proto.error_response(str(e), e.status), code=e.status,
+                headers={"Retry-After":
+                         str(max(1, int(round(e.retry_after))))})
         except BrokenPipeError:
             pass  # client went away mid-write; abort handled in stream loop
         except Exception as e:  # pragma: no cover
@@ -619,6 +649,13 @@ def build_engine_config(args) -> EngineConfig:
         sp_ring_threshold=args.sp_ring_threshold,
         mm_processor_min_pixels=args.mm_processor_min_pixels,
         mm_processor_max_pixels=args.mm_processor_max_pixels,
+        max_queued_requests=args.max_queued_requests,
+        max_resident_requests=args.max_resident_requests,
+        request_deadline_s=args.request_deadline_s,
+        max_step_failures=args.max_step_failures,
+        watchdog_stall_s=args.watchdog_stall_s,
+        drain_timeout_s=args.drain_timeout_s,
+        fault_inject=args.fault_inject,
         scheduler=SchedulerConfig(
             schedule_method=args.schedule_method,
             max_decode_seqs=args.maxd,
@@ -759,6 +796,35 @@ def make_parser() -> argparse.ArgumentParser:
                    choices=["qwen", "hermes", "deepseek", "none"],
                    help="tool-call markup parser (default: auto-detect "
                         "from model name)")
+    # request-lifecycle robustness (docs/robustness.md)
+    p.add_argument("--max-queued-requests", type=int, default=0,
+                   help="admission bound on the intake queue; over-limit "
+                        "submits get HTTP 429 + Retry-After instead of "
+                        "queueing unboundedly (0 = unbounded)")
+    p.add_argument("--max-resident-requests", type=int, default=0,
+                   help="cap on concurrently open request streams; "
+                        "beyond it submits get HTTP 429 (0 = unbounded)")
+    p.add_argument("--request-deadline-s", type=float, default=0.0,
+                   help="default wall-clock TTL per request: waiting or "
+                        "overrunning requests are aborted with finish "
+                        "reason 'deadline' (0 = none; per-request "
+                        "deadline_s overrides)")
+    p.add_argument("--max-step-failures", type=int, default=3,
+                   help="consecutive failed engine steps before the "
+                        "engine latches unhealthy (readiness 503); "
+                        "individual failures only abort their own batch")
+    p.add_argument("--watchdog-stall-s", type=float, default=0.0,
+                   help="flip /readyz to 503 while the engine heartbeat "
+                        "is staler than this (hung device dispatch); "
+                        "must exceed the longest legitimate compile "
+                        "(0 = watchdog off)")
+    p.add_argument("--drain-timeout-s", type=float, default=5.0,
+                   help="graceful-shutdown budget for in-flight requests "
+                        "before they are aborted with terminal chunks")
+    p.add_argument("--fault-inject", default="",
+                   help="deterministic fault injection spec "
+                        "'point[:after_n[:count]][,...]' "
+                        "(gllm_tpu/faults.py; chaos testing only)")
     p.add_argument("--skip-warmup", action="store_true",
                    help="don't pre-compile decode buckets before serving "
                         "(first requests pay compile latency instead)")
@@ -895,7 +961,7 @@ def main(argv=None):
         finally:
             for s in servers[1:]:
                 s.shutdown()
-            servers[0].state.engine.shutdown()
+            servers[0].state.engine.shutdown(drain=True)
         return
     else:
         httpd = serve(llm, args.host, args.port,
@@ -907,7 +973,13 @@ def main(argv=None):
     except KeyboardInterrupt:
         pass
     finally:
-        httpd.state.engine.shutdown()
+        # graceful drain: stop admitting, let in-flight requests finish
+        # (bounded), close every open stream with a terminal chunk, join
+        eng = httpd.state.engine
+        try:
+            eng.shutdown(drain=True)
+        except TypeError:   # MultihostServingEngine: no drain support
+            eng.shutdown()
 
 
 if __name__ == "__main__":

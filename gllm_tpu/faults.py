@@ -1,0 +1,190 @@
+"""Deterministic fault-injection harness (docs/robustness.md).
+
+Every recovery path in the serving stack — batch quarantine after a step
+exception, the unhealthy escalation latch, kvswap recompute fallback,
+host-tier canary rejection, the watchdog readiness flip, admission-bound
+rejection — exists to handle events that production makes rare and tests
+would otherwise never see. This module gives each of them a NAMED
+injection point that fires deterministically, so the chaos suite
+(tests/test_robustness.py) exercises the real code paths instead of
+mocking around them.
+
+Spec grammar (``--fault-inject`` / ``GLLM_FAULT_INJECT``)::
+
+    point[:after_n[:count]][,point2...]
+
+``after_n`` invocations of the point are skipped, then the point fires
+``count`` times (default 1; ``-1``/``inf`` = every time) and disarms.
+Example: ``step_exception:2:3`` lets two steps collect normally, then
+fails the next three.
+
+Points and their wired sites:
+
+- ``step_exception``     raises in ``LLM.step`` before the collect →
+                         exercises quarantine + escalation
+- ``dispatch_stall``     sleeps ``FAULTS.stall_s`` in ``LLM.step`` like a
+                         hung device dispatch → exercises the watchdog
+- ``kvswap_transfer_fail`` raises in ``SwapEngine.gather``/``scatter`` →
+                         exercises the recompute fallback (gather) and
+                         restore-failure quarantine (scatter)
+- ``host_canary_corrupt`` corrupts the stored canary in
+                         ``HostKVPool.put_prefix`` → exercises the
+                         canary-mismatch miss path
+- ``intake_burst``       makes one ``ServingEngine.submit`` behave as if
+                         the intake queue were saturated → exercises the
+                         HTTP 429 admission rejection
+
+Firing a point records a ``fault`` event on the steptrace ring. Everything
+here is stdlib-only and cheap when disarmed: ``fire()`` is one attribute
+read until a spec is armed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["POINTS", "InjectedFault", "FaultInjector", "FAULTS"]
+
+# Every valid injection point. tests/test_robustness.py carries a guard
+# asserting each name is exercised by at least one chaos test — extend
+# BOTH together or the guard fails the new point.
+POINTS = (
+    "step_exception",
+    "kvswap_transfer_fail",
+    "host_canary_corrupt",
+    "dispatch_stall",
+    "intake_burst",
+)
+
+
+class InjectedFault(RuntimeError):
+    """Raised by an armed raise-style injection point."""
+
+    def __init__(self, point: str):
+        super().__init__(f"injected fault: {point}")
+        self.point = point
+
+
+class FaultInjector:
+    """Thread-safe registry of armed injection points.
+
+    ``fire(point)`` returns True exactly when the point's spec says so;
+    call sites wrap it in whatever failure shape fits (raise, corrupt,
+    stall, reject). Invocation counting starts at arming time, so a test
+    that arms ``point:n:k`` gets n clean passes and then k faults no
+    matter what ran before.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # point -> [skip_remaining, fire_remaining (None = unlimited)]
+        self._armed: Dict[str, list] = {}
+        # lifetime fire counts per point (test assertions / debugging)
+        self.hits: Dict[str, int] = {}
+        # dispatch_stall sleep length (seconds)
+        self.stall_s = float(os.environ.get("GLLM_FAULT_STALL_S", "2.0"))
+        self._active = False
+
+    # ---- arming -----------------------------------------------------------
+
+    def arm(self, spec: str) -> None:
+        """Arm from a spec string (grammar in the module docstring).
+        Replaces any prior arming of the named points; other armed
+        points are untouched. Empty spec is a no-op."""
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            point = fields[0]
+            if point not in POINTS:
+                raise ValueError(
+                    f"unknown fault point {point!r} (choices: "
+                    f"{', '.join(POINTS)})")
+            if len(fields) > 3:
+                raise ValueError(
+                    f"bad fault spec {part!r} (grammar: "
+                    "point[:after_n[:count]])")
+            after_n = int(fields[1]) if len(fields) > 1 else 0
+            count_s = fields[2] if len(fields) > 2 else "1"
+            count: Optional[int]
+            if count_s in ("inf", "-1"):
+                count = None
+            else:
+                count = int(count_s)
+            if after_n < 0 or (count is not None and count < 1):
+                raise ValueError(f"bad fault spec {part!r}")
+            with self._lock:
+                self._armed[point] = [after_n, count]
+                self._active = True
+            logger.warning("fault point armed: %s after=%d count=%s",
+                           point, after_n, count_s)
+
+    def reset(self) -> None:
+        """Disarm everything and zero the hit counts (test isolation)."""
+        with self._lock:
+            self._armed.clear()
+            self.hits.clear()
+            self._active = False
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def armed_state(self) -> Dict[str, Tuple[int, Optional[int]]]:
+        with self._lock:
+            return {p: tuple(v) for p, v in self._armed.items()}
+
+    # ---- firing -----------------------------------------------------------
+
+    def fire(self, point: str) -> bool:
+        """One invocation of ``point``; True when the fault should
+        happen NOW. Near-free when nothing is armed."""
+        if not self._active:
+            return False
+        with self._lock:
+            st = self._armed.get(point)
+            if st is None:
+                return False
+            if st[0] > 0:                      # still skipping
+                st[0] -= 1
+                return False
+            if st[1] is not None:
+                st[1] -= 1
+                if st[1] <= 0:
+                    del self._armed[point]
+                    if not self._armed:
+                        self._active = False
+            self.hits[point] = self.hits.get(point, 0) + 1
+        # outside the lock: the trace ring takes its own lock
+        try:
+            from gllm_tpu.obs.steptrace import TRACE
+            TRACE.record("fault", point=point)
+        except Exception:  # pragma: no cover - tracing must never mask
+            pass
+        logger.warning("fault point fired: %s", point)
+        return True
+
+    def maybe_raise(self, point: str) -> None:
+        if self.fire(point):
+            raise InjectedFault(point)
+
+    def maybe_stall(self, point: str) -> None:
+        if self.fire(point):
+            import time
+            logger.warning("fault point %s stalling %.1fs", point,
+                           self.stall_s)
+            time.sleep(self.stall_s)
+
+
+FAULTS = FaultInjector()
+
+# Env arming lets headless runs (bench soak, CI chaos jobs) inject
+# without touching the CLI surface.
+if os.environ.get("GLLM_FAULT_INJECT"):
+    FAULTS.arm(os.environ["GLLM_FAULT_INJECT"])

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gllm_tpu import faults
 from gllm_tpu.utils import next_pow2
 
 
@@ -64,6 +65,9 @@ class SwapEngine:
                host_pages: Sequence[int]) -> None:
         """Dispatch a page gather and start its async host copy; the data
         lands in the pool at the next :meth:`materialize`."""
+        # chaos point (docs/robustness.md): a failed device→host transfer
+        # — the manager catches it and reverts the intents to recompute
+        faults.FAULTS.maybe_raise("kvswap_transfer_fail")
         out = _gather_pages(kv, jnp.asarray(_pad_idx(dev_pages)))
         leaves = jax.tree.leaves(out)
         for leaf in leaves:
@@ -97,6 +101,10 @@ class SwapEngine:
     def scatter(self, kv, dev_pages: Sequence[int], pool,
                 host_pages: Sequence[int]):
         """Restore host pages into device pages; returns the new kv."""
+        # chaos point: a failed host→device restore poisons the batch
+        # that needed the pages — it propagates and the serving engine
+        # quarantines that batch (docs/robustness.md)
+        faults.FAULTS.maybe_raise("kvswap_transfer_fail")
         idx = _pad_idx(dev_pages)
         data = pool.read_pages(host_pages, pad_to=len(idx))
         tree = jax.tree.unflatten(jax.tree.structure(kv), data)

@@ -152,6 +152,12 @@ class HostKVPool:
 
     def put_prefix(self, page: int, digest: bytes,
                    canary: Tuple[int, ...]) -> None:
+        from gllm_tpu.faults import FAULTS
+        if FAULTS.fire("host_canary_corrupt"):
+            # chaos point (docs/robustness.md): store a poisoned canary —
+            # the next match_prefix probe must detect it and miss rather
+            # than serve this page
+            canary = tuple(int(c) + 1 for c in canary)
         old = self.hash_to_page.get(digest)
         if old is not None and old != page:
             # newer copy wins; the old page keeps its data but loses the

@@ -27,6 +27,7 @@ from typing import List, Optional, Set, Tuple
 
 import numpy as np
 
+from gllm_tpu.faults import InjectedFault
 from gllm_tpu.kvswap.engine import SwapEngine
 from gllm_tpu.kvswap.host_pool import HostKVPool
 from gllm_tpu.obs import metrics as obs
@@ -84,8 +85,11 @@ class KVSwapManager:
             [((leaf.shape[0],) + leaf.shape[2:], np.dtype(leaf.dtype))
              for leaf in leaves], num_host_pages)
         self.engine = SwapEngine()
-        # queued intents, drained by the runner at dispatch time
-        self._out: List[Tuple[List[int], List[int]]] = []   # (dev, host)
+        # queued intents, drained by the runner at dispatch time:
+        # (dev, host, kind, owner_seq) — kind "seq" carries the swapped
+        # sequence so a failed/quarantined transfer can revert it to
+        # recompute; prefix spills carry None
+        self._out: List[Tuple[List[int], List[int], str, object]] = []
         self._in: List[Tuple[List[int], List[int], str]] = []  # +kind
         # device pages whose restore scatter hasn't drained: a re-mint of
         # one must not spill its (not yet written) content
@@ -130,7 +134,7 @@ class KVSwapManager:
             return False
         dev = list(seq.page_table[:n])
         self.pool.pin(host)              # in-flight until the fetch lands
-        self._out.append((dev, host))
+        self._out.append((dev, host, "seq", seq))
         mm.free_seq(seq)                 # device refcounts / page reuse
         seq.swap_out(host)
         _M_SWAP_OUT.inc()
@@ -173,7 +177,7 @@ class KVSwapManager:
         if host is None:
             return   # pool full of pinned pages; drop the spill
         self.pool.pin(host)
-        self._out.append(([dev_page], host))
+        self._out.append(([dev_page], host, "prefix", None))
         self.pool.put_prefix(host[0], digest, canary)
         _M_SPILL.inc()
         _M_PAGES.inc(dir="out")
@@ -222,9 +226,18 @@ class KVSwapManager:
         ins, self._in = self._in, []
         self.last_scatter_dev = {p for _, d, _ in ins for p in d}
         if outs:
-            dev = [p for d, _ in outs for p in d]
-            host = [p for _, h in outs for p in h]
-            self.engine.gather(kv, dev, host)
+            dev = [p for d, _, _, _ in outs for p in d]
+            host = [p for _, h, _, _ in outs for p in h]
+            try:
+                self.engine.gather(kv, dev, host)
+            except InjectedFault:
+                # transfer plane failed before any data moved: revert
+                # every queued swap-out to the legacy recompute path and
+                # drop the spills — nobody may ever read the unwritten
+                # host slots (docs/robustness.md)
+                logger.warning("kvswap gather failed; reverting %d "
+                               "intents to recompute", len(outs))
+                self._drop_out_intents(outs)
         if ins:
             needed = {p for h, _, _ in ins for p in h}
             if needed & self.engine.pending_host_pages():
@@ -249,6 +262,52 @@ class KVSwapManager:
                     self.pool.unpin(h_pages)
         self._update_gauges()
         return kv
+
+    # ---- fault recovery ----------------------------------------------------
+
+    def _drop_out_intents(self, outs) -> None:
+        """Undo queued (never-dispatched) device→host intents: their host
+        slots hold no data. Seq swap-outs revert to recompute (the seq
+        re-prefills from scratch on re-admission); prefix spills lose
+        their digest key so a zeroed page can never be served."""
+        from gllm_tpu.sequence import SequenceStatus
+        for dev, host, kind, seq in outs:
+            self.pool.unpin(host)
+            if kind == "seq" and seq is not None:
+                if seq.swap_host_pages:
+                    seq.swap_host_pages = None
+                    if seq.status is SequenceStatus.SWAPPED:
+                        seq.preempt()
+                    _M_FALLBACK.inc()
+                    self._free_host_pages(host)
+                # else: an abort already routed through release_seq and
+                # freed these host pages — don't double-free
+            else:
+                for p in host:
+                    self.pool.drop_prefix(p)
+                self._free_host_pages(host)
+        self._update_gauges()
+
+    def quarantine(self) -> None:
+        """Step-failure rollback (LLM.quarantine_step_failure): drop every
+        QUEUED transfer intent — the dispatch they were waiting for will
+        never run, and the pages they reference may be freed/re-minted by
+        the quarantine. Already-dispatched gathers (``engine._pending``)
+        are left to land normally: they read consistent pre-overwrite
+        data and their host pages free through ``_free_after_fetch``."""
+        outs, self._out = self._out, []
+        ins, self._in = self._in, []
+        self._drop_out_intents(outs)
+        for host, dev, kind in ins:
+            self._pending_restore_dev.difference_update(dev)
+            if kind == "seq":
+                # record_swap_in already detached these pages from their
+                # seq; the restore will never run, so free the copy
+                self._free_host_pages(host)
+            else:
+                self.pool.unpin(host)
+        self.last_scatter_dev.clear()
+        self._update_gauges()
 
     # ---- internals ---------------------------------------------------------
 

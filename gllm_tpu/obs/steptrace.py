@@ -41,8 +41,16 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 #                  batch, host-work features), spec (speculation owns
 #                  dispatch), finish (legacy membership loss — zero under
 #                  --decode-slot-batching)
+#   fault        - a robustness event (docs/robustness.md): an injected
+#                  fault point fired (``point`` field names it), the
+#                  watchdog detected a stale heartbeat
+#                  (point=dispatch_stall_detected), or the engine latched
+#                  unhealthy (point=engine_unhealthy)
+#   quarantine   - a step exception was isolated: the failed dispatch's
+#                  sequences were aborted (``num_seqs``), everything else
+#                  rescheduled
 STEP_KINDS = ("prefill", "decode", "fused_block", "pp_stage", "compile",
-              "chain_break")
+              "chain_break", "fault", "quarantine")
 CHAIN_BREAK_REASONS = ("waiting", "pages", "shape", "spec", "finish")
 
 
@@ -129,6 +137,8 @@ def summarize(events: List[dict]) -> dict:
     total_ms = 0.0
     compiles = chain_breaks = 0
     break_reasons: Dict[str, int] = {}
+    faults_total = quarantines = 0
+    fault_points: Dict[str, int] = {}
     # on-device finish attribution (fused_block events carry k_exec /
     # dead_substeps when config.ondevice_finish is on): wasted sub-step
     # share of all executed row-sub-steps over the window
@@ -142,6 +152,14 @@ def summarize(events: List[dict]) -> dict:
             chain_breaks += 1
             r = e.get("reason", "unknown")
             break_reasons[r] = break_reasons.get(r, 0) + 1
+            continue
+        if k == "fault":
+            faults_total += 1
+            p = e.get("point", "unknown")
+            fault_points[p] = fault_points.get(p, 0) + 1
+            continue
+        if k == "quarantine":
+            quarantines += 1
             continue
         if k == "pp_stage":
             continue                     # dispatch-side only; no wall
@@ -183,4 +201,7 @@ def summarize(events: List[dict]) -> dict:
         "compiles": compiles,
         "chain_breaks": chain_breaks,
         "chain_breaks_by_reason": break_reasons,
+        "faults": faults_total,
+        "faults_by_point": fault_points,
+        "quarantines": quarantines,
     }

@@ -31,6 +31,11 @@ class SamplingParams:
     logprobs: Optional[int] = None       # top-N logprobs per output token
     prompt_logprobs: Optional[int] = None
     seed: Optional[int] = None
+    # Wall-clock budget in seconds from submit: the serving engine aborts
+    # the request with finish reason "deadline" once it expires, whether
+    # it is still waiting for admission or mid-generation. None defers to
+    # the engine-wide TTL (config.request_deadline_s; docs/robustness.md).
+    deadline_s: Optional[float] = None
 
     @property
     def is_greedy(self) -> bool:
@@ -72,6 +77,8 @@ class SamplingParams:
             raise ValueError("prompt_logprobs must be in [0, 20]")
         if any(not s for s in self.stop):
             raise ValueError("stop strings must be non-empty")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError("deadline_s must be > 0")
         if self.seed is not None:
             if self.seed < 0:
                 raise ValueError("seed must be >= 0")

@@ -924,6 +924,43 @@ class Scheduler:
 
     # ---- aborts / stats ---------------------------------------------------
 
+    def quarantine(self, seq_ids) -> List[Sequence]:
+        """Fault-isolation rollback after a step exception (serving
+        engine → ``LLM.quarantine_step_failure``): the given seqs'
+        device state is unknown — drop them wholesale. Pages free
+        immediately (the engine already cleared its dispatch queue, so
+        nothing is writing into them), in-flight counts reset, deferred
+        frees flush, and the seqs leave both queues so ``has_unfinished``
+        can reach False again — no hot-retry of a poisoned batch."""
+        ids = set(seq_ids)
+        dropped: List[Sequence] = []
+        for seq in [s for s in self.running if s.seq_id in ids]:
+            self.running.remove(seq)
+            self._quarantine_one(seq, dropped)
+        for seq in [s for s in self.waiting if s.seq_id in ids]:
+            self.waiting.remove(seq)
+            self._quarantine_one(seq, dropped)
+        for seq in [s for s in self._deferred_free
+                    if s.seq_id in ids]:
+            # already FINISHED; its pages waited on an in-flight step
+            # that will never land now
+            self._deferred_free.discard(seq)
+            seq.num_in_flight = 0
+            self.mm.free_seq(seq)
+        self._aborted_ids -= ids
+        # the shared hole sentinel's in-flight bumps from dropped fused
+        # chains will never see their process_output decrements
+        self._hole_seq.num_in_flight = 0
+        return dropped
+
+    def _quarantine_one(self, seq: Sequence,
+                        dropped: List[Sequence]) -> None:
+        seq.num_in_flight = 0
+        seq.status = SequenceStatus.ABORTED
+        seq.finish_reason = "error"
+        self.mm.free_seq(seq)
+        dropped.append(seq)
+
     def _finish_abort(self, seq: Sequence) -> None:
         seq.status = SequenceStatus.ABORTED
         seq.finish_reason = "abort"

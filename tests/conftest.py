@@ -21,3 +21,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # chaos: deterministic fault-injection tests (gllm_tpu/faults.py +
+    # tests/test_robustness.py). CPU-safe tiny models, tier-1 ("not
+    # slow") — every faults.py injection point must be exercised by at
+    # least one of these (guard test in test_robustness.py).
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection tests (docs/robustness.md)")

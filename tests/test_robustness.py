@@ -1,0 +1,578 @@
+"""Request-lifecycle robustness chaos suite (docs/robustness.md).
+
+Every recovery path of the serving stack is exercised through the
+deterministic fault-injection harness (gllm_tpu/faults.py) instead of
+being hoped-for:
+
+- step-exception quarantine: only the failed dispatch's requests abort,
+  concurrent work completes with correct tokens, the engine returns to
+  idle (no hot-retry) with zero leaked pages;
+- escalation: N consecutive failures latch unhealthy — /readyz 503
+  while /healthz stays 200, submits rejected 503;
+- watchdog: an injected dispatch stall flips readiness and recovery
+  restores it;
+- admission control: over-bound intake yields HTTP 429 + Retry-After;
+- deadlines: waiting requests past their TTL finish with reason
+  "deadline";
+- kvswap transfer faults: failed gathers revert to recompute, failed
+  restores propagate to quarantine; corrupted host canaries miss;
+- abort/disconnect races and shutdown handle closure (satellites).
+
+A guard test asserts every faults.py injection point is exercised by at
+least one chaos-marked test here, so new points can't land untested.
+"""
+
+import ast
+import json
+import http.client
+import threading
+import time
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.engine import serving_engine as se
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.engine.serving_engine import (RequestHandle, RequestRejected,
+                                            ServingEngine)
+from gllm_tpu.faults import FAULTS, POINTS, InjectedFault
+from gllm_tpu.kvswap import KVSwapManager
+from gllm_tpu.kvswap import manager as kvswap_manager
+from gllm_tpu.memory_manager import make_memory_manager
+from gllm_tpu.sampling_params import SamplingParams
+from gllm_tpu.sequence import Sequence, SequenceStatus
+
+TINY = dict(
+    vocab_size=128, hidden_size=64, num_hidden_layers=2,
+    num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+    max_position_embeddings=512, rms_norm_eps=1e-6, rope_theta=10000.0,
+    tie_word_embeddings=False, eos_token_id=0, bos_token_id=1,
+)
+PROMPT = [5, 17, 93, 41]
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(11)
+    model = LlamaForCausalLM(LlamaConfig(**TINY, attention_bias=False))
+    d = tmp_path_factory.mktemp("robust_model")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def make_llm(model_dir, **over):
+    cfg = EngineConfig(
+        model=model_dir, dtype="float32", max_model_len=256,
+        scheduler=SchedulerConfig(),
+        cache=CacheConfig(page_size=4, num_pages=128),
+    )
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    cfg.validate()
+    return LLM(config=cfg)
+
+
+@pytest.fixture
+def engines():
+    """Track engines so every test tears its threads down."""
+    made = []
+
+    def make(llm, **kw):
+        eng = ServingEngine(llm, **kw)
+        made.append(eng)
+        return eng
+
+    yield make
+    for eng in made:
+        eng.shutdown()
+
+
+def wait_until(cond, timeout=20.0, interval=0.01, what="condition"):
+    limit = time.monotonic() + timeout
+    while time.monotonic() < limit:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def collect(handle, timeout=30.0):
+    """Drain a handle with a wall-clock guard (a hung stream must fail
+    the test, not the suite)."""
+    out = []
+    box = {}
+
+    def run():
+        try:
+            for c in handle:
+                out.append(c)
+        except Exception as e:  # pragma: no cover - surfaced below
+            box["err"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), "stream never terminated"
+    if "err" in box:
+        raise box["err"]
+    return out
+
+
+def free_pages(llm):
+    return llm.memory_manager.allocator.num_free
+
+
+LONG = SamplingParams(temperature=0.0, max_tokens=60, ignore_eos=True)
+SHORT = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+
+# ---- fault-injector unit semantics ----------------------------------------
+
+def test_fault_spec_grammar():
+    FAULTS.arm("step_exception:2:2")
+    assert not FAULTS.fire("step_exception")
+    assert not FAULTS.fire("step_exception")
+    assert FAULTS.fire("step_exception")
+    assert FAULTS.fire("step_exception")
+    assert not FAULTS.fire("step_exception")   # disarmed after count
+    assert not FAULTS.active
+    FAULTS.arm("intake_burst")                 # bare point = :0:1
+    assert FAULTS.fire("intake_burst")
+    assert not FAULTS.fire("intake_burst")
+    FAULTS.arm("dispatch_stall:0:inf")
+    for _ in range(5):
+        assert FAULTS.fire("dispatch_stall")
+    with pytest.raises(ValueError):
+        FAULTS.arm("no_such_point:1:1")
+    with pytest.raises(ValueError):
+        FAULTS.arm("step_exception:1:2:3")
+    with pytest.raises(ValueError):
+        EngineConfig(fault_inject="bogus_point").validate()
+
+
+# ---- quarantine / escalation ----------------------------------------------
+
+@pytest.mark.chaos
+def test_step_exception_quarantines_only_failed_batch(tiny_ckpt, engines):
+    """An injected step_exception aborts only the scheduled batch; a
+    concurrently submitted (still-waiting) request completes with the
+    exact tokens a clean engine produces, and no pages leak."""
+    llm = make_llm(tiny_ckpt, max_num_seqs=1)   # B can't join A's batch
+    baseline = free_pages(llm)
+    want = make_llm(tiny_ckpt).generate(
+        prompt_token_ids=[list(PROMPT)],
+        sampling_params=SamplingParams(**SHORT.__dict__)
+    )[0].output_token_ids
+
+    eng = engines(llm)
+    FAULTS.arm("step_exception:0:1")
+    fail_before = se._M_STEP_FAIL.get()
+    ha = eng.submit(list(PROMPT), SamplingParams(**LONG.__dict__))
+    hb = eng.submit([9, 9, 3, 77], SamplingParams(**SHORT.__dict__))
+    # A dies with a terminal error chunk carrying the injected reason
+    chunks_a = collect(ha)
+    assert chunks_a[-1].finish_reason == "error"
+    assert "step_exception" in (chunks_a[-1].error or "")
+    # B survives the quarantine and decodes correct tokens... for ITS
+    # prompt (sanity: the same clean engine agrees)
+    chunks_b = collect(hb)
+    toks_b = [c.token_id for c in chunks_b if c.token_id is not None]
+    want_b = make_llm(tiny_ckpt).generate(
+        prompt_token_ids=[[9, 9, 3, 77]],
+        sampling_params=SamplingParams(**SHORT.__dict__)
+    )[0].output_token_ids
+    assert toks_b == want_b
+    assert se._M_STEP_FAIL.get() == fail_before + 1
+    # engine stays healthy and returns to idle — no hot retry, no leaks
+    assert eng.readiness() == (True, "ok")
+    wait_until(lambda: not llm.has_unfinished, what="engine idle")
+    wait_until(lambda: free_pages(llm) == baseline, what="pages freed")
+    assert not llm.scheduler.running and not llm.scheduler.waiting
+    # a fresh submit on the SAME engine still produces correct tokens
+    hc = eng.submit(list(PROMPT), SamplingParams(**SHORT.__dict__))
+    toks_c = [c.token_id for c in collect(hc) if c.token_id is not None]
+    assert toks_c == want
+
+
+@pytest.mark.chaos
+def test_consecutive_failures_latch_unhealthy(tiny_ckpt, engines):
+    llm = make_llm(tiny_ckpt, max_step_failures=2)
+    eng = engines(llm)
+    FAULTS.arm("step_exception:0:inf")
+    h1 = eng.submit(list(PROMPT), SamplingParams(**SHORT.__dict__))
+    assert collect(h1)[-1].finish_reason == "error"
+    assert eng.readiness() == (True, "ok")       # one failure: not yet
+    h2 = eng.submit(list(PROMPT), SamplingParams(**SHORT.__dict__))
+    assert collect(h2)[-1].finish_reason == "error"
+    # second consecutive failure: latched
+    wait_until(lambda: not eng.readiness()[0], what="unhealthy latch")
+    assert eng.readiness() == (False, "unhealthy")
+    assert eng.is_alive                          # liveness stays up
+    with pytest.raises(RequestRejected) as ei:
+        eng.submit(list(PROMPT), SamplingParams(**SHORT.__dict__))
+    assert ei.value.status == 503 and ei.value.reason == "unhealthy"
+
+
+@pytest.mark.chaos
+def test_watchdog_flips_readiness_on_dispatch_stall(tiny_ckpt, engines):
+    llm = make_llm(tiny_ckpt, watchdog_stall_s=0.25)
+    eng = engines(llm)
+    # warm the engine first so the stall hits a steady loop, not compile
+    h = eng.submit(list(PROMPT), SamplingParams(**SHORT.__dict__))
+    collect(h)
+    # the first-dispatch compile may itself have tripped the watchdog;
+    # wait for the heartbeat to look fresh again
+    wait_until(lambda: eng.readiness() == (True, "ok"), timeout=5.0,
+               what="post-warmup readiness")
+    FAULTS.stall_s = 1.2
+    FAULTS.arm("dispatch_stall:0:1")
+    h2 = eng.submit(list(PROMPT), SamplingParams(**SHORT.__dict__))
+    wait_until(lambda: eng.readiness() == (False, "stalled"),
+               timeout=5.0, what="watchdog readiness flip")
+    # the stall ends, the loop resumes, readiness recovers, tokens flow
+    wait_until(lambda: eng.readiness() == (True, "ok"), timeout=10.0,
+               what="readiness recovery")
+    assert collect(h2)[-1].finish_reason == "length"
+
+
+# ---- admission control / deadlines ----------------------------------------
+
+@pytest.mark.chaos
+def test_resident_limit_rejects_429(tiny_ckpt, engines):
+    llm = make_llm(tiny_ckpt, max_resident_requests=1)
+    eng = engines(llm)
+    ha = eng.submit(list(PROMPT), SamplingParams(**LONG.__dict__))
+    with pytest.raises(RequestRejected) as ei:
+        eng.submit(list(PROMPT), SamplingParams(**SHORT.__dict__))
+    assert ei.value.status == 429
+    assert ei.value.reason == "resident_limit"
+    assert ei.value.retry_after > 0
+    assert se._M_REJECTED.get(reason="resident_limit") >= 1
+    eng.abort(ha.seq_id)
+    collect(ha)
+    # capacity freed: admission opens again
+    wait_until(lambda: not eng._handles, what="handle reaped")
+    hc = eng.submit(list(PROMPT), SamplingParams(**SHORT.__dict__))
+    assert collect(hc)[-1].finish_reason == "length"
+
+
+@pytest.mark.chaos
+def test_deadline_expires_waiting_request(tiny_ckpt, engines):
+    llm = make_llm(tiny_ckpt, max_num_seqs=1)
+    eng = engines(llm)
+    before = se._M_DEADLINE.get()
+    ha = eng.submit(list(PROMPT), SamplingParams(**LONG.__dict__))
+    # B can never be scheduled while A runs (max_num_seqs=1) and expires
+    # in the waiting queue
+    sp = SamplingParams(**SHORT.__dict__)
+    sp.deadline_s = 0.2
+    hb = eng.submit([8, 2, 8, 1], sp)
+    chunks_b = collect(hb)
+    assert chunks_b[-1].finish_reason == "deadline"
+    assert [c.token_id for c in chunks_b if c.token_id is not None] == []
+    assert se._M_DEADLINE.get() == before + 1
+    # A is unaffected
+    assert collect(ha)[-1].finish_reason == "length"
+
+
+def test_engine_wide_ttl_applies_without_per_request_deadline(
+        tiny_ckpt, engines):
+    llm = make_llm(tiny_ckpt, max_num_seqs=1, request_deadline_s=0.2)
+    eng = engines(llm)
+    ha = eng.submit(list(PROMPT), SamplingParams(**LONG.__dict__))
+    hb = eng.submit([7, 7, 7], SamplingParams(**SHORT.__dict__))
+    assert collect(hb)[-1].finish_reason == "deadline"
+    # A overran the TTL mid-generation (first-dispatch compile alone
+    # exceeds it) — the budget is wall-clock, waiting or not
+    assert collect(ha)[-1].finish_reason == "deadline"
+
+
+# ---- HTTP surface ----------------------------------------------------------
+
+def _request(port, method, path, body=None, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request(method, path,
+                 body=json.dumps(body) if body is not None else None,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    data = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, headers
+
+
+@pytest.fixture
+def http_server(tiny_ckpt):
+    from gllm_tpu.entrypoints.api_server import serve
+    servers = []
+
+    def make(**over):
+        llm = make_llm(tiny_ckpt, **over)
+        httpd = serve(llm, "127.0.0.1", 0)
+        port = httpd.server_address[1]
+        threading.Thread(target=httpd.serve_forever,
+                         daemon=True).start()
+        servers.append(httpd)
+        return port
+
+    yield make
+    for httpd in servers:
+        httpd.shutdown()
+        httpd.state.engine.shutdown()
+
+
+@pytest.mark.chaos
+def test_http_intake_burst_yields_429_with_retry_after(http_server):
+    port = http_server()
+    FAULTS.arm("intake_burst:0:1")
+    req = {"model": "m", "prompt": PROMPT, "max_tokens": 4,
+           "ignore_eos": True, "temperature": 0.0}
+    status, body, headers = _request(port, "POST", "/v1/completions", req)
+    assert status == 429, body
+    assert "Retry-After" in headers
+    assert "full" in json.loads(body)["error"]["message"]
+    # the burst passed; the same request is admitted now
+    status, body, _ = _request(port, "POST", "/v1/completions", req)
+    assert status == 200, body
+
+
+@pytest.mark.chaos
+def test_http_healthz_vs_readyz_after_latch(http_server):
+    port = http_server(max_step_failures=2)
+    FAULTS.arm("step_exception:0:inf")
+    req = {"model": "m", "prompt": PROMPT, "max_tokens": 4,
+           "ignore_eos": True, "temperature": 0.0}
+    for _ in range(2):
+        status, body, _ = _request(port, "POST", "/v1/completions", req)
+        assert status == 200
+        assert json.loads(body)["choices"][0]["finish_reason"] == "error"
+    # latched: readiness 503, liveness 200, submits 503 + Retry-After
+    status, body, headers = _request(port, "GET", "/readyz")
+    assert status == 503
+    assert json.loads(body)["reason"] == "unhealthy"
+    assert "Retry-After" in headers
+    status, body, _ = _request(port, "GET", "/healthz")
+    assert status == 200
+    assert json.loads(body)["healthy"] is False
+    status, body, headers = _request(port, "POST", "/v1/completions", req)
+    assert status == 503
+    assert "Retry-After" in headers
+
+
+def test_http_health_and_readyz_ok_when_clean(http_server):
+    port = http_server()
+    status, body, _ = _request(port, "GET", "/health")
+    assert status == 200 and json.loads(body)["status"] == "ok"
+    status, body, _ = _request(port, "GET", "/healthz")
+    body = json.loads(body)
+    assert status == 200 and body["ready"] and body["alive"]
+    assert "heartbeat_age_s" in body
+    status, _, _ = _request(port, "GET", "/readyz")
+    assert status == 200
+
+
+# ---- kvswap transfer faults ------------------------------------------------
+
+def _swap_fixture(num_pages=16, page_size=4, host_pages=8):
+    shape = (2, num_pages, page_size, 3)
+    kv = (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+    mm = make_memory_manager(num_pages, page_size, False)
+    sw = KVSwapManager(kv, page_size, host_pages)
+    mm.swap = sw
+    return mm, sw, kv
+
+
+def _running_seq(mm, sid=0, tokens=8):
+    seq = Sequence(sid, list(range(tokens)), SamplingParams(max_tokens=4))
+    seq.status = SequenceStatus.RUNNING
+    mm.allocate_seq_pages(seq, tokens)
+    seq.num_computed_tokens = tokens
+    return seq
+
+
+@pytest.mark.chaos
+def test_kvswap_gather_fault_reverts_to_recompute():
+    mm, sw, kv = _swap_fixture()
+    seq = _running_seq(mm)
+    fallback_before = kvswap_manager._M_FALLBACK.get()
+    assert sw.try_swap_out(seq, mm)
+    assert seq.status is SequenceStatus.SWAPPED
+    FAULTS.arm("kvswap_transfer_fail:0:1")
+    kv = sw.apply(kv)          # gather fails; intent reverted in place
+    assert seq.status is SequenceStatus.PREEMPTED
+    assert seq.swap_host_pages is None
+    assert seq.num_computed_tokens == 0    # full re-prefill on resume
+    assert sw.pool.num_free == sw.pool.num_pages   # nothing leaked
+    assert kvswap_manager._M_FALLBACK.get() == fallback_before + 1
+    assert not sw.has_work
+
+
+@pytest.mark.chaos
+def test_kvswap_scatter_fault_propagates_to_quarantine():
+    mm, sw, kv = _swap_fixture()
+    seq = _running_seq(mm)
+    assert sw.try_swap_out(seq, mm)
+    kv = sw.apply(kv)                      # clean gather
+    # re-admission: fresh device pages covering the computed prefix +
+    # the queued restore
+    mm.allocate_seq_pages(seq, 0)
+    sw.record_swap_in(seq)
+    FAULTS.arm("kvswap_transfer_fail:0:1")
+    with pytest.raises(InjectedFault):
+        sw.apply(kv)   # a failed restore poisons the batch → step fails,
+        #                the serving engine quarantines it
+    # quarantine() then clears the wreckage
+    sw.quarantine()
+    assert not sw.has_work or sw.engine._pending  # queued intents gone
+
+
+@pytest.mark.chaos
+def test_host_canary_corrupt_is_detected_as_miss():
+    mm, sw, kv = _swap_fixture()
+    canary_before = kvswap_manager._M_CANARY.get()
+    (page,) = sw.pool.allocate(1)
+    FAULTS.arm("host_canary_corrupt:0:1")
+    sw.pool.put_prefix(page, b"digest", (1, 2, 3, 4, 5, 6, 7, 8))
+    # the poisoned entry must never be served — and it is dropped
+    assert sw.match_host_prefix(b"digest", [1, 2, 3, 4, 5, 6, 7, 8]) \
+        is None
+    assert kvswap_manager._M_CANARY.get() == canary_before + 1
+    assert sw.pool.hash_to_page.get(b"digest") is None
+
+
+def test_quarantine_drops_queued_swap_intents():
+    mm, sw, kv = _swap_fixture()
+    seq = _running_seq(mm, sid=1)
+    assert sw.try_swap_out(seq, mm)
+    assert sw.has_work
+    sw.quarantine()
+    assert not sw._out and not sw._in
+    # the swapped seq reverted to recompute, host pages freed
+    assert seq.status is SequenceStatus.PREEMPTED
+    assert sw.pool.num_free == sw.pool.num_pages
+
+
+# ---- abort / disconnect races (satellites) ---------------------------------
+
+def test_abort_waiting_request_never_scheduled(tiny_ckpt, engines):
+    llm = make_llm(tiny_ckpt, max_num_seqs=1)
+    baseline = free_pages(llm)
+    eng = engines(llm)
+    ha = eng.submit(list(PROMPT), SamplingParams(**LONG.__dict__))
+    hb = eng.submit([4, 4, 4, 4], SamplingParams(**SHORT.__dict__))
+    # B is scheduler-resident but never scheduled (max_num_seqs=1)
+    eng.abort(hb.seq_id)
+    chunks_b = collect(hb)
+    assert chunks_b[-1].finish_reason == "abort"
+    assert all(c.token_id is None for c in chunks_b)
+    assert collect(ha)[-1].finish_reason == "length"
+    wait_until(lambda: free_pages(llm) == baseline, what="pages freed")
+    assert not eng._handles and not eng._emitted and not eng._seqs
+    assert not eng._deadlines
+
+
+def test_abort_between_submit_and_first_step(tiny_ckpt, engines):
+    llm = make_llm(tiny_ckpt)
+    baseline = free_pages(llm)
+    eng = engines(llm)
+    h = eng.submit(list(PROMPT), SamplingParams(**LONG.__dict__))
+    eng.abort(h.seq_id)          # races intake drain / first schedule
+    chunks = collect(h)
+    assert chunks[-1].finish_reason in ("abort", "length")
+    wait_until(lambda: not llm.has_unfinished, what="engine idle")
+    wait_until(lambda: free_pages(llm) == baseline, what="pages freed")
+    assert not eng._handles and not eng._emitted and not eng._seqs
+
+
+def test_double_abort_is_idempotent(tiny_ckpt, engines):
+    llm = make_llm(tiny_ckpt, max_num_seqs=1)
+    eng = engines(llm)
+    ha = eng.submit(list(PROMPT), SamplingParams(**LONG.__dict__))
+    hb = eng.submit([3, 3, 3], SamplingParams(**SHORT.__dict__))
+    eng.abort(hb.seq_id)
+    eng.abort(hb.seq_id)
+    chunks = collect(hb)
+    assert chunks[-1].finish_reason == "abort"
+    eng.abort(hb.seq_id)         # after reap: still a no-op
+    collect(ha)
+    wait_until(lambda: not eng._handles, what="handles reaped")
+    time.sleep(0.2)              # give a buggy double-delivery time
+    assert hb.chunks.qsize() == 0
+
+
+def test_shutdown_closes_open_handles(tiny_ckpt, engines):
+    llm = make_llm(tiny_ckpt)
+    eng = engines(llm)
+    h = eng.submit(list(PROMPT),
+                   SamplingParams(temperature=0.0, max_tokens=200,
+                                  ignore_eos=True))
+    eng.shutdown()
+    chunks = collect(h, timeout=15.0)
+    assert chunks and chunks[-1].finish_reason is not None
+
+
+def test_handle_detects_dead_engine():
+    class DeadEngine:
+        is_alive = False
+
+    h = RequestHandle(1, 4, engine=DeadEngine())
+    h.POLL_S = 0.05
+    chunks = list(h)
+    assert len(chunks) == 1
+    assert chunks[0].finish_reason == "error"
+    assert "died" in chunks[0].error
+
+
+# ---- flag-off legacy equivalence -------------------------------------------
+
+def test_flags_off_token_stream_matches_offline_generate(tiny_ckpt,
+                                                         engines):
+    """With every robustness knob at its default and no fault armed, the
+    served token stream is byte-identical to the offline engine."""
+    sp = SamplingParams(temperature=0.0, max_tokens=16)
+    want = make_llm(tiny_ckpt).generate(
+        prompt_token_ids=[list(PROMPT)],
+        sampling_params=SamplingParams(**sp.__dict__))
+    llm = make_llm(tiny_ckpt)
+    eng = engines(llm)
+    assert eng.max_queued_requests == 0 and eng.max_resident_requests == 0
+    assert eng.request_deadline_s == 0.0 and eng.watchdog_stall_s == 0.0
+    chunks = collect(eng.submit(list(PROMPT),
+                                SamplingParams(**sp.__dict__)))
+    toks = [c.token_id for c in chunks if c.token_id is not None]
+    assert toks == want[0].output_token_ids
+    assert chunks[-1].finish_reason == want[0].finish_reason
+
+
+# ---- guard: every injection point is exercised -----------------------------
+
+def test_every_fault_point_has_a_chaos_test():
+    """New faults.py injection points cannot land untested: each name
+    must appear in the body of at least one @pytest.mark.chaos test in
+    this file."""
+    src = open(__file__).read()
+    tree = ast.parse(src)
+    chaos_bodies = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for dec in node.decorator_list:
+            if "chaos" in ast.unparse(dec):
+                chaos_bodies.append(ast.get_source_segment(src, node))
+    assert chaos_bodies, "no chaos-marked tests found"
+    blob = "\n".join(chaos_bodies)
+    missing = [p for p in POINTS if p not in blob]
+    assert not missing, (
+        f"faults.py points with no chaos test exercising them: {missing}")
