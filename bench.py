@@ -421,6 +421,13 @@ def main():
     # GLLM_BENCH_KV_DTYPE=int8 stores quantized KV with in-kernel dequant
     # on every rung; the default arm stays byte-identical legacy.
     kv_dtype = os.environ.get("GLLM_BENCH_KV_DTYPE", "auto") or "auto"
+    # Tiered-prefix-store lever (GLLM_BENCH_PREFIX=1): configure prefix
+    # caching + host pool + disk tier and run a repeated-system-prompt
+    # pass reporting per-tier hit rate and TTFT with/without the disk
+    # tier (docs/kv_offload.md). Off by default: the headline engine
+    # stays byte-identical (random ShareGPT prompts share no prefixes,
+    # but the A/B discipline is the same as the other levers).
+    prefix_bench = os.environ.get("GLLM_BENCH_PREFIX", "0") not in ("", "0")
     if args.tiny:
         model_cfg = ModelConfig(
             architecture="LlamaForCausalLM", vocab_size=2048,
@@ -496,6 +503,15 @@ def main():
             cache=CacheConfig(page_size=16, num_pages=8192,
                               kv_cache_dtype=kv_dtype))
         n_requests = args.requests or 160
+
+    if prefix_bench:
+        import tempfile
+        c = engine_cfg.cache
+        c.enable_prefix_caching = True
+        if not c.kv_host_pool_pages and c.kv_host_pool_gb <= 0:
+            c.kv_host_pool_pages = 256 if args.tiny else 2048
+        c.kv_disk_path = tempfile.mkdtemp(prefix="gllm_bench_kvdisk_")
+        c.kv_disk_gb = 2.0
 
     phase("backend_init")
     log(f"backend={jax.default_backend()} devices={jax.devices()} "
@@ -618,6 +634,74 @@ def main():
         log(f"sampled pass: {s_dt:.2f}s → {s_tokens / s_dt:.1f} "
             f"output tok/s ({n_sampled} reqs, temp=0.8 top_p=0.95)")
 
+    # Repeated-system-prompt pass (ISSUE 9): the workload real multi-user
+    # traffic is made of — N requests sharing one long system prefix with
+    # unique tails. Three arms probe the tier stack: "populate" (cold
+    # store; requests 2..N hit HBM), "disk" (HBM + host demoted to the
+    # disk tier first, so every prefix page restores from disk), and
+    # "no_tier" (tiers detached, full recompute — the without-disk
+    # control). Hit rate + TTFT p50 per arm land first-class in the
+    # result JSON.
+    prefix_result = None
+    if prefix_bench and getattr(llm, "prefix_tiers", None) is not None:
+        from gllm_tpu.sampling_params import SamplingParams
+        phase("prefix_pass")
+        sys_len = 64 if args.tiny else 512
+        n_pref = min(n_requests, 8 if args.tiny else 32)
+        shared = rng.integers(1, 30000, size=sys_len).tolist()
+        ttft_h = obs_metrics.REGISTRY.get("gllm_request_ttft_seconds")
+        q_m = obs_metrics.REGISTRY.get(
+            "gllm_prefix_cache_query_tokens_total")
+        h_m = obs_metrics.REGISTRY.get(
+            "gllm_prefix_cache_hit_tokens_total")
+        disk_hits = obs_metrics.REGISTRY.get("gllm_kvstore_hits_total")
+
+        def prefix_arm():
+            before, q0, h0 = ttft_h.snapshot(), q_m.get(), h_m.get()
+            p_prompts = [shared + rng.integers(
+                1, 30000, size=16).tolist() for _ in range(n_pref)]
+            p_params = [SamplingParams(temperature=0.0, max_tokens=8,
+                                       ignore_eos=True)
+                        for _ in range(n_pref)]
+            t0 = time.monotonic()
+            llm.generate(prompt_token_ids=p_prompts,
+                         sampling_params=p_params)
+            p50 = obs_metrics.percentile(ttft_h, 0.5, before=before)
+            dq, dh = q_m.get() - q0, h_m.get() - h0
+            return {"hit_rate": round(dh / dq, 4) if dq else 0.0,
+                    "ttft_p50_s": (round(p50, 4) if p50 is not None
+                                   else None),
+                    "wall_s": round(time.monotonic() - t0, 2)}
+
+        arms = {"populate": prefix_arm()}
+        moved = llm.demote_prefix_cache()
+        d0 = disk_hits.get(tier="disk")
+        arms["disk"] = prefix_arm()
+        disk_pages = disk_hits.get(tier="disk") - d0
+        # control: detach the tiers AND the eviction demotion hook, and
+        # forget every upper level (HBM maps + host-pool entries — the
+        # disk arm re-staged pages there), so the same workload
+        # recomputes every prefix token with true-legacy eviction costs
+        pool = llm.swap_manager.pool
+        llm.swap_manager.tiers, pool.on_evict = None, None
+        mm = llm.memory_manager
+        mm.hash_to_page.clear(); mm.page_meta.clear()
+        mm._seq_chain.clear()
+        for p in list(pool.page_meta):
+            pool.drop_prefix(p)
+        arms["no_tier"] = prefix_arm()
+        llm.swap_manager.tiers = llm.prefix_tiers
+        pool.on_evict = llm.prefix_tiers._on_host_evict
+        prefix_result = {"system_prompt_tokens": sys_len,
+                         "requests": n_pref,
+                         "pages_demoted": moved,
+                         "disk_hit_pages": int(disk_pages), **arms}
+        log(f"prefix pass: hit_rate populate={arms['populate']['hit_rate']}"
+            f" disk={arms['disk']['hit_rate']} "
+            f"no_tier={arms['no_tier']['hit_rate']}; ttft_p50 "
+            f"disk={arms['disk']['ttft_p50_s']} vs "
+            f"no_tier={arms['no_tier']['ttft_p50_s']}")
+
     phase("report")
     # MFU: every processed token (prompt + output) makes one forward pass.
     total_proc = total_in + total_out
@@ -656,6 +740,12 @@ def main():
     }
     if sampled_result is not None:
         result["sampled"] = sampled_result
+    if prefix_result is not None:
+        # tiered prefix store A/B (ISSUE 9, GLLM_BENCH_PREFIX=1):
+        # repeated-system-prompt hit rate + TTFT with the disk tier vs
+        # full recompute — first-class so the trajectory tracks it
+        result["prefix"] = prefix_result
+        result["prefix_tiers"] = True
     print(json.dumps(result))
 
 

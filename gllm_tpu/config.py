@@ -66,12 +66,30 @@ class CacheConfig:
     # "recompute" forces the legacy free-and-recompute preemption even
     # with a pool configured.
     swap_policy: str = "auto"
+    # ---- tiered prefix store (gllm_tpu/kvstore, docs/kv_offload.md) ----
+    # Disk tier behind the host pool (--kv-disk-path): content-addressed
+    # prefix-page files written on host-tier eviction, probed on host
+    # miss, byte-budgeted LRU (--kv-disk-gb). Requires the host pool and
+    # prefix caching; None disables the tier (byte-identical legacy).
+    kv_disk_path: Optional[str] = None
+    kv_disk_gb: float = 4.0
+    # Cluster tier (--prefix-peers): comma-separated host:port of peer
+    # replicas' prefix servers — match_prefix can restore a prefix
+    # another replica computed. --prefix-serve-port starts this
+    # replica's serving endpoint (0 = ephemeral; None = don't serve).
+    prefix_peers: Optional[str] = None
+    prefix_serve_port: Optional[int] = None
 
     @property
     def host_pool_configured(self) -> bool:
         return (self.swap_policy != "recompute"
                 and (self.kv_host_pool_gb > 0
                      or bool(self.kv_host_pool_pages)))
+
+    @property
+    def kvstore_configured(self) -> bool:
+        return bool(self.kv_disk_path or self.prefix_peers
+                    or self.prefix_serve_port is not None)
 
 
 @dataclasses.dataclass
@@ -346,3 +364,30 @@ class EngineConfig:
             raise ValueError(
                 "swap_policy='swap' needs a host pool: set "
                 "kv_host_pool_gb (--kv-host-pool-gb) > 0")
+        if self.cache.kvstore_configured:
+            # the lower tiers stage every restore through the host pool
+            # and only cache digest-keyed prefix pages — both upper
+            # layers must exist or the flags silently do nothing
+            if not self.cache.enable_prefix_caching:
+                raise ValueError(
+                    "--kv-disk-path/--prefix-peers/--prefix-serve-port "
+                    "extend the prefix cache: add "
+                    "--enable-prefix-caching")
+            if not self.cache.host_pool_configured:
+                raise ValueError(
+                    "the disk/peer prefix tiers stage restores through "
+                    "the host pool: set --kv-host-pool-gb > 0")
+            if self.cache.kv_disk_path and self.cache.kv_disk_gb <= 0:
+                raise ValueError("kv_disk_gb (--kv-disk-gb) must be > 0 "
+                                 "when --kv-disk-path is set")
+            if self.cache.prefix_peers:
+                # a typo'd peer must fail startup, not the first
+                # scheduling probe
+                from gllm_tpu.kvstore.peer import parse_peer_addr
+                for a in self.cache.prefix_peers.split(","):
+                    if a.strip():
+                        parse_peer_addr(a)
+            if self.cache.prefix_serve_port is not None \
+                    and self.cache.prefix_serve_port < 0:
+                raise ValueError("prefix_serve_port must be >= 0 "
+                                 "(0 = ephemeral)")

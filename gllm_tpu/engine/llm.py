@@ -293,8 +293,11 @@ class LLM:
         and the topology supports it. Gated to the single-program runner
         (pp = dp = 1) and paged-only KV layouts (hybrid GDN state lives
         in slot pools, not pages — swapping its KV without the recurrent
-        state would corrupt the recurrence)."""
+        state would corrupt the recurrence). When disk/peer prefix tiers
+        are configured (gllm_tpu/kvstore) they attach below the host
+        pool here too."""
         cache = self.config.cache
+        self.prefix_tiers = None
         if not cache.host_pool_configured:
             return None
         import jax
@@ -326,7 +329,52 @@ class LLM:
         logger.info("KV host tier: %d pages x %d tokens (%.2f GiB)",
                     n, cache.page_size,
                     n * sw.pool.bytes_per_page / (1 << 30))
+        if cache.kvstore_configured and cache.enable_prefix_caching:
+            # tiered prefix store (docs/kv_offload.md): disk behind the
+            # host pool + cluster-wide digest-addressed sharing. Probes
+            # run HBM → host → disk → peer; every restore stages through
+            # the host pool and rides the swap intent queue, so device
+            # ordering guarantees are untouched.
+            from gllm_tpu.kvstore import build_tiers
+            self.prefix_tiers = sw.tiers = build_tiers(sw.pool, cache)
+            logger.info(
+                "prefix store tiers: disk=%s peers=%s serving=%s",
+                cache.kv_disk_path or "off",
+                cache.prefix_peers or "off",
+                f"port {self.prefix_tiers.server.port}"
+                if self.prefix_tiers.server is not None else "off")
         return sw
+
+    def demote_prefix_cache(self) -> int:
+        """Persist the warm prefix cache down the tier stack: spill
+        every unclaimed (refcount-0) HBM prefix page through the host
+        tier, drain the gathers, then flush host-resident prefix pages
+        to the disk tier and drop the upper-tier keys — subsequent
+        probes (this engine or any replica sharing the store/peering to
+        it) restore from disk instead of recomputing. The operational
+        use is a graceful shutdown/restart or a bench A/B; call it only
+        between requests (no batch may be in flight). Returns the
+        number of pages flushed to disk; 0 when no disk tier is
+        configured."""
+        mm, sw = self.memory_manager, self.swap_manager
+        if sw is None or self.prefix_tiers is None \
+                or self.prefix_tiers.disk is None:
+            return 0
+        for page, meta in list(mm.page_meta.items()):
+            digest, canary = meta[0], meta[1]
+            if mm.hash_to_page.get(digest) == page \
+                    and page not in mm.ref_count:
+                sw.spill_prefix(page, digest, canary,
+                                parent=mm._digest_parent.get(digest))
+        # drain like a dispatch would, then land the gathers NOW (the
+        # usual double buffer has no next step to ride)
+        self.runner.kv = sw.apply(self.runner.kv)
+        sw._materialize()
+        moved = self.prefix_tiers.flush_host_to_disk(drop=True)
+        mm.hash_to_page.clear()
+        mm.page_meta.clear()
+        mm._seq_chain.clear()
+        return moved
 
     def init_disagg(self, disagg_cfg) -> None:
         """Become a disagg LM node: start the coordinator (slot pool,

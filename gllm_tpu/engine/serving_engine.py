@@ -387,6 +387,15 @@ class ServingEngine:
         # the loop's finally already closed the handles if the thread
         # exited; this is the backstop for a hung/killed thread
         self._close_open_handles("abort", "engine shutdown")
+        tiers = getattr(self.llm, "prefix_tiers", None)
+        if tiers is not None:
+            # stop serving peers, drain pending disk writes; host-tier
+            # pages are NOT force-demoted here (an operator who wants
+            # the warm cache persisted calls flush_host_to_disk first)
+            try:
+                tiers.close()
+            except Exception:  # pragma: no cover - shutdown must finish
+                logger.exception("prefix store close failed")
 
     # ---- engine thread ----------------------------------------------------
 

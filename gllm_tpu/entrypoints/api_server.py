@@ -264,6 +264,20 @@ class Handler(BaseHTTPRequestHandler):
                 "page_size": cfg.cache.page_size,
                 "num_pages": st.llm.runner.num_pages,
                 "prefix_caching": cfg.cache.enable_prefix_caching,
+                # tiered prefix store (docs/kv_offload.md): which lower
+                # tiers are live, and the peer-server address peers
+                # should put in their --prefix-peers
+                "prefix_store": {
+                    "host_pool": cfg.cache.host_pool_configured,
+                    "disk_path": cfg.cache.kv_disk_path,
+                    "peers": cfg.cache.prefix_peers,
+                    "serve_port": (
+                        st.llm.prefix_tiers.server.port
+                        if getattr(st.llm, "prefix_tiers", None)
+                        is not None
+                        and st.llm.prefix_tiers.server is not None
+                        else None),
+                },
                 "parallel": {"tp": cfg.parallel.tp, "dp": cfg.parallel.dp,
                              "pp": cfg.parallel.pp},
                 "attention_impl": st.llm.runner.attn_impl,
@@ -674,6 +688,10 @@ def build_engine_config(args) -> EngineConfig:
             enable_prefix_caching=args.enable_prefix_caching,
             kv_host_pool_gb=args.kv_host_pool_gb,
             swap_policy=args.swap_policy,
+            kv_disk_path=args.kv_disk_path,
+            kv_disk_gb=args.kv_disk_gb,
+            prefix_peers=args.prefix_peers,
+            prefix_serve_port=args.prefix_serve_port,
         ),
         parallel=ParallelConfig(
             pp=args.pp, tp=args.tp, dp=args.dp,
@@ -742,6 +760,24 @@ def make_parser() -> argparse.ArgumentParser:
                    help="auto: swap iff a host pool is configured; "
                         "swap: require the pool; recompute: legacy "
                         "free-and-recompute preemption")
+    p.add_argument("--kv-disk-path", default=None,
+                   help="disk prefix tier behind the host pool: "
+                        "content-addressed page files under this "
+                        "directory, written on host-tier eviction, "
+                        "probed on host miss (needs "
+                        "--enable-prefix-caching and --kv-host-pool-gb; "
+                        "docs/kv_offload.md)")
+    p.add_argument("--kv-disk-gb", type=float, default=4.0,
+                   help="byte budget of the disk prefix tier "
+                        "(LRU-evicted above it)")
+    p.add_argument("--prefix-peers", default=None,
+                   help="comma-separated host:port of peer replicas' "
+                        "prefix servers — match_prefix restores "
+                        "digest-addressed pages another replica "
+                        "computed (docs/kv_offload.md)")
+    p.add_argument("--prefix-serve-port", type=int, default=None,
+                   help="serve this replica's prefix pages to peers on "
+                        "this port (0 = ephemeral; omit to not serve)")
     p.add_argument("--allow-hub-download", action="store_true",
                    help="resolve a non-local model id via HF-hub snapshot "
                         "download (file-lock serialized); default is "

@@ -33,6 +33,13 @@ Points and their wired sites:
 - ``intake_burst``       makes one ``ServingEngine.submit`` behave as if
                          the intake queue were saturated → exercises the
                          HTTP 429 admission rejection
+- ``disk_read_corrupt``  corrupts the canary read back by
+                         ``DiskPrefixStore.get`` → exercises the disk
+                         tier's poison-drop (entry deleted, probe falls
+                         to the next tier; docs/kv_offload.md)
+- ``peer_prefix_timeout`` makes one ``PrefixClient.fetch`` behave as a
+                         peer deadline expiry → exercises the
+                         bounded-timeout miss (next tier, never a stall)
 
 Firing a point records a ``fault`` event on the steptrace ring. Everything
 here is stdlib-only and cheap when disarmed: ``fire()`` is one attribute
@@ -59,6 +66,8 @@ POINTS = (
     "host_canary_corrupt",
     "dispatch_stall",
     "intake_burst",
+    "disk_read_corrupt",
+    "peer_prefix_timeout",
 )
 
 

@@ -1,0 +1,47 @@
+"""Tiered prefix KV store (docs/kv_offload.md).
+
+Extends the two-level prefix cache (HBM + host RAM) downward to disk and
+outward across replicas:
+
+- ``DiskPrefixStore``  — content-addressed page files behind the host
+  pool: written on host-tier eviction, probed on host miss, byte-
+  budgeted LRU, async read-ahead of chained descendants;
+- ``PeerPrefixServer`` / ``PrefixClient`` — digest-addressed page
+  exchange between replicas over the blob-channel wire, with page
+  geometry / kv-dtype negotiation;
+- ``TieredPrefixManager`` — probe order (HBM → host → disk → peer),
+  demotion on eviction, and the peer-serving surface. Restores always
+  stage through the host pool and ride the existing ``KVSwapManager``
+  intent queue, so device ordering guarantees are untouched.
+
+Flag-off (no ``--kv-disk-path`` / ``--prefix-peers`` /
+``--prefix-serve-port``) nothing here is imported and every probe path
+is byte-identical to the two-level legacy.
+"""
+
+from gllm_tpu.kvstore.disk import DiskPrefixStore
+from gllm_tpu.kvstore.manager import TieredPrefixManager
+from gllm_tpu.kvstore.pagefmt import pool_geometry
+from gllm_tpu.kvstore.peer import PeerPrefixServer, PrefixClient
+
+__all__ = ["DiskPrefixStore", "PeerPrefixServer", "PrefixClient",
+           "TieredPrefixManager", "pool_geometry", "build_tiers"]
+
+
+def build_tiers(pool, cache_cfg) -> TieredPrefixManager:
+    """Wire the configured lower tiers onto a ``HostKVPool``
+    (engine-side entry point; ``cache_cfg`` is the ``CacheConfig``)."""
+    geometry = pool_geometry(pool.page_shapes, cache_cfg.page_size)
+    disk = None
+    if cache_cfg.kv_disk_path:
+        disk = DiskPrefixStore(cache_cfg.kv_disk_path,
+                               int(cache_cfg.kv_disk_gb * (1 << 30)),
+                               geometry)
+    client = None
+    if cache_cfg.prefix_peers:
+        client = PrefixClient(cache_cfg.prefix_peers.split(","), geometry)
+    tiers = TieredPrefixManager(pool, cache_cfg.page_size, disk=disk,
+                                client=client)
+    if cache_cfg.prefix_serve_port is not None:
+        tiers.start_server(port=cache_cfg.prefix_serve_port)
+    return tiers

@@ -1,0 +1,381 @@
+"""Disk tier of the prefix KV store.
+
+Content-addressed page files under one directory: `<digest hex>.kvp`,
+each holding a ``pagefmt`` payload (header + all KV leaves of one page).
+The "LLM in a flash" argument (PAPERS.md) is that an SSD tier pays off
+when transfers are large and sequential — a prefix page is exactly that
+(hundreds of KiB in one contiguous read/write), and the chained-digest
+structure gives a *free prefetch oracle*: a hit on page ``i`` of a chain
+makes pages ``i+1..`` overwhelmingly likely next, so descendants are
+read ahead asynchronously into a small in-memory staging cache.
+
+Safety model is the host tier's, extended one level down:
+
+- entries are keyed by the chained digest and verified against the same
+  8-token canary on every read; a mismatch (corruption, collision, or
+  the ``disk_read_corrupt`` fault point) is a **poison-drop** — the file
+  is deleted and the probe misses, so the disk tier can serve stale or
+  corrupt KV to nobody, exactly once or never;
+- writes go through ``tmp + os.replace`` so a crash mid-write leaves
+  either the old entry or the new one, never a torn file — which also
+  makes a directory shared between replicas safe (last writer wins on
+  identical content);
+- the byte budget is enforced by LRU over files; eviction here is final
+  (there is no tier below), mirroring what the host tier did before it
+  had this one.
+
+Thread model: the engine thread calls ``put``/``get``; a peer-server
+handler thread may call ``get_payload``; one internal worker thread
+performs file writes and read-ahead. All index state is under one lock;
+file reads happen outside it (a concurrently deleted file reads as a
+miss). No jax anywhere.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from gllm_tpu.faults import FAULTS
+from gllm_tpu.kvstore import stats
+from gllm_tpu.kvstore.pagefmt import (assemble_payload, coerce_leaves,
+                                      header_meta, pack_header,
+                                      read_header, verify_payload)
+from gllm_tpu.utils import LRUBytesCache
+
+logger = logging.getLogger(__name__)
+
+_SUFFIX = ".kvp"
+_BAD = object()   # _read_parent sentinel: file unreadable, delete it
+
+
+class DiskPrefixStore:
+    """Byte-budgeted, content-addressed page-file store."""
+
+    def __init__(self, path: str, max_bytes: int, geometry: dict,
+                 readahead_pages: int = 4, staging_mb: float = 64.0):
+        if max_bytes < 1:
+            raise ValueError("disk tier needs a positive byte budget")
+        self.path = path
+        self.max_bytes = max_bytes
+        self.geometry = geometry
+        self.readahead_pages = readahead_pages
+        os.makedirs(path, exist_ok=True)
+        self._lock = threading.RLock()
+        # digest -> file bytes, oldest-first (the eviction frontier)
+        self._lru: "OrderedDict[bytes, int]" = OrderedDict()
+        self._bytes = 0
+        # chain edges for read-ahead: parent digest -> child digests,
+        # plus the inverse so eviction can unlink its own edge
+        self._children: Dict[bytes, Set[bytes]] = {}
+        self._parent: Dict[bytes, bytes] = {}
+        # entries accepted by put() whose file write hasn't landed yet:
+        # digest -> (header prefix bytes, leaf arrays) — leaves
+        # serialize on the worker, not the engine thread
+        self._pending: Dict[bytes, tuple] = {}
+        # read-ahead staging: digest hex -> payload bytes
+        self._staged = LRUBytesCache(max_entries=256, max_mb=staging_mb)
+        self._worker = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="gllm-kvstore-disk")
+        self._scan()
+
+    # ---- index ------------------------------------------------------------
+
+    def _fname(self, digest: bytes) -> str:
+        return os.path.join(self.path, digest.hex() + _SUFFIX)
+
+    def _scan(self) -> None:
+        """Adopt pre-existing page files (a restarted engine warms from
+        its previous cache; replicas sharing a directory see each
+        other's flushes). LRU order approximated by mtime; unreadable
+        files are deleted on sight."""
+        entries = []
+        for name in os.listdir(self.path):
+            if not name.endswith(_SUFFIX):
+                continue
+            full = os.path.join(self.path, name)
+            try:
+                st = os.stat(full)
+                entries.append((st.st_mtime, name, st.st_size))
+            except OSError:
+                continue
+        for _, name, size in sorted(entries):
+            try:
+                digest = bytes.fromhex(name[:-len(_SUFFIX)])
+            except ValueError:
+                continue
+            parent = self._read_parent(self._fname(digest))
+            if parent is _BAD:
+                self._unlink(digest)
+                continue
+            self._lru[digest] = size
+            self._bytes += size
+            if parent is not None:
+                self._children.setdefault(parent, set()).add(digest)
+                self._parent[digest] = parent
+        # adoption counts against the budget too: a restart over an
+        # over-full directory (or a smaller --kv-disk-gb than last run)
+        # trims oldest-first right here instead of never
+        self._evict_over_budget()
+        self._update_gauges()
+        if self._lru:
+            logger.info("disk prefix tier adopted %d pages (%.1f MiB) "
+                        "from %s", len(self._lru),
+                        self._bytes / (1 << 20), self.path)
+
+    def _read_parent(self, full: str):
+        """Parent digest out of a file header; ``_BAD`` when unreadable."""
+        try:
+            with open(full, "rb") as f:
+                head = f.read(4)
+                if len(head) < 4:
+                    return _BAD
+                hlen = int.from_bytes(head, "big")
+                hdr = f.read(hlen)
+                if len(hdr) < hlen:
+                    return _BAD
+                header = read_header(head + hdr)
+            _, _, parent = header_meta(header)
+            return parent
+        except (OSError, ValueError, KeyError):
+            return _BAD
+
+    def _adopt_unscanned(self, digest: bytes) -> bool:
+        """A digest not in the index may still exist on a shared
+        directory (another replica flushed it after our scan) — stat
+        once and adopt it."""
+        try:
+            size = os.stat(self._fname(digest)).st_size
+        except OSError:
+            return False
+        self._lru[digest] = size
+        self._bytes += size
+        # link the chain edge like _scan does, or pages another replica
+        # flushed after our scan would never read ahead
+        parent = self._read_parent(self._fname(digest))
+        if parent is not None and parent is not _BAD:
+            self._children.setdefault(parent, set()).add(digest)
+            self._parent[digest] = parent
+        self._evict_over_budget()        # adoption respects the budget
+        self._update_gauges()
+        return digest in self._lru       # may have been the trim victim
+
+    # ---- write path -------------------------------------------------------
+
+    def put(self, digest: bytes, canary: Sequence[int],
+            parent: Optional[bytes],
+            leaves: Sequence[np.ndarray]) -> None:
+        """Store one page. The caller hands over OWNED leaf copies
+        (eviction hook / flush both copy under the pool lock), so only
+        the tiny header is built here — the leaf serialization and the
+        file write both land on the worker thread, off the scheduling
+        hot path."""
+        header = pack_header(digest, canary, parent, self.geometry)
+        leaves = coerce_leaves(leaves, self.geometry)
+        size = len(header) + sum(leaf.nbytes for leaf in leaves)
+        with self._lock:
+            if digest in self._lru or digest in self._pending:
+                return
+            self._pending[digest] = (header, leaves)
+            self._lru[digest] = size
+            self._bytes += size
+            if parent is not None:
+                self._children.setdefault(parent, set()).add(digest)
+                self._parent[digest] = parent
+            # a re-write of this digest must not serve an older staged
+            # copy (e.g. one that was poison-dropped and replaced)
+            self._staged.pop(digest.hex())
+            self._evict_over_budget()
+            self._update_gauges()
+        stats.BYTES.inc(size, tier="disk", dir="write")
+        self._worker.submit(self._write, digest)
+
+    def _write(self, digest: bytes) -> None:
+        with self._lock:
+            pending = self._pending.get(digest)
+        if pending is None:
+            return                       # evicted before the write landed
+        payload = assemble_payload(*pending)
+        full = self._fname(digest)
+        tmp = full + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(payload)
+            os.replace(tmp, full)
+        except OSError:
+            logger.exception("disk prefix tier write failed; dropping %s",
+                             digest.hex())
+            with self._lock:
+                self._forget(digest)
+            return
+        with self._lock:
+            self._pending.pop(digest, None)
+            if digest not in self._lru:
+                # evicted while the write was in flight: the replace
+                # above resurrected the file — take it back out, or a
+                # future stat would re-adopt a page the LRU discarded
+                self._unlink(digest)
+
+    def _evict_over_budget(self) -> None:
+        while self._bytes > self.max_bytes and len(self._lru) > 1:
+            victim, _ = next(iter(self._lru.items()))
+            self._forget(victim)
+            self._unlink(victim)
+            stats.EVICTIONS.inc(tier="disk")
+
+    def _forget(self, digest: bytes) -> None:
+        size = self._lru.pop(digest, None)
+        if size is not None:
+            self._bytes -= size
+        self._pending.pop(digest, None)
+        self._staged.pop(digest.hex())   # never serve a forgotten copy
+        self._children.pop(digest, None)
+        parent = self._parent.pop(digest, None)
+        if parent is not None:
+            kids = self._children.get(parent)
+            if kids is not None:
+                kids.discard(digest)
+                if not kids:
+                    del self._children[parent]
+        self._update_gauges()
+
+    def _unlink(self, digest: bytes) -> None:
+        try:
+            os.unlink(self._fname(digest))
+        except OSError:
+            pass
+
+    # ---- read path --------------------------------------------------------
+
+    def contains(self, digest: bytes) -> bool:
+        with self._lock:
+            return digest in self._lru or digest in self._pending
+
+    def _load_payload(self, digest: bytes) -> Optional[bytes]:
+        """Raw payload bytes: pending writes, then the staging cache,
+        then the file itself."""
+        payload = None
+        with self._lock:
+            pending = self._pending.get(digest)
+            if pending is not None:
+                payload = assemble_payload(*pending)
+            elif digest not in self._lru:
+                if not self._adopt_unscanned(digest):
+                    return None
+        if payload is None:
+            payload = self._staged.get(digest.hex())
+        if payload is None:
+            try:
+                with open(self._fname(digest), "rb") as f:
+                    payload = f.read()
+            except OSError:
+                with self._lock:
+                    self._forget(digest)
+                return None
+        return payload
+
+    def get(self, digest: bytes, tokens) -> Optional[
+            Tuple[List[np.ndarray], Optional[bytes]]]:
+        """Canary-verified read: ``(leaves, parent)`` on a hit, None on
+        a miss. Any verification failure poison-drops the entry. A hit
+        touches the LRU and kicks off read-ahead of chained
+        descendants."""
+        payload = self._load_payload(digest)
+        if payload is None:
+            stats.MISSES.inc(tier="disk")
+            return None
+        try:
+            # chaos point disk_read_corrupt (docs/robustness.md):
+            # simulate a bit-rotted read — the shared verification gate
+            # must catch it, drop the entry exactly once, and degrade
+            # to the next tier
+            leaves, parent = verify_payload(
+                payload, self.geometry, digest, tokens,
+                mangle_canary=FAULTS.fire("disk_read_corrupt"))
+        except (ValueError, KeyError):
+            self._poison(digest, "digest/canary/geometry")
+            return None
+        with self._lock:
+            if digest in self._lru:
+                self._lru.move_to_end(digest)
+        stats.HITS.inc(tier="disk")
+        stats.BYTES.inc(len(payload), tier="disk", dir="read")
+        self._readahead(digest)
+        return leaves, parent
+
+    def get_payload(self, digest: bytes) -> Optional[bytes]:
+        """Unverified raw payload — the peer-serving path (the FETCHING
+        side verifies canary + geometry before trusting it)."""
+        return self._load_payload(digest)
+
+    def _poison(self, digest: bytes, why: str) -> None:
+        logger.warning("disk prefix tier dropping poisoned entry %s (%s)",
+                       digest.hex(), why)
+        with self._lock:
+            self._forget(digest)
+        self._unlink(digest)
+        stats.POISON.inc(tier="disk")
+        stats.MISSES.inc(tier="disk")
+
+    # ---- read-ahead -------------------------------------------------------
+
+    def _readahead(self, digest: bytes) -> None:
+        """Stage chained descendants of a hit into memory so the
+        match_prefix walk's next probes read RAM, not disk."""
+        frontier, depth = [digest], 0
+        to_fetch: List[bytes] = []
+        with self._lock:
+            while frontier and depth < self.readahead_pages:
+                nxt = []
+                for d in frontier:
+                    for child in self._children.get(d, ()):
+                        if child in self._lru \
+                                and child not in self._pending:
+                            nxt.append(child)
+                frontier = nxt
+                to_fetch.extend(nxt)
+                depth += 1
+        for child in to_fetch:
+            if self._staged.get(child.hex()) is None:
+                self._worker.submit(self._stage, child)
+
+    def _stage(self, digest: bytes) -> None:
+        if self._staged.get(digest.hex()) is not None:
+            return
+        try:
+            with open(self._fname(digest), "rb") as f:
+                self._staged.put(digest.hex(), f.read())
+        except OSError:
+            pass
+
+    # ---- lifecycle --------------------------------------------------------
+
+    @property
+    def bytes_used(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._lru)
+
+    def flush(self) -> None:
+        """Block until every accepted put has landed on disk."""
+        self._worker.submit(lambda: None).result()
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        except RuntimeError:
+            pass                         # already shut down
+        self._worker.shutdown(wait=True)
+
+    def _update_gauges(self) -> None:
+        stats.DISK_USED.set(self._bytes)
+        stats.DISK_ENTRIES.set(len(self._lru))

@@ -1,0 +1,159 @@
+"""TieredPrefixManager: the probe-order owner of the prefix KV store.
+
+The prefix hierarchy is four levels, probed strictly in order of cost::
+
+    HBM (PrefixMemoryManager maps)          ~0, page already resident
+     └─ host RAM (HostKVPool)               one queued scatter
+         └─ disk (DiskPrefixStore)          one file read + scatter
+             └─ peers (PrefixClient)        one bounded RPC + scatter
+
+This class owns levels three and four and the demotion edge between two
+and three. It deliberately does NOT own a new restore path to the
+device: a disk or peer hit is **staged into the host pool** and returned
+as a host page id, so the existing ``KVSwapManager.restore_prefix``
+intent queue — and with it every device-ordering guarantee the runner's
+dispatch-time drain provides (docs/kv_offload.md) — carries the page the
+rest of the way. Lower tiers extend the hierarchy; they never add a
+second way to touch the device.
+
+Demotion mirrors promotion: the host pool's LRU eviction (which used to
+discard) now hands the evicted page's bytes to ``_on_host_evict``, which
+writes it to the disk tier — eviction becomes a demotion all the way
+down, and only the disk tier's own LRU ever discards for good.
+
+The peer-serving side (``serve``) runs on a server handler thread and
+reads the host pool under its lock, then falls back to the disk tier;
+payloads ship unverified (the fetching replica verifies digest + canary
+against its own geometry before trusting a byte).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Tuple
+
+import numpy as np
+
+from gllm_tpu.kvstore import stats
+from gllm_tpu.kvstore.disk import DiskPrefixStore
+from gllm_tpu.kvstore.pagefmt import pack_page, pool_geometry
+from gllm_tpu.kvstore.peer import PeerPrefixServer, PrefixClient
+
+logger = logging.getLogger(__name__)
+
+
+class TieredPrefixManager:
+    def __init__(self, pool, page_size: int,
+                 disk: Optional[DiskPrefixStore] = None,
+                 client: Optional[PrefixClient] = None):
+        self.pool = pool
+        self.geometry = pool_geometry(pool.page_shapes, page_size)
+        self.disk = disk
+        self.client = client
+        self.server: Optional[PeerPrefixServer] = None
+        # demotion hook: host-tier LRU eviction hands the page here
+        # instead of discarding it
+        pool.on_evict = self._on_host_evict
+
+    # ---- probe (engine thread; called by KVSwapManager on host miss) ------
+
+    def probe(self, digest: bytes, tokens
+              ) -> Optional[Tuple[int, str]]:
+        """Probe disk then peers for ``digest``. On a hit, stage the
+        page into the host pool (allocating, possibly demoting older
+        host pages to disk) and return ``(host_page, tier)`` — the
+        caller restores host→device through the normal intent queue.
+        None = every lower tier missed; the prefix walk stops and the
+        tokens recompute."""
+        got, tier = None, None
+        if self.disk is not None:
+            got = self.disk.get(digest, tokens)
+            if got is not None:
+                tier = "disk"
+        if got is None and self.client is not None:
+            got = self.client.fetch(digest, tokens)
+            if got is not None:
+                tier = "peer"
+        if got is None:
+            return None
+        leaves, parent = got
+        host = self.pool.allocate(1)
+        if host is None:
+            return None                  # pool full of pinned pages
+        page = host[0]
+        with self.pool.lock:
+            for store, leaf in zip(self.pool.store, leaves):
+                store[page] = leaf
+            self.pool.put_prefix(page, digest,
+                                 tuple(int(t) for t in
+                                       tokens[:self._canary_len()]),
+                                 parent=parent)
+        return page, tier
+
+    def _canary_len(self) -> int:
+        from gllm_tpu.kvswap.host_pool import CANARY_TOKENS
+        return CANARY_TOKENS
+
+    # ---- demotion (engine thread, inside HostKVPool eviction) -------------
+
+    def _on_host_evict(self, digest: bytes, canary, parent,
+                       leaves) -> None:
+        if self.disk is not None:
+            self.disk.put(digest, canary, parent, leaves)
+
+    def flush_host_to_disk(self, drop: bool = False) -> int:
+        """Demote every unpinned host-resident prefix page to the disk
+        tier NOW (graceful shutdown / bench lever: the warm cache
+        survives a restart). ``drop=True`` additionally forgets the host
+        entries, forcing subsequent probes through the disk tier.
+        Returns the number of pages demoted; blocks until the writes
+        land."""
+        if self.disk is None:
+            return 0
+        # snapshot copies under the lock; serialization + writes happen
+        # outside it so peer serving never blocks on a flush
+        with self.pool.lock:
+            items = [(page, meta) for page, meta
+                     in self.pool.page_meta.items()
+                     if self.pool.hash_to_page.get(meta[0]) == page
+                     and not self.pool.is_pinned(page)]
+            snap = [(meta, [s[page].copy() for s in self.pool.store])
+                    for page, meta in items]
+            if drop:
+                for page, _ in items:
+                    self.pool.drop_prefix(page)
+        for (digest, canary, parent), leaves in snap:
+            self.disk.put(digest, canary, parent, leaves)
+        self.disk.flush()
+        return len(snap)
+
+    # ---- peer serving (server handler thread) -----------------------------
+
+    def serve(self, digest: bytes) -> Optional[bytes]:
+        """Packed payload for a peer's fetch, or None. Host pool first
+        (locked copy), then the disk tier's raw file bytes."""
+        exported = self.pool.export_prefix(digest)
+        if exported is not None:
+            canary, parent, leaves = exported
+            return pack_page(digest, canary, parent, leaves,
+                             self.geometry)
+        if self.disk is not None:
+            return self.disk.get_payload(digest)
+        return None
+
+    def start_server(self, host: str = "0.0.0.0",
+                     port: int = 0) -> "PeerPrefixServer":
+        self.server = PeerPrefixServer(self.serve, self.geometry,
+                                       host=host, port=port)
+        return self.server
+
+    # ---- lifecycle --------------------------------------------------------
+
+    def close(self) -> None:
+        if self.server is not None:
+            self.server.close()
+            self.server = None
+        if self.client is not None:
+            self.client.close()
+        if self.disk is not None:
+            self.disk.close()

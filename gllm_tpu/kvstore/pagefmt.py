@@ -1,0 +1,169 @@
+"""Content-addressed prefix-page format shared by the disk and peer tiers.
+
+One *page payload* is the self-describing serialization of one host-pool
+page — every KV leaf's per-page slab — plus the metadata the lower tiers
+need to stay exactly as safe as the host tier they extend:
+
+- the **chained digest** (the content address; same
+  ``memory_manager.prefix_digests`` chain the HBM and host tiers key by),
+- the **8-token canary** (same collision guard: a reader verifies the
+  canary against the tokens it is probing for and treats any mismatch as
+  a poisoned miss),
+- the **parent digest** (the previous page in the chain — the disk
+  tier's read-ahead walks this edge to prefetch descendants),
+- the **geometry**: per-leaf shapes and dtypes plus the page size. A
+  payload written by an int8-KV replica is half the bytes of a bf16 one
+  and *must not* be restored into a bf16 pool — geometry mismatch is a
+  hard miss, which is what the peer protocol's hello negotiation checks
+  up front.
+
+Layout: ``u32 header_len | header JSON (utf-8) | leaf bytes...`` with
+leaves concatenated in pool order, C-contiguous. Stdlib + numpy only —
+no jax, no pickle (payloads cross trust boundaries: a peer fetch must
+never execute remote bytes).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HLEN = struct.Struct("!I")
+FORMAT_VERSION = 1
+
+
+def pool_geometry(page_shapes: Sequence[Tuple[tuple, np.dtype]],
+                  page_size: int) -> dict:
+    """Canonical geometry dict for a ``HostKVPool``-shaped page layout.
+    Two stores interoperate iff their geometries compare equal — this is
+    the negotiated object of the peer hello exchange."""
+    return {
+        "v": FORMAT_VERSION,
+        "page_size": int(page_size),
+        "leaves": [[list(int(x) for x in s), np.dtype(d).name]
+                   for s, d in page_shapes],
+    }
+
+
+def geometry_bytes(geometry: dict) -> int:
+    """Payload bytes one page of this geometry serializes to (leaves
+    only; the header adds ~200 B)."""
+    return sum(int(np.prod(s)) * np.dtype(d).itemsize
+               for s, d in geometry["leaves"])
+
+
+def pack_header(digest: bytes, canary: Sequence[int],
+                parent: Optional[bytes], geometry: dict) -> bytes:
+    """The ``[u32 len][header JSON]`` prefix of a payload — cheap (no
+    leaf bytes touched), so hot paths can compute exact payload sizes
+    and defer the leaf serialization to a worker."""
+    header = dict(geometry)
+    header["digest"] = digest.hex()
+    header["canary"] = [int(c) for c in canary]
+    header["parent"] = parent.hex() if parent else ""
+    hdr = json.dumps(header, separators=(",", ":")).encode()
+    return _HLEN.pack(len(hdr)) + hdr
+
+
+def coerce_leaves(leaves: Sequence[np.ndarray],
+                  geometry: dict) -> List[np.ndarray]:
+    """Validate leaves against the geometry and make them contiguous in
+    the right dtype (a no-op for pool slabs, which already match)."""
+    out = []
+    for leaf, (shape, dtype) in zip(leaves, geometry["leaves"]):
+        arr = np.ascontiguousarray(leaf, dtype=np.dtype(dtype))
+        if list(arr.shape) != list(shape):
+            raise ValueError(
+                f"leaf shape {arr.shape} does not match geometry {shape}")
+        out.append(arr)
+    return out
+
+
+def assemble_payload(header_prefix: bytes,
+                     leaves: Sequence[np.ndarray]) -> bytes:
+    return header_prefix + b"".join(leaf.tobytes() for leaf in leaves)
+
+
+def pack_page(digest: bytes, canary: Sequence[int],
+              parent: Optional[bytes], leaves: Sequence[np.ndarray],
+              geometry: dict) -> bytes:
+    return assemble_payload(pack_header(digest, canary, parent, geometry),
+                            coerce_leaves(leaves, geometry))
+
+
+def read_header(payload: bytes) -> dict:
+    """Header dict of a packed payload (no leaf deserialization)."""
+    if len(payload) < _HLEN.size:
+        raise ValueError("truncated page payload")
+    (hlen,) = _HLEN.unpack_from(payload)
+    if len(payload) < _HLEN.size + hlen:
+        raise ValueError("truncated page header")
+    return json.loads(payload[_HLEN.size:_HLEN.size + hlen].decode())
+
+
+def unpack_page(payload: bytes, geometry: dict
+                ) -> Tuple[dict, List[np.ndarray]]:
+    """Parse a payload and verify it against the LOCAL geometry.
+
+    Returns ``(header, leaves)``. Raises ``ValueError`` on any
+    structural mismatch — truncation, wrong leaf set, wrong dtype/shape,
+    wrong page size — so a caller can only ever restore bytes that mean
+    the same thing locally that they meant to the writer.
+    """
+    header = read_header(payload)
+    if (header.get("v") != geometry["v"]
+            or header.get("page_size") != geometry["page_size"]
+            or header.get("leaves") != geometry["leaves"]):
+        raise ValueError(
+            f"page geometry mismatch: payload "
+            f"{ {k: header.get(k) for k in ('v', 'page_size')} } vs local "
+            f"{ {k: geometry[k] for k in ('v', 'page_size')} }")
+    (hlen,) = _HLEN.unpack_from(payload)
+    off = _HLEN.size + hlen
+    leaves: List[np.ndarray] = []
+    for shape, dtype in geometry["leaves"]:
+        dt = np.dtype(dtype)
+        n = int(np.prod(shape)) * dt.itemsize
+        if off + n > len(payload):
+            raise ValueError("truncated page payload (leaf bytes)")
+        leaves.append(np.frombuffer(payload, dtype=dt, count=int(np.prod(shape)),
+                                    offset=off).reshape(shape))
+        off += n
+    if off != len(payload):
+        raise ValueError("trailing bytes after page payload")
+    return header, leaves
+
+
+def header_meta(header: dict) -> Tuple[bytes, Tuple[int, ...],
+                                       Optional[bytes]]:
+    """(digest, canary, parent) out of a parsed header."""
+    parent = bytes.fromhex(header["parent"]) if header.get("parent") \
+        else None
+    return (bytes.fromhex(header["digest"]),
+            tuple(int(c) for c in header["canary"]), parent)
+
+
+def verify_payload(payload: bytes, geometry: dict, digest: bytes,
+                   tokens, mangle_canary: bool = False
+                   ) -> Tuple[List[np.ndarray], Optional[bytes]]:
+    """THE verification gate every lower tier reads through: unpack
+    against the local geometry, then require the header's digest to be
+    the probed digest and its canary to match the probed tokens. Raises
+    ``ValueError`` on any mismatch — one implementation, so the disk
+    and peer tiers can never drift on what counts as trustworthy.
+    ``mangle_canary`` is the ``disk_read_corrupt`` chaos hook: it
+    simulates bit-rot AFTER unpack so the canary check must be what
+    catches it. Returns contiguous leaf COPIES (safe to write into pool
+    storage) plus the chain parent."""
+    header, leaves = unpack_page(payload, geometry)
+    got_digest, canary, parent = header_meta(header)
+    if mangle_canary:
+        canary = tuple(int(c) + 1 for c in canary)
+    if got_digest != digest:
+        raise ValueError("payload digest mismatch")
+    if tuple(tokens[:len(canary)]) != tuple(canary):
+        raise ValueError("payload canary mismatch")
+    return [np.array(leaf) for leaf in leaves], parent

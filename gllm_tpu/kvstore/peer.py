@@ -1,0 +1,367 @@
+"""Cluster tier of the prefix KV store: digest-addressed page exchange.
+
+Modeled on the multihost blob channel (docs/multihost_blob_channel.md):
+the same content-addressed pull protocol, the same "a vanished peer
+degrades the path, never fails it" posture. The difference is the payload — KV prefix
+pages instead of media blobs — which adds two obligations:
+
+- **geometry negotiation.** A fetched page is written straight into the
+  local host pool, so both sides must agree on page size, per-leaf
+  shapes, and kv dtype (an int8-KV replica's pages are half the bytes of
+  a bf16 replica's and mean different numbers). The first exchange on a
+  connection is ``hello`` → the server's ``pagefmt.pool_geometry``; any
+  mismatch disables that peer for the life of the client.
+- **verification at the trust boundary.** The server ships payloads
+  unverified (it may be streaming straight off its disk tier); the
+  CLIENT unpacks against its own geometry and checks digest + canary
+  before anything touches the pool. A bad payload is a miss, never an
+  exception on the scheduling path.
+
+Probe-latency contract: ``fetch`` is bounded by ``timeout_s`` per live
+peer (connect + request + response all under one socket deadline) and a
+failed/slow peer backs off, so the scheduler's match_prefix walk can
+never stall on the network — the ``peer_prefix_timeout`` chaos point
+proves the degrade path in tests.
+
+Wire framing is deliberately NOT the pickle framing of
+``disagg/wire.py`` (that plane runs between mutually trusting processes
+of one deployment): control frames here are ``[u32 len][JSON utf-8]``
+and page payloads are the raw ``pagefmt`` bytes — nothing received from
+a peer is ever unpickled, so a hostile or compromised peer can feed us
+at worst a payload that fails digest/canary/geometry verification.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from gllm_tpu.faults import FAULTS
+from gllm_tpu.kvstore import stats
+from gllm_tpu.kvstore.pagefmt import verify_payload
+
+logger = logging.getLogger(__name__)
+
+# Provider signature: digest -> packed payload (or None). The manager
+# backs this with host pool + disk tier.
+Provider = Callable[[bytes], Optional[bytes]]
+
+_LEN = struct.Struct("!I")
+_MAX_FRAME = 1 << 20            # control frames are tiny; cap hostile ones
+
+
+def _send_frame(sock: socket.socket, obj: dict,
+                raw: Optional[bytes] = None) -> None:
+    """``[u32][json]`` control frame, optionally followed by
+    ``[u32][raw bytes]`` (the pagefmt payload, shipped un-decoded)."""
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    parts = [_LEN.pack(len(body)), body]
+    if raw is not None:
+        parts += [_LEN.pack(len(raw)), raw]
+    sock.sendall(b"".join(parts))
+
+
+def _recv_exact(sock: socket.socket, n: int,
+                deadline: Optional[float] = None) -> Optional[bytes]:
+    """Like ``disagg/wire._recv_exact`` but DEADLINE-aware: the per-op
+    socket timeout alone lets a slow-dribbling peer stretch one logical
+    read to (bytes / chunk) × timeout — here the remaining wall budget
+    re-arms the socket timeout before every chunk, so the WHOLE read is
+    bounded (the reason this is not shared with wire.py, whose trusted
+    plane wants blocking reads)."""
+    buf = bytearray()
+    while len(buf) < n:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("peer read deadline exceeded")
+            sock.settimeout(remaining)
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def _recv_frame(sock: socket.socket, limit: int = _MAX_FRAME,
+                deadline: Optional[float] = None) -> Optional[dict]:
+    head = _recv_exact(sock, _LEN.size, deadline)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    if n > limit:
+        raise OSError(f"oversized peer frame ({n} B)")
+    body = _recv_exact(sock, n, deadline)
+    if body is None:
+        return None
+    obj = json.loads(body.decode())
+    if not isinstance(obj, dict):
+        raise OSError("peer frame is not an object")
+    return obj
+
+
+def _recv_payload(sock: socket.socket, limit: int,
+                  deadline: Optional[float] = None) -> Optional[bytes]:
+    head = _recv_exact(sock, _LEN.size, deadline)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    if n > limit:
+        raise OSError(f"oversized peer payload ({n} B)")
+    return _recv_exact(sock, n, deadline)
+
+
+def parse_peer_addr(addr: str) -> Tuple[str, int]:
+    """``host:port`` → validated pair; raises ``ValueError`` on a
+    malformed entry (checked at construction/config time so a typo in
+    ``--prefix-peers`` fails startup, not the first scheduling probe)."""
+    host, sep, port = addr.strip().rpartition(":")
+    if not sep or not host:
+        raise ValueError(f"peer address {addr!r} is not host:port")
+    try:
+        port_n = int(port)
+    except ValueError:
+        raise ValueError(f"peer address {addr!r} has a non-numeric port")
+    if not 0 < port_n < 65536:
+        raise ValueError(f"peer address {addr!r} port out of range")
+    return host, port_n
+
+
+class PeerPrefixServer:
+    """Read-only prefix-page endpoint over this replica's host + disk
+    tiers. One of these per serving replica (``--prefix-serve-port``);
+    other replicas point ``--prefix-peers`` at it."""
+
+    IDLE_S = 60.0
+
+    def __init__(self, provider: Provider, geometry: dict,
+                 host: str = "0.0.0.0", port: int = 0):
+        self._provider = provider
+        self._geometry = geometry
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                self.request.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                # idle bound: a connection that sends nothing (port
+                # scanner, wedged client) releases its handler thread
+                # and fd instead of pinning them forever
+                self.request.settimeout(PeerPrefixServer.IDLE_S)
+                while True:
+                    try:
+                        msg = _recv_frame(self.request)
+                        if msg is None:
+                            return
+                        outer._on_req(msg, self.request)
+                    except (OSError, ValueError):
+                        # idle timeout, hostile frame, or the client
+                        # hanging up mid-reply (its fetch deadline is
+                        # shorter than a slow send) — routine, not an
+                        # error: just drop the connection
+                        return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Server((host, port), _Handler)
+        self.port = self._srv.server_address[1]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        logger.info("prefix peer server on port %d", self.port)
+
+    def _on_req(self, msg: dict, sock) -> None:
+        op = msg.get("op")
+        if op == "hello":
+            _send_frame(sock, {"geometry": self._geometry})
+        elif op == "get":
+            try:
+                digest = bytes.fromhex(msg.get("digest", ""))
+            except (TypeError, ValueError):
+                _send_frame(sock, {"hit": False}, raw=b"")
+                return
+            try:
+                payload = self._provider(digest)
+            except Exception:            # serving must never kill the conn
+                logger.exception("prefix serve failed for %s",
+                                 msg.get("digest"))
+                payload = None
+            if payload is not None:
+                stats.PEER_SERVED.inc()
+                stats.BYTES.inc(len(payload), tier="peer", dir="write")
+            _send_frame(sock, {"hit": payload is not None},
+                        raw=payload or b"")
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class PrefixClient:
+    """Fetch-by-digest against a list of peer replicas.
+
+    Peers are tried in order; each attempt is deadline-bounded and a
+    peer that times out / errors backs off for ``BACKOFF_S`` (a
+    geometry-mismatched peer is disabled permanently). Thread-safe for
+    the single engine thread that probes it; sockets are cached per
+    peer.
+    """
+
+    BACKOFF_S = 30.0
+
+    def __init__(self, peers: Sequence[str], geometry: dict,
+                 timeout_s: Optional[float] = None):
+        self.geometry = geometry
+        # expected payload size: geometry is fixed, so anything larger
+        # than the page bytes + header slack is hostile/corrupt
+        from gllm_tpu.kvstore.pagefmt import geometry_bytes
+        self._payload_limit = geometry_bytes(geometry) + 4096
+        self.timeout_s = (timeout_s if timeout_s is not None else float(
+            os.environ.get("GLLM_PREFIX_PEER_TIMEOUT_S", "2.0")))
+        # guards peer/socket state: fetch() runs on the engine thread,
+        # close() on whatever thread drives shutdown
+        self._lock = threading.Lock()
+        self._closed = False
+        # addr -> {sock, negotiated (None=not yet, False=refused),
+        #          down_until}; parse up front so a malformed
+        #          --prefix-peers entry fails construction, not the
+        #          first scheduling probe
+        self._peers: Dict[Tuple[str, int], dict] = {
+            parse_peer_addr(a): {"sock": None, "negotiated": None,
+                                 "down_until": 0.0}
+            for a in peers if a.strip()}
+        if not self._peers:
+            raise ValueError("prefix client needs at least one peer")
+
+    # ---- connection management -------------------------------------------
+
+    def _connect(self, addr: Tuple[str, int]) -> socket.socket:
+        sock = socket.create_connection(addr, timeout=self.timeout_s)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _drop(self, addr, st: dict, backoff: bool = True) -> None:
+        sock, st["sock"] = st["sock"], None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if backoff:
+            st["down_until"] = time.monotonic() + self.BACKOFF_S
+
+    def _negotiate(self, addr, st: dict, sock: socket.socket,
+                   deadline: Optional[float] = None) -> bool:
+        """hello → geometry check, once per client lifetime per peer."""
+        _send_frame(sock, {"op": "hello"})
+        reply = _recv_frame(sock, deadline=deadline)
+        if reply is None:
+            raise OSError("bad hello reply")
+        if reply.get("geometry") != self.geometry:
+            logger.warning(
+                "prefix peer %s refused: page geometry/kv-dtype mismatch "
+                "(%s vs local %s) — peer disabled", addr,
+                {k: reply.get("geometry", {}).get(k)
+                 for k in ("page_size", "v")},
+                {k: self.geometry[k] for k in ("page_size", "v")})
+            st["negotiated"] = False
+            self._drop(addr, st, backoff=False)
+            return False
+        st["negotiated"] = True
+        return True
+
+    # ---- fetch ------------------------------------------------------------
+
+    def fetch(self, digest: bytes, tokens) -> Optional[
+            Tuple[List[np.ndarray], Optional[bytes]]]:
+        """``(leaves, parent)`` from the first peer that can serve this
+        digest, canary-verified; None = every peer missed / was down.
+        Bounded: one ``timeout_s`` deadline per live peer, no retries
+        inside the call."""
+        if FAULTS.fire("peer_prefix_timeout"):
+            # chaos point (docs/robustness.md): the whole peer tier
+            # behaves as a deadline expiry — the probe degrades to the
+            # next tier (recompute) without stalling
+            stats.PEER_TIMEOUTS.inc()
+            stats.MISSES.inc(tier="peer")
+            return None
+        now = time.monotonic()
+        with self._lock:
+            peers = list(self._peers.items())
+        for addr, st in peers:
+            if st["negotiated"] is False or now < st["down_until"]:
+                continue
+            # ONE wall-clock budget covers connect + hello + request +
+            # full response for this peer — a dribbling sender can't
+            # stretch a probe past timeout_s by keeping each recv alive
+            deadline = time.monotonic() + self.timeout_s
+            hdr = raw = None
+            for _retry in range(2):
+                try:
+                    # hold a LOCAL ref: a concurrent close() nulls
+                    # st["sock"], and the closed socket must surface as
+                    # the OSError below, never an AttributeError
+                    with self._lock:
+                        if self._closed:
+                            return None
+                        sock = st["sock"]
+                        fresh = sock is None
+                        if fresh:
+                            sock = st["sock"] = self._connect(addr)
+                    if st["negotiated"] is None and not self._negotiate(
+                            addr, st, sock, deadline):
+                        break
+                    _send_frame(sock, {"op": "get",
+                                       "digest": digest.hex()})
+                    hdr = _recv_frame(sock, deadline=deadline)
+                    raw = (None if hdr is None else
+                           _recv_payload(sock, self._payload_limit,
+                                         deadline))
+                    if hdr is None or raw is None:
+                        raise OSError("peer closed mid-reply")
+                    break
+                except (socket.timeout, TimeoutError):
+                    stats.PEER_TIMEOUTS.inc()
+                    logger.warning("prefix peer %s timed out (%.1fs); "
+                                   "backing off", addr, self.timeout_s)
+                    self._drop(addr, st)
+                    break
+                except (OSError, ConnectionError, ValueError):
+                    # ValueError = garbled JSON control frame: same
+                    # posture as a broken pipe. A CACHED socket may
+                    # just have idled past the server's IDLE_S — retry
+                    # once on a fresh connection before backing off.
+                    hdr = raw = None
+                    self._drop(addr, st, backoff=fresh)
+                    if fresh:
+                        break
+            if not (hdr and hdr.get("hit") and raw):
+                continue        # clean miss or transport failure here
+            try:
+                leaves, parent = verify_payload(raw, self.geometry,
+                                                digest, tokens)
+            except (ValueError, KeyError):
+                stats.POISON.inc(tier="peer")
+                continue
+            stats.HITS.inc(tier="peer")
+            stats.BYTES.inc(len(raw), tier="peer", dir="read")
+            return leaves, parent
+        stats.MISSES.inc(tier="peer")
+        return None
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            for addr, st in self._peers.items():
+                self._drop(addr, st, backoff=False)

@@ -1,0 +1,46 @@
+"""Metric family of the tiered prefix store (docs/observability.md).
+
+One module owns the registrations so the disk tier, the peer tiers, and
+the manager share the exact same metric objects — the registry would
+reject a drifted re-registration, but sharing them makes drift
+impossible by construction.
+"""
+
+from __future__ import annotations
+
+from gllm_tpu.obs import metrics as obs
+
+# tier ∈ {disk, peer} — the two tiers this subsystem adds below the
+# existing HBM / host levels (whose hit accounting lives in
+# memory_manager / kvswap; the steptrace `prefix` events unify all four).
+HITS = obs.counter(
+    "gllm_kvstore_hits_total",
+    "prefix-page probes served by a kvstore tier", ("tier",))
+MISSES = obs.counter(
+    "gllm_kvstore_misses_total",
+    "prefix-page probes a kvstore tier could not serve", ("tier",))
+POISON = obs.counter(
+    "gllm_kvstore_poison_drops_total",
+    "kvstore entries dropped on canary/geometry verification failure "
+    "(corruption or hash collision — treated as a miss, never served)",
+    ("tier",))
+EVICTIONS = obs.counter(
+    "gllm_kvstore_evictions_total",
+    "kvstore entries evicted by the tier's byte-budgeted LRU", ("tier",))
+BYTES = obs.counter(
+    "gllm_kvstore_bytes_total",
+    "payload bytes moved through a kvstore tier (dir=read|write; int8 "
+    "KV pages move roughly half the bf16 bytes)", ("tier", "dir"))
+DISK_USED = obs.gauge(
+    "gllm_kvstore_disk_used_bytes",
+    "bytes currently stored by the disk prefix tier")
+DISK_ENTRIES = obs.gauge(
+    "gllm_kvstore_disk_entries",
+    "page files currently stored by the disk prefix tier")
+PEER_TIMEOUTS = obs.counter(
+    "gllm_kvstore_peer_timeouts_total",
+    "peer prefix fetches abandoned at the deadline (the probe degrades "
+    "to the next tier; it never stalls the scheduler)")
+PEER_SERVED = obs.counter(
+    "gllm_kvstore_peer_served_total",
+    "prefix pages this replica served to peers")

@@ -16,21 +16,36 @@ Two tenant classes share the pool:
   treated as a miss and the poisoned entry dropped — the host tier can
   serve stale/garbage data to nobody.
 
+With a tier below (gllm_tpu/kvstore), LRU eviction DEMOTES instead of
+discarding: ``on_evict`` receives the evicted page's metadata + a copy
+of its bytes and writes it to the disk tier. Prefix metadata carries the
+chain-parent digest so the lower tiers can read descendants ahead.
+
 Pure host bookkeeping — no jax imports; device transfers live in
-``kvswap/engine.py``.
+``kvswap/engine.py``. ``lock`` serializes the prefix maps and page
+bytes against the peer-serving thread (``export_prefix``); the engine
+thread is the only mutator, so its own paths never contend.
 """
 
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from gllm_tpu.obs import metrics as obs
 
 # The host tier verifies with the SAME collision guard as the device
 # prefix cache — one constant, so the two can never drift apart and
 # silently miss (or under-verify) on every probe.
 from gllm_tpu.memory_manager import _CANARY_TOKENS as CANARY_TOKENS
+
+_M_EVICT = obs.counter(
+    "gllm_kvswap_prefix_evictions_total",
+    "host-tier prefix pages evicted by the LRU (demoted to the disk "
+    "tier when one is configured, discarded otherwise)")
 
 
 class HostKVPool:
@@ -49,12 +64,21 @@ class HostKVPool:
         self._free: OrderedDict[int, None] = OrderedDict(
             (i, None) for i in range(num_pages))
         self._pins: Dict[int, int] = {}
-        # Prefix tier (mirrors PrefixMemoryManager's maps).
+        # Prefix tier (mirrors PrefixMemoryManager's maps; meta is
+        # (digest, canary, chain-parent digest or None)).
         self.hash_to_page: Dict[bytes, int] = {}
-        self.page_meta: Dict[int, Tuple[bytes, Tuple[int, ...]]] = {}
+        self.page_meta: Dict[int, Tuple[bytes, Tuple[int, ...],
+                                        Optional[bytes]]] = {}
         # Unpinned prefix pages in recency order (oldest first) —
         # the eviction frontier.
         self._lru: OrderedDict[int, None] = OrderedDict()
+        # Serializes prefix maps + page bytes against the peer-serving
+        # thread; reentrant because free/allocate call drop_prefix.
+        self.lock = threading.RLock()
+        # Demotion hook (gllm_tpu/kvstore.TieredPrefixManager): called
+        # with (digest, canary, parent, leaf copies) as an evicted page
+        # leaves this tier. None keeps legacy discard-on-evict.
+        self.on_evict: Optional[Callable] = None
 
     # ---- sizing -----------------------------------------------------------
 
@@ -91,20 +115,40 @@ class HostKVPool:
     def _evict_one(self) -> None:
         for page in self._lru:
             if not self._pins.get(page):
-                del self._lru[page]
-                self.drop_prefix(page)
-                self._free[page] = None
+                demote = None
+                with self.lock:
+                    del self._lru[page]
+                    meta = self.page_meta.get(page)
+                    if (meta is not None and self.on_evict is not None
+                            and self.hash_to_page.get(meta[0]) == page):
+                        # copy before the slot is re-tenanted; the hook
+                        # itself (serialization + file I/O scheduling)
+                        # runs AFTER the lock drops so the peer-serving
+                        # thread is never blocked on a demotion. An
+                        # evictable page is never in-flight (spills pin
+                        # until the gather lands), so the bytes are
+                        # real.
+                        demote = meta + ([s[page].copy()
+                                          for s in self.store],)
+                    self.drop_prefix(page)
+                    self._free[page] = None
+                if demote is not None:
+                    digest, canary, parent, leaves = demote
+                    self.on_evict(digest, canary, parent, leaves)
+                _M_EVICT.inc()
                 return
         raise RuntimeError("no evictable host page")  # guarded by caller
 
     def free(self, pages) -> None:
-        for page in pages:
-            if page in self._free:
-                raise RuntimeError(f"double free of host page {page}")
-            self._pins.pop(page, None)
-            self._lru.pop(page, None)
-            self.drop_prefix(page)
-            self._free[page] = None
+        with self.lock:
+            for page in pages:
+                if page in self._free:
+                    raise RuntimeError(
+                        f"double free of host page {page}")
+                self._pins.pop(page, None)
+                self._lru.pop(page, None)
+                self.drop_prefix(page)
+                self._free[page] = None
 
     def pin(self, pages) -> None:
         """In-flight / ownership guard: pinned pages are never evicted
@@ -129,8 +173,9 @@ class HostKVPool:
                    col: int) -> None:
         """Store column ``col`` of a gathered batch (leaves
         ``[L, n, page_size, ...]``) as host page ``page``."""
-        for store, src in zip(self.store, gathered):
-            store[page] = src[:, col]
+        with self.lock:
+            for store, src in zip(self.store, gathered):
+                store[page] = src[:, col]
 
     def read_pages(self, pages: Sequence[int],
                    pad_to: Optional[int] = None) -> List[np.ndarray]:
@@ -151,40 +196,62 @@ class HostKVPool:
     # ---- prefix tier ------------------------------------------------------
 
     def put_prefix(self, page: int, digest: bytes,
-                   canary: Tuple[int, ...]) -> None:
+                   canary: Tuple[int, ...],
+                   parent: Optional[bytes] = None) -> None:
         from gllm_tpu.faults import FAULTS
         if FAULTS.fire("host_canary_corrupt"):
             # chaos point (docs/robustness.md): store a poisoned canary —
             # the next match_prefix probe must detect it and miss rather
             # than serve this page
             canary = tuple(int(c) + 1 for c in canary)
-        old = self.hash_to_page.get(digest)
-        if old is not None and old != page:
-            # newer copy wins; the old page keeps its data but loses the
-            # key (it will age out of the LRU)
-            self.page_meta.pop(old, None)
-        self.hash_to_page[digest] = page
-        self.page_meta[page] = (digest, tuple(canary))
-        self._lru[page] = None
-        self._lru.move_to_end(page)
+        with self.lock:
+            old = self.hash_to_page.get(digest)
+            if old is not None and old != page:
+                # newer copy wins; the old page keeps its data but loses
+                # the key (it will age out of the LRU)
+                self.page_meta.pop(old, None)
+            self.hash_to_page[digest] = page
+            self.page_meta[page] = (digest, tuple(canary), parent)
+            self._lru[page] = None
+            self._lru.move_to_end(page)
 
     def match_prefix(self, digest: bytes, tokens) -> Optional[int]:
         """Host page for this chained digest, canary-verified; a mismatch
         (hash collision / corruption) drops the entry and misses."""
-        page = self.hash_to_page.get(digest)
-        if page is None:
-            return None
-        _, canary = self.page_meta[page]
-        if tuple(tokens[:CANARY_TOKENS]) != canary:
-            # collision / corruption: poison the entry, never serve it.
-            # The page stays in the LRU (metaless) and ages out normally.
-            self.drop_prefix(page)
-            return None
-        self._lru[page] = None
-        self._lru.move_to_end(page)
-        return page
+        with self.lock:
+            page = self.hash_to_page.get(digest)
+            if page is None:
+                return None
+            _, canary, _ = self.page_meta[page]
+            if tuple(tokens[:CANARY_TOKENS]) != canary:
+                # collision / corruption: poison the entry, never serve
+                # it. The page stays in the LRU (metaless) and ages out
+                # normally.
+                self.drop_prefix(page)
+                return None
+            self._lru[page] = None
+            self._lru.move_to_end(page)
+            return page
 
     def drop_prefix(self, page: int) -> None:
-        meta = self.page_meta.pop(page, None)
-        if meta is not None and self.hash_to_page.get(meta[0]) == page:
-            del self.hash_to_page[meta[0]]
+        with self.lock:
+            meta = self.page_meta.pop(page, None)
+            if meta is not None and self.hash_to_page.get(meta[0]) == page:
+                del self.hash_to_page[meta[0]]
+
+    def export_prefix(self, digest: bytes) -> Optional[
+            Tuple[Tuple[int, ...], Optional[bytes], List[np.ndarray]]]:
+        """Peer-serving read (handler thread): ``(canary, parent, leaf
+        copies)`` for a resident digest, or None. Copies under the lock
+        so a concurrent eviction/rewrite can never tear the bytes; does
+        not touch the LRU (a remote reader is not a local reuse
+        signal). PINNED pages are never exported: a freshly spilled
+        page stays pinned until its device→host gather lands, and its
+        canary would validate bytes that were never written — the peer
+        retries later or misses."""
+        with self.lock:
+            page = self.hash_to_page.get(digest)
+            if page is None or self._pins.get(page):
+                return None
+            _, canary, parent = self.page_meta[page]
+            return canary, parent, [s[page].copy() for s in self.store]

@@ -63,6 +63,11 @@ _M_CANARY = obs.counter(
     "host-tier digest hits rejected by the canary check (treated as miss)")
 _M_HOST = obs.gauge("gllm_kvswap_host_pool_pages",
                     "host KV pool pages by state", ("state",))
+_M_HOST_USED = obs.gauge(
+    "gllm_kvswap_host_pool_used_pages",
+    "host KV pool occupancy (pinned sequence pages + resident prefix "
+    "pages); the unlabeled companion of gllm_kvswap_host_pool_pages "
+    "for dashboards and autoscalers")
 _M_XFER = obs.histogram(
     "gllm_kvswap_transfer_seconds",
     "host wall time of drained swap transfers per step",
@@ -98,6 +103,14 @@ class KVSwapManager:
         # only after the fetch lands (their slot must not be re-tenanted
         # under a pending write)
         self._free_after_fetch: Set[int] = set()
+        # Tiered prefix store (gllm_tpu/kvstore.TieredPrefixManager):
+        # attached by the engine when disk/peer tiers are configured.
+        # None keeps every probe path byte-identical two-level legacy.
+        self.tiers = None
+        # which tier served the last match_host_prefix hit ("host" |
+        # "disk" | "peer") — read by PrefixMemoryManager for the
+        # per-tier steptrace attribution, valid until the next probe
+        self.last_hit_tier: Optional[str] = None
         # device pages the LAST apply() scattered host data into — their
         # scales came from the host tier, so the runner's int8
         # minted-page scale reset must skip them (consumed once, so a
@@ -168,9 +181,12 @@ class KVSwapManager:
 
     # ---- memory-manager API: prefix spill tier ----------------------------
 
-    def spill_prefix(self, dev_page: int, digest: bytes, canary) -> None:
+    def spill_prefix(self, dev_page: int, digest: bytes, canary,
+                     parent: Optional[bytes] = None) -> None:
         """A refcount-0 cached page is being re-minted for new content —
-        copy it to the host tier keyed by the same digest."""
+        copy it to the host tier keyed by the same digest. ``parent``
+        (the chain-predecessor digest) rides along so a later demotion
+        to the disk tier keeps the read-ahead edges."""
         if dev_page in self._pending_restore_dev:
             return   # its content hasn't landed on device yet
         host = self.pool.allocate(1)
@@ -178,21 +194,46 @@ class KVSwapManager:
             return   # pool full of pinned pages; drop the spill
         self.pool.pin(host)
         self._out.append(([dev_page], host, "prefix", None))
-        self.pool.put_prefix(host[0], digest, canary)
+        self.pool.put_prefix(host[0], digest, canary, parent=parent)
         _M_SPILL.inc()
         _M_PAGES.inc(dir="out")
         _M_BYTES.inc(self.pool.bytes_per_page, dir="out")
         self._update_gauges()
 
     def match_host_prefix(self, digest: bytes, tokens) -> Optional[int]:
-        """Host page for this chained digest, canary-verified; a
-        mismatch counts and misses (the entry is dropped)."""
-        if self.pool.hash_to_page.get(digest) is None:
-            return None
-        page = self.pool.match_prefix(digest, tokens)
-        if page is None:
-            _M_CANARY.inc()
+        """Prefix probe below HBM, in tier order: host pool (canary-
+        verified; a mismatch counts and misses, dropping the entry),
+        then — when lower tiers are attached — disk and peers, whose
+        hits are staged INTO the host pool so the returned page is
+        always a host page id the normal restore path can carry.
+        ``last_hit_tier`` records which tier served it.
+
+        The returned page comes back PINNED (probe pin): the caller's
+        next step — minting a device page — can itself evict from this
+        pool (the mint's spill allocates a host page), and an unpinned
+        hit would be a legal victim, letting the spill re-tenant it
+        before the restore reads it. The caller must
+        ``release_probe_pin`` once ``restore_prefix`` holds its own pin
+        (or on bail-out)."""
+        self.last_hit_tier = None
+        page = None
+        if self.pool.hash_to_page.get(digest) is not None:
+            page = self.pool.match_prefix(digest, tokens)
+            if page is None:
+                _M_CANARY.inc()
+            else:
+                self.last_hit_tier = "host"
+        if page is None and self.tiers is not None:
+            staged = self.tiers.probe(digest, tokens)
+            if staged is not None:
+                page, self.last_hit_tier = staged
+                self._update_gauges()
+        if page is not None:
+            self.pool.pin([page])
         return page
+
+    def release_probe_pin(self, page: int) -> None:
+        self.pool.unpin([page])
 
     def restore_prefix(self, host_page: int, dev_page: int) -> None:
         """Queue a host->device copy of a cached prefix page into a
@@ -330,3 +371,4 @@ class KVSwapManager:
     def _update_gauges(self) -> None:
         _M_HOST.set(self.pool.num_free, state="free")
         _M_HOST.set(self.pool.num_used, state="used")
+        _M_HOST_USED.set(self.pool.num_used)

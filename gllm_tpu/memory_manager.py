@@ -26,10 +26,12 @@ telemetry happens in the runner, which passes ``num_pages`` here.
 from __future__ import annotations
 
 import hashlib
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 from gllm_tpu.id_allocator import IDAllocator
 from gllm_tpu.obs import metrics as obs
+from gllm_tpu.obs.steptrace import TRACE
 from gllm_tpu.sequence import Sequence
 from gllm_tpu.utils import cdiv
 
@@ -44,6 +46,12 @@ _M_PFX_HIT = obs.counter("gllm_prefix_cache_hit_tokens_total",
 # Tokens stored per cached page to verify against hash collisions
 # (reference memory_manager.py:920-935).
 _CANARY_TOKENS = 8
+
+# Chain-parent map bound (digest -> predecessor digest, LRU): the lower
+# prefix tiers (gllm_tpu/kvstore) use the edge for read-ahead; a capped
+# map loses only the oldest edges (a lost edge costs a prefetch, never
+# correctness).
+_PARENT_CAP = 1 << 16
 
 
 def _chain_hash(prev: bytes, token_ids: List[int], extra_key: bytes = b"") -> bytes:
@@ -266,6 +274,18 @@ class PrefixMemoryManager(MemoryManager):
         self.page2snap: Dict[int, int] = {}
         self.hit_tokens = 0
         self.query_tokens = 0
+        # digest -> chain-predecessor digest (None for a chain head),
+        # LRU-capped; consumed by the host spill so demoted pages carry
+        # their read-ahead edge down the tier stack.
+        self._digest_parent: "OrderedDict[bytes, Optional[bytes]]" = \
+            OrderedDict()
+
+    def _note_parent(self, digest: bytes,
+                     parent: Optional[bytes]) -> None:
+        self._digest_parent[digest] = parent
+        self._digest_parent.move_to_end(digest)
+        while len(self._digest_parent) > _PARENT_CAP:
+            self._digest_parent.popitem(last=False)
 
     # A page in the free list may still carry cache metadata; minting it for
     # new content must drop the stale key (reference :1254-1262).
@@ -280,7 +300,9 @@ class PrefixMemoryManager(MemoryManager):
                     # this was the canonical copy of its content — spill
                     # it to the host tier instead of losing it (eviction
                     # becomes a transfer, not a future re-prefill)
-                    self.swap.spill_prefix(page, digest, canary)
+                    self.swap.spill_prefix(
+                        page, digest, canary,
+                        parent=self._digest_parent.get(digest))
         self._release_snapshot_for(page)
         return page
 
@@ -292,10 +314,17 @@ class PrefixMemoryManager(MemoryManager):
         if self.swap is None:
             return None
         host_page = self.swap.match_host_prefix(digest, tokens)
-        if host_page is None or not self.can_allocate(1):
+        if host_page is None:
             return None
+        if not self.can_allocate(1):
+            self.swap.release_probe_pin(host_page)
+            return None
+        # the probe pin guards host_page across this mint: the mint's
+        # own spill may allocate (and evict) in the host pool, and the
+        # hit must not be its victim
         page = self._mint_page()
-        self.swap.restore_prefix(host_page, page)
+        self.swap.restore_prefix(host_page, page)   # takes its own pin
+        self.swap.release_probe_pin(host_page)
         self.hash_to_page[digest] = page
         self.page_meta[page] = (digest, tuple(tokens[:_CANARY_TOKENS]))
         return page
@@ -358,15 +387,23 @@ class PrefixMemoryManager(MemoryManager):
         matched_digest = b"root"
         matched = 0
         digests: List[bytes] = []
+        page_tiers: List[str] = []   # which tier served each claimed page
         for digest, tokens in prefix_digests(
                 seq.cache_token_ids, seq.prompt_len, self.page_size,
                 extra_key):
+            self._note_parent(digest,
+                              matched_digest if digests else None)
             page = self._probe_page(digest, tokens)
+            tier = "hbm" if page is not None else None
             if page is None:
-                # HBM miss → host spill tier (gllm_tpu/kvswap): a hit
-                # mints a fresh device page and queues the restore copy,
-                # which the runner drains before the step that reads it.
+                # HBM miss → lower tiers (gllm_tpu/kvswap + kvstore,
+                # probe order host → disk → peer): a hit mints a fresh
+                # device page and queues the restore copy, which the
+                # runner drains before the step that reads it.
                 page = self._restore_from_host(digest, tokens)
+                if page is not None:
+                    tier = getattr(self.swap, "last_hit_tier",
+                                   None) or "host"
             if page is None:
                 break
             if self.allocator.is_free(page):
@@ -376,6 +413,7 @@ class PrefixMemoryManager(MemoryManager):
             matched += 1
             matched_digest = digest
             digests.append(digest)
+            page_tiers.append(tier)
         if self.use_ssm and matched:
             # Hybrid: a KV hit is only usable up to the last page whose SSM
             # snapshot exists — roll the claim back to that boundary
@@ -400,6 +438,15 @@ class PrefixMemoryManager(MemoryManager):
             self._seq_chain[seq.seq_id] = (matched, matched_digest)
         self.hit_tokens += seq.num_computed_tokens
         _M_PFX_HIT.inc(seq.num_computed_tokens)
+        # Per-tier attribution on the steptrace ring: one event per
+        # admission probe; steptrace.summarize() reduces a window to a
+        # per-tier prefix hit rate (docs/observability.md). The SSM
+        # rollback above trimmed the claim, so count only kept pages.
+        pages: Dict[str, int] = {}
+        for t in page_tiers[:matched]:
+            pages[t] = pages.get(t, 0) + 1
+        TRACE.record("prefix", query_tokens=seq.prompt_len,
+                     hit_tokens=seq.num_computed_tokens, pages=pages)
         return seq.num_computed_tokens
 
     def register_computed_pages(self, seq: Sequence, extra_key: bytes = b"") -> None:
@@ -418,7 +465,9 @@ class PrefixMemoryManager(MemoryManager):
         n_hashed, digest = self._seq_chain.get(seq.seq_id, (0, b"root"))
         for i in range(n_hashed, min(full_pages, len(seq.page_table))):
             tokens = self._page_tokens(seq, i)
+            parent = digest if digest != b"root" else None
             digest = _chain_hash(digest, tokens, extra_key)
+            self._note_parent(digest, parent)
             page = seq.page_table[i]
             existing = self.hash_to_page.get(digest)
             if existing is None:

@@ -49,8 +49,13 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 #   quarantine   - a step exception was isolated: the failed dispatch's
 #                  sequences were aborted (``num_seqs``), everything else
 #                  rescheduled
+#   prefix       - one prefix-cache admission probe
+#                  (PrefixMemoryManager.match_prefix): ``query_tokens``,
+#                  ``hit_tokens``, and ``pages`` — claimed page counts
+#                  keyed by the serving tier (hbm/host/disk/peer,
+#                  docs/kv_offload.md)
 STEP_KINDS = ("prefill", "decode", "fused_block", "pp_stage", "compile",
-              "chain_break", "fault", "quarantine")
+              "chain_break", "fault", "quarantine", "prefix")
 CHAIN_BREAK_REASONS = ("waiting", "pages", "shape", "spec", "finish")
 
 
@@ -143,8 +148,18 @@ def summarize(events: List[dict]) -> dict:
     # dead_substeps when config.ondevice_finish is on): wasted sub-step
     # share of all executed row-sub-steps over the window
     dead_rows = exec_rows = 0
+    # prefix-cache attribution: per-window hit rate + tier split
+    pfx_queries = pfx_query_tokens = pfx_hit_tokens = 0
+    pfx_pages: Dict[str, int] = {}
     for e in events:
         k = e["kind"]
+        if k == "prefix":
+            pfx_queries += 1
+            pfx_query_tokens += int(e.get("query_tokens", 0))
+            pfx_hit_tokens += int(e.get("hit_tokens", 0))
+            for tier, n in (e.get("pages") or {}).items():
+                pfx_pages[tier] = pfx_pages.get(tier, 0) + int(n)
+            continue
         if k == "compile":
             compiles += 1
             continue
@@ -198,6 +213,16 @@ def summarize(events: List[dict]) -> dict:
         # None when no block reported finish steps (ondevice_finish off)
         "dead_substep_frac": (round(dead_rows / exec_rows, 4)
                               if exec_rows else None),
+        # per-window prefix-cache hit rate by tier (None when the window
+        # saw no admission probes — prefix caching off or pure decode)
+        "prefix": ({
+            "queries": pfx_queries,
+            "query_tokens": pfx_query_tokens,
+            "hit_tokens": pfx_hit_tokens,
+            "hit_rate": (round(pfx_hit_tokens / pfx_query_tokens, 4)
+                         if pfx_query_tokens else 0.0),
+            "pages_by_tier": pfx_pages,
+        } if pfx_queries else None),
         "compiles": compiles,
         "chain_breaks": chain_breaks,
         "chain_breaks_by_reason": break_reasons,

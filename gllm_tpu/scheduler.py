@@ -1009,9 +1009,19 @@ class Scheduler:
             spec = (" spec_accept={:.1f}%".format(
                 100.0 * self.spec_stats["accepted"]
                 / self.spec_stats["proposed"]))
+        # host KV tier occupancy (+ disk tier when attached) — the
+        # lower-tier health reads off the same 1 Hz line as kv_util
+        host = ""
+        swap = getattr(self.mm, "swap", None)
+        if swap is not None:
+            host = f" host_pool={swap.pool.num_used}/{swap.pool.num_pages}"
+            tiers = getattr(swap, "tiers", None)
+            if tiers is not None and tiers.disk is not None:
+                host += (f" disk={len(tiers.disk)}pg/"
+                         f"{tiers.disk.bytes_used / (1 << 20):.0f}MiB")
         logger.info(
-            "sched: wait=%d run=%d prefill=%d decode=%d kv_util=%.1f%%%s%s",
+            "sched: wait=%d run=%d prefill=%d decode=%d kv_util=%.1f%%%s%s%s",
             len(self.waiting), len(self.running), n_prefill, n_decode,
             util * 100.0,
             f" cache_hit={hit*100.0:.1f}%" if hit is not None else "",
-            spec)
+            spec, host)

@@ -79,6 +79,14 @@ class LRUBytesCache:
             self._cache.move_to_end(key)
             return v
 
+    def pop(self, key) -> None:
+        """Invalidate one entry (a caller replaced or poisoned the
+        underlying data; the cached copy must not be served again)."""
+        with self._lock:
+            v = self._cache.pop(key, None)
+            if v is not None:
+                self._cur_bytes -= self._size_of(v)
+
     def put(self, key, value) -> None:
         sz = self._size_of(value)
         if sz > self.max_bytes:

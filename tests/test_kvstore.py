@@ -1,0 +1,609 @@
+"""Tiered prefix KV store (gllm_tpu/kvstore, docs/kv_offload.md).
+
+Coverage layers, all CPU-deterministic:
+
+- page wire format (pack/unpack, geometry negotiation object);
+- DiskPrefixStore semantics: round trip, canary poison-drop (exactly
+  once), byte-budgeted LRU, restart adoption, chained read-ahead;
+- peer pair: serve/fetch, geometry refusal, bounded timeout;
+- host-pool eviction under pin churn (LRU order, pinned pages never
+  victims, demotion hook);
+- engine e2e: a prefix computed by engine A restores on engine B via
+  (a) one shared disk store and (b) the peer wire — token-identical
+  continuations with ZERO re-prefill of the shared pages on B;
+- chaos: corruption/timeout at each tier degrades to the next tier
+  without wrong tokens (fault points disk_read_corrupt /
+  peer_prefix_timeout / host_canary_corrupt).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.faults import FAULTS
+from gllm_tpu.kvstore import (DiskPrefixStore, PrefixClient,
+                              TieredPrefixManager, pool_geometry)
+from gllm_tpu.kvstore.pagefmt import header_meta, pack_page, unpack_page
+from gllm_tpu.kvswap.host_pool import HostKVPool
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.sampling_params import SamplingParams
+
+CANARY = (11, 12, 13, 14, 15, 16, 17, 18)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def _pool(n=8):
+    return HostKVPool([((2, 4, 3), np.float32), ((2, 4), np.int32)], n)
+
+
+def _geom(pool, page_size=4):
+    return pool_geometry(pool.page_shapes, page_size)
+
+
+def _leaves(seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.random((2, 4, 3)).astype(np.float32),
+            rng.integers(0, 99, size=(2, 4)).astype(np.int32)]
+
+
+def _digest(i):
+    return bytes([i]) * 16
+
+
+# ---- page format -----------------------------------------------------------
+
+def test_pagefmt_roundtrip():
+    pool = _pool()
+    geom = _geom(pool)
+    leaves = _leaves()
+    payload = pack_page(_digest(1), CANARY, _digest(9), leaves, geom)
+    header, got = unpack_page(payload, geom)
+    digest, canary, parent = header_meta(header)
+    assert digest == _digest(1) and canary == CANARY
+    assert parent == _digest(9)
+    for a, b in zip(leaves, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_pagefmt_rejects_foreign_geometry():
+    pool = _pool()
+    payload = pack_page(_digest(1), CANARY, None, _leaves(),
+                        _geom(pool, page_size=4))
+    with pytest.raises(ValueError):
+        unpack_page(payload, _geom(pool, page_size=8))
+    with pytest.raises(ValueError):
+        unpack_page(payload[:-3], _geom(pool, page_size=4))  # truncated
+
+
+# ---- disk tier -------------------------------------------------------------
+
+def _disk(tmp_path, pool=None, max_bytes=1 << 20, **kw):
+    pool = pool or _pool()
+    return DiskPrefixStore(str(tmp_path), max_bytes, _geom(pool), **kw)
+
+
+def test_disk_roundtrip_and_restart_adoption(tmp_path):
+    disk = _disk(tmp_path)
+    leaves = _leaves()
+    disk.put(_digest(1), CANARY, None, leaves)
+    disk.flush()
+    got = disk.get(_digest(1), list(CANARY) + [99])
+    assert got is not None
+    for a, b in zip(leaves, got[0]):
+        np.testing.assert_array_equal(a, b)
+    disk.close()
+    # a new store over the same directory adopts the files (warm restart)
+    disk2 = _disk(tmp_path)
+    assert len(disk2) == 1
+    assert disk2.get(_digest(1), list(CANARY)) is not None
+    disk2.close()
+
+
+def test_disk_canary_poison_drop_exactly_once(tmp_path):
+    disk = _disk(tmp_path)
+    disk.put(_digest(1), CANARY, None, _leaves())
+    disk.flush()
+    p0 = obs.REGISTRY.get("gllm_kvstore_poison_drops_total").get(
+        tier="disk")
+    assert disk.get(_digest(1), [9] * 8) is None        # collision
+    # dropped exactly once: the file is gone, the right canary misses
+    # too, and no second poison-drop is counted
+    assert disk.get(_digest(1), list(CANARY)) is None
+    assert obs.REGISTRY.get("gllm_kvstore_poison_drops_total").get(
+        tier="disk") - p0 == 1
+    assert not any(f.endswith(".kvp") for f in os.listdir(tmp_path))
+    disk.close()
+
+
+def test_disk_byte_budget_lru_eviction(tmp_path):
+    pool = _pool()
+    one = len(pack_page(_digest(0), CANARY, None, _leaves(), _geom(pool)))
+    disk = _disk(tmp_path, pool, max_bytes=3 * one + one // 2)
+    for i in range(1, 5):
+        disk.put(_digest(i), CANARY, None, _leaves(i))
+    disk.flush()
+    # budget holds 3: the OLDEST entry was evicted
+    assert disk.get(_digest(1), list(CANARY)) is None
+    assert disk.get(_digest(4), list(CANARY)) is not None
+    assert disk.bytes_used <= 3 * one + one // 2
+    disk.close()
+
+
+def test_disk_readahead_stages_chained_descendants(tmp_path):
+    disk = _disk(tmp_path)
+    # chain 1 -> 2 -> 3
+    disk.put(_digest(1), CANARY, None, _leaves(1))
+    disk.put(_digest(2), CANARY, _digest(1), _leaves(2))
+    disk.put(_digest(3), CANARY, _digest(2), _leaves(3))
+    disk.flush()
+    # restart so nothing is pending in RAM, then hit the chain head
+    disk.close()
+    disk = _disk(tmp_path)
+    assert disk.get(_digest(1), list(CANARY)) is not None
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if (disk._staged.get(_digest(2).hex()) is not None
+                and disk._staged.get(_digest(3).hex()) is not None):
+            break
+        time.sleep(0.01)
+    assert disk._staged.get(_digest(2).hex()) is not None
+    assert disk._staged.get(_digest(3).hex()) is not None
+    disk.close()
+
+
+@pytest.mark.chaos
+def test_chaos_disk_read_corrupt_degrades_to_miss(tmp_path):
+    """disk_read_corrupt: the canary check catches the corrupt read,
+    poison-drops the entry exactly once, and the probe misses (degrades
+    to the next tier) instead of serving wrong bytes."""
+    disk = _disk(tmp_path)
+    disk.put(_digest(1), CANARY, None, _leaves())
+    disk.flush()
+    FAULTS.arm("disk_read_corrupt")
+    assert disk.get(_digest(1), list(CANARY)) is None
+    assert FAULTS.hits.get("disk_read_corrupt") == 1
+    # entry was dropped; a later (uncorrupted) probe is a clean miss
+    assert disk.get(_digest(1), list(CANARY)) is None
+    disk.close()
+
+
+# ---- peer tier -------------------------------------------------------------
+
+def _tiers_with_server(tmp_path=None, pool=None):
+    pool = pool or _pool()
+    disk = _disk(tmp_path, pool) if tmp_path is not None else None
+    tiers = TieredPrefixManager(pool, 4, disk=disk)
+    srv = tiers.start_server(host="127.0.0.1", port=0)
+    return pool, tiers, srv
+
+
+def test_peer_fetch_from_host_pool_and_disk(tmp_path):
+    pool, tiers, srv = _tiers_with_server(tmp_path)
+    leaves = _leaves(3)
+    # host-resident page
+    (hp,) = pool.allocate(1)
+    with pool.lock:
+        for s, leaf in zip(pool.store, leaves):
+            s[hp] = leaf
+    pool.put_prefix(hp, _digest(1), CANARY)
+    # disk-resident page
+    tiers.disk.put(_digest(2), CANARY, None, _leaves(4))
+    tiers.disk.flush()
+    client = PrefixClient([f"127.0.0.1:{srv.port}"], tiers.geometry)
+    got = client.fetch(_digest(1), list(CANARY))
+    assert got is not None
+    for a, b in zip(leaves, got[0]):
+        np.testing.assert_array_equal(a, b)
+    assert client.fetch(_digest(2), list(CANARY)) is not None
+    assert client.fetch(_digest(7), list(CANARY)) is None   # clean miss
+    client.close()
+    tiers.close()
+
+
+def test_peer_geometry_mismatch_disables_peer(tmp_path):
+    pool, tiers, srv = _tiers_with_server(tmp_path)
+    other = PrefixClient([f"127.0.0.1:{srv.port}"],
+                         _geom(_pool(), page_size=16))
+    assert other.fetch(_digest(1), list(CANARY)) is None
+    assert list(other._peers.values())[0]["negotiated"] is False
+    other.close()
+    tiers.close()
+
+
+def test_peer_addr_validation_fails_at_startup():
+    from gllm_tpu.kvstore.peer import parse_peer_addr
+    assert parse_peer_addr(" 10.0.0.2:8111 ") == ("10.0.0.2", 8111)
+    for bad in ("localhost", "host:", ":123", "host:http", "h:99999"):
+        with pytest.raises(ValueError):
+            parse_peer_addr(bad)
+    # config-level: a typo'd --prefix-peers is a startup error, not a
+    # first-probe scheduling exception
+    cfg = EngineConfig(cache=CacheConfig(
+        enable_prefix_caching=True, kv_host_pool_pages=8,
+        prefix_peers="localhost"))
+    with pytest.raises(ValueError):
+        cfg.validate()
+
+
+def test_peer_dead_peer_is_bounded_and_backs_off():
+    pool = _pool()
+    # nothing listens on this port: connect must fail fast, mark the
+    # peer down, and miss — never stall the probe
+    client = PrefixClient(["127.0.0.1:1"], _geom(pool), timeout_s=0.5)
+    t0 = time.monotonic()
+    assert client.fetch(_digest(1), list(CANARY)) is None
+    assert time.monotonic() - t0 < 2.0
+    assert list(client._peers.values())[0]["down_until"] > time.monotonic()
+    client.close()
+
+
+@pytest.mark.chaos
+def test_chaos_peer_prefix_timeout_is_a_fast_miss(tmp_path):
+    """peer_prefix_timeout: the peer tier behaves as a deadline expiry —
+    the probe returns a miss immediately (next tier / recompute), the
+    timeout is counted, and nothing stalls."""
+    pool, tiers, srv = _tiers_with_server(tmp_path)
+    tiers.disk.put(_digest(1), CANARY, None, _leaves())
+    tiers.disk.flush()
+    client = PrefixClient([f"127.0.0.1:{srv.port}"], tiers.geometry)
+    t_before = obs.REGISTRY.get("gllm_kvstore_peer_timeouts_total").get()
+    FAULTS.arm("peer_prefix_timeout")
+    t0 = time.monotonic()
+    assert client.fetch(_digest(1), list(CANARY)) is None
+    assert time.monotonic() - t0 < 0.5
+    assert obs.REGISTRY.get(
+        "gllm_kvstore_peer_timeouts_total").get() - t_before == 1
+    # disarmed again: the same fetch now hits
+    assert client.fetch(_digest(1), list(CANARY)) is not None
+    client.close()
+    tiers.close()
+
+
+# ---- host-pool eviction / demotion ----------------------------------------
+
+def test_host_eviction_demotes_to_disk_in_lru_order(tmp_path):
+    pool = _pool(3)
+    disk = _disk(tmp_path, pool)
+    TieredPrefixManager(pool, 4, disk=disk)   # installs on_evict
+    pages = pool.allocate(3)
+    for i, p in enumerate(pages):
+        with pool.lock:
+            for s, leaf in zip(pool.store, _leaves(i)):
+                s[p] = leaf
+        pool.put_prefix(p, _digest(i + 1), CANARY)
+    ev0 = obs.REGISTRY.get("gllm_kvswap_prefix_evictions_total").get()
+    pool.allocate(1)                          # full → evict oldest
+    disk.flush()
+    assert obs.REGISTRY.get(
+        "gllm_kvswap_prefix_evictions_total").get() - ev0 == 1
+    # the OLDEST entry (digest 1) was demoted, not discarded
+    got = disk.get(_digest(1), list(CANARY))
+    assert got is not None
+    for a, b in zip(_leaves(0), got[0]):
+        np.testing.assert_array_equal(a, b)
+    assert not disk.contains(_digest(3))
+    disk.close()
+
+
+def test_host_eviction_under_pin_churn_never_victimizes_pinned():
+    """Satellite guard: prefix pages evict in LRU order while PINNED
+    (sequence/in-flight) pages are never victims, across interleaved
+    pin/unpin churn; a canary-poisoned entry is dropped exactly once."""
+    pool = _pool(4)
+    pages = pool.allocate(4)
+    for i, p in enumerate(pages):
+        pool.put_prefix(p, _digest(i + 1), (i,) + CANARY[1:])
+    # pin pages 0 and 2 (swapped-sequence style), churn recency of 1
+    pool.pin([pages[0], pages[2]])
+    assert pool.match_prefix(_digest(2), [1] + list(CANARY[1:])) \
+        == pages[1]                            # touch: 1 newer than 3
+    # eviction must pick page 3 (oldest unpinned), then page 1
+    got = pool.allocate(1)
+    assert got == [pages[3]]
+    got = pool.allocate(1)
+    assert got == [pages[1]]
+    # only pinned pages remain: allocation fails without touching them
+    assert pool.allocate(1) is None
+    assert pool.match_prefix(_digest(1), [0] + list(CANARY[1:])) \
+        == pages[0]
+    # unpin → evictable again
+    pool.unpin([pages[0], pages[2]])
+    got2 = pool.allocate(2)
+    assert sorted(got2) == sorted([pages[0], pages[2]])
+    # canary poison drops exactly once: second probe is a plain miss
+    # (entry already gone), and the freed page was NOT double-freed
+    p = got2[0]
+    pool.put_prefix(p, _digest(9), CANARY)
+    assert pool.match_prefix(_digest(9), [99] * 8) is None
+    assert _digest(9) not in pool.hash_to_page
+    assert p not in pool.page_meta
+    assert pool.match_prefix(_digest(9), list(CANARY)) is None
+
+
+# ---- scheduler-level pin churn (host tier under real swap flows) ----------
+
+def test_sched_level_pin_churn_prefix_evicts_seq_pages_survive():
+    """Scheduler-level e2e: with swapped sequences pinning host pages
+    and prefix spills churning the LRU, evictions only ever take
+    unpinned prefix pages — every swapped seq still resumes via swap-in
+    with zero re-prefill."""
+    from gllm_tpu.memory_manager import make_memory_manager
+    from gllm_tpu.scheduler import Scheduler
+    from gllm_tpu.sequence import Sequence, SequenceStatus
+    from gllm_tpu.kvswap import KVSwapManager
+    import jax.numpy as jnp
+
+    num_pages, page_size, host_pages = 12, 4, 6
+    cfg = EngineConfig(
+        max_model_len=num_pages * page_size, max_num_seqs=8,
+        scheduler=SchedulerConfig(max_prefill_tokens=32,
+                                  min_prefill_tokens=4, max_decode_seqs=8),
+        cache=CacheConfig(page_size=page_size, num_pages=num_pages,
+                          enable_prefix_caching=True,
+                          kv_host_pool_pages=host_pages))
+    mm = make_memory_manager(num_pages, page_size, True)
+    shape = (2, num_pages, page_size, 3)
+    kv = (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+    sw = KVSwapManager(kv, page_size, host_pages)
+    mm.swap = sw
+    sched = Scheduler(cfg, mm)
+    in0 = obs.REGISTRY.get("gllm_kvswap_swap_in_total").get()
+    pre0 = obs.REGISTRY.get("gllm_sched_preemptions_total").get()
+    rng = np.random.default_rng(0)
+    for i in range(5):
+        sched.add_seq(Sequence(
+            i, rng.integers(1, 500, size=14).tolist(),
+            SamplingParams(max_tokens=16, ignore_eos=True)))
+    seqs = list(sched.waiting)
+    for _ in range(200):
+        batch = sched.schedule_once()
+        if batch is None:
+            break
+        kv = sw.apply(kv)
+        # invariant under churn: a swapped-out seq's host pages are
+        # never eviction victims — only LRU (prefix) members are
+        # evictable, and seq pages must never appear there or in the
+        # free list while the seq still owns them
+        for s in seqs:
+            if s.status is SequenceStatus.SWAPPED and s.swap_host_pages:
+                for p in s.swap_host_pages:
+                    assert p not in sw.pool._lru
+                    assert p not in sw.pool._free
+        sched.process_output(batch, [7] * batch.num_seqs, 2)
+    assert all(s.status is SequenceStatus.FINISHED for s in seqs)
+    pre = obs.REGISTRY.get("gllm_sched_preemptions_total").get() - pre0
+    sin = obs.REGISTRY.get("gllm_kvswap_swap_in_total").get() - in0
+    assert pre > 0, "no memory pressure — the churn test lost its teeth"
+    assert sin == pre                      # zero re-prefill resumes
+    kv = sw.apply(kv)
+    kv = sw.apply(kv)                      # land the double buffer
+    # every page still resident is an UNPINNED prefix-cache tenant (the
+    # evictable LRU); no seq page and no in-flight pin leaked
+    assert not sw.pool._pins
+    assert sw.pool.num_used == len(sw.pool._lru)
+
+
+# ---- engine e2e ------------------------------------------------------------
+
+MODEL_KW = dict(architecture="LlamaForCausalLM", vocab_size=512,
+                hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+                head_dim=16, intermediate_size=128, max_position=256)
+
+
+def _make_llm(num_pages=64, host_pages=64, disk_path=None, peers=None,
+              serve=False):
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.models.config import ModelConfig
+    cfg = EngineConfig(
+        load_format="dummy", dtype="float32", max_model_len=128,
+        max_num_seqs=8,
+        scheduler=SchedulerConfig(max_prefill_tokens=64,
+                                  max_decode_seqs=8),
+        cache=CacheConfig(page_size=4, num_pages=num_pages,
+                          enable_prefix_caching=True,
+                          kv_host_pool_pages=host_pages,
+                          kv_disk_path=disk_path, kv_disk_gb=1.0,
+                          prefix_peers=peers,
+                          prefix_serve_port=0 if serve else None))
+    cfg.validate()
+    return LLM(config=cfg, model_cfg=ModelConfig(**MODEL_KW))
+
+
+PROMPT_LEN = 40
+
+
+def _prompt(seed=1):
+    return np.random.default_rng(seed).integers(
+        1, 500, size=PROMPT_LEN).tolist()
+
+
+def _sp():
+    return SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+
+@pytest.fixture(scope="module")
+def reference_tokens():
+    llm = _make_llm(disk_path=None, host_pages=None)
+    assert llm.prefix_tiers is None           # flag-off: legacy 2-level
+    return llm.generate(prompt_token_ids=[_prompt()],
+                        sampling_params=_sp())[0].output_token_ids
+
+
+def test_e2e_shared_disk_store_cross_engine_zero_reprefill(
+        tmp_path, reference_tokens):
+    """Acceptance: engine A computes a prefix and demotes it to a
+    shared disk store; a FRESH engine B over the same store restores it
+    — token-identical continuation, all full prefix pages served by the
+    disk tier (restore path, not recompute)."""
+    store = str(tmp_path / "shared")
+    a = _make_llm(disk_path=store)
+    got_a = a.generate(prompt_token_ids=[_prompt()],
+                       sampling_params=_sp())[0].output_token_ids
+    assert got_a == reference_tokens
+    moved = a.demote_prefix_cache()
+    assert moved > 0
+    a.prefix_tiers.close()
+
+    hit0 = obs.REGISTRY.get("gllm_kvstore_hits_total").get(tier="disk")
+    pfx0 = obs.REGISTRY.get("gllm_prefix_cache_hit_tokens_total").get()
+    rest0 = obs.REGISTRY.get(
+        "gllm_kvswap_prefix_restore_pages_total").get()
+    b = _make_llm(disk_path=store)
+    got_b = b.generate(prompt_token_ids=[_prompt()],
+                       sampling_params=_sp())[0].output_token_ids
+    assert got_b == reference_tokens
+    page_size = 4
+    full_pages = (PROMPT_LEN - 1) // page_size
+    disk_hits = obs.REGISTRY.get(
+        "gllm_kvstore_hits_total").get(tier="disk") - hit0
+    # zero re-prefill of the shared prefix: EVERY full page came off the
+    # disk tier and was claimed as cached tokens, and each rode the
+    # normal host→device restore path
+    assert disk_hits == full_pages
+    assert obs.REGISTRY.get(
+        "gllm_prefix_cache_hit_tokens_total").get() - pfx0 \
+        == full_pages * page_size
+    assert obs.REGISTRY.get(
+        "gllm_kvswap_prefix_restore_pages_total").get() - rest0 \
+        == full_pages
+    b.prefix_tiers.close()
+
+
+def test_e2e_peer_fetch_cross_engine(tmp_path, reference_tokens):
+    """Acceptance (cluster tier): a prefix computed by replica A is
+    fetched digest-addressed over the wire and restored by replica B —
+    token-identical, every full page served by the peer tier."""
+    a = _make_llm(disk_path=str(tmp_path / "a"), serve=True)
+    got_a = a.generate(prompt_token_ids=[_prompt()],
+                       sampling_params=_sp())[0].output_token_ids
+    assert got_a == reference_tokens
+    assert a.demote_prefix_cache() > 0        # host+disk now hold it
+    port = a.prefix_tiers.server.port
+
+    hit0 = obs.REGISTRY.get("gllm_kvstore_hits_total").get(tier="peer")
+    b = _make_llm(disk_path=None, peers=f"127.0.0.1:{port}")
+    got_b = b.generate(prompt_token_ids=[_prompt()],
+                       sampling_params=_sp())[0].output_token_ids
+    assert got_b == reference_tokens
+    full_pages = (PROMPT_LEN - 1) // 4
+    assert obs.REGISTRY.get(
+        "gllm_kvstore_hits_total").get(tier="peer") - hit0 == full_pages
+    b.prefix_tiers.close()
+    a.prefix_tiers.close()
+
+
+@pytest.mark.chaos
+def test_chaos_any_tier_failure_degrades_without_wrong_tokens(
+        tmp_path, reference_tokens):
+    """Acceptance: corruption/timeout at ANY tier degrades to the next
+    tier (ultimately recompute) with token-identical output — armed
+    points: host_canary_corrupt, disk_read_corrupt,
+    peer_prefix_timeout."""
+    store = str(tmp_path / "shared")
+    a = _make_llm(disk_path=store, serve=True)
+    a.generate(prompt_token_ids=[_prompt()], sampling_params=_sp())
+    a.demote_prefix_cache()
+    port = a.prefix_tiers.server.port
+
+    # disk corrupt → B degrades to peer (A still serves off its disk) or
+    # recompute; tokens identical either way
+    FAULTS.arm("disk_read_corrupt:0:-1")
+    b = _make_llm(disk_path=store, peers=f"127.0.0.1:{port}")
+    got = b.generate(prompt_token_ids=[_prompt()],
+                     sampling_params=_sp())[0].output_token_ids
+    assert got == reference_tokens
+    assert FAULTS.hits.get("disk_read_corrupt", 0) > 0
+    b.prefix_tiers.close()
+    FAULTS.reset()
+
+    # peer timeout (disk disabled) → recompute; tokens identical
+    FAULTS.arm("peer_prefix_timeout:0:-1")
+    c = _make_llm(disk_path=None, peers=f"127.0.0.1:{port}")
+    got = c.generate(prompt_token_ids=[_prompt()],
+                     sampling_params=_sp())[0].output_token_ids
+    assert got == reference_tokens
+    assert FAULTS.hits.get("peer_prefix_timeout", 0) > 0
+    c.prefix_tiers.close()
+    FAULTS.reset()
+
+    # host canary corrupt on the SPILL path of a tiered engine: the
+    # poisoned host entry misses and the probe degrades (disk/recompute)
+    FAULTS.arm("host_canary_corrupt:0:-1")
+    d = _make_llm(disk_path=str(tmp_path / "d"))
+    got = d.generate(prompt_token_ids=[_prompt()],
+                     sampling_params=_sp())[0].output_token_ids
+    assert got == reference_tokens
+    d.prefix_tiers.close()
+    a.prefix_tiers.close()
+
+
+def test_e2e_flag_off_is_legacy(reference_tokens):
+    """No disk path / peers / serve port → no tiers object, no probe-
+    path change: byte-identical legacy two-level behavior."""
+    llm = _make_llm(disk_path=None)
+    assert llm.prefix_tiers is None
+    assert llm.swap_manager.tiers is None
+    got = llm.generate(prompt_token_ids=[_prompt()],
+                       sampling_params=_sp())[0].output_token_ids
+    assert got == reference_tokens
+
+
+# ---- observability ---------------------------------------------------------
+
+def test_host_pool_occupancy_metrics_exported():
+    pool = _pool(4)
+    from gllm_tpu.kvswap import KVSwapManager
+    import jax.numpy as jnp
+    shape = (2, 6, 4, 3)
+    kv = (jnp.zeros(shape, jnp.float32),)
+    sw = KVSwapManager(kv, 4, 4)
+    g = obs.REGISTRY.get("gllm_kvswap_host_pool_used_pages")
+    assert g is not None and g.get() == 0
+    sw.pool.allocate(3)
+    sw._update_gauges()
+    assert g.get() == 3
+
+
+def test_steptrace_summarize_prefix_by_tier():
+    from gllm_tpu.obs.steptrace import StepTrace, summarize
+    tr = StepTrace(capacity=16)
+    tr.record("prefix", query_tokens=40, hit_tokens=32,
+              pages={"hbm": 3, "disk": 5})
+    tr.record("prefix", query_tokens=40, hit_tokens=0, pages={})
+    tr.record("decode", wall_ms=1.0, tokens=8)
+    s = summarize(tr.events())
+    assert s["prefix"]["queries"] == 2
+    assert s["prefix"]["query_tokens"] == 80
+    assert s["prefix"]["hit_tokens"] == 32
+    assert s["prefix"]["hit_rate"] == 0.4
+    assert s["prefix"]["pages_by_tier"] == {"hbm": 3, "disk": 5}
+    # windows with no probes report None, and prefix events never leak
+    # into the wall-time attribution
+    assert "prefix" not in s["by_kind"]
+    assert summarize([])["prefix"] is None
+
+
+def test_match_prefix_emits_tiered_trace_event(tmp_path):
+    from gllm_tpu.obs.steptrace import TRACE
+    store = str(tmp_path / "s")
+    a = _make_llm(disk_path=store)
+    a.generate(prompt_token_ids=[_prompt()], sampling_params=_sp())
+    a.demote_prefix_cache()
+    a.prefix_tiers.close()
+    b = _make_llm(disk_path=store)
+    mark = TRACE.mark()
+    b.generate(prompt_token_ids=[_prompt()], sampling_params=_sp())
+    evs = TRACE.events(since=mark, kinds=("prefix",))
+    assert evs, "match_prefix recorded no prefix event"
+    tiers_seen = {t for e in evs for t in (e.get("pages") or {})}
+    assert "disk" in tiers_seen
+    b.prefix_tiers.close()

@@ -1,0 +1,79 @@
+"""Metrics-catalog guard: the code and docs/observability.md cannot
+drift.
+
+Every ``gllm_*`` metric registered anywhere under ``gllm_tpu/`` (via the
+``obs.counter/gauge/histogram`` helpers) must have a row in
+docs/observability.md, and every ``gllm_*`` name the doc mentions must
+be a registered metric (or a histogram's derived ``_bucket``/``_sum``/
+``_count`` sample, or a documented-retired alias) — so a new subsystem
+can't ship undocumented metrics and the doc can't advertise ghosts.
+
+Registration sites are found by source scan rather than imports: it
+covers modules that only load under flags/topologies CI never runs
+(pp_runner, disagg, the kvstore tiers), and it needs no jax.
+"""
+
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "gllm_tpu")
+DOC = os.path.join(REPO, "docs", "observability.md")
+
+# obs.counter( / metrics.gauge( / histogram( ... "gllm_..." — the name
+# is always the first (string-literal) argument.
+_REG_RE = re.compile(
+    r"\b(?:counter|gauge|histogram)\(\s*\n?\s*['\"](gllm_[a-z0-9_]+)['\"]",
+    re.MULTILINE)
+_DOC_RE = re.compile(r"\bgllm_[a-z0-9_]+")
+
+# Histogram sample suffixes the doc legitimately shows as full series
+# names in PromQL recipes / examples.
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _registered_names():
+    names = {}
+    for root, _, files in os.walk(PKG):
+        if "__pycache__" in root:
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            src = open(path).read()
+            for m in _REG_RE.finditer(src):
+                names.setdefault(m.group(1), path)
+    return names
+
+
+def test_every_registered_metric_is_documented():
+    registered = _registered_names()
+    assert registered, "source scan found no metric registrations"
+    doc = open(DOC).read()
+    missing = sorted(n for n in registered if n not in doc)
+    assert not missing, (
+        "metrics registered in gllm_tpu/ but absent from "
+        "docs/observability.md (add a catalog row): "
+        + ", ".join(f"{n} ({os.path.relpath(registered[n], REPO)})"
+                    for n in missing))
+
+
+def test_every_documented_metric_is_registered():
+    registered = set(_registered_names())
+    doc = open(DOC).read()
+    ghosts = []
+    for name in sorted(set(_DOC_RE.findall(doc))):
+        if name == "gllm_tpu":           # the package name, not a metric
+            continue
+        if name in registered:
+            continue
+        if any(name.endswith(s) and name[:-len(s)] in registered
+               for s in _HIST_SUFFIXES):
+            continue
+        if any(r.startswith(name) for r in registered):
+            continue                     # grep-prefix in a shell recipe
+        ghosts.append(name)
+    assert not ghosts, (
+        "docs/observability.md mentions gllm_* names no code registers "
+        "(typo or removed metric — fix the doc): " + ", ".join(ghosts))

@@ -25,6 +25,7 @@ least one chaos-marked test here, so new points can't land untested.
 import ast
 import json
 import http.client
+import os
 import threading
 import time
 
@@ -561,16 +562,18 @@ def test_flags_off_token_stream_matches_offline_generate(tiny_ckpt,
 def test_every_fault_point_has_a_chaos_test():
     """New faults.py injection points cannot land untested: each name
     must appear in the body of at least one @pytest.mark.chaos test in
-    this file."""
-    src = open(__file__).read()
-    tree = ast.parse(src)
+    the chaos suites (this file + the kvstore tier chaos tests)."""
     chaos_bodies = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.FunctionDef):
-            continue
-        for dec in node.decorator_list:
-            if "chaos" in ast.unparse(dec):
-                chaos_bodies.append(ast.get_source_segment(src, node))
+    here = os.path.dirname(__file__)
+    for fname in (__file__, os.path.join(here, "test_kvstore.py")):
+        src = open(fname).read()
+        tree = ast.parse(src)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for dec in node.decorator_list:
+                if "chaos" in ast.unparse(dec):
+                    chaos_bodies.append(ast.get_source_segment(src, node))
     assert chaos_bodies, "no chaos-marked tests found"
     blob = "\n".join(chaos_bodies)
     missing = [p for p in POINTS if p not in blob]
