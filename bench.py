@@ -116,6 +116,21 @@ def salvage_result(text):
     return None
 
 
+def salvage_attribution(text):
+    """The ``ATTRIBUTION <json>`` line the inner process prints right
+    after RESULT (measured-pass phase/device/overlap/MFU attribution,
+    ISSUE 10): a salvaged run keeps its attribution instead of going
+    blind — the r02-r04 trajectory had numbers with no *why*. None when
+    no parseable line landed."""
+    for line in reversed((text or "").strip().splitlines()):
+        if line.startswith("ATTRIBUTION "):
+            try:
+                return json.loads(line[len("ATTRIBUTION "):])
+            except json.JSONDecodeError:
+                continue   # truncated mid-write; scan on
+    return None
+
+
 def supervise(args, argv):
     """Degrade-ladder supervisor; always prints one JSON line.
 
@@ -148,11 +163,17 @@ def supervise(args, argv):
             return False
         log(f"[bench supervisor] salvaged RESULT {v:.1f} tok/s from "
             f"{how} {profile} attempt")
-        consider((0 if profile == "minimal" else 1, 0, v), profile,
-                 {"metric": METRIC, "value": round(v, 2), "unit": "tok/s",
+        parsed = {"metric": METRIC, "value": round(v, 2), "unit": "tok/s",
                   "vs_baseline": round(v / BASELINE_TOK_S, 4),
                   "salvaged": True,
-                  "salvaged_from": how})
+                  "salvaged_from": how}
+        attr = salvage_attribution(out_text)
+        if attr:
+            # attribution survives the salvage: the measured pass's
+            # phase/overlap/MFU fields ride the ATTRIBUTION line
+            parsed.update(attr)
+        consider((0 if profile == "minimal" else 1, 0, v), profile,
+                 parsed)
         return True
 
     while ladder:
@@ -278,28 +299,18 @@ def build_workload(rng, n_requests, max_model_len, tiny=False):
     return prompts, params
 
 
-# Dense-peak bf16 TFLOP/s by TPU generation (public spec sheets); used only
-# to turn measured tok/s into an MFU so rounds compare efficiency, not just
-# absolute rate on a changing workload (VERDICT r03 next #3).
-PEAK_TFLOPS = (("v5 lite", 197.0), ("v5e", 197.0), ("v6", 918.0),
-               ("trillium", 918.0), ("v5p", 459.0), ("v5", 459.0),
-               ("v4", 275.0), ("v3", 123.0))
-
-
+# The dense-peak bf16 TFLOP/s table moved to gllm_tpu/obs/spans.py
+# (PEAK_TFLOPS) — the per-step MFU gauge needs it too, and two copies
+# would drift. It turns measured tok/s into an MFU so rounds compare
+# efficiency, not just absolute rate (VERDICT r03 next #3).
 def chip_peak_flops() -> float:
-    """Peak bf16 FLOP/s of device 0, or 0.0 when unknown (CPU)."""
-    ov = os.environ.get("GLLM_TPU_PEAK_TFLOPS")
-    if ov:
-        try:
-            return float(ov) * 1e12
-        except ValueError:
-            log(f"[bench] ignoring malformed GLLM_TPU_PEAK_TFLOPS={ov!r}")
+    """Peak bf16 FLOP/s of device 0, or 0.0 when unknown (CPU).
+    Thin wrapper over the obs-layer table (obs/spans.py peak_flops)
+    so bench and the per-step MFU gauge can never disagree; the
+    GLLM_TPU_PEAK_TFLOPS override lives there too."""
+    from gllm_tpu.obs.spans import peak_flops
     import jax
-    kind = jax.devices()[0].device_kind.lower()
-    for tag, tf in PEAK_TFLOPS:
-        if tag in kind:
-            return tf * 1e12
-    return 0.0
+    return peak_flops(jax.devices()[0].device_kind)
 
 
 def model_flops(mc, prompts, params, prefill_chunk: int) -> float:
@@ -400,6 +411,12 @@ def main():
 
     if args.tiny:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        # CPU has no spec-sheet peak, which would null every MFU field
+        # and leave the attribution smoke blind — assume a declared
+        # 1 TFLOP/s nominal peak so the --tiny MFU numbers exercise the
+        # full plumbing (they are relative to this declared peak, not a
+        # real chip; the on-chip rungs use the real table).
+        os.environ.setdefault("GLLM_TPU_PEAK_TFLOPS", "1")
 
     phase("import_jax")
     import numpy as np
@@ -513,6 +530,12 @@ def main():
         c.kv_disk_path = tempfile.mkdtemp(prefix="gllm_bench_kvdisk_")
         c.kv_disk_gb = 2.0
 
+    # Tracing A/B lever (ISSUE 10 acceptance gate: default-on tracing
+    # must cost <2% --tiny throughput and keep token streams
+    # byte-identical): GLLM_BENCH_TRACING=0 runs the flag-off arm.
+    engine_cfg.tracing = (os.environ.get("GLLM_BENCH_TRACING", "1")
+                          not in ("", "0"))
+
     phase("backend_init")
     log(f"backend={jax.default_backend()} devices={jax.devices()} "
         f"profile={args.profile}")
@@ -571,6 +594,33 @@ def main():
     # straight out of BENCH_r*.json now instead of log archaeology.
     events = TRACE.events(since=trace_mark)
     step_summary = summarize(events)
+    # Salvageable attribution right behind RESULT (ISSUE 10): a run the
+    # supervisor kills in the sampled pass / report / teardown keeps its
+    # WHY, not just its number — the supervisor merges this line into
+    # the salvaged JSON.
+    # NOTE window_mfu (the steptrace-window estimator) is deliberately
+    # NOT named "mfu": the result JSON's mfu is the workload-level
+    # model_flops/dt/peak, and a salvage merge must never swap one
+    # definition for the other under the same key mid-trajectory.
+    print("ATTRIBUTION " + json.dumps({
+        "host_ms_by_phase": step_summary.get("host_ms_by_phase"),
+        "device_ms_by_kind": step_summary.get("device_ms_by_kind"),
+        "overlap_efficiency": step_summary.get("overlap_efficiency"),
+        "bubble_frac": step_summary.get("bubble_frac"),
+        "window_mfu": step_summary.get("mfu"),
+    }), flush=True)
+
+    # On-demand Chrome trace artifact of the measured pass
+    # (GLLM_BENCH_TRACE=1): engine-phase tracks + per-request span
+    # tracks, loadable in Perfetto (docs/observability.md#tracing).
+    trace_path = None
+    if os.environ.get("GLLM_BENCH_TRACE", "0") not in ("", "0"):
+        from gllm_tpu.obs.spans import chrome_trace
+        trace_path = os.path.abspath(f"bench_trace_{args.profile}.json")
+        with open(trace_path, "w") as f:
+            json.dump(chrome_trace(events, llm.spans.spans(),
+                                   span_t0=TRACE.t0), f)
+        log(f"[bench] chrome trace written to {trace_path}")
     kv_read = (kv_read_metric.get() - kv_read0) if kv_read_metric else 0.0
     # no silent caps: the ring holds GLLM_OBS_TRACE_CAP events — report
     # how many measured-pass iterations rolled off before the dump
@@ -736,8 +786,18 @@ def main():
         # ondevice_finish is off (GLLM_BENCH_ODF=0 A/B arm).
         "dead_substep_frac": step_summary.get("dead_substep_frac"),
         "chain_breaks": step_summary.get("chain_breaks_by_reason") or {},
+        # Performance attribution (ISSUE 10): where the measured pass's
+        # wall clock went (host phases vs device by kind), how much
+        # device wall hid under host work, and the device-idle share —
+        # every future BENCH_r*.json says WHY it got its number.
+        "host_ms_by_phase": step_summary.get("host_ms_by_phase"),
+        "device_ms_by_kind": step_summary.get("device_ms_by_kind"),
+        "overlap_efficiency": step_summary.get("overlap_efficiency"),
+        "bubble_frac": step_summary.get("bubble_frac"),
         "metrics": metrics_snapshot,
     }
+    if trace_path is not None:
+        result["trace_path"] = trace_path
     if sampled_result is not None:
         result["sampled"] = sampled_result
     if prefix_result is not None:

@@ -27,6 +27,7 @@ from gllm_tpu.config import EngineConfig
 from gllm_tpu.memory_manager import make_memory_manager
 from gllm_tpu.models.config import ModelConfig, from_hf_config
 from gllm_tpu.obs import metrics as obs
+from gllm_tpu.obs.spans import SpanTrace, StepFlopsModel, peak_flops
 from gllm_tpu.obs.steptrace import TRACE
 from gllm_tpu.sampling_params import SamplingParams
 from gllm_tpu.scheduler import Scheduler, SeqOutput
@@ -102,6 +103,21 @@ _M_ONDEV_FINISH = obs.counter(
 _M_DEAD_FRAC = obs.gauge(
     "gllm_dead_substep_frac",
     "wasted (dead-row) sub-step fraction of the latest fused block")
+# Performance attribution (docs/observability.md#tracing): per-step MFU
+# from the obs/spans.py FLOPs model against the device wall, the share
+# of that device wall hidden under host work (1 = never blocked), and
+# the estimated HBM read bandwidth (weights + KV stream / device wall).
+_M_MFU = obs.gauge(
+    "gllm_step_mfu",
+    "model FLOPs utilization of the latest step's device wall "
+    "(0 when the chip peak is unknown)")
+_M_OVERLAP = obs.gauge(
+    "gllm_overlap_efficiency",
+    "share of the latest step's device wall hidden under host work")
+_M_HBM = obs.gauge(
+    "gllm_step_hbm_gbps",
+    "estimated HBM read bandwidth of the latest step (weights + KV "
+    "stream over the device wall; per-device)")
 
 
 @dataclasses.dataclass
@@ -273,6 +289,30 @@ class LLM:
         # Encoder disaggregation (gllm_tpu/disagg/): set by init_disagg on
         # LM nodes; monolith engines leave it None.
         self.disagg_coordinator = None
+        # Performance-attribution layer (gllm_tpu/obs/spans.py,
+        # docs/observability.md#tracing): request-scoped spans are gated
+        # per ENGINE by config.tracing and recorded on a PER-ENGINE ring
+        # — seq_ids restart at 0 per LLM, so a process-global ring would
+        # merge co-resident engines' trees. The step FLOPs model + chip
+        # peak feed the per-step MFU/HBM estimates on steptrace events.
+        self.tracing = bool(getattr(config, "tracing", True))
+        self.spans = SpanTrace()
+        for s in self.schedulers:
+            s.spans = self.spans      # admission opens the span tree
+        try:
+            self._flops_model = StepFlopsModel.from_model_config(
+                model_cfg)
+        except Exception:       # exotic configs: attribution, not audit
+            self._flops_model = None
+        try:
+            kind = jax.devices()[0].device_kind
+        except Exception:
+            kind = ""
+        self._peak_flops = peak_flops(kind)
+        # monotonic timestamp of the last collect's completion — the
+        # lower bound of the next step's device-busy window (device
+        # wall = ready - max(dispatched, prev_ready))
+        self._last_ready = 0.0
 
     @property
     def eos_token_ids(self) -> frozenset:
@@ -527,6 +567,10 @@ class LLM:
         slot_mode = overlap and self.config.decode_slot_batching
         cup = self.config.chain_under_prefill if overlap else 0
         while len(self._in_flight) < depth:
+            # engine-loop phase attribution: everything from here to the
+            # runner call is "schedule" wall for the entry this pass
+            # produces (obs/spans.py, docs/observability.md#tracing)
+            t_enter = time.monotonic()
             if overlap and self._in_flight:
                 # chain the next decode step(s) off the chain's newest
                 # on-device tokens (overlap scheduling). Slot mode tracks
@@ -579,15 +623,18 @@ class LLM:
                     if pressure:
                         self._chained_under_pressure += len(chain)
                     self._yield_noted = False
+                    t_sched = time.monotonic()
                     if len(chain) > 1:
                         entry = (chain,
                                  self.runner.step_multi(chain, prev_handle),
-                                 time.monotonic())
+                                 time.monotonic(),
+                                 self._entry_phases(t_enter, t_sched))
                     else:
                         entry = (chain[0],
                                  self.runner.step_async_chained(
                                      chain[0], prev_handle),
-                                 time.monotonic())
+                                 time.monotonic(),
+                                 self._entry_phases(t_enter, t_sched))
                     self._in_flight.append(entry)
                     if slot_mode:
                         self._chain_tip = entry[:2]
@@ -615,15 +662,19 @@ class LLM:
                             [min(d + 1, k) for d in au]
                             if au is not None else None))
                     chain = [first] + links
+                    t_sched = time.monotonic()
                     entry = (chain, self.runner.step_multi(chain),
-                             time.monotonic())
+                             time.monotonic(),
+                             self._entry_phases(t_enter, t_sched))
                     self._in_flight.append(entry)
                     self._yield_noted = False
                     if slot_mode:
                         self._chain_tip = entry[:2]
                     continue
+            t_sched = time.monotonic()
             entry = (batch, self.runner.step_async(batch),
-                     time.monotonic())
+                     time.monotonic(),
+                     self._entry_phases(t_enter, t_sched))
             self._in_flight.append(entry)
             if batch.num_decode == batch.num_seqs and not batch.has_drafts:
                 self._yield_noted = False
@@ -641,7 +692,7 @@ class LLM:
         # hung device dispatch blocking the loop inside collect.
         faults.FAULTS.maybe_stall("dispatch_stall")
         faults.FAULTS.maybe_raise("step_exception")
-        batch, handle, t_dispatch = self._in_flight.popleft()
+        batch, handle, t_dispatch, phases = self._in_flight.popleft()
         if not self._in_flight:
             # pipeline drained: the tip (this very batch, or older) is
             # collected — a future burst must root a fresh chain, not
@@ -653,7 +704,7 @@ class LLM:
         if isinstance(batch, list) and aux.get("finish") is not None:
             extra = self._ondevice_block_stats(
                 aux["finish"][0][:batch[0].num_seqs])
-        self._record_step(batch, t0, t_dispatch, extra)
+        self._record_step(batch, t0, t_dispatch, extra, phases)
         if isinstance(batch, list):
             # multi-step block: tokens [K, S]; advance K scheduler steps
             outs = []
@@ -729,11 +780,78 @@ class LLM:
                        and out.new_token_id in self.eos_token_ids)
                 _M_ONDEV_FINISH.inc(kind="eos" if eos else "stop")
 
+    def _entry_phases(self, t_enter: float, t_sched_end: float) -> dict:
+        """Host-phase walls for one in-flight entry at dispatch time:
+        schedule (engine loop → batch/chain formed) plus the runner's
+        build/dispatch split and its per-dispatch KV-read estimate
+        (``ModelRunner.last_phases``). Seconds; converted to ms when
+        the collect lands (:meth:`_record_step`)."""
+        ph = {"t_enter": t_enter, "schedule": t_sched_end - t_enter}
+        rp = getattr(self.runner, "last_phases", None)
+        if rp:
+            ph.update(rp)
+        return ph
+
+    def _step_flops(self, batch, extra: Optional[dict] = None) -> float:
+        """Matmul-path FLOPs of one collected step (obs/spans.py model;
+        host arithmetic on scheduler counts). Fused blocks count the
+        sub-steps that actually EXECUTED (k_exec under on-device
+        finish) over their live rows."""
+        from gllm_tpu.sequence import HOLE_SEQ_ID
+        fm = self._flops_model
+        if fm is None:
+            return 0.0
+        if isinstance(batch, list):
+            k = (extra or {}).get("k_exec") or len(batch)
+            ctxs = [it.computed_before for it in batch[0].items
+                    if it.seq.seq_id != HOLE_SEQ_ID]
+            return fm.block_flops(ctxs, k)
+        return fm.step_flops(
+            (it.num_new_tokens, it.computed_before, it.samples)
+            for it in batch.items if it.seq.seq_id != HOLE_SEQ_ID)
+
+    def _record_spans(self, batch, t_dispatch: float, now: float,
+                      extra: Optional[dict] = None) -> None:
+        """Request-scoped span events for one collected step: each live
+        sequence in the batch gets one child span [dispatch → collect]
+        — ``prefill_chunk``, ``decode_step``, or ``decode_chain`` for a
+        fused block (obs/spans.py; no-op for requests the span tracker
+        never opened)."""
+        from gllm_tpu.sequence import HOLE_SEQ_ID
+        dur = (now - t_dispatch) * 1e3
+        if isinstance(batch, list):
+            meta = {"k": len(batch)}
+            if extra and extra.get("k_exec") is not None:
+                meta["k_exec"] = extra["k_exec"]
+                meta["dead_substeps"] = extra.get("dead_substeps")
+            self.spans.event_many(
+                [it.seq.seq_id for it in batch[0].items
+                 if it.seq.seq_id != HOLE_SEQ_ID],
+                "decode_chain", t_dispatch, dur, meta)
+            return
+        decode_rows = []
+        for it in batch.items:
+            sid = it.seq.seq_id
+            if sid == HOLE_SEQ_ID:
+                continue
+            if (it.num_new_tokens > 1
+                    or it.computed_before < it.seq.prompt_len):
+                self.spans.event(sid, "prefill_chunk", t_dispatch, dur,
+                            tokens=it.num_new_tokens)
+            else:
+                decode_rows.append(sid)
+        if decode_rows:
+            self.spans.event_many(decode_rows, "decode_step", t_dispatch, dur)
+
     def _record_step(self, batch, t0: float, t_dispatch: float,
-                     extra: Optional[dict] = None) -> None:
+                     extra: Optional[dict] = None,
+                     phases: Optional[dict] = None) -> None:
         """Step-kind attribution for one collected engine iteration:
-        latency/RTT histograms, per-kind counters, one steptrace event.
-        Host wall clock only — the handle was already collected."""
+        latency/RTT histograms, per-kind counters, one steptrace event
+        — extended with the engine-loop phase breakdown, the device
+        wall attributed back to this step, and the MFU/HBM estimates
+        (docs/observability.md#tracing). Host wall clock only — the
+        handle was already collected."""
         now = time.monotonic()
         fused = isinstance(batch, list)
         b = batch[-1] if fused else batch
@@ -760,13 +878,65 @@ class LLM:
             ev["k"] = len(batch)
         if extra:
             ev.update(extra)
+        if phases is not None:
+            # sub-steps that actually EXECUTED: on-device early exit
+            # (k_exec < k) shrinks both the weight re-reads and the KV
+            # stream — the HBM estimate must shrink with them or it
+            # contradicts the k_exec-based MFU on the same step
+            k_sched = len(batch) if fused else 1
+            k_exec = ((extra or {}).get("k_exec") or k_sched) if fused \
+                else 1
+            rd = (phases.get("kv_bytes", 0) * k_exec / k_sched
+                  + getattr(self.runner, "param_bytes", 0) * k_exec)
+            flops = (self._step_flops(batch, extra)
+                     if self._peak_flops else 0.0)
+            self._attach_attribution(ev, phases, wall, now, t_dispatch,
+                                     flops, rd)
+        else:
+            self._last_ready = now
         TRACE.record(kind, **ev)
+        if self.tracing:
+            self._record_spans(batch, t_dispatch, now, extra)
         timer = self._step_timer
         if timer is not None:
             timer.append((wall,
                           f"decode_block{len(batch)}" if fused
                           else "decode" if kind == "decode"
                           else "prefill_mixed", tokens))
+
+    def _attach_attribution(self, ev: dict, phases: dict, wall: float,
+                            now: float, t_dispatch: float,
+                            flops: float, rd_bytes: float) -> None:
+        """Shared attribution tail for a collected step event (single
+        runner AND dp paths — one implementation so they cannot drift):
+        host phase walls, the device wall attributed back to this step
+        (block-until-ready delta at collect, floored by the previous
+        collect's completion — before that the device was busy with the
+        OLDER step; no profiler, no extra device round trips), and the
+        MFU / HBM-bandwidth estimates + gauges."""
+        dev = max(0.0, now - max(t_dispatch, self._last_ready))
+        self._last_ready = now
+        ev["ph"] = {
+            "schedule": round(phases.get("schedule", 0.0) * 1e3, 3),
+            "build": round(phases.get("build", 0.0) * 1e3, 3),
+            "dispatch": round(phases.get("dispatch", 0.0) * 1e3, 3),
+            "collect": round(wall * 1e3, 3),
+        }
+        ev["step_wall_ms"] = round(
+            (now - phases.get("t_enter", t_dispatch)) * 1e3, 3)
+        ev["dev_ms"] = round(dev * 1e3, 3)
+        if dev <= 0:
+            return
+        _M_OVERLAP.set(round(max(0.0, dev - wall) / dev, 4))
+        if flops and self._peak_flops:
+            # 6 digits, matching summarize()'s window rounding: a
+            # compile-absorbed step's true MFU sits below 1e-4 and
+            # must not floor to 0
+            ev["mfu"] = round(flops / dev / self._peak_flops, 6)
+            _M_MFU.set(ev["mfu"])
+        if rd_bytes:
+            ev["hbm_gbps"] = round(rd_bytes / dev / 1e9, 2)
+            _M_HBM.set(ev["hbm_gbps"])
 
     def _observe_outputs(self, outs) -> None:
         """Per-request latency bookkeeping over one iteration's outputs
@@ -797,6 +967,17 @@ class LLM:
                 if n > 1 and seq.first_token_time:
                     _M_TPOT.observe((seq.last_token_time
                                      - seq.first_token_time) / (n - 1))
+                if self.tracing:
+                    # close the request's span tree: accumulated
+                    # detokenize/stream wall as one rolled-up child,
+                    # then the finish (obs/spans.py)
+                    detok = getattr(seq, "_detok_s", 0.0)
+                    if detok:
+                        self.spans.event(seq.seq_id, "detokenize",
+                                    now - detok, detok * 1e3,
+                                    accumulated=True)
+                    self.spans.finish(seq.seq_id, out.finish_reason, now,
+                                 output_tokens=n)
 
     def _schedule_multi(self, prev_batch, multi: int):
         """Chain up to ``multi`` decode steps off ``prev_batch`` for one
@@ -838,12 +1019,13 @@ class LLM:
     def _step_dp(self) -> List[SeqOutput]:
         """One synchronous step over all DP replicas (single jit program;
         idle replicas run dummy batches inside it)."""
+        t_enter = time.monotonic()
         batches = [s.schedule_once() for s in self.schedulers]
         if all(b is None for b in batches):
             return []
         faults.FAULTS.maybe_stall("dispatch_stall")
         faults.FAULTS.maybe_raise("step_exception")
-        t_dispatch = time.monotonic()
+        t_sched = t_dispatch = time.monotonic()
         handle = self.runner.step_async_dp(batches)
         t0 = time.monotonic()
         rows, auxes = self.runner.collect_dp(handle)
@@ -859,10 +1041,24 @@ class LLM:
         _M_STEP_TOKENS.inc(tokens, kind=kind)
         if kind == "decode":
             _M_DECODE_STEPS.inc(fused="false")
-        TRACE.record(kind, num_seqs=sum(b.num_seqs for b in live),
-                     tokens=tokens, wall_ms=round((now - t0) * 1e3, 3),
-                     rtt_ms=round((now - t_dispatch) * 1e3, 3),
-                     dp=len(live))
+        # same attribution fields as the single-runner path — the
+        # shared helper keeps the two call sites from drifting (the dp
+        # step is synchronous: device wall ≈ collect block)
+        ph = self._entry_phases(t_enter, t_sched)
+        ev = dict(num_seqs=sum(b.num_seqs for b in live),
+                  tokens=tokens, wall_ms=round((now - t0) * 1e3, 3),
+                  rtt_ms=round((now - t_dispatch) * 1e3, 3),
+                  dp=len(live))
+        flops = (sum(self._step_flops(b) for b in live)
+                 if self._peak_flops else 0.0)
+        rd = (ph.get("kv_bytes", 0)
+              + getattr(self.runner, "param_bytes", 0))
+        self._attach_attribution(ev, ph, now - t0, now, t_dispatch,
+                                 flops, rd)
+        TRACE.record(kind, **ev)
+        if self.tracing:
+            for b in live:
+                self._record_spans(b, t_dispatch, now)
         outs: List[SeqOutput] = []
         for sched, b, row, aux in zip(self.schedulers, batches, rows,
                                       auxes):
@@ -1204,11 +1400,18 @@ class LLM:
     # ---- output -----------------------------------------------------------
 
     def _stream_detokenize(self, seq: Sequence) -> str:
+        t0 = time.monotonic() if self.tracing else 0.0
         text, seq.detok_prefix_offset, seq.detok_read_offset = (
             detokenize_incrementally(self.tokenizer, seq.token_ids,
                                      seq.detok_prefix_offset,
                                      seq.detok_read_offset))
         seq.output_text += text
+        if self.tracing:
+            # accumulated per request; emitted as ONE rolled-up
+            # "detokenize" span at finish (one event per token would
+            # blow the span-phase cap on long streams)
+            seq._detok_s = (getattr(seq, "_detok_s", 0.0)
+                            + (time.monotonic() - t0))
         return text
 
     def _finalize(self, seq: Sequence) -> RequestOutput:
@@ -1302,5 +1505,12 @@ class LLM:
                 self.disagg_coordinator.abort(sorted(failed))
             except Exception:
                 logger.exception("disagg abort during quarantine failed")
+        if self.tracing:
+            # quarantined requests never emit a finishing SeqOutput —
+            # close their span trees here (reason matches the terminal
+            # error chunk the serving engine delivers)
+            now = time.monotonic()
+            for sid in failed:
+                self.spans.finish(sid, "error", now)
         TRACE.record("quarantine", num_seqs=len(failed))
         return sorted(failed)

@@ -474,12 +474,17 @@ class ServingEngine:
             logger.exception("quarantine after step failure failed")
             self._latch_unhealthy(f"unrecoverable step failure: {detail}")
             return
-        for sid in failed:
-            self._deliver_error(sid, "error", detail)
+        # Latch BEFORE delivering the terminal chunks: a client whose
+        # failed request just returned may immediately probe /readyz,
+        # and readiness must already reflect the escalation by the time
+        # any client can observe the failure (the pre-fix order lost
+        # that race — the order-dependent healthz-vs-readyz flake).
         if self._failed_steps >= self.max_step_failures:
             self._latch_unhealthy(
                 f"{self._failed_steps} consecutive step failures "
                 f"(last: {detail})")
+        for sid in failed:
+            self._deliver_error(sid, "error", detail)
 
     def _latch_unhealthy(self, why: str) -> None:
         if not self._healthy:
@@ -520,6 +525,12 @@ class ServingEngine:
 
     def _deliver_error(self, seq_id: int, reason: str,
                        detail: Optional[str] = None) -> None:
+        if getattr(self.llm.config, "tracing", True):
+            # abort/deadline/shutdown requests never reach the engine's
+            # normal finish path — close their span tree with the same
+            # reason the terminal chunk carries (first close wins)
+            self.llm.spans.finish(seq_id, reason or "error",
+                                  time.monotonic())
         with self._lock:
             handle = self._handles.pop(seq_id, None)
             self._seqs.pop(seq_id, None)
@@ -545,6 +556,11 @@ class ServingEngine:
             _M_ACTIVE.set(0)
         if handles:
             _M_ABORTED.inc(len(handles))
+        if getattr(self.llm.config, "tracing", True):
+            now = time.monotonic()
+            for h in handles:
+                self.llm.spans.finish(h.seq_id, reason or "error",
+                                      now)
         for h in handles:
             h.chunks.put(StreamChunk(None, "", reason, error=detail))
 

@@ -45,7 +45,15 @@ class ServerState:
         # pinned to replica ``pin_dp`` (reference --endpoint-per-dp)
         self.pin_dp = pin_dp
         self.start_time = time.time()
+        # jax.profiler state: _profile_mu makes every check+transition
+        # atomic across the legacy /start_profile//stop_profile pair
+        # and the POST /profile one-shot; _profiling_oneshot marks a
+        # capture /stop_profile must not truncate; _profile_lock
+        # serializes whole one-shot captures.
         self._profiling = False
+        self._profiling_oneshot = False
+        self._profile_mu = threading.Lock()
+        self._profile_lock = threading.Lock()   # POST /profile one-shot
         self.tool_parser = get_tool_parser(
             tool_parser, llm.config.model or served_model,
             architecture=getattr(llm.model_cfg, "architecture", "") or "")
@@ -233,7 +241,8 @@ class Handler(BaseHTTPRequestHandler):
         elif self.path.split("?", 1)[0] == "/steptrace":
             # JSON dump of the step-trace ring (pipe into
             # ``python -m gllm_tpu.obs.dump -`` for a readable table);
-            # ?since=N resumes from a previous dump's last seq.
+            # ?since=N resumes from a previous dump's last seq and
+            # ?kind=a,b filters by event kind.
             from urllib.parse import parse_qs, urlparse
             from gllm_tpu.obs.steptrace import TRACE, summarize
             q = parse_qs(urlparse(self.path).query)
@@ -243,11 +252,34 @@ class Handler(BaseHTTPRequestHandler):
                 self._json(proto.error_response(
                     "since must be an integer"), code=400)
                 return
-            events = TRACE.events(since=since)
+            kinds = [k for part in q.get("kind", [])
+                     for k in part.split(",") if k]
+            events = TRACE.events(since=since, kinds=kinds or None)
             self._json({"events": events,
                         "dropped": TRACE.dropped,
                         "next_since": TRACE.mark(),
                         "summary": summarize(events)})
+        elif self.path.split("?", 1)[0] == "/trace":
+            # Chrome trace-event JSON (Perfetto / chrome://tracing
+            # loadable): one track per engine phase + the device track,
+            # one track per request (this engine's span ring — spans
+            # are per-LLM; seq_ids restart per engine). ?since=N limits
+            # the step events like /steptrace.
+            from urllib.parse import parse_qs, urlparse
+            from gllm_tpu.obs.spans import SPANS, chrome_trace
+            from gllm_tpu.obs.steptrace import TRACE
+            q = parse_qs(urlparse(self.path).query)
+            try:
+                since = int(q.get("since", ["0"])[0])
+            except ValueError:
+                self._json(proto.error_response(
+                    "since must be an integer"), code=400)
+                return
+            spans = getattr(st.llm, "spans", SPANS)
+            self._json(chrome_trace(
+                TRACE.events(since=since),
+                spans.spans() + spans.open_spans(),
+                span_t0=TRACE.t0))
         elif self.path == "/version":
             self._json({"version": gllm_tpu.__version__})
         elif self.path == "/v1/models":
@@ -297,6 +329,8 @@ class Handler(BaseHTTPRequestHandler):
                 self._profile(True)
             elif self.path == "/stop_profile":
                 self._profile(False)
+            elif self.path.split("?", 1)[0] == "/profile":
+                self._profile_oneshot()
             else:
                 self._json(proto.error_response("not found", 404), code=404)
         except proto.ProtocolError as e:
@@ -624,20 +658,82 @@ class Handler(BaseHTTPRequestHandler):
     def _profile(self, start: bool):
         import jax
         st = self.state
-        if start and not st._profiling:
-            import os
+        with st._profile_mu:
+            if start and not st._profiling:
+                import os
+                trace_dir = os.environ.get("GLLM_PROFILE_DIR",
+                                           "/tmp/gllm_tpu_profile")
+                jax.profiler.start_trace(trace_dir)
+                st._profiling = True
+                self._json({"status": "profiling started",
+                            "trace_dir": trace_dir})
+            elif not start and st._profiling_oneshot:
+                # a POST /profile capture owns the profiler right now —
+                # stopping it here would truncate that capture and make
+                # its own stop_trace raise
+                self._json(proto.error_response(
+                    "a one-shot /profile capture is in progress", 409),
+                    code=409)
+            elif not start and st._profiling:
+                jax.profiler.stop_trace()
+                st._profiling = False
+                self._json({"status": "profiling stopped"})
+            else:
+                self._json({"status": "noop"})
+
+    def _profile_oneshot(self):
+        """POST /profile?seconds=N — one-shot jax.profiler capture:
+        start, sleep N seconds (serving continues; the engine thread is
+        untouched), stop, return the artifact directory. The
+        start/stop pair above remains for manual bracketing; this is
+        the capture-and-return call a bench/ops script wants."""
+        import os
+        import time as _time
+        from urllib.parse import parse_qs, urlparse
+        import jax
+        st = self.state
+        q = parse_qs(urlparse(self.path).query)
+        try:
+            seconds = float(q.get("seconds", ["3"])[0])
+        except ValueError:
+            self._json(proto.error_response("seconds must be a number"),
+                       code=400)
+            return
+        if not 0 < seconds <= 120:
+            self._json(proto.error_response(
+                "seconds must be in (0, 120]"), code=400)
+            return
+        if not st._profile_lock.acquire(blocking=False):
+            self._json(proto.error_response(
+                "a profile capture is already running", 409), code=409)
+            return
+        try:
             trace_dir = os.environ.get("GLLM_PROFILE_DIR",
                                        "/tmp/gllm_tpu_profile")
-            jax.profiler.start_trace(trace_dir)
-            st._profiling = True
-            self._json({"status": "profiling started",
+            # check + start atomically vs /start_profile (_profile_mu):
+            # a racing manual start must not double-start the profiler
+            with st._profile_mu:
+                if st._profiling:
+                    self._json(proto.error_response(
+                        "profiler already started via /start_profile",
+                        409), code=409)
+                    return
+                st._profiling = True
+                st._profiling_oneshot = True
+                jax.profiler.start_trace(trace_dir)
+            try:
+                _time.sleep(seconds)
+            finally:
+                with st._profile_mu:
+                    try:
+                        jax.profiler.stop_trace()
+                    finally:
+                        st._profiling = False
+                        st._profiling_oneshot = False
+            self._json({"status": "ok", "seconds": seconds,
                         "trace_dir": trace_dir})
-        elif not start and st._profiling:
-            jax.profiler.stop_trace()
-            st._profiling = False
-            self._json({"status": "profiling stopped"})
-        else:
-            self._json({"status": "noop"})
+        finally:
+            st._profile_lock.release()
 
 
 def build_engine_config(args) -> EngineConfig:
@@ -663,6 +759,7 @@ def build_engine_config(args) -> EngineConfig:
         sp_ring_threshold=args.sp_ring_threshold,
         mm_processor_min_pixels=args.mm_processor_min_pixels,
         mm_processor_max_pixels=args.mm_processor_max_pixels,
+        tracing=not args.no_tracing,
         max_queued_requests=args.max_queued_requests,
         max_resident_requests=args.max_resident_requests,
         request_deadline_s=args.request_deadline_s,
@@ -861,6 +958,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="deterministic fault injection spec "
                         "'point[:after_n[:count]][,...]' "
                         "(gllm_tpu/faults.py; chaos testing only)")
+    p.add_argument("--no-tracing", action="store_true",
+                   help="disable the request-span tracing layer "
+                        "(GET /trace request tracks; the engine-phase "
+                        "attribution on /steptrace stays on). Token "
+                        "streams are byte-identical either way "
+                        "(docs/observability.md#tracing)")
     p.add_argument("--skip-warmup", action="store_true",
                    help="don't pre-compile decode buckets before serving "
                         "(first requests pay compile latency instead)")

@@ -1,15 +1,20 @@
-"""Dependency-free observability layer (metrics + step traces).
+"""Dependency-free observability layer (metrics, step traces, spans).
 
-Two pillars, both pure-host bookkeeping (no jax import, no device work,
+Three pillars, all pure-host bookkeeping (no jax import, no device work,
 no effect on jit cache keys):
 
 - ``gllm_tpu.obs.metrics``: a Prometheus-style registry (Counter / Gauge /
   Histogram with fixed buckets, thread-safe, text-exposition renderer)
   served by the api_server's ``GET /metrics``.
 - ``gllm_tpu.obs.steptrace``: a ring buffer of per-step records (kind,
-  batch size, token counts, wall ms, ...) dumped by ``GET /steptrace``
-  and summarized into bench.py's metrics snapshot. ``python -m
-  gllm_tpu.obs.dump trace.jsonl`` pretty-prints a saved trace.
+  batch size, token counts, wall ms, and the engine-loop phase/device
+  attribution fields) dumped by ``GET /steptrace`` and summarized into
+  bench.py's metrics snapshot. ``python -m gllm_tpu.obs.dump
+  trace.jsonl`` pretty-prints a saved trace.
+- ``gllm_tpu.obs.spans``: the performance-attribution layer — per-request
+  span trees, the step FLOPs model behind ``gllm_step_mfu``, and the
+  Chrome trace-event converter behind ``GET /trace`` and ``obs.dump
+  --format chrome`` (docs/observability.md#tracing--attribution).
 
 Every round-5 finding (unfused decode steps at 8x the fused latency, the
 sampled-path sort, the tuning-table regression) had to be excavated from
@@ -17,4 +22,4 @@ ad-hoc stderr logs; this layer makes the same questions one HTTP GET or
 one JSON blob.
 """
 
-from gllm_tpu.obs import metrics, steptrace  # noqa: F401
+from gllm_tpu.obs import metrics, spans, steptrace  # noqa: F401

@@ -1,12 +1,17 @@
-"""Pretty-print a steptrace JSONL for bench post-mortems.
+"""Pretty-print or convert a steptrace JSONL for bench post-mortems.
 
 Usage:
   python -m gllm_tpu.obs.dump trace.jsonl            # event table + summary
   python -m gllm_tpu.obs.dump trace.jsonl --summary  # summary only
+  python -m gllm_tpu.obs.dump trace.jsonl --format chrome > t.json
+                                  # Chrome trace-event JSON (Perfetto)
+  python -m gllm_tpu.obs.dump t.jsonl --since 1200 --kind decode,fused_block
   curl -s host:8000/steptrace | python -m gllm_tpu.obs.dump -  # live dump
 
 The input is one JSON event per line (``StepTrace.to_jsonl``) or a single
 JSON object with an ``events`` list (the ``GET /steptrace`` payload).
+``--format chrome`` runs the same event→trace-event converter the
+``GET /trace`` endpoint uses (gllm_tpu/obs/spans.py chrome_trace).
 """
 
 from __future__ import annotations
@@ -56,16 +61,33 @@ def format_table(events: list) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m gllm_tpu.obs.dump",
-        description="pretty-print a steptrace JSONL")
+        description="pretty-print or convert a steptrace JSONL")
     ap.add_argument("path", help="JSONL file, or - for stdin")
     ap.add_argument("--summary", action="store_true",
                     help="print only the by-kind wall-time summary")
+    ap.add_argument("--format", choices=("table", "chrome"),
+                    default="table",
+                    help="chrome: emit Chrome trace-event JSON "
+                         "(Perfetto-loadable; the GET /trace converter)")
+    ap.add_argument("--since", type=int, default=0,
+                    help="drop events whose ring seq is below this")
+    ap.add_argument("--kind", default=None,
+                    help="comma-separated event kinds to keep")
     args = ap.parse_args(argv)
     if args.path == "-":
         events = load_events(sys.stdin)
     else:
         with open(args.path) as f:
             events = load_events(f)
+    if args.since:
+        events = [e for e in events if e.get("seq", 0) >= args.since]
+    if args.kind:
+        keep = {k for k in args.kind.split(",") if k}
+        events = [e for e in events if e.get("kind") in keep]
+    if args.format == "chrome":
+        from gllm_tpu.obs.spans import chrome_trace
+        print(json.dumps(chrome_trace(events)))
+        return 0
     if not args.summary:
         print(format_table(events))
         print()

@@ -54,6 +54,14 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 #                  ``hit_tokens``, and ``pages`` — claimed page counts
 #                  keyed by the serving tier (hbm/host/disk/peer,
 #                  docs/kv_offload.md)
+#
+# Step events (prefill/decode/fused_block) additionally carry the
+# performance-attribution fields (docs/observability.md#tracing):
+# ``ph`` = host wall by engine phase {schedule, build, dispatch,
+# collect} in ms, ``step_wall_ms`` = schedule-start → collect-end,
+# ``dev_ms`` = device wall attributed back to the launching step
+# (block-until-ready delta at collect), and optional ``mfu`` /
+# ``hbm_gbps`` estimates from the step FLOPs model (obs/spans.py).
 STEP_KINDS = ("prefill", "decode", "fused_block", "pp_stage", "compile",
               "chain_break", "fault", "quarantine", "prefix")
 CHAIN_BREAK_REASONS = ("waiting", "pages", "shape", "spec", "finish")
@@ -89,6 +97,13 @@ class StepTrace:
         only what was recorded after this point."""
         with self._lock:
             return self._next_seq
+
+    @property
+    def t0(self) -> float:
+        """The ring's monotonic epoch — event ``t`` fields are relative
+        to this; the chrome exporter rebases span timestamps onto it."""
+        with self._lock:
+            return self._t0
 
     def __len__(self) -> int:
         with self._lock:
@@ -151,6 +166,14 @@ def summarize(events: List[dict]) -> dict:
     # prefix-cache attribution: per-window hit rate + tier split
     pfx_queries = pfx_query_tokens = pfx_hit_tokens = 0
     pfx_pages: Dict[str, int] = {}
+    # engine-loop phase breakdown + device-wall attribution (events
+    # carrying ``ph``/``dev_ms`` — docs/observability.md#tracing)
+    host_phase: Dict[str, float] = {}
+    dev_by_kind: Dict[str, float] = {}
+    dev_total = hidden_total = 0.0
+    mfu_dev = hbm_dev = 0.0          # Σ(estimate · dev_ms) numerators
+    mfu_seen = hbm_seen = False
+    t_first_start = t_last_end = None
     for e in events:
         k = e["kind"]
         if k == "prefix":
@@ -185,6 +208,27 @@ def summarize(events: List[dict]) -> dict:
         row["wall_ms"] += wall
         total_ms += wall
         row["tokens"] += int(e.get("tokens", 0))
+        ph = e.get("ph")
+        if isinstance(ph, dict):
+            for name, ms in ph.items():
+                host_phase[name] = host_phase.get(name, 0.0) + float(ms)
+            dev = float(e.get("dev_ms", 0.0))
+            dev_by_kind[k] = dev_by_kind.get(k, 0.0) + dev
+            dev_total += dev
+            coll = float(ph.get("collect", wall))
+            hidden_total += max(0.0, dev - coll)
+            if e.get("mfu") is not None:
+                mfu_seen = True
+                mfu_dev += float(e["mfu"]) * dev
+            if e.get("hbm_gbps") is not None:
+                hbm_seen = True
+                hbm_dev += float(e["hbm_gbps"]) * dev
+            start = float(e["t"]) - float(
+                e.get("step_wall_ms", wall)) / 1e3
+            if t_first_start is None or start < t_first_start:
+                t_first_start = start
+            if t_last_end is None or float(e["t"]) > t_last_end:
+                t_last_end = float(e["t"])
         if k == "decode":
             unfused_steps += 1
             unfused_ms += wall
@@ -199,6 +243,10 @@ def summarize(events: List[dict]) -> dict:
         row["wall_ms"] = round(row["wall_ms"], 2)
         row["ms_per_step"] = round(row["wall_ms"] / row["steps"], 2)
     decode_ms = fused_ms + unfused_ms
+    # window wall: first step's schedule-start → last step's collect-end
+    elapsed_ms = ((t_last_end - t_first_start) * 1e3
+                  if t_first_start is not None
+                  and t_last_end > t_first_start else 0.0)
     return {
         "by_kind": kinds,
         "decode_steps_unfused": unfused_steps,
@@ -223,6 +271,37 @@ def summarize(events: List[dict]) -> dict:
                          if pfx_query_tokens else 0.0),
             "pages_by_tier": pfx_pages,
         } if pfx_queries else None),
+        # ---- performance attribution (docs/observability.md#tracing;
+        # None/{} when the window's events predate the tracing layer) --
+        # host wall by engine-loop phase over the window
+        "host_ms_by_phase": ({k: round(v, 2)
+                              for k, v in host_phase.items()}
+                             if host_phase else None),
+        # device wall (block-until-ready deltas) attributed by step kind
+        "device_ms_by_kind": ({k: round(v, 2)
+                               for k, v in dev_by_kind.items()}
+                              if dev_by_kind else None),
+        # share of device wall hidden under host work (1 = the host
+        # never blocked on the device; 0 = fully synchronous)
+        "overlap_efficiency": (round(hidden_total / dev_total, 4)
+                               if dev_total > 0 else None),
+        # share of the window's wall clock with the device idle — the
+        # gLLM bubble ratio, reproduced from engine-side attribution
+        "bubble_frac": (round(max(0.0, 1.0 - dev_total / elapsed_ms), 4)
+                        if elapsed_ms > 0 and dev_total > 0 else None),
+        # window MFU against the wall clock (Σ step-FLOPs / peak /
+        # elapsed) and against device-busy time only; None when the
+        # peak is unknown (CPU without GLLM_TPU_PEAK_TFLOPS). 6 digits:
+        # a tiny-model window with compile gaps sits at 1e-6 and must
+        # not quantize to a fake hard zero
+        "mfu": (round(mfu_dev / elapsed_ms, 6)
+                if mfu_seen and elapsed_ms > 0 else None),
+        "device_mfu": (round(mfu_dev / dev_total, 6)
+                       if mfu_seen and dev_total > 0 else None),
+        # estimated HBM read bandwidth over device-busy time (weights +
+        # KV stream per step; per-device)
+        "hbm_gbps": (round(hbm_dev / dev_total, 2)
+                     if hbm_seen and dev_total > 0 else None),
         "compiles": compiles,
         "chain_breaks": chain_breaks,
         "chain_breaks_by_reason": break_reasons,

@@ -168,6 +168,9 @@ class PPModelRunner(ModelRunner):
         self.rng_key = jax.random.key(config.seed)
         self._step_count = 0
         self._seen_sigs = set()          # see ModelRunner._note_dispatch
+        self.last_phases = {}            # see ModelRunner.last_phases
+        self._last_kv_read = 0
+        self.param_bytes = 0             # summed over stages below
 
         if model_cfg.use_hybrid:
             from gllm_tpu.models.hybrid import period_pattern
@@ -246,6 +249,13 @@ class PPModelRunner(ModelRunner):
             # calls differ only in arg placement → per-sharding compiles
             # dedupe through the jit cache)
             staged.append((scfg, sparams, self._make_stage_fn(scfg)))
+            try:
+                from gllm_tpu.ops.quant import param_bytes as _pbytes
+                # whole-pipeline weight bytes (HBM-bandwidth estimate);
+                # every stage's weights stream once per microbatch
+                self.param_bytes += int(_pbytes(sparams))
+            except Exception:
+                pass
             logger.info("[startup] phase=weight_load stage=%d seconds=%.2f",
                         i, _time.monotonic() - _t_load)
             _t_load = _time.monotonic()
@@ -448,8 +458,10 @@ class PPModelRunner(ModelRunner):
     def _run_pipeline(self, stages, sched_batch, step_key):
         """Launch one microbatch through one replica's stage chain; all
         dispatch is async — returns (tokens_future, aux, num_seqs)."""
+        import time as _time
         from gllm_tpu.parallel.mesh import mesh_context
         from gllm_tpu.runner.runner import _spec_sampled
+        t_enter = _time.monotonic()
         batch, max_q, presence = self.builder.build(sched_batch, step_key,
                                                     device=False)
         lp_k, want_plp = self._lp_flags(sched_batch)
@@ -464,6 +476,7 @@ class PPModelRunner(ModelRunner):
         TRACE.record("pp_stage", stages=len(stages),
                      num_seqs=sched_batch.num_seqs,
                      tokens=sched_batch.total_tokens)
+        t_build = _time.monotonic()
         hidden = residual = None
         out = None
         # one batched host→device transfer fans the step batch out to
@@ -498,6 +511,9 @@ class PPModelRunner(ModelRunner):
             if not stage.cfg.is_last_stage:
                 hidden, residual = out
         tokens, aux = out
+        self.last_phases = {"build": t_build - t_enter,
+                            "dispatch": _time.monotonic() - t_build,
+                            "kv_bytes": self._last_kv_read}
         return tokens, aux, sched_batch.num_seqs
 
     def _apply_scale_resets(self) -> None:

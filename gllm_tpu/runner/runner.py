@@ -308,6 +308,13 @@ class ModelRunner:
         # (shape-bucket, static-flag) signatures already dispatched —
         # first sightings count as compile events (obs layer)
         self._seen_sigs = set()
+        # Dispatch-phase attribution (docs/observability.md#tracing):
+        # every step_async* records its host build/dispatch split here
+        # (seconds) plus the step's KV-read estimate; the engine copies
+        # it into the in-flight entry it is building. Overwritten per
+        # dispatch — the engine reads it synchronously after the call.
+        self.last_phases = {}
+        self._last_kv_read = 0
 
         ep_loaded = False
         _t_load = time.monotonic()
@@ -415,6 +422,13 @@ class ModelRunner:
             self.kv = jax.tree.map(
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
                 self.kv, kspecs)
+        # Total parameter bytes on device — the per-dispatch weight-read
+        # term of the HBM-bandwidth estimate (gllm_step_hbm_gbps).
+        try:
+            from gllm_tpu.ops.quant import param_bytes
+            self.param_bytes = int(param_bytes(self.params))
+        except Exception:
+            self.param_bytes = 0
         logger.info("KV cache: %d pages × %d tokens (%s)", self.num_pages,
                     config.cache.page_size, self._kv_dtype().__name__)
         _M_KV_DTYPE.set(1, dtype=jnp.dtype(self._kv_dtype()).name)
@@ -874,13 +888,16 @@ class ModelRunner:
         attention: each row reads its whole context (kv_len after this
         step's writes); a K-step fused block re-reads the growing
         context every sub-step. Pure host arithmetic on scheduler state
-        — never touches the device."""
+        — never touches the device. The per-dispatch value is stashed
+        for the engine's HBM-bandwidth attribution (last_phases)."""
         tok_bytes = getattr(self, "_kv_rd_tok_bytes", 0)
+        self._last_kv_read = 0
         if not tok_bytes:
             return
         ctx = sum(it.computed_before + it.num_new_tokens for it in items)
         grow = len(items) * steps * (steps - 1) // 2
-        _M_KV_READ.inc(int((ctx * steps + grow) * tok_bytes))
+        self._last_kv_read = int((ctx * steps + grow) * tok_bytes)
+        _M_KV_READ.inc(self._last_kv_read)
 
     def _note_dispatch(self, kind: str, batch, static_flags: tuple,
                        all_greedy: bool) -> None:
@@ -929,6 +946,7 @@ class ModelRunner:
         """
         from jax.sharding import NamedSharding, PartitionSpec as P
         assert len(sched_batches) == self.dp
+        t_enter = time.monotonic()
         self._apply_ssm_intents()
         self._apply_swap_intents()   # no-op under dp>1 (tier is gated)
         self._step_count += 1
@@ -1007,6 +1025,7 @@ class ModelRunner:
                             (max_q, lp_k, want_plp, spec_sampled_dp,
                              all_greedy_dp),
                             all_greedy_dp)
+        t_build = time.monotonic()
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
             tokens, self.kv, aux = self._step_fn_dp(
@@ -1015,6 +1034,9 @@ class ModelRunner:
                 spec_sampled=spec_sampled_dp,
                 all_greedy=all_greedy_dp)
         _start_host_copy((tokens, aux))
+        self.last_phases = {"build": t_build - t_enter,
+                            "dispatch": time.monotonic() - t_build,
+                            "kv_bytes": self._last_kv_read}
         return tokens, aux, [b.num_seqs if b is not None else 0
                              for b in sched_batches]
 
@@ -1032,6 +1054,7 @@ class ModelRunner:
         """Launch one step; returns an opaque handle whose tokens are an
         uncommitted device future (jax async dispatch — the host does not
         block until ``collect``)."""
+        t_enter = time.monotonic()
         if self.model_cfg.use_mm:
             self._prepare_mm(sched_batch)
         self._apply_ssm_intents()
@@ -1048,6 +1071,7 @@ class ModelRunner:
         self._note_dispatch("step", batch,
                             (max_q, lp_k, want_plp, ring, spec_sampled,
                              all_greedy), all_greedy)
+        t_build = time.monotonic()
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
             tokens, self.kv, aux = self._step_fn(
@@ -1057,6 +1081,9 @@ class ModelRunner:
                 spec_sampled=spec_sampled,
                 all_greedy=all_greedy)
         _start_host_copy((tokens, aux))
+        self.last_phases = {"build": t_build - t_enter,
+                            "dispatch": time.monotonic() - t_build,
+                            "kv_bytes": self._last_kv_read}
         return tokens, aux, sched_batch.num_seqs
 
     def _use_ring(self, sched_batch: ScheduledBatch, t_pad: int) -> bool:
@@ -1107,6 +1134,7 @@ class ModelRunner:
         the next step's token_ids)."""
         prev_tokens, _, prev_n = prev_handle
         assert prev_n == sched_batch.num_seqs
+        t_enter = time.monotonic()
         self._apply_ssm_intents()
         self._apply_swap_intents()
         self._step_count += 1
@@ -1122,6 +1150,7 @@ class ModelRunner:
         self._note_dispatch("step", batch,
                             (1, lp_k, False, False, False, all_greedy),
                             all_greedy)
+        t_build = time.monotonic()
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
             tokens, self.kv, aux = self._step_fn(
@@ -1129,6 +1158,9 @@ class ModelRunner:
                 max_q_len=1, logprobs_k=lp_k,
                 all_greedy=all_greedy)
         _start_host_copy((tokens, aux))
+        self.last_phases = {"build": t_build - t_enter,
+                            "dispatch": time.monotonic() - t_build,
+                            "kv_bytes": self._last_kv_read}
         return tokens, aux, sched_batch.num_seqs
 
     def step_multi(self, chain, prev_handle=None):
@@ -1143,6 +1175,7 @@ class ModelRunner:
         Returns a handle whose collect() yields tokens [K, n]; chainable
         (the last step's on-device tokens feed the next block)."""
         K = len(chain)
+        t_enter = time.monotonic()
         # chain scheduling may have minted prefix-cached pages (spill
         # intents) — drain before the block overwrites them
         self._apply_swap_intents()
@@ -1191,6 +1224,7 @@ class ModelRunner:
         # changes the pytree structure and its pow2 width E the shapes
         self._note_dispatch("multi_step", batch,
                             (K, all_greedy, odf, e_bucket), all_greedy)
+        t_build = time.monotonic()
         from gllm_tpu.parallel.mesh import mesh_context
         with mesh_context(self.mesh):
             tokens, finish_step, self.kv = self._multi_step_fn(
@@ -1199,6 +1233,9 @@ class ModelRunner:
                 all_greedy=all_greedy, ondevice_finish=odf)
         aux = {"finish": (finish_step,)} if finish_step is not None else {}
         _start_host_copy((tokens, aux))
+        self.last_phases = {"build": t_build - t_enter,
+                            "dispatch": time.monotonic() - t_build,
+                            "kv_bytes": self._last_kv_read}
         return tokens, aux, chain[0].num_seqs
 
     def _build_multi_step_fn(self):

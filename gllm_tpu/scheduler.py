@@ -37,6 +37,7 @@ from typing import Deque, List, Optional, Tuple
 from gllm_tpu.config import EngineConfig
 from gllm_tpu.memory_manager import MemoryManager
 from gllm_tpu.obs import metrics as obs
+from gllm_tpu.obs.spans import SPANS
 from gllm_tpu.sequence import (HOLE_SEQ_ID, Sequence, SequenceStatus,
                                make_hole_seq)
 from gllm_tpu.utils import bucket_size, cdiv
@@ -216,6 +217,11 @@ class Scheduler:
                                    self.sched_cfg.max_decode_seqs
                                    + self.sched_cfg.max_prefill_tokens)
         self.chain_break_reason: Optional[str] = None
+        # Request-span ring (obs/spans.py): the owning LLM overwrites
+        # this with its per-engine instance (seq_ids restart per engine
+        # — a shared ring would merge co-resident engines' trees); the
+        # global is the standalone-scheduler fallback.
+        self.spans = SPANS
 
     # ---- intake -----------------------------------------------------------
 
@@ -555,6 +561,13 @@ class Scheduler:
                 # queue-time anchor (request histograms, engine/llm.py);
                 # a preempted seq keeps its original admission time
                 seq.first_sched_time = time.monotonic()
+                if getattr(self.config, "tracing", True):
+                    # open the request's span tree (obs/spans.py): the
+                    # "queued" phase is arrival → this first schedule
+                    self.spans.begin(seq.seq_id,
+                                seq.arrival_time or seq.first_sched_time,
+                                seq.first_sched_time,
+                                prompt_tokens=seq.prompt_len)
             _M_ADMIT.inc()
             self.running.append(seq)
             items.append(ScheduledSeq(seq, n, seq.num_computed_tokens))
@@ -966,6 +979,13 @@ class Scheduler:
         seq.finish_reason = "abort"
         self.mm.free_seq(seq)
         self._aborted_ids.discard(seq.seq_id)
+        if getattr(self.config, "tracing", True):
+            # aborted seqs never emit a finishing SeqOutput — close the
+            # span tree here (first close wins: the serving engine may
+            # already have recorded a more specific reason, e.g.
+            # "deadline")
+            self.spans.finish(seq.seq_id, "abort",
+                              time.monotonic())
 
     def _process_aborts(self) -> None:
         if not self._aborted_ids:

@@ -77,3 +77,84 @@ def test_every_documented_metric_is_registered():
     assert not ghosts, (
         "docs/observability.md mentions gllm_* names no code registers "
         "(typo or removed metric — fix the doc): " + ", ".join(ghosts))
+
+
+# ---- steptrace event kinds / span phases (ISSUE 10 satellite) --------------
+#
+# Same no-drift contract for the trace vocabularies: every
+# ``TRACE.record("<kind>", ...)`` call site in gllm_tpu/ must have a row
+# in the doc's event-kind catalog (and vice versa), and every
+# ``SPANS.event(..., "<phase>", ...)``-recorded span phase a row in the
+# span-phase catalog. The catalogs are marker-delimited tables so the
+# doc can mention kind-words in prose without tripping the guard.
+
+_TRACE_RE = re.compile(r"\bTRACE\.record\(\s*\n?\s*['\"]([a-z_]+)['\"]")
+# SPANS.event(sid, "phase", ...) / SPANS.event_many(ids, "phase", ...)
+# — also matches the tracker-internal self.event(...) call that records
+# the "queued" phase in spans.py. The first argument may be a bracketed
+# list comprehension (no commas/parens), so [^,()]+ spans it.
+_SPAN_RE = re.compile(
+    r"\.event(?:_many)?\(\s*\n?\s*[^,()]+,\s*\n?\s*['\"]([a-z_]+)['\"]")
+
+
+def _scan(regex):
+    found = {}
+    for root, _, files in os.walk(PKG):
+        if "__pycache__" in root:
+            continue
+        for fn in files:
+            if fn.endswith(".py"):
+                path = os.path.join(root, fn)
+                for m in regex.finditer(open(path).read()):
+                    found.setdefault(m.group(1), path)
+    return found
+
+
+def _catalog(marker):
+    doc = open(DOC).read()
+    start = doc.index(f"<!-- {marker} -->")
+    end = doc.index(f"<!-- /{marker} -->")
+    return set(re.findall(r"^\|\s*`([a-z_]+)`",
+                          doc[start:end], re.MULTILINE))
+
+
+def test_every_trace_kind_is_documented_and_vice_versa():
+    # The step kinds (prefill/decode/fused_block) are recorded through a
+    # VARIABLE (engine/llm.py _record_step computes the kind), so the
+    # declared taxonomy in steptrace.STEP_KINDS joins the literal call
+    # sites as the authoritative "recorded" set.
+    from gllm_tpu.obs.steptrace import STEP_KINDS
+    recorded = _scan(_TRACE_RE)
+    assert recorded, "source scan found no TRACE.record call sites"
+    known = set(recorded) | set(STEP_KINDS)
+    documented = _catalog("event-kind-catalog")
+    missing = sorted(known - documented)
+    assert not missing, (
+        "TRACE.record kinds with no docs/observability.md event-kind-"
+        "catalog row: "
+        + ", ".join(f"{k} ({os.path.relpath(recorded[k], REPO)})"
+                    if k in recorded else k for k in missing))
+    ghosts = sorted(documented - known)
+    assert not ghosts, (
+        "event-kind-catalog rows no TRACE.record call site emits "
+        f"(fix the doc): {ghosts}")
+    stray = sorted(set(recorded) - set(STEP_KINDS))
+    assert not stray, (
+        "TRACE.record call sites using kinds absent from "
+        f"steptrace.STEP_KINDS (extend the taxonomy): {stray}")
+
+
+def test_every_span_phase_is_documented_and_vice_versa():
+    recorded = _scan(_SPAN_RE)
+    assert recorded, "source scan found no SPANS.event call sites"
+    documented = _catalog("span-phase-catalog")
+    missing = sorted(set(recorded) - documented)
+    assert not missing, (
+        "span phases with no docs/observability.md span-phase-catalog "
+        "row: "
+        + ", ".join(f"{p} ({os.path.relpath(recorded[p], REPO)})"
+                    for p in missing))
+    ghosts = sorted(documented - set(recorded))
+    assert not ghosts, (
+        "span-phase-catalog rows no SPANS.event call site emits "
+        f"(fix the doc): {ghosts}")

@@ -1,0 +1,507 @@
+"""Performance-attribution layer (ISSUE 10, docs/observability.md#tracing):
+
+- SpanTrace lifecycle units: begin/event/finish, ring bound/eviction,
+  open-bound untracking, phase-cap rollup, idempotent close;
+- summarize() attribution math (host_ms_by_phase, overlap_efficiency,
+  bubble_frac, MFU) on synthetic events;
+- chrome_trace JSON schema (engine-phase tracks + request tracks,
+  phase slices reconstruct the step wall);
+- engine e2e on a dummy-weight CPU model: step events carry the phase
+  breakdown, the phase-sum ≈ step-wall invariant holds on the
+  synchronous engine, span trees complete for every request
+  (queued → prefill → decode → finish) and fused chains record
+  decode_chain spans;
+- terminal paths (abort / deadline / quarantine) close spans;
+- tracing=False: zero spans recorded, token streams byte-identical;
+- /trace + /steptrace?kind= + POST /profile HTTP surface;
+- obs.dump --format chrome / --kind / --since;
+- the bench --tiny CPU smoke: attribution fields present and
+  non-degenerate in the result JSON, ATTRIBUTION salvage line, chrome
+  trace artifact (GLLM_BENCH_TRACE=1).
+"""
+
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.obs.spans import (SPANS, SpanTrace, StepFlopsModel,
+                                chrome_trace, peak_flops)
+from gllm_tpu.obs.steptrace import StepTrace, summarize
+from gllm_tpu.sampling_params import SamplingParams
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_spans():
+    SPANS.clear()
+    yield
+    SPANS.clear()
+
+
+# ---- SpanTrace units -------------------------------------------------------
+
+def test_span_lifecycle_and_ring_bound():
+    tr = SpanTrace(capacity=4, max_open=8, max_phases=64)
+    tr.begin(1, arrival_t=10.0, admitted_t=10.5, prompt_tokens=7)
+    assert tr.open_count == 1
+    tr.begin(1, arrival_t=99.0, admitted_t=99.5)     # idempotent
+    assert tr.open_count == 1
+    tr.event(1, "prefill_chunk", 10.6, 3.0, tokens=7)
+    tr.event(999, "decode_step", 0.0, 1.0)           # untracked: no-op
+    rec = tr.finish(1, "stop", 11.0, output_tokens=3)
+    assert rec["reason"] == "stop" and rec["output_tokens"] == 3
+    assert rec["phases"][0]["ph"] == "queued"
+    assert rec["phases"][0]["dur_ms"] == pytest.approx(500.0)
+    assert [p["ph"] for p in rec["phases"]] == ["queued", "prefill_chunk"]
+    assert tr.open_count == 0
+    assert tr.finish(1, "stop", 12.0) is None        # second close: no-op
+    # ring eviction: capacity 4 keeps the newest 4 completed trees
+    for sid in range(2, 9):
+        tr.begin(sid, sid * 1.0, sid * 1.0 + 0.1)
+        tr.finish(sid, "length", sid * 1.0 + 1)
+    assert [r["seq_id"] for r in tr.spans()] == [5, 6, 7, 8]
+    assert tr.dropped == 4
+
+
+def test_span_open_bound_and_phase_cap():
+    tr = SpanTrace(capacity=8, max_open=2, max_phases=3)
+    tr.begin(1, 0.0, 0.1)
+    tr.begin(2, 0.0, 0.1)
+    tr.begin(3, 0.0, 0.1)                            # over the bound
+    assert tr.open_count == 2 and tr.untracked == 1
+    # phase cap: later events roll up into per-phase aggregates
+    for i in range(6):
+        tr.event(1, "decode_step", float(i), 2.0)
+    rec = tr.finish(1, "length", 10.0)
+    assert len(rec["phases"]) == 3                   # queued + 2 decode
+    agg = rec["agg"]["decode_step"]
+    assert agg["n"] == 4 and agg["ms"] == pytest.approx(8.0)
+
+
+def test_flops_model_and_peak():
+    fm = StepFlopsModel(num_layers=2, hidden_size=8, num_heads=2,
+                        num_kv_heads=1, head_dim=4, intermediate_size=16,
+                        vocab_size=32)
+    # one decode row at context 10: body + lm_head + attn over 11 keys
+    f = fm.step_flops([(1, 10, True)])
+    attn = fm.attn_coeff * (10 + 1)
+    assert f == fm.body_per_token + fm.lm_head_per_row + attn
+    # a 4-step block over the same row reconciles with 4 single steps
+    f4 = fm.block_flops([10], 4)
+    singles = sum(fm.step_flops([(1, 10 + j, True)]) for j in range(4))
+    assert f4 == pytest.approx(singles)
+    assert peak_flops("TPU v5e") == pytest.approx(197e12)
+    assert peak_flops("weird accelerator") == 0.0
+    os.environ["GLLM_TPU_PEAK_TFLOPS"] = "2.5"
+    try:
+        assert peak_flops("anything") == pytest.approx(2.5e12)
+    finally:
+        del os.environ["GLLM_TPU_PEAK_TFLOPS"]
+
+
+# ---- summarize() attribution math ------------------------------------------
+
+def _step_event(tr, kind, t, sched, build, disp, coll, wall, dev,
+                mfu=None, **extra):
+    tr.record(kind, num_seqs=2, tokens=2, wall_ms=coll, rtt_ms=wall,
+              ph={"schedule": sched, "build": build, "dispatch": disp,
+                  "collect": coll},
+              step_wall_ms=wall, dev_ms=dev,
+              **({"mfu": mfu} if mfu is not None else {}), **extra)
+    # pin the event's t for deterministic window math
+    tr._buf[(tr._next_seq - 1) % tr.capacity]["t"] = t
+
+
+def test_summarize_attribution_window():
+    tr = StepTrace(capacity=64)
+    # two decode steps: 10ms wall each, device 8ms, collect 2ms
+    _step_event(tr, "decode", 0.010, 1.0, 2.0, 1.0, 2.0,
+                wall=10.0, dev=8.0, mfu=0.5)
+    _step_event(tr, "decode", 0.020, 1.0, 2.0, 1.0, 2.0,
+                wall=10.0, dev=8.0, mfu=0.5)
+    s = summarize(tr.events())
+    assert s["host_ms_by_phase"] == {"schedule": 2.0, "build": 4.0,
+                                     "dispatch": 2.0, "collect": 4.0}
+    assert s["device_ms_by_kind"] == {"decode": 16.0}
+    # hidden = (8-2)*2 of 16 device ms
+    assert s["overlap_efficiency"] == pytest.approx(12 / 16)
+    # window: first start 0.000 → last end 0.020 = 20ms; 16ms device
+    assert s["bubble_frac"] == pytest.approx(1 - 16 / 20, abs=1e-4)
+    # wall mfu: Σ(mfu·dev)/elapsed = 0.5*16/20; device mfu = 0.5
+    assert s["mfu"] == pytest.approx(0.4, abs=1e-4)
+    assert s["device_mfu"] == pytest.approx(0.5, abs=1e-4)
+
+
+def test_summarize_without_attribution_fields_is_none():
+    tr = StepTrace(capacity=8)
+    tr.record("decode", tokens=4, wall_ms=2.0, num_seqs=1)
+    s = summarize(tr.events())
+    assert s["host_ms_by_phase"] is None
+    assert s["overlap_efficiency"] is None
+    assert s["bubble_frac"] is None and s["mfu"] is None
+
+
+# ---- chrome_trace schema ---------------------------------------------------
+
+def test_chrome_trace_schema_and_phase_reconstruction():
+    tr = StepTrace(capacity=16)
+    _step_event(tr, "prefill", 0.050, 2.0, 3.0, 1.0, 4.0,
+                wall=12.0, dev=5.0)
+    spans = [{"seq_id": 7, "t0": 100.0, "t1": 100.2, "reason": "stop",
+              "prompt_tokens": 5, "output_tokens": 3,
+              "phases": [{"ph": "queued", "t": 100.0, "dur_ms": 10.0},
+                         {"ph": "decode_chain", "t": 100.05,
+                          "dur_ms": 20.0, "k": 8}]}]
+    doc = chrome_trace(tr.events(), spans, span_t0=100.0)
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    for e in evs:
+        assert e["ph"] in ("X", "M")
+        assert "name" in e and "pid" in e
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and e["ts"] >= 0
+    xs = [e for e in evs if e["ph"] == "X"]
+    eng = [e for e in xs if e["pid"] == 1]
+    req = [e for e in xs if e["pid"] == 2]
+    # engine phase slices: schedule..collect are contiguous and span
+    # exactly step_wall, ending at the event's t
+    by_name = {e["name"]: e for e in eng}
+    order = ["prefill:schedule", "prefill:build", "prefill:dispatch",
+             "prefill:wait", "prefill:collect"]
+    present = [n for n in order if n in by_name]
+    assert present[0] == "prefill:schedule"
+    first = by_name[present[0]]
+    last = by_name[present[-1]]
+    span_us = (last["ts"] + last["dur"]) - first["ts"]
+    assert span_us == pytest.approx(12.0 * 1e3, rel=0.10)
+    assert last["ts"] + last["dur"] == pytest.approx(0.050 * 1e6, abs=2)
+    assert "prefill:device" in by_name
+    # request track: root slice + children on tid 7
+    assert all(e["tid"] == 7 for e in req)
+    names = {e["name"] for e in req}
+    assert "queued" in names and "decode_chain" in names
+    assert any(n.startswith("request 7") for n in names)
+    json.dumps(doc)                                   # serializable
+
+
+# ---- engine e2e (dummy weights, CPU) ---------------------------------------
+
+TINY_MODEL = dict(architecture="LlamaForCausalLM", vocab_size=256,
+                  hidden_size=64, num_layers=2, num_heads=4,
+                  num_kv_heads=2, head_dim=16, intermediate_size=128,
+                  max_position=256)
+
+
+def make_llm(**over):
+    from gllm_tpu.engine.llm import LLM
+    from gllm_tpu.models.config import ModelConfig
+    cfg = EngineConfig(load_format="dummy", dtype="float32",
+                       max_model_len=128, max_num_seqs=8,
+                       scheduler=SchedulerConfig(max_prefill_tokens=64,
+                                                 max_decode_seqs=8),
+                       cache=CacheConfig(page_size=4, num_pages=128))
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    cfg.validate()
+    return LLM(config=cfg, model_cfg=ModelConfig(**TINY_MODEL))
+
+
+GREEDY = dict(temperature=0.0, ignore_eos=True)
+
+
+def test_sync_engine_phase_breakdown_and_spans():
+    llm = make_llm()
+    from gllm_tpu.obs.steptrace import TRACE
+    mark = TRACE.mark()
+    outs = llm.generate(prompt_token_ids=[[3, 5, 7, 9], [11, 13]],
+                        sampling_params=[
+                            SamplingParams(max_tokens=6, **GREEDY),
+                            SamplingParams(max_tokens=4, **GREEDY)])
+    assert all(o.finish_reason == "length" for o in outs)
+    steps = [e for e in TRACE.events(since=mark)
+             if e["kind"] in ("prefill", "decode", "fused_block")]
+    assert steps, "no step events recorded"
+    tot_ph = tot_wall = 0.0
+    for e in steps:
+        assert set(e["ph"]) == {"schedule", "build", "dispatch",
+                                "collect"}
+        assert e["dev_ms"] >= 0 and e["step_wall_ms"] > 0
+        ph_sum = sum(e["ph"].values())
+        # phases never exceed the step wall (small scheduling jitter
+        # allowed); the aggregate invariant below is the 10% criterion
+        assert ph_sum <= e["step_wall_ms"] * 1.10 + 0.5
+        tot_ph += ph_sum
+        tot_wall += e["step_wall_ms"]
+    # synchronous engine (no overlap): phase sums reconstruct the
+    # measured step wall within 10%
+    assert tot_ph == pytest.approx(tot_wall, rel=0.10)
+    s = summarize(steps)
+    assert s["host_ms_by_phase"] is not None
+    assert set(s["device_ms_by_kind"]) <= {"prefill", "decode",
+                                           "fused_block"}
+    assert 0.0 <= s["overlap_efficiency"] <= 1.0
+    assert s["bubble_frac"] is None or 0.0 <= s["bubble_frac"] <= 1.0
+    # span trees: one completed tree per request, none left open
+    # (per-ENGINE ring: seq_ids restart per LLM, so each engine owns one)
+    assert llm.spans.open_count == 0
+    recs = {r["seq_id"]: r for r in llm.spans.spans()}
+    assert len(recs) == 2
+    for r in recs.values():
+        assert r["reason"] == "length"
+        phs = [p["ph"] for p in r["phases"]]
+        assert phs[0] == "queued"
+        assert "prefill_chunk" in phs and "decode_step" in phs
+        assert r["t1"] > r["t0"]
+
+
+def test_fused_engine_records_decode_chain_spans():
+    llm = make_llm(overlap_scheduling=True, multi_step_decode=4)
+    outs = llm.generate(prompt_token_ids=[[2, 4, 6, 8]],
+                        sampling_params=SamplingParams(max_tokens=12,
+                                                       **GREEDY))
+    assert outs[0].num_output_tokens == 12
+    (rec,) = llm.spans.spans()
+    chains = [p for p in rec["phases"] if p["ph"] == "decode_chain"]
+    assert chains and all(c["k"] >= 1 for c in chains)
+    assert llm.spans.open_count == 0
+
+
+def test_tracing_off_is_byte_identical_and_records_nothing():
+    prompts = [[3, 5, 7, 9], [2, 4, 6]]
+    sps = [SamplingParams(max_tokens=8, **GREEDY) for _ in prompts]
+    import dataclasses as dc
+    want = [o.output_token_ids for o in make_llm().generate(
+        prompt_token_ids=prompts,
+        sampling_params=[dc.replace(s) for s in sps])]
+    llm_off = make_llm(tracing=False)
+    assert llm_off.tracing is False
+    got = [o.output_token_ids for o in llm_off.generate(
+        prompt_token_ids=prompts,
+        sampling_params=[dc.replace(s) for s in sps])]
+    assert got == want
+    assert llm_off.spans.spans() == []
+    assert llm_off.spans.open_count == 0
+
+
+def test_terminal_paths_close_spans():
+    """abort / deadline / quarantine all close the request's span tree
+    with the terminal reason (no tree may leak open)."""
+    from gllm_tpu.engine.serving_engine import ServingEngine
+    from gllm_tpu.faults import FAULTS
+    FAULTS.reset()
+    llm = make_llm()
+    eng = ServingEngine(llm)
+    try:
+        # abort mid-stream (the model may hit the length cap first on a
+        # fast box — either way the span closes with the chunk's reason)
+        ha = eng.submit([5, 6, 7], SamplingParams(max_tokens=5000,
+                                                  **GREEDY))
+        last = ha.chunks.get(timeout=60)      # at least one token flowed
+        eng.abort(ha.seq_id)
+        while last.finish_reason is None:
+            last = ha.chunks.get(timeout=60)
+        assert last.finish_reason in ("abort", "length")
+        spans = llm.spans
+        deadline = time.monotonic() + 10
+        while not any(r["seq_id"] == ha.seq_id for r in spans.spans()):
+            assert time.monotonic() < deadline, "span never closed"
+            time.sleep(0.01)
+        rec = [r for r in spans.spans() if r["seq_id"] == ha.seq_id][-1]
+        assert rec["reason"] == last.finish_reason
+        # deadline mid-generation
+        hb = eng.submit([9, 9, 9], SamplingParams(max_tokens=10000,
+                                                  **GREEDY),
+                        deadline_s=0.25)
+        for c in hb:
+            last = c
+        assert last.finish_reason == "deadline"
+        rec = [r for r in spans.spans() if r["seq_id"] == hb.seq_id][-1]
+        assert rec["reason"] == "deadline"
+        # quarantine (injected step exception)
+        FAULTS.arm("step_exception:0:1")
+        hc = eng.submit([1, 2, 3], SamplingParams(max_tokens=8,
+                                                  **GREEDY))
+        for c in hc:
+            last = c
+        assert last.finish_reason == "error"
+        recs = [r for r in spans.spans() if r["seq_id"] == hc.seq_id]
+        if recs:                        # quarantined after admission
+            assert recs[-1]["reason"] == "error"
+        assert spans.open_count == 0
+    finally:
+        FAULTS.reset()
+        eng.shutdown()
+
+
+# ---- HTTP surface ----------------------------------------------------------
+
+def _drive_completion(port):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request("POST", "/v1/completions", body=json.dumps({
+        "prompt": [5, 6, 7, 8], "max_tokens": 6, "temperature": 0,
+        "ignore_eos": True}),
+        headers={"Content-Type": "application/json"})
+    r = conn.getresponse()
+    assert r.status == 200, r.read()
+    r.read()
+    conn.close()
+
+
+@pytest.fixture(scope="module")
+def trace_server():
+    from gllm_tpu.entrypoints.api_server import serve
+    llm = make_llm()
+    httpd = serve(llm, "127.0.0.1", 0, served_model="trace-smoke")
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    # drive one request so the steptrace ring has content (the span
+    # ring is cleared per test — span-needing tests drive their own)
+    _drive_completion(port)
+    yield port
+    httpd.shutdown()
+    httpd.state.engine.shutdown()
+
+
+def _req(port, method, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+    conn.request(method, path)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def test_trace_endpoint_serves_chrome_json(trace_server):
+    _drive_completion(trace_server)     # fresh spans (ring cleared per test)
+    status, body = _req(trace_server, "GET", "/trace")
+    assert status == 200
+    doc = json.loads(body)
+    evs = doc["traceEvents"]
+    pids = {e["pid"] for e in evs if e["ph"] == "X"}
+    assert 1 in pids and 2 in pids      # engine + request tracks
+    assert any(e["name"].endswith(":collect") for e in evs
+               if e["ph"] == "X")
+
+
+def test_steptrace_kind_filter(trace_server):
+    status, body = _req(trace_server, "GET", "/steptrace?kind=prefill")
+    assert status == 200
+    d = json.loads(body)
+    assert d["events"] and all(e["kind"] == "prefill"
+                               for e in d["events"])
+    status, body = _req(trace_server, "GET",
+                        "/steptrace?kind=prefill,decode")
+    kinds = {e["kind"] for e in json.loads(body)["events"]}
+    assert kinds <= {"prefill", "decode"}
+
+
+def test_profile_oneshot_endpoint(trace_server, tmp_path, monkeypatch):
+    monkeypatch.setenv("GLLM_PROFILE_DIR", str(tmp_path))
+    status, body = _req(trace_server, "POST", "/profile?seconds=0.1")
+    assert status == 200, body
+    d = json.loads(body)
+    assert d["status"] == "ok" and d["trace_dir"] == str(tmp_path)
+    assert os.path.isdir(str(tmp_path))
+    # artifact landed (jax profiler writes plugins/profile/<run>/)
+    assert any(os.scandir(str(tmp_path)))
+    status, body = _req(trace_server, "POST", "/profile?seconds=0")
+    assert status == 400
+    status, body = _req(trace_server, "POST", "/profile?seconds=bogus")
+    assert status == 400
+    # a legacy /stop_profile must NOT truncate an in-flight one-shot
+    box = {}
+
+    def oneshot():
+        box["r"] = _req(trace_server, "POST", "/profile?seconds=4")
+
+    th = threading.Thread(target=oneshot)
+    th.start()
+    # poll: before the capture starts /stop_profile is a harmless noop
+    # (200); once the one-shot owns the profiler it must refuse (409)
+    deadline = time.monotonic() + 3.0
+    saw_409 = False
+    while time.monotonic() < deadline:
+        status, _ = _req(trace_server, "POST", "/stop_profile")
+        if status == 409:
+            saw_409 = True
+            break
+        time.sleep(0.1)
+    th.join()
+    assert saw_409, "stop_profile never refused during the one-shot"
+    assert box["r"][0] == 200, box["r"][1]
+
+
+# ---- dump CLI --------------------------------------------------------------
+
+def test_dump_chrome_format_and_filters(tmp_path, capsys):
+    from gllm_tpu.obs import dump
+    tr = StepTrace(capacity=16)
+    _step_event(tr, "decode", 0.010, 1.0, 1.0, 1.0, 1.0,
+                wall=5.0, dev=3.0)
+    tr.record("compile", dispatch="step")
+    p = tmp_path / "t.jsonl"
+    tr.to_jsonl(str(p))
+    assert dump.main([str(p), "--format", "chrome"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert any(e.get("name") == "decode:collect"
+               for e in doc["traceEvents"])
+    # kind/since filters drop events before formatting
+    assert dump.main([str(p), "--kind", "compile", "--summary"]) == 0
+    out = capsys.readouterr().out
+    assert json.loads(out[out.index("{"):])["compiles"] == 1
+    assert dump.main([str(p), "--since", "2", "--summary"]) == 0
+
+
+# ---- bench --tiny CPU smoke (the attribution acceptance gate) --------------
+
+@pytest.mark.obs_smoke
+def test_bench_tiny_attribution_smoke(tmp_path):
+    """bench.py --tiny (inner, 4 requests) must emit non-degenerate
+    attribution: host_ms_by_phase / device_ms_by_kind /
+    overlap_efficiency / mfu in the result JSON, a salvageable
+    ATTRIBUTION line, and a loadable Chrome trace artifact — the bench
+    trajectory must never again have numbers without a why."""
+    env = dict(os.environ,
+               GLLM_BENCH_SAMPLED="0", GLLM_BENCH_TRACE="1",
+               JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--tiny",
+         "--inner", "--requests", "4"],
+        cwd=str(tmp_path), env=env, text=True, timeout=540,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    assert proc.returncode == 0, proc.stdout[-3000:]
+    lines = proc.stdout.strip().splitlines()
+    result = json.loads(
+        [ln for ln in lines if ln.startswith("{")][-1])
+    # salvage line rides right behind RESULT
+    attr_lines = [ln for ln in lines if ln.startswith("ATTRIBUTION ")]
+    assert attr_lines
+    attr = json.loads(attr_lines[-1][len("ATTRIBUTION "):])
+    for blob in (result, attr):
+        hp = blob["host_ms_by_phase"]
+        assert hp and sum(hp.values()) > 0
+        assert set(hp) == {"schedule", "build", "dispatch", "collect"}
+        dm = blob["device_ms_by_kind"]
+        assert dm and sum(dm.values()) > 0
+        assert blob["overlap_efficiency"] is not None
+        assert 0.0 <= blob["overlap_efficiency"] <= 1.0
+    # --tiny declares a nominal CPU peak so both MFU estimators are
+    # exercised; the salvage line keeps the window estimator under its
+    # OWN key (never swapped for the workload-level result mfu)
+    assert result["mfu"] is not None and result["mfu"] > 0
+    assert attr["window_mfu"] is not None and attr["window_mfu"] > 0
+    assert result["bubble_frac"] is None \
+        or 0.0 <= result["bubble_frac"] <= 1.0
+    # chrome artifact loads and has engine + request tracks
+    doc = json.load(open(result["trace_path"]))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} >= {1, 2}
+    assert all(e["dur"] >= 0 for e in xs)
