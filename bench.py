@@ -452,13 +452,17 @@ def main():
             head_dim=32, intermediate_size=256, max_position=512)
         # same A/B levers as the on-chip full profile: GLLM_BENCH_SLOTS=0
         # reverts to legacy chain membership, GLLM_BENCH_ODF=0 to
-        # host-side finish detection, on the CPU pass
+        # host-side finish detection, GLLM_BENCH_PIPELINED=0 to the
+        # drain-on-break engine loop, on the CPU pass
         slots = os.environ.get("GLLM_BENCH_SLOTS", "1") not in ("", "0")
         odf = os.environ.get("GLLM_BENCH_ODF", "1") not in ("", "0")
+        pipelined = os.environ.get("GLLM_BENCH_PIPELINED",
+                                   "1") not in ("", "0")
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="float32", max_model_len=512,
             max_num_seqs=32,
             overlap_scheduling=full, multi_step_decode=8 if full else 1,
+            pipelined_loop=full and pipelined,
             ondevice_finish=full and odf,
             decode_slot_batching=full and slots,
             chain_under_prefill=8 if full and slots else 0,
@@ -496,6 +500,11 @@ def main():
         # membership, GLLM_BENCH_ODF=0 to host-side finish detection)
         slots = os.environ.get("GLLM_BENCH_SLOTS", "1") not in ("", "0")
         odf = os.environ.get("GLLM_BENCH_ODF", "1") not in ("", "0")
+        # Pipelined-loop A/B (GLLM_BENCH_PIPELINED=0 reverts the full
+        # profile to the drain-on-break loop; the bubble_frac /
+        # mean_inflight_depth fields below are the comparison axes)
+        pipelined = os.environ.get("GLLM_BENCH_PIPELINED",
+                                   "1") not in ("", "0")
         cup = int(os.environ.get("GLLM_BENCH_CUP", str(msd)))
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="bfloat16", max_model_len=2048,
@@ -504,6 +513,7 @@ def main():
             # spends its time measuring, not compiling
             max_num_seqs=256 if full else 128,
             overlap_scheduling=full,
+            pipelined_loop=full and pipelined,
             overlap_depth=depth if full else 1,
             multi_step_decode=msd if full else 1,
             ondevice_finish=full and odf,
@@ -608,7 +618,14 @@ def main():
         "overlap_efficiency": step_summary.get("overlap_efficiency"),
         "bubble_frac": step_summary.get("bubble_frac"),
         "window_mfu": step_summary.get("mfu"),
+        # pipelined loop (ISSUE 11): the sustained run-ahead depth and
+        # why the loop failed to run further ahead — a salvaged run
+        # keeps the bubble story, not just the bubble number
+        "mean_inflight_depth": step_summary.get("mean_inflight_depth"),
+        "loop_stalls": step_summary.get("loop_stalls_by_reason"),
+        "pipelined_loop": bool(engine_cfg.pipelined_loop),
     }), flush=True)
+
 
     # On-demand Chrome trace artifact of the measured pass
     # (GLLM_BENCH_TRACE=1): engine-phase tracks + per-request span
@@ -640,6 +657,49 @@ def main():
             lat[short] = {f"p{q}": (round(v, 4) if v is not None else None)
                           for q, v in pcts.items()}
     metrics_snapshot = {"steps": step_summary, "request_latency_s": lat}
+
+    # Tiny-mode pipelined A/B control (ISSUE 11): re-run the same
+    # measured workload on a flag-off engine in the same process so the
+    # result JSON carries the bubble_frac DELTA directly — the on-chip
+    # rungs A/B across runs via GLLM_BENCH_PIPELINED instead (engine
+    # build + recompiles are too expensive to double there). Runs AFTER
+    # the headline window's metric deltas (kv_read, latency histograms)
+    # were snapshotted so the control never pollutes them.
+    bubble_delta = None
+    if args.tiny and engine_cfg.pipelined_loop:
+        phase("pipelined_control_pass")
+        import dataclasses as _dc
+        ctrl_cfg = _dc.replace(engine_cfg, pipelined_loop=False)
+        ctrl = LLM(config=ctrl_cfg, model_cfg=model_cfg)
+        ctrl.generate(prompt_token_ids=prompts,
+                      sampling_params=params)          # warm the buckets
+        c_mark = TRACE.mark()
+        ctrl.generate(prompt_token_ids=prompts, sampling_params=params)
+        c_summary = summarize(TRACE.events(since=c_mark))
+        b_on = step_summary.get("bubble_frac")
+        b_off = c_summary.get("bubble_frac")
+        if b_on is not None and b_off is not None:
+            bubble_delta = {"bubble_frac_sync": b_off,
+                            "bubble_frac_delta": round(b_on - b_off, 4)}
+            log(f"pipelined A/B: bubble_frac {b_off} (sync) -> {b_on} "
+                f"(pipelined)")
+            # re-print the salvageable ATTRIBUTION line carrying BOTH
+            # arms (salvage takes the most recent line; if the run dies
+            # during the control, the first line already landed)
+            print("ATTRIBUTION " + json.dumps({
+                "host_ms_by_phase": step_summary.get("host_ms_by_phase"),
+                "device_ms_by_kind":
+                    step_summary.get("device_ms_by_kind"),
+                "overlap_efficiency":
+                    step_summary.get("overlap_efficiency"),
+                "bubble_frac": b_on,
+                "window_mfu": step_summary.get("mfu"),
+                "mean_inflight_depth":
+                    step_summary.get("mean_inflight_depth"),
+                "loop_stalls": step_summary.get("loop_stalls_by_reason"),
+                "pipelined_loop": True,
+                **bubble_delta,
+            }), flush=True)
 
     # Sampled-path pass (VERDICT r05: the sampled sampler program never
     # appeared in BENCH JSON, so its ~88 ms full-vocab sort regression was
@@ -794,8 +854,16 @@ def main():
         "device_ms_by_kind": step_summary.get("device_ms_by_kind"),
         "overlap_efficiency": step_summary.get("overlap_efficiency"),
         "bubble_frac": step_summary.get("bubble_frac"),
+        # Pipelined loop (ISSUE 11, GLLM_BENCH_PIPELINED A/B): sustained
+        # run-ahead depth + stall taxonomy — the bubble_frac's WHY; the
+        # --tiny rung also carries the in-process sync-control delta.
+        "pipelined_loop": bool(engine_cfg.pipelined_loop),
+        "mean_inflight_depth": step_summary.get("mean_inflight_depth"),
+        "loop_stalls": step_summary.get("loop_stalls_by_reason") or {},
         "metrics": metrics_snapshot,
     }
+    if bubble_delta is not None:
+        result.update(bubble_delta)
     if trace_path is not None:
         result["trace_path"] = trace_path
     if sampled_result is not None:

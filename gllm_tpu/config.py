@@ -162,6 +162,20 @@ class EngineConfig:
     # tokens in both modes); off = byte-identical legacy device
     # programs. docs/overlap_scheduling.md#on-device-finish.
     ondevice_finish: bool = False
+    # Bubble-zero pipelined engine loop (--pipelined-loop,
+    # docs/overlap_scheduling.md#pipelined-loop): when a decode chain
+    # cannot extend (finish, compaction, membership growth), the engine
+    # speculatively RE-FORMS the next pure-decode batch off *promised*
+    # token counts — the sampled ids stay on device and are spliced in
+    # as the new batch's inputs — instead of draining the pipeline and
+    # rebuilding only after the collect lands. Divergence between
+    # promised and actual state (host-side EOS/stop, stop strings) is
+    # reconciled at collect time by invalidating and rebuilding exactly
+    # the speculated entries (the reference's OverlapWorker/FutureMap
+    # design, PAPER.md §4-5). Greedy and seeded token streams are
+    # byte-identical to the sync loop; implies overlap_scheduling.
+    # False = today's loop, byte for byte.
+    pipelined_loop: bool = False
     # Persistent-slot decode batching (--decode-slot-batching, overlap
     # scheduling only): chain membership becomes slot-based, so fused
     # decode chains survive sequence finishes — a finished row is masked
@@ -285,6 +299,12 @@ class EngineConfig:
             self.ondevice_finish = False
             self.decode_slot_batching = False
             self.chain_under_prefill = 0
+            self.pipelined_loop = False
+        if self.pipelined_loop and not self.overlap_scheduling:
+            # the pipelined loop is the overlap machinery run one step
+            # further ahead — chains are its primary edge; lifting the
+            # flag keeps "--pipelined-loop" a one-flag opt-in
+            self.overlap_scheduling = True
         if self.chain_under_prefill < 0:
             raise ValueError("chain_under_prefill must be >= 0")
         if self.decode_chain_len is not None:

@@ -33,6 +33,7 @@ from gllm_tpu.sampling_params import SamplingParams
 from gllm_tpu.scheduler import Scheduler, SeqOutput
 from gllm_tpu.sequence import Sequence
 from gllm_tpu.engine.detokenizer import detokenize_incrementally
+from gllm_tpu.engine.pipeline import FutureMap, InFlight
 
 logger = logging.getLogger(__name__)
 
@@ -118,6 +119,18 @@ _M_HBM = obs.gauge(
     "gllm_step_hbm_gbps",
     "estimated HBM read bandwidth of the latest step (weights + KV "
     "stream over the device wall; per-device)")
+# Pipelined loop (config.pipelined_loop,
+# docs/overlap_scheduling.md#pipelined-loop): dispatched-but-uncollected
+# entries after the latest fill pass — the run-ahead depth the loop
+# actually achieved. Stall *reasons* (why it failed to run further
+# ahead) ride loop_stall steptrace events: readback (the next step needs
+# host-committed state), rebuild (promised-vs-actual divergence
+# invalidated speculated entries), pages (no KV room to speculate),
+# depth (the overlap_depth cap was the binding constraint).
+_M_INFLIGHT = obs.gauge(
+    "gllm_inflight_depth",
+    "dispatched-but-uncollected engine entries after the latest fill "
+    "pass (pipelined loop)")
 
 
 @dataclasses.dataclass
@@ -277,6 +290,13 @@ class LLM:
         self._next_seq_id = 0
         from collections import deque
         self._in_flight = deque()
+        # Pipelined loop (docs/overlap_scheduling.md#pipelined-loop): the
+        # FutureMap owns promise reconciliation — a finish committing for
+        # a seq some speculatively re-formed entry assumed alive
+        # invalidates that entry (and its chained descendants) at collect
+        # time; the sync path rebuilds from committed state.
+        self.pipelined = bool(getattr(config, "pipelined_loop", False))
+        self.futures = FutureMap()
         # GLLM_TPU_STEP_TIMING=1: generate() records per-iteration collect
         # latency / batch kind / committed tokens and prints one JSON
         # summary line to stderr (where the serving wall-clock goes:
@@ -543,6 +563,15 @@ class LLM:
         collects the oldest and advances scheduler state. With pp=1 this is
         launch-one/collect-one, with jax async dispatch hiding host work
         behind the device step.
+
+        Under ``config.pipelined_loop`` the fill pass additionally runs
+        ahead ACROSS chain breaks: when a membership change refuses the
+        chain, the next batch is speculatively re-formed off promised
+        token counts (``_dispatch_reform``; FutureMap contract in
+        gllm_tpu/engine/pipeline.py) instead of draining the pipeline,
+        and promised-vs-actual divergence is reconciled at collect time
+        (``_commit_outputs``) by invalidating exactly the speculated
+        entries. Flag off = the pre-flag loop, byte for byte.
         """
         if self.disagg_coordinator is not None:
             # multihost: the MultihostEngine polls the coordinator itself
@@ -566,6 +595,11 @@ class LLM:
         multi = self.config.multi_step_decode if overlap else 1
         slot_mode = overlap and self.config.decode_slot_batching
         cup = self.config.chain_under_prefill if overlap else 0
+        # Pipelined loop: run ahead across chain breaks via speculative
+        # re-forms; ``ran_dry`` marks a fill pass that stopped early for
+        # a reason other than the depth cap (stall classification).
+        pipelined = self.pipelined and overlap
+        ran_dry = False
         while len(self._in_flight) < depth:
             # engine-loop phase attribution: everything from here to the
             # runner call is "schedule" wall for the entry this pass
@@ -576,8 +610,13 @@ class LLM:
                 # on-device tokens (overlap scheduling). Slot mode tracks
                 # the chain tip explicitly so it survives interleaved
                 # prefill dispatches; legacy chains off _in_flight[-1].
+                # an INVALIDATED entry can never be a tip: its tokens
+                # will be discarded, so chaining or re-forming off its
+                # promises would commit positions that skip a token —
+                # the rebuild must root from committed state instead
                 tip = (self._chain_tip if slot_mode
-                       else self._in_flight[-1][:2])
+                       else (None if self._in_flight[-1].invalid
+                             else self._in_flight[-1].tip))
                 pressure = bool(self.scheduler.waiting)
                 if not pressure:
                     # pressure subsided without a yield: a later burst
@@ -617,30 +656,50 @@ class LLM:
                         self._note_chain_break(
                             prev_batch,
                             self.scheduler.chain_break_reason or "shape")
+                        # Pipelined loop: a membership change is not a
+                        # reason to drain — speculatively RE-FORM the
+                        # next batch off promised token counts and keep
+                        # the device fed; the sync path only takes over
+                        # when re-forming needs host-committed state.
+                        if pipelined and self._dispatch_reform(
+                                prev_batch, prev_handle, t_enter, multi,
+                                slot_mode, pressure):
+                            continue
                         self._chain_tip = None
                         self._chained_under_pressure = 0
+                        ran_dry = True
                         break
                     if pressure:
                         self._chained_under_pressure += len(chain)
                     self._yield_noted = False
                     t_sched = time.monotonic()
                     if len(chain) > 1:
-                        entry = (chain,
-                                 self.runner.step_multi(chain, prev_handle),
-                                 time.monotonic(),
-                                 self._entry_phases(t_enter, t_sched))
+                        entry = InFlight(
+                            chain, self.runner.step_multi(chain,
+                                                          prev_handle),
+                            time.monotonic(),
+                            self._entry_phases(t_enter, t_sched),
+                            chained=True)
                     else:
-                        entry = (chain[0],
-                                 self.runner.step_async_chained(
-                                     chain[0], prev_handle),
-                                 time.monotonic(),
-                                 self._entry_phases(t_enter, t_sched))
+                        entry = InFlight(
+                            chain[0], self.runner.step_async_chained(
+                                chain[0], prev_handle),
+                            time.monotonic(),
+                            self._entry_phases(t_enter, t_sched),
+                            chained=True)
                     self._in_flight.append(entry)
                     if slot_mode:
-                        self._chain_tip = entry[:2]
+                        self._chain_tip = entry.tip
                     continue
             batch = self.scheduler.schedule_once()
             if batch is None:
+                if (pipelined and self._in_flight
+                        and self.scheduler.has_unfinished):
+                    # unfinished work, nothing schedulable from committed
+                    # state, no chain/re-form edge to run ahead on — the
+                    # loop must block on readback before it can proceed
+                    self._note_stall("readback")
+                ran_dry = True
                 break
             if (overlap and multi > 1
                     and not self.scheduler.waiting
@@ -663,24 +722,34 @@ class LLM:
                             if au is not None else None))
                     chain = [first] + links
                     t_sched = time.monotonic()
-                    entry = (chain, self.runner.step_multi(chain),
-                             time.monotonic(),
-                             self._entry_phases(t_enter, t_sched))
+                    entry = InFlight(chain, self.runner.step_multi(chain),
+                                     time.monotonic(),
+                                     self._entry_phases(t_enter, t_sched),
+                                     roots=True)
                     self._in_flight.append(entry)
                     self._yield_noted = False
                     if slot_mode:
-                        self._chain_tip = entry[:2]
+                        self._chain_tip = entry.tip
                     continue
             t_sched = time.monotonic()
-            entry = (batch, self.runner.step_async(batch),
-                     time.monotonic(),
-                     self._entry_phases(t_enter, t_sched))
+            entry = InFlight(batch, self.runner.step_async(batch),
+                             time.monotonic(),
+                             self._entry_phases(t_enter, t_sched),
+                             roots=(batch.num_decode == batch.num_seqs
+                                    and not batch.has_drafts))
             self._in_flight.append(entry)
-            if batch.num_decode == batch.num_seqs and not batch.has_drafts:
+            if entry.roots:
                 self._yield_noted = False
                 if slot_mode:
                     # a sync pure-decode batch roots a new persistent chain
-                    self._chain_tip = entry[:2]
+                    self._chain_tip = entry.tip
+        if pipelined:
+            _M_INFLIGHT.set(len(self._in_flight))
+            if not ran_dry and len(self._in_flight) >= depth:
+                # the fill pass stopped ONLY because the pipeline is
+                # full — overlap_depth was the binding constraint on
+                # running further ahead
+                self._note_stall("depth")
         if not self._in_flight:
             if self.disagg_coordinator is not None:
                 # gate-B-blocked seqs park in waiting; don't spin hot
@@ -692,12 +761,26 @@ class LLM:
         # hung device dispatch blocking the loop inside collect.
         faults.FAULTS.maybe_stall("dispatch_stall")
         faults.FAULTS.maybe_raise("step_exception")
-        batch, handle, t_dispatch, phases = self._in_flight.popleft()
+        entry = self._in_flight.popleft()
+        batch, handle, t_dispatch, phases = (entry.batch, entry.handle,
+                                             entry.t_dispatch,
+                                             entry.phases)
         if not self._in_flight:
             # pipeline drained: the tip (this very batch, or older) is
             # collected — a future burst must root a fresh chain, not
             # retain the old batch/handle or fail a stale extension
             self._chain_tip = None
+        if entry.invalid:
+            # reconciliation discard (pipelined loop): the speculated
+            # schedule assumed a sequence alive that has since finished
+            # — unwind the in-flight bookkeeping WITHOUT committing
+            # tokens or blocking on the device (its writes are harmless:
+            # live rows' positions are rewritten identically by the
+            # rebuild, dead rows' pages free once the counts drain); the
+            # sync path re-schedules the same positions from committed
+            # state next pass.
+            self.scheduler.discard_batch(batch)
+            return []
         t0 = time.monotonic()
         tokens, aux = self.runner.collect(handle)
         extra = None
@@ -713,9 +796,7 @@ class LLM:
                     b, row.tolist(), self.eos_token_ids))
             if extra is not None:
                 self._count_ondevice_finishes(outs)
-            self._check_stop_strings(outs)
-            self._observe_outputs(outs)
-            return outs
+            return self._commit_outputs(outs)
         spec = aux.pop("spec", None) if aux else None
         spec_lp = aux.pop("spec_lp", None) if aux else None
         if aux:
@@ -740,9 +821,96 @@ class LLM:
         else:
             outs = self.scheduler.process_output(batch, tokens.tolist(),
                                                  self.eos_token_ids)
+        return self._commit_outputs(outs)
+
+    def _commit_outputs(self, outs) -> List[SeqOutput]:
+        """Shared commit tail for one collected entry: stop-string
+        trimming, promise reconciliation (pipelined loop — a finish for
+        a sequence some later speculative entry assumed alive
+        invalidates that entry and its chained descendants), and the
+        per-request latency bookkeeping."""
         self._check_stop_strings(outs)
+        if self.pipelined and self._in_flight:
+            finished = frozenset(o.seq.seq_id for o in outs
+                                 if o.finish_reason is not None)
+            n = self.futures.reconcile(self._in_flight, finished)
+            if n:
+                # drop the tip only if the tip entry ITSELF was
+                # invalidated — a tip descending from a later valid
+                # sync root keeps extending (the legacy tip guards via
+                # _in_flight[-1].invalid instead)
+                if self._chain_tip is not None and any(
+                        e.invalid and e.handle is self._chain_tip[1]
+                        for e in self._in_flight):
+                    self._chain_tip = None
+                self._note_stall("rebuild", invalidated=n)
         self._observe_outputs(outs)
         return outs
+
+    def _dispatch_reform(self, prev_batch, prev_handle, t_enter: float,
+                         multi: int, slot_mode: bool,
+                         pressure: bool) -> bool:
+        """Speculatively re-form and dispatch the next decode batch off
+        ``prev_batch``'s promised token counts (pipelined loop;
+        scheduler.schedule_reform holds the FutureMap contract). The
+        re-formed batch fuses with chain links into one multi-step
+        dispatch when eligible — finishes no longer cost the fused-block
+        shape. Returns False (with a loop_stall recorded) when
+        re-forming needs host-committed state."""
+        if self.model_cfg.use_hybrid:
+            # the GDN recurrent state is CUMULATIVE: a discarded
+            # speculative step leaves the slot advanced by a token that
+            # never committed, and the rebuild advances it again.
+            # Paged-KV rewrites are idempotent; SSM state is not — so
+            # hybrid models keep the drain-and-sync edge (no snapshot
+            # pool is budgeted for per-step rollback here).
+            self._note_stall("readback")
+            return False
+        batch = self.scheduler.schedule_reform(prev_batch)
+        if batch is None:
+            reason = self.scheduler.reform_fail_reason
+            self._note_stall("pages" if reason == "pages"
+                             else "readback")
+            return False
+        promises = FutureMap.promised_ids(batch)
+        links = (self._schedule_multi_links(batch, multi - 1)
+                 if multi > 1 else [])
+        t_sched = time.monotonic()
+        if links:
+            au = links[0].active_until
+            k = 1 + len(links)
+            first = dataclasses.replace(
+                batch, active_until=([min(d + 1, k) for d in au]
+                                     if au is not None else None))
+            chain = [first] + links
+            entry = InFlight(chain,
+                             self.runner.step_multi(chain, prev_handle),
+                             time.monotonic(),
+                             self._entry_phases(t_enter, t_sched),
+                             chained=True, promises=promises)
+        else:
+            entry = InFlight(batch,
+                             self.runner.step_async_chained(batch,
+                                                            prev_handle),
+                             time.monotonic(),
+                             self._entry_phases(t_enter, t_sched),
+                             chained=True, promises=promises)
+        self._in_flight.append(entry)
+        self._yield_noted = False
+        if pressure:
+            # a speculative re-form spends ramp budget like the chain it
+            # replaced — prefill admission must still get its yields
+            self._chained_under_pressure += 1 + len(links)
+        if slot_mode:
+            self._chain_tip = entry.tip
+        return True
+
+    def _note_stall(self, reason: str, **fields) -> None:
+        """One loop_stall steptrace event (pipelined loop only): why the
+        fill pass failed to run further ahead — readback / rebuild /
+        pages / depth (docs/observability.md event catalog)."""
+        TRACE.record("loop_stall", reason=reason,
+                     depth=len(self._in_flight), **fields)
 
     def _note_chain_break(self, batch, reason: str) -> None:
         """One overlap chain break: steptrace event + labeled counter.
@@ -873,7 +1041,11 @@ class LLM:
             _M_DECODE_STEPS.inc(len(batch), fused="true")
         ev = dict(num_seqs=b.num_seqs, tokens=tokens,
                   wall_ms=round(wall * 1e3, 3),
-                  rtt_ms=round((now - t_dispatch) * 1e3, 3))
+                  rtt_ms=round((now - t_dispatch) * 1e3, 3),
+                  # entries still in flight AFTER this collect — the
+                  # run-ahead depth the loop sustained (summarize() →
+                  # mean_inflight_depth; bench promotes it)
+                  inflight=len(self._in_flight))
         if fused:
             ev["k"] = len(batch)
         if extra:
@@ -1476,7 +1648,7 @@ class LLM:
         from gllm_tpu.sequence import HOLE_SEQ_ID
         failed: set = set()
         for entry in self._in_flight:
-            batch = entry[0]
+            batch = entry.batch
             for b in (batch if isinstance(batch, list) else [batch]):
                 for it in b.items:
                     if it.seq.seq_id != HOLE_SEQ_ID:

@@ -748,6 +748,7 @@ def build_engine_config(args) -> EngineConfig:
         allow_hub_download=args.allow_hub_download,
         attention_impl=args.attention_impl,
         overlap_scheduling=args.overlap_scheduling,
+        pipelined_loop=args.pipelined_loop,
         decode_slot_batching=args.decode_slot_batching,
         chain_under_prefill=args.chain_under_prefill,
         decode_chain_len=args.decode_chain_len,
@@ -882,6 +883,14 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--overlap-scheduling", action="store_true",
                    help="chain decode steps on-device (no host round trip "
                         "between decode iterations)")
+    p.add_argument("--pipelined-loop", action="store_true",
+                   help="bubble-zero engine loop: speculatively re-form "
+                        "the next decode batch off promised token counts "
+                        "when a chain breaks (finish, compaction, "
+                        "membership growth) instead of draining the "
+                        "pipeline; divergence is reconciled at collect "
+                        "time (implies --overlap-scheduling; "
+                        "docs/overlap_scheduling.md#pipelined-loop)")
     p.add_argument("--decode-slot-batching", action="store_true",
                    help="persistent-slot decode chains (needs "
                         "--overlap-scheduling): finished rows become "

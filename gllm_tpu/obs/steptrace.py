@@ -54,6 +54,13 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 #                  ``hit_tokens``, and ``pages`` — claimed page counts
 #                  keyed by the serving tier (hbm/host/disk/peer,
 #                  docs/kv_offload.md)
+#   loop_stall   - the pipelined engine loop failed to run further ahead
+#                  (config.pipelined_loop); ``reason``: readback (the
+#                  next step needs host-committed state), rebuild
+#                  (promised-vs-actual divergence invalidated speculated
+#                  entries — ``invalidated`` counts them), pages (no KV
+#                  room to speculate), depth (the overlap_depth cap was
+#                  binding); ``depth`` = in-flight entries at the stall
 #
 # Step events (prefill/decode/fused_block) additionally carry the
 # performance-attribution fields (docs/observability.md#tracing):
@@ -63,8 +70,10 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 # (block-until-ready delta at collect), and optional ``mfu`` /
 # ``hbm_gbps`` estimates from the step FLOPs model (obs/spans.py).
 STEP_KINDS = ("prefill", "decode", "fused_block", "pp_stage", "compile",
-              "chain_break", "fault", "quarantine", "prefix")
+              "chain_break", "fault", "quarantine", "prefix",
+              "loop_stall")
 CHAIN_BREAK_REASONS = ("waiting", "pages", "shape", "spec", "finish")
+LOOP_STALL_REASONS = ("readback", "rebuild", "pages", "depth")
 
 
 class StepTrace:
@@ -159,6 +168,11 @@ def summarize(events: List[dict]) -> dict:
     break_reasons: Dict[str, int] = {}
     faults_total = quarantines = 0
     fault_points: Dict[str, int] = {}
+    # pipelined-loop stalls (loop_stall events) + the sustained run-ahead
+    # depth (the ``inflight`` field step events carry)
+    loop_stalls = 0
+    stall_reasons: Dict[str, int] = {}
+    inflight_sum = inflight_n = 0
     # on-device finish attribution (fused_block events carry k_exec /
     # dead_substeps when config.ondevice_finish is on): wasted sub-step
     # share of all executed row-sub-steps over the window
@@ -199,8 +213,16 @@ def summarize(events: List[dict]) -> dict:
         if k == "quarantine":
             quarantines += 1
             continue
+        if k == "loop_stall":
+            loop_stalls += 1
+            r = e.get("reason", "unknown")
+            stall_reasons[r] = stall_reasons.get(r, 0) + 1
+            continue
         if k == "pp_stage":
             continue                     # dispatch-side only; no wall
+        if e.get("inflight") is not None:
+            inflight_sum += int(e["inflight"])
+            inflight_n += 1
         row = kinds.setdefault(k, {"steps": 0, "wall_ms": 0.0,
                                    "tokens": 0})
         row["steps"] += 1
@@ -305,6 +327,14 @@ def summarize(events: List[dict]) -> dict:
         "compiles": compiles,
         "chain_breaks": chain_breaks,
         "chain_breaks_by_reason": break_reasons,
+        # pipelined loop (docs/overlap_scheduling.md#pipelined-loop):
+        # why the fill pass failed to run further ahead, and the mean
+        # run-ahead depth sustained over the window's collected steps
+        # (None when the window's events predate the pipelined layer)
+        "loop_stalls": loop_stalls,
+        "loop_stalls_by_reason": stall_reasons,
+        "mean_inflight_depth": (round(inflight_sum / inflight_n, 2)
+                                if inflight_n else None),
         "faults": faults_total,
         "faults_by_point": fault_points,
         "quarantines": quarantines,

@@ -1126,6 +1126,41 @@ class ModelRunner:
                 prev_tokens))
         return batch._replace(token_ids=prev_tokens)
 
+    def _splice_mapped_tokens(self, batch: StepBatch, prev_tokens,
+                              src_rows):
+        """Input tokens for a speculatively RE-FORMED batch (pipelined
+        loop): row j takes the previous decode entry's on-device sampled
+        token at row ``src_rows[j]`` (a promised in-flight row), or
+        keeps the host-built value (-1: a joining decode-ready seq whose
+        last token is committed). Unlike :meth:`_splice_chain_tokens`
+        the two sides' row buckets may differ — membership changed —
+        so the splice is a tiny [S_new] gather over prev's row space
+        plus a select; no new jit-step variant. NOTE prev_tokens is NOT
+        donated into the new step: the previous entry's collect still
+        reads it (its async host copy may be in flight)."""
+        if prev_tokens.ndim == 2:
+            prev_tokens = prev_tokens[-1]   # preceding multi-step block
+        s_pad = batch.token_ids.shape[0]
+        src = np.full(s_pad, -1, np.int32)
+        src[:len(src_rows)] = src_rows
+        src_j = jnp.asarray(src)
+        gathered = jnp.asarray(prev_tokens)[
+            jnp.clip(src_j, 0, prev_tokens.shape[0] - 1)]
+        return batch._replace(token_ids=jnp.where(
+            src_j >= 0, gathered, jnp.asarray(batch.token_ids)))
+
+    def _splice_prev(self, batch: StepBatch, sched_batch: ScheduledBatch,
+                     prev_tokens):
+        """Dispatch-time input-token splice for a batch that chains off
+        on-device sampled tokens: the mapped re-form splice when the
+        scheduler set ``src_rows`` (membership changed), else the
+        identity chain splice (+ host_rows joins)."""
+        if sched_batch.src_rows is not None:
+            return self._splice_mapped_tokens(batch, prev_tokens,
+                                              sched_batch.src_rows)
+        return self._splice_chain_tokens(batch, prev_tokens,
+                                         sched_batch.host_rows)
+
     def step_async_chained(self, sched_batch: ScheduledBatch, prev_handle):
         """Launch a chained decode step whose input tokens are the PREVIOUS
         step's on-device sampled tokens (overlap scheduling: the reference's
@@ -1133,7 +1168,10 @@ class ModelRunner:
         negative-id dance — the sampled-token array is simply spliced in as
         the next step's token_ids)."""
         prev_tokens, _, prev_n = prev_handle
-        assert prev_n == sched_batch.num_seqs
+        if sched_batch.src_rows is None:
+            # re-formed batches (src_rows) legitimately change the seq
+            # count across the edge; identity chains must not
+            assert prev_n == sched_batch.num_seqs
         t_enter = time.monotonic()
         self._apply_ssm_intents()
         self._apply_swap_intents()
@@ -1142,8 +1180,7 @@ class ModelRunner:
         batch, max_q, token_counts = self.builder.build(sched_batch,
                                                         step_key)
         assert max_q == 1 and token_counts is None
-        batch = self._splice_chain_tokens(batch, prev_tokens,
-                                          sched_batch.host_rows)
+        batch = self._splice_prev(batch, sched_batch, prev_tokens)
         lp_k, _ = self._lp_flags(sched_batch)
         all_greedy = _all_greedy(sched_batch.items)
         self._note_kv_read(sched_batch.items)
@@ -1192,8 +1229,7 @@ class ModelRunner:
             chain[0], keys[0], force_signature=sig)
         assert max_q == 1 and token_counts is None
         if prev_handle is not None:
-            batch = self._splice_chain_tokens(batch, prev_handle[0],
-                                              chain[0].host_rows)
+            batch = self._splice_prev(batch, chain[0], prev_handle[0])
         # Per-row alive-link count: rows whose seq dies (length cap)
         # inside the block freeze their position and write KV to the
         # dummy page from their death step on; bucket-padding rows are

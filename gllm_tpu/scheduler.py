@@ -146,6 +146,13 @@ class ScheduledBatch:
     # for them). Set on the FIRST batch of a chain only; None = every
     # row chains off the device tokens.
     host_rows: Optional[List[int]] = None
+    # Pipelined loop (schedule_reform): per-row index into the PREVIOUS
+    # decode entry's sampled-token array — the device-side splice map
+    # across a membership change (row buckets may differ on the two
+    # sides). -1 = the row's input token is host-known (a joining
+    # decode-ready seq). None = not a re-formed batch (chains use the
+    # identity mapping + host_rows instead).
+    src_rows: Optional[List[int]] = None
 
     @property
     def num_seqs(self) -> int:
@@ -217,6 +224,10 @@ class Scheduler:
                                    self.sched_cfg.max_decode_seqs
                                    + self.sched_cfg.max_prefill_tokens)
         self.chain_break_reason: Optional[str] = None
+        # Why the last schedule_reform refused (pipelined loop — feeds
+        # the engine's loop_stall reason classification): spec / shape /
+        # pages, or None after a successful re-form.
+        self.reform_fail_reason: Optional[str] = None
         # Request-span ring (obs/spans.py): the owning LLM overwrites
         # this with its per-engine instance (seq_ids restart per engine
         # — a shared ring would merge co-resident engines' trees); the
@@ -809,6 +820,131 @@ class Scheduler:
         for row, seq in joins:
             base[row] = (seq, seq.num_computed_tokens)
         return [row for row, _ in joins]
+
+    # ---- pipelined loop (speculative re-form) -----------------------------
+
+    def schedule_reform(self, prev: ScheduledBatch
+                        ) -> Optional[ScheduledBatch]:
+        """Speculatively RE-FORM the next pure-decode batch off ``prev``'s
+        *promised* token counts, before ``prev``'s sampled ids have
+        reached the host (the pipelined engine loop,
+        docs/overlap_scheduling.md#pipelined-loop).
+
+        Where ``schedule_chain`` extends a batch with UNCHANGED
+        membership, this is the membership-change edge the chain refuses
+        — a committed finish dropped a row, slot compaction shrank the
+        bucket, or decode-ready sequences must be seated. The FutureMap
+        contract: every included in-flight row advances to its promised
+        frontier (``computed_before + num_new_tokens`` of its ``prev``
+        item) and the runner splices its input token from ``prev``'s
+        on-device sampled array via ``ScheduledBatch.src_rows``; rows
+        whose promised frontier provably dies by LENGTH are dropped here
+        (the sync loop would drop them too — no divergence possible),
+        while EOS/stop deaths the host cannot know yet are assumed
+        alive: the engine invalidates and rebuilds this batch at collect
+        time if the assumption breaks.
+
+        Returns None with ``reform_fail_reason`` ∈ spec/shape/pages when
+        re-forming needs host-committed state (the caller falls back to
+        the drain-and-sync path and records a loop_stall)."""
+        self.reform_fail_reason = None
+        if self.spec_cfg is not None:
+            # speculation owns decode dispatch (drafting needs committed
+            # token VALUES) — same deferral as schedule_chain
+            return self._reform_fail("spec")
+        base: List[Tuple[Sequence, int, int]] = []   # (seq, cn0, src row)
+        for i, it in enumerate(prev.items):
+            seq = it.seq
+            if (seq.seq_id == HOLE_SEQ_ID
+                    or seq.status is SequenceStatus.FINISHED):
+                continue       # committed finish / hole: the row drops
+            if seq.status is not SequenceStatus.RUNNING:
+                return self._reform_fail("shape")   # preempted: sync path
+            if seq.seq_id in self._aborted_ids:
+                # _process_aborts reaps pages only on the sync pass; a
+                # reform that skipped the row forever would leak it
+                return self._reform_fail("shape")
+            if it.computed_before + it.num_new_tokens < seq.num_tokens:
+                return self._reform_fail("shape")   # mid-prefill row
+            sp = seq.sampling_params
+            if (sp.repetition_penalty != 1.0 or sp.presence_penalty != 0.0
+                    or sp.frequency_penalty != 0.0):
+                # penalty counts are built host-side from token_ids,
+                # which lack the promised token — the adjusted logits
+                # would diverge from the sync loop
+                return self._reform_fail("shape")
+            cn0 = it.computed_before + it.num_new_tokens
+            # promised LENGTH death: once prev commits, the seq holds
+            # cn0+1 tokens — host-predictable, so the row drops here
+            if (cn0 + 1 - seq.prompt_len >= sp.max_tokens
+                    or cn0 + 1 >= self.config.max_model_len):
+                continue
+            base.append((seq, cn0, i))
+        # decode-ready running seqs join with HOST-known input tokens
+        # (src -1); one unfusable-for-promising candidate (penalties)
+        # refuses the whole re-form so the sync pass can seat it —
+        # skipping it here would starve it at decode saturation
+        in_batch = {seq.seq_id for seq, _, _ in base}
+        budget = self.sched_cfg.max_decode_seqs
+        for s in self.running:
+            if (s.num_remaining_tokens != 1 or s.num_in_flight
+                    or s.seq_id in in_batch
+                    or s.seq_id in self._aborted_ids):
+                continue
+            if len(base) >= budget:
+                # over budget: waits, as in legacy rotation — and a
+                # penalized candidate past the budget must NOT refuse
+                # the re-form (the sync path could not seat it either,
+                # so the refusal would buy no fairness while degrading
+                # the whole loop to drain-and-sync)
+                continue
+            sp = s.sampling_params
+            if (sp.repetition_penalty != 1.0 or sp.presence_penalty != 0.0
+                    or sp.frequency_penalty != 0.0):
+                return self._reform_fail("shape")
+            base.append((s, s.num_computed_tokens, -1))
+        if not base:
+            return self._reform_fail("shape")   # nothing left to run
+        base = base[:budget]
+        page = self.mm.page_size
+        need = sum(max(0, cdiv(cn0 + 1, page) - len(seq.page_table))
+                   for seq, cn0, _ in base)
+        if not self.mm.can_allocate(need):
+            # never preempt for a speculative batch — a victim's freed
+            # pages could not be restored if the speculation invalidates
+            return self._reform_fail("pages")
+        items: List[ScheduledSeq] = []
+        for seq, cn0, _ in base:
+            cover = cn0 + 1 - seq.num_computed_tokens
+            self.mm.allocate_seq_pages(seq, cover)
+            seq.num_in_flight += 1
+            items.append(ScheduledSeq(seq, 1, cn0))
+        return ScheduledBatch(items,
+                              src_rows=[src for _, _, src in base])
+
+    def _reform_fail(self, reason: str):
+        self.reform_fail_reason = reason
+        return None
+
+    def discard_batch(self, batch: ScheduledBatch) -> None:
+        """Unwind a speculatively scheduled entry the reconciliation
+        invalidated (pipelined loop): per-item in-flight counts drop
+        WITHOUT committing tokens or advancing computed counts, so the
+        sync rebuild re-schedules the same positions. Pages allocated
+        toward the promised frontier stay on the seq's table (tables
+        longer than the next step needs are legal — the speculative-
+        decode precedent in BatchBuilder.shape_signature); a finished
+        seq's deferred free fires once its last in-flight entry drains.
+        Accepts a single batch or a fused chain list."""
+        for b in (batch if isinstance(batch, list) else [batch]):
+            for it in b.items:
+                seq = it.seq
+                seq.num_in_flight -= 1
+                if (seq.status is not SequenceStatus.RUNNING
+                        and seq in self._deferred_free
+                        and seq.num_in_flight == 0):
+                    self._deferred_free.discard(seq)
+                    self.mm.free_seq(seq)
 
     # ---- output path ------------------------------------------------------
 
