@@ -458,14 +458,22 @@ def main():
         odf = os.environ.get("GLLM_BENCH_ODF", "1") not in ("", "0")
         pipelined = os.environ.get("GLLM_BENCH_PIPELINED",
                                    "1") not in ("", "0")
+        # Unified-step A/B (GLLM_BENCH_UNIFIED=0 reverts to the split
+        # prefill/decode dispatch + per-kind shape families; the
+        # unfused_frac / mixed_step_frac / warmed_buckets fields below
+        # are the comparison axes)
+        unified = os.environ.get("GLLM_BENCH_UNIFIED",
+                                 "1") not in ("", "0")
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="float32", max_model_len=512,
             max_num_seqs=32,
             overlap_scheduling=full, multi_step_decode=8 if full else 1,
             pipelined_loop=full and pipelined,
+            unified_step=full and unified,
             ondevice_finish=full and odf,
             decode_slot_batching=full and slots,
-            chain_under_prefill=8 if full and slots else 0,
+            chain_under_prefill=(8 if full and slots and not unified
+                                 else 0),
             scheduler=SchedulerConfig(max_prefill_tokens=128,
                                       max_decode_seqs=16),
             cache=CacheConfig(page_size=4, num_pages=512,
@@ -505,6 +513,9 @@ def main():
         # mean_inflight_depth fields below are the comparison axes)
         pipelined = os.environ.get("GLLM_BENCH_PIPELINED",
                                    "1") not in ("", "0")
+        # Unified-step A/B lever, same discipline as the tiny profile
+        unified = os.environ.get("GLLM_BENCH_UNIFIED",
+                                 "1") not in ("", "0")
         cup = int(os.environ.get("GLLM_BENCH_CUP", str(msd)))
         engine_cfg = EngineConfig(
             load_format="dummy", dtype="bfloat16", max_model_len=2048,
@@ -514,13 +525,16 @@ def main():
             max_num_seqs=256 if full else 128,
             overlap_scheduling=full,
             pipelined_loop=full and pipelined,
+            unified_step=full and unified,
             overlap_depth=depth if full else 1,
             multi_step_decode=msd if full else 1,
             ondevice_finish=full and odf,
             decode_slot_batching=full and slots,
             # gated on slots too: the GLLM_BENCH_SLOTS=0 arm must be the
             # byte-identical legacy baseline, not legacy-with-ramp-policy
-            chain_under_prefill=cup if full and slots else 0,
+            # (and the unified step retires the ramp policy entirely)
+            chain_under_prefill=(cup if full and slots and not unified
+                                 else 0),
             scheduler=SchedulerConfig(max_prefill_tokens=chunk,
                                       max_decode_seqs=256 if full
                                       else 128),
@@ -604,6 +618,16 @@ def main():
     # straight out of BENCH_r*.json now instead of log archaeology.
     events = TRACE.events(since=trace_mark)
     step_summary = summarize(events)
+    # Unified-step acceptance (ISSUE 12): with the flag on, prefill
+    # arrivals are absorbed into mixed re-formed batches — the 'waiting'
+    # break class is retired and MUST stay at zero, on every profile
+    # (the flag is inert for hybrid models, where legacy yields remain).
+    if engine_cfg.unified_step and not model_cfg.use_hybrid:
+        waiting = (step_summary.get("chain_breaks_by_reason")
+                   or {}).get("waiting", 0)
+        assert not waiting, (
+            f"--unified-step run recorded {waiting} chain_breaks with "
+            f"reason='waiting' — the retired break class fired")
     # Salvageable attribution right behind RESULT (ISSUE 10): a run the
     # supervisor kills in the sampled pass / report / teardown keeps its
     # WHY, not just its number — the supervisor merges this line into
@@ -624,6 +648,13 @@ def main():
         "mean_inflight_depth": step_summary.get("mean_inflight_depth"),
         "loop_stalls": step_summary.get("loop_stalls_by_reason"),
         "pipelined_loop": bool(engine_cfg.pipelined_loop),
+        # unified step (ISSUE 12): the dispatch-shape story — share of
+        # steps that were mixed unified batches and the shape-bucket
+        # population the runner compiled/warmed over the whole run
+        "unified_step": bool(engine_cfg.unified_step),
+        "mixed_step_frac": step_summary.get("mixed_step_frac"),
+        "warmed_buckets": getattr(llm.runner, "num_shape_signatures",
+                                  None),
     }), flush=True)
 
 
@@ -700,6 +731,72 @@ def main():
                 "pipelined_loop": True,
                 **bubble_delta,
             }), flush=True)
+
+    # Tiny-mode unified-step A/B (ISSUE 12): the headline pass submits
+    # every request up front, so the prefill/decode phase split barely
+    # fires — run a STAGGERED-ARRIVAL churn micro-pass on two fresh
+    # engines (flag on / flag off, same workload) and report the
+    # dispatch-shape story directly: distinct warmed shape-bucket
+    # signatures and the unfused decode share, both of which the
+    # unified step must hold strictly lower. On-chip rungs A/B across
+    # runs via GLLM_BENCH_UNIFIED instead.
+    unified_ab = None
+    if args.tiny and engine_cfg.unified_step:
+        phase("unified_ab_pass")
+        import dataclasses as _dc
+        from gllm_tpu.sampling_params import SamplingParams
+
+        def churn_arm(unified_on):
+            cfg = _dc.replace(
+                engine_cfg, unified_step=unified_on,
+                # the flag-off arm runs the legacy ramp policy the
+                # unified step retires — but only in the slots
+                # configuration the headline gates it on (the SLOTS=0
+                # arm must stay byte-identical legacy, not
+                # legacy-with-ramp-policy)
+                chain_under_prefill=(
+                    0 if unified_on
+                    else 8 if engine_cfg.decode_slot_batching else 0))
+            arm = LLM(config=cfg, model_cfg=model_cfg)
+            arng = np.random.default_rng(7)
+            arrivals = {0: 4, 3: 3, 7: 3, 12: 2, 18: 2, 25: 2}
+            mark, nseq, it = TRACE.mark(), 0, 0
+            while nseq < 14 or arm.has_unfinished:
+                for _ in range(arrivals.get(it, 0)):
+                    if nseq >= 14:
+                        break
+                    ids = arng.integers(
+                        1, model_cfg.vocab_size - 1,
+                        size=int(arng.integers(8, 64))).tolist()
+                    s = arm._allocate_seq(
+                        ids, SamplingParams(
+                            temperature=0.0, ignore_eos=True,
+                            max_tokens=int(arng.integers(16, 48))))
+                    arm.add_seq(s)
+                    nseq += 1
+                arm.step()
+                it += 1
+                assert it < 4000, "unified A/B churn arm wedged"
+            summ = summarize(TRACE.events(since=mark))
+            return {"warmed_buckets": arm.runner.num_shape_signatures,
+                    "unfused_frac": summ.get("unfused_frac"),
+                    "mixed_step_frac": summ.get("mixed_step_frac"),
+                    "chain_breaks": summ.get("chain_breaks_by_reason")}
+
+        on, off = churn_arm(True), churn_arm(False)
+        assert not (on["chain_breaks"] or {}).get("waiting"), (
+            "unified churn arm recorded retired 'waiting' breaks")
+        unified_ab = {
+            "warmed_buckets": on["warmed_buckets"],
+            "warmed_buckets_split": off["warmed_buckets"],
+            "unfused_frac": on["unfused_frac"],
+            "unfused_frac_split": off["unfused_frac"],
+            "mixed_step_frac": on["mixed_step_frac"],
+        }
+        log(f"unified A/B (churn): warmed_buckets "
+            f"{off['warmed_buckets']} (split) -> {on['warmed_buckets']} "
+            f"(unified); unfused_frac {off['unfused_frac']} -> "
+            f"{on['unfused_frac']}")
 
     # Sampled-path pass (VERDICT r05: the sampled sampler program never
     # appeared in BENCH JSON, so its ~88 ms full-vocab sort regression was
@@ -860,10 +957,21 @@ def main():
         "pipelined_loop": bool(engine_cfg.pipelined_loop),
         "mean_inflight_depth": step_summary.get("mean_inflight_depth"),
         "loop_stalls": step_summary.get("loop_stalls_by_reason") or {},
+        # Unified step (ISSUE 12, GLLM_BENCH_UNIFIED A/B): one dispatch
+        # family — share of steps that were mixed unified batches
+        # (chains absorbing arrivals) and the distinct shape-bucket
+        # signatures the runner compiled/warmed over the whole run (the
+        # two-population decode+mixed split this flag collapses).
+        "unified_step": bool(engine_cfg.unified_step),
+        "mixed_step_frac": step_summary.get("mixed_step_frac"),
+        "warmed_buckets": getattr(llm.runner, "num_shape_signatures",
+                                  None),
         "metrics": metrics_snapshot,
     }
     if bubble_delta is not None:
         result.update(bubble_delta)
+    if unified_ab is not None:
+        result["unified_ab"] = unified_ab
     if trace_path is not None:
         result["trace_path"] = trace_path
     if sampled_result is not None:

@@ -155,6 +155,110 @@ def build_ragged(q_block, kv_block, kv_dtype="auto", **workload):
     return run, (q, kc, vc)
 
 
+UNIFIED_MIXES = ("decode", "balanced", "prefill")
+
+
+def _unified_workload(mix="balanced", Hq=32, Hkv=8, D=128, page=16,
+                      ctx=1024, kv_dtype="auto", shrink=False):
+    """Representative UNIFIED mixed batch for the --unified-step kernel:
+    a decode prefix (one token per sequence) followed by prefill chunks,
+    in the three row mixes the serving loop actually emits —
+    decode-heavy (a chain absorbing one arrival), balanced, and
+    prefill-heavy (ramp-up). Returns the same tuple shape as
+    ``_mixed_workload``."""
+    import jax
+    import jax.numpy as jnp
+    shapes = {
+        # (decode rows, prefill chunk lengths)
+        "decode": (120, (128,)),
+        "balanced": (64, (256, 256)),
+        "prefill": (8, (512, 512)),
+    }[mix]
+    if shrink:                     # interpret-mode smoke geometry
+        shapes = {"decode": (24, (16,)), "balanced": (8, (32, 32)),
+                  "prefill": (2, (64, 64))}[mix]
+        ctx = min(ctx, 256)
+    nd, chunks = shapes
+    T = nd + sum(chunks)
+    S = nd + len(chunks)
+    P = S * (ctx // page) + 1
+    key = jax.random.key(0)
+    q = jax.random.normal(key, (T, Hq, D), jnp.bfloat16)
+    if kv_dtype == "int8":
+        kq = jax.random.key(1)
+        kc, ks = _quant_caches(kq, (P, page, Hkv, D))
+        vc, vs = _quant_caches(jax.random.fold_in(kq, 1),
+                               (P, page, Hkv, D))
+        caches = (kc, vc, ks, vs)
+    else:
+        caches = (jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16),
+                  jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16))
+    lens = [1] * nd + list(chunks)
+    cu = [0]
+    for n in lens:
+        cu.append(cu[-1] + n)
+    cu = jnp.asarray(cu, jnp.int32)
+    kv_lens = jnp.asarray([ctx] * nd + [ctx + c for c in chunks],
+                          jnp.int32)
+    mp = max(-(-int(kv) // page) for kv in kv_lens)
+    pt = (jnp.arange(S * mp, dtype=jnp.int32).reshape(S, mp)
+          % (P - 1)) + 1
+    return q, caches, cu, kv_lens, pt, D ** -0.5
+
+
+def build_unified(q_block, kv_block, gsz, mix="balanced",
+                  kv_dtype="auto", shrink=False):
+    """Jitted unified-sweep body + its buffers (caches as ARGS, never
+    closure constants — see build_ragged; the closure guard in
+    tests/test_kernel_tuning.py traces this body too)."""
+    import jax
+    from gllm_tpu.ops.pallas.ragged_attention import ragged_paged_attention
+    from gllm_tpu.utils import tpu_compiler_options
+    q, caches, cu, kl, pt, scale = _unified_workload(
+        mix, kv_dtype=kv_dtype, shrink=shrink)
+    interp = _interp()
+
+    if kv_dtype == "int8":
+        @functools.partial(jax.jit,
+                           compiler_options=tpu_compiler_options())
+        def run(qq, kc, vc, ks, vs):
+            return ragged_paged_attention(
+                qq, kc, vc, cu, kl, pt, scale=scale, q_block=q_block,
+                kv_block=kv_block, interpret=interp, unified=True,
+                group_size=gsz, k_scale=ks, v_scale=vs)
+
+        return run, (q, *caches)
+    kc, vc = caches
+
+    @functools.partial(jax.jit, compiler_options=tpu_compiler_options())
+    def run(qq, kc, vc):
+        return ragged_paged_attention(qq, kc, vc, cu, kl, pt, scale=scale,
+                                      q_block=q_block, kv_block=kv_block,
+                                      interpret=interp, unified=True,
+                                      group_size=gsz)
+
+    return run, (q, kc, vc)
+
+
+def time_unified(q_block, kv_block, gsz, iters=8, kv_dtype="auto"):
+    """One unified config timed over ALL THREE row mixes; RESULT is the
+    mix-summed ms (the serving loop runs all three shapes — a winner
+    must not trade one regime for another)."""
+    shrink = _interp()
+    iters = 1 if shrink else iters
+    reps = 2 if shrink else 3
+    total = 0.0
+    for mix in UNIFIED_MIXES:
+        run, (q, *args) = build_unified(q_block, kv_block, gsz, mix=mix,
+                                        kv_dtype=kv_dtype, shrink=shrink)
+        from gllm_tpu.ops.pallas.ragged_attention import effective_q_block
+        bq = effective_q_block(q_block, kv_block, q.shape[1], q.shape[0])
+        print(f"EFFECTIVE unified:{bq}:{kv_block}:{gsz} mix={mix}",
+              flush=True)
+        total += _time_reps(run, q, iters, *args, reps=reps)
+    return total
+
+
 def time_ragged(q_block, kv_block, iters=12, kv_dtype="auto"):
     # Interpret mode (CPU smoke) runs each grid program as traced
     # python — the silicon-shaped workload would take hours per point.
@@ -320,7 +424,8 @@ def main():
     ap.add_argument("--write", action="store_true",
                     help="merge winners into gllm_tpu/ops/pallas/tables.json")
     ap.add_argument("--vmem-probe", action="store_true")
-    ap.add_argument("--kernel", choices=("ragged", "decode"), default=None)
+    ap.add_argument("--kernel", choices=("ragged", "decode", "unified"),
+                    default=None)
     ap.add_argument("--kv-dtype", choices=("auto", "int8"), default="auto",
                     help="sweep the kernels against an int8 quantized "
                          "cache (kv_cache_dtype=int8 serving shape); "
@@ -341,6 +446,11 @@ def main():
                              int(parts[2]) if len(parts) > 2 else 1,
                              kv_dtype=(parts[3] if len(parts) > 3
                                        else "auto"))
+        elif parts[0] == "unified":
+            ms = time_unified(int(parts[1]), int(parts[2]),
+                              int(parts[3]),
+                              kv_dtype=(parts[4] if len(parts) > 4
+                                        else "auto"))
         elif parts[0] == "vmem":
             vmem_probe_one(int(parts[1]), int(parts[2]))
             print("RESULT 0.0", flush=True)
@@ -448,7 +558,7 @@ def main():
                             for ln in out[-1200:].splitlines()[-12:]),
                   file=sys.stderr, flush=True)
 
-    results = {"ragged": {}, "decode": {}}
+    results = {"ragged": {}, "decode": {}, "unified": {}}
     best = {}
     if args.kernel in (None, "ragged"):
         # requested configs whose VMEM-clamped program was already timed
@@ -483,6 +593,25 @@ def main():
             kb, gsz = min(ok_d, key=ok_d.get).split("g")
             best["decode"] = {"kv_block": int(kb), "group": int(gsz)}
             write_best({"decode": best["decode"]})
+    if args.kernel in (None, "unified"):
+        # unified mixed-batch sweep (--unified-step geometry): each
+        # config's RESULT is the decode-heavy + balanced + prefill-heavy
+        # mix-summed time (time_unified), so the committed winner never
+        # trades one serving regime for another. The group dimension is
+        # the decode-class DMA interleave depth.
+        for (qb, kb), gsz in itertools.product(
+                itertools.product(BLOCKS[:3], BLOCKS), (2, 4, 8)):
+            ms, out = run_inner(f"unified:{qb}:{kb}:{gsz}:{args.kv_dtype}")
+            results["unified"][f"{qb}x{kb}g{gsz}"] = ms
+            report("unified", f"q={qb} kv={kb} group={gsz} (mix-sum)",
+                   ms, out)
+        ok_u = {k: v for k, v in results["unified"].items() if v}
+        if ok_u:
+            qbkb, gsz = min(ok_u, key=ok_u.get).split("g")
+            qb, kb = qbkb.split("x")
+            best["unified"] = {"q_block": int(qb), "kv_block": int(kb),
+                               "group": int(gsz)}
+            write_best({"unified": best["unified"]})
     print(json.dumps({"results": results, "best": best}))
 
 

@@ -296,6 +296,22 @@ class LLM:
         # invalidates that entry (and its chained descendants) at collect
         # time; the sync path rebuilds from committed state.
         self.pipelined = bool(getattr(config, "pipelined_loop", False))
+        # Unified mixed-batch step (--unified-step,
+        # docs/overlap_scheduling.md#unified-step): one dispatch family
+        # (runner/prepare signature collapse + the unified kernel), and
+        # under overlap scheduling the chain absorbs prefill chunks via
+        # mixed re-forms — steps record as kind="unified_step". INERT
+        # for hybrid (GDN) models: re-forms are gated off for them
+        # (cumulative SSM state cannot replay a discarded step), so the
+        # whole flag stays legacy — dispatch, signatures, and step
+        # kinds — keeping the retired-'waiting' invariant true wherever
+        # unified kinds are recorded.
+        self.unified = (bool(getattr(config, "unified_step", False))
+                        and not model_cfg.use_hybrid)
+        if getattr(config, "unified_step", False) and not self.unified:
+            logger.warning(
+                "--unified-step is inert for hybrid (GDN) models: "
+                "legacy dispatch and step kinds retained")
         self.futures = FutureMap()
         # GLLM_TPU_STEP_TIMING=1: generate() records per-iteration collect
         # latency / batch kind / committed tokens and prints one JSON
@@ -591,7 +607,9 @@ class LLM:
         overlap = (self.config.overlap_scheduling
                    and self.config.parallel.pp == 1)
         if overlap:
-            depth = max(2, self.config.overlap_depth)
+            # --inflight-depth is honored exactly: depth 1 is the
+            # serialized launch-collect control arm (no run-ahead)
+            depth = max(1, self.config.overlap_depth)
         multi = self.config.multi_step_decode if overlap else 1
         slot_mode = overlap and self.config.decode_slot_batching
         cup = self.config.chain_under_prefill if overlap else 0
@@ -599,6 +617,13 @@ class LLM:
         # re-forms; ``ran_dry`` marks a fill pass that stopped early for
         # a reason other than the depth cap (stall classification).
         pipelined = self.pipelined and overlap
+        # Unified step (docs/overlap_scheduling.md#unified-step): prefill
+        # pressure no longer yields the chain — the next dispatch is a
+        # MIXED re-formed batch carrying the promised decode rows next
+        # to the admitted prefill chunks, so the 'waiting' break class
+        # and the chain_under_prefill ramp are retired. (self.unified
+        # is already False for hybrid models — the flag is inert there.)
+        unified = self.unified and overlap
         ran_dry = False
         while len(self._in_flight) < depth:
             # engine-loop phase attribution: everything from here to the
@@ -622,6 +647,25 @@ class LLM:
                     # pressure subsided without a yield: a later burst
                     # starts its ramp budget from zero, not a stale count
                     self._chained_under_pressure = 0
+                if unified and tip is not None and pressure:
+                    # the chain ABSORBS the waiting work: one mixed
+                    # re-formed dispatch carries the promised decode
+                    # rows next to the admitted prefill chunks — no
+                    # yield, no 'waiting' break, the chain re-roots off
+                    # the mixed entry once every row samples
+                    prev_batch, prev_handle = tip
+                    if isinstance(prev_batch, list):
+                        prev_batch = prev_batch[-1]
+                    if self._dispatch_reform(prev_batch, prev_handle,
+                                             t_enter, multi, slot_mode,
+                                             False, mixed=True):
+                        continue
+                    # re-forming needs host-committed state — fall
+                    # through to the sync pass, which admits whatever
+                    # the re-form couldn't (as a non-chained entry
+                    # riding the pipeline, like a legacy yield)
+                    self._chain_tip = None
+                    tip = None
                 allow = tip is not None and (
                     not pressure
                     or (cup > 0 and self._chained_under_pressure < cup))
@@ -652,10 +696,14 @@ class LLM:
                         # the sync path re-forms the batch next iteration
                         # — each break is a dispatch round trip the chain
                         # would have hidden (step-kind attribution reads
-                        # these next to the decode/fused_block split)
-                        self._note_chain_break(
-                            prev_batch,
-                            self.scheduler.chain_break_reason or "shape")
+                        # these next to the decode/fused_block split).
+                        # Unified step: the 'waiting' class (ready seqs
+                        # the slots can't seat) is retired — the mixed
+                        # re-form below seats them; record 'reform'.
+                        reason = self.scheduler.chain_break_reason or "shape"
+                        if unified and reason == "waiting":
+                            reason = "reform"
+                        self._note_chain_break(prev_batch, reason)
                         # Pipelined loop: a membership change is not a
                         # reason to drain — speculatively RE-FORM the
                         # next batch off promised token counts and keep
@@ -663,7 +711,7 @@ class LLM:
                         # when re-forming needs host-committed state.
                         if pipelined and self._dispatch_reform(
                                 prev_batch, prev_handle, t_enter, multi,
-                                slot_mode, pressure):
+                                slot_mode, pressure, mixed=unified):
                             continue
                         self._chain_tip = None
                         self._chained_under_pressure = 0
@@ -849,14 +897,17 @@ class LLM:
 
     def _dispatch_reform(self, prev_batch, prev_handle, t_enter: float,
                          multi: int, slot_mode: bool,
-                         pressure: bool) -> bool:
-        """Speculatively re-form and dispatch the next decode batch off
+                         pressure: bool, mixed: bool = False) -> bool:
+        """Speculatively re-form and dispatch the next batch off
         ``prev_batch``'s promised token counts (pipelined loop;
         scheduler.schedule_reform holds the FutureMap contract). The
         re-formed batch fuses with chain links into one multi-step
         dispatch when eligible — finishes no longer cost the fused-block
-        shape. Returns False (with a loop_stall recorded) when
-        re-forming needs host-committed state."""
+        shape. ``mixed=True`` (unified step) re-forms ACROSS the phase
+        boundary: prefill chunks ride the same dispatch with host-known
+        tokens, so a chain absorbs an arrival instead of yielding.
+        Returns False (with a loop_stall recorded) when re-forming
+        needs host-committed state."""
         if self.model_cfg.use_hybrid:
             # the GDN recurrent state is CUMULATIVE: a discarded
             # speculative step leaves the slot advanced by a token that
@@ -866,15 +917,23 @@ class LLM:
             # pool is budgeted for per-step rollback here).
             self._note_stall("readback")
             return False
-        batch = self.scheduler.schedule_reform(prev_batch)
+        batch = self.scheduler.schedule_reform(prev_batch,
+                                               allow_prefill=mixed)
         if batch is None:
             reason = self.scheduler.reform_fail_reason
             self._note_stall("pages" if reason == "pages"
                              else "readback")
             return False
         promises = FutureMap.promised_ids(batch)
+        # fused chain links require an all-decode first step (a mixed
+        # re-form's mid-prompt chunks can't ride step_multi); the gate
+        # reads chunk POSITIONS, not committed counts — a promised row
+        # descending from a final prefill chunk is decode here
+        decode_only = all(it.num_new_tokens == 1
+                          and it.computed_before >= it.seq.prompt_len
+                          for it in batch.items)
         links = (self._schedule_multi_links(batch, multi - 1)
-                 if multi > 1 else [])
+                 if multi > 1 and decode_only else [])
         t_sched = time.monotonic()
         if links:
             au = links[0].active_until
@@ -1023,9 +1082,23 @@ class LLM:
         now = time.monotonic()
         fused = isinstance(batch, list)
         b = batch[-1] if fused else batch
+        mix = None
         if fused:
             kind = "fused_block"
             tokens = sum(x.total_tokens for x in batch)
+        elif self.unified:
+            # one step kind for the one dispatch family
+            # (docs/observability.md: decode/prefill retired under the
+            # flag); ``mix`` keeps the decode-vs-mixed split readable
+            # (summarize() → mixed_step_frac, unfused accounting)
+            kind = "unified_step"
+            from gllm_tpu.sequence import HOLE_SEQ_ID
+            mix = ("mixed" if any(
+                it.num_new_tokens > 1
+                or it.computed_before < it.seq.prompt_len
+                for it in b.items if it.seq.seq_id != HOLE_SEQ_ID)
+                else "decode")
+            tokens = b.total_tokens
         else:
             kind = ("decode" if b.num_decode == b.num_seqs
                     else "prefill")
@@ -1035,7 +1108,8 @@ class LLM:
         _M_RTT.observe(now - t_dispatch, kind=kind)
         _M_STEPS.inc(kind=kind)
         _M_STEP_TOKENS.inc(tokens, kind=kind)
-        if kind == "decode":
+        if kind == "decode" or (kind == "unified_step"
+                                and mix == "decode"):
             _M_DECODE_STEPS.inc(fused="false")
         elif fused:
             _M_DECODE_STEPS.inc(len(batch), fused="true")
@@ -1048,6 +1122,8 @@ class LLM:
                   inflight=len(self._in_flight))
         if fused:
             ev["k"] = len(batch)
+        if mix is not None:
+            ev["mix"] = mix
         if extra:
             ev.update(extra)
         if phases is not None:
@@ -1073,7 +1149,8 @@ class LLM:
         if timer is not None:
             timer.append((wall,
                           f"decode_block{len(batch)}" if fused
-                          else "decode" if kind == "decode"
+                          else "decode" if (kind == "decode"
+                                            or mix == "decode")
                           else "prefill_mixed", tokens))
 
     def _attach_attribution(self, ev: dict, phases: dict, wall: float,
@@ -1204,14 +1281,15 @@ class LLM:
         live = [b for b in batches if b is not None]
         # one step event for the stacked program (all replicas run in it)
         now = time.monotonic()
-        kind = ("decode" if all(b.num_decode == b.num_seqs for b in live)
-                else "prefill")
+        decode_only = all(b.num_decode == b.num_seqs for b in live)
+        kind = ("unified_step" if self.unified
+                else "decode" if decode_only else "prefill")
         tokens = sum(b.total_tokens for b in live)
         _M_STEP_LAT.observe(now - t0, kind=kind)
         _M_RTT.observe(now - t_dispatch, kind=kind)
         _M_STEPS.inc(kind=kind)
         _M_STEP_TOKENS.inc(tokens, kind=kind)
-        if kind == "decode":
+        if decode_only:
             _M_DECODE_STEPS.inc(fused="false")
         # same attribution fields as the single-runner path — the
         # shared helper keeps the two call sites from drifting (the dp
@@ -1221,6 +1299,8 @@ class LLM:
                   tokens=tokens, wall_ms=round((now - t0) * 1e3, 3),
                   rtt_ms=round((now - t_dispatch) * 1e3, 3),
                   dp=len(live))
+        if self.unified:
+            ev["mix"] = "decode" if decode_only else "mixed"
         flops = (sum(self._step_flops(b) for b in live)
                  if self._peak_flops else 0.0)
         rd = (ph.get("kv_bytes", 0)

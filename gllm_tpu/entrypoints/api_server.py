@@ -749,6 +749,8 @@ def build_engine_config(args) -> EngineConfig:
         attention_impl=args.attention_impl,
         overlap_scheduling=args.overlap_scheduling,
         pipelined_loop=args.pipelined_loop,
+        unified_step=args.unified_step,
+        overlap_depth=args.inflight_depth,
         decode_slot_batching=args.decode_slot_batching,
         chain_under_prefill=args.chain_under_prefill,
         decode_chain_len=args.decode_chain_len,
@@ -891,6 +893,23 @@ def make_parser() -> argparse.ArgumentParser:
                         "pipeline; divergence is reconciled at collect "
                         "time (implies --overlap-scheduling; "
                         "docs/overlap_scheduling.md#pipelined-loop)")
+    p.add_argument("--unified-step", action="store_true",
+                   help="one ragged kernel, one dispatch: serve every "
+                        "paged step as a unified mixed batch (decode "
+                        "rows are q_len=1 rows of the ragged batch), "
+                        "collapse the shape-signature space to (row "
+                        "bucket × token bucket), and let decode chains "
+                        "ABSORB prefill chunks through mixed re-formed "
+                        "batches instead of yielding (retires the "
+                        "'waiting' break class and --chain-under-"
+                        "prefill; docs/overlap_scheduling.md#unified-"
+                        "step). Off = byte-identical legacy dispatch")
+    p.add_argument("--inflight-depth", type=int, default=2,
+                   help="max dispatched-but-uncollected engine entries "
+                        "under --overlap-scheduling (the pipelined "
+                        "loop's run-ahead bound; depth 2 hides host "
+                        "batch building, deeper also hides the "
+                        "remote-dispatch round trip)")
     p.add_argument("--decode-slot-batching", action="store_true",
                    help="persistent-slot decode chains (needs "
                         "--overlap-scheduling): finished rows become "

@@ -28,7 +28,16 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 
 # Step-event kinds recorded by the engine/runner instrumentation:
 #   prefill      - step whose batch carries at least one prefill chunk
-#   decode       - single-step pure-decode dispatch (the UNfused path)
+#                  (retired under --unified-step: see unified_step)
+#   decode       - single-step pure-decode dispatch (the UNfused path;
+#                  retired under --unified-step: see unified_step)
+#   unified_step - one unified mixed-batch dispatch (--unified-step,
+#                  docs/overlap_scheduling.md#unified-step): the single
+#                  step kind replacing prefill/decode when the flag is
+#                  on — the ``mix`` field ("decode" | "mixed") keeps the
+#                  composition readable (summarize() folds mix=decode
+#                  into the unfused-decode accounting and reports
+#                  mixed_step_frac over the window)
 #   fused_block  - multi-step decode block (one dispatch, K sub-steps)
 #   pp_stage     - one pipeline-stage dispatch of a microbatch
 #   compile      - first dispatch of a new (shape-bucket, static-flag)
@@ -40,7 +49,10 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 #                  seqs), pages (KV pool), shape (compaction, non-decode
 #                  batch, host-work features), spec (speculation owns
 #                  dispatch), finish (legacy membership loss — zero under
-#                  --decode-slot-batching)
+#                  --decode-slot-batching), reform (unified step: the
+#                  chain re-formed through a mixed/grown batch instead
+#                  of waiting — 'waiting' is retired, zero with
+#                  --unified-step on)
 #   fault        - a robustness event (docs/robustness.md): an injected
 #                  fault point fired (``point`` field names it), the
 #                  watchdog detected a stale heartbeat
@@ -69,10 +81,11 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 # ``dev_ms`` = device wall attributed back to the launching step
 # (block-until-ready delta at collect), and optional ``mfu`` /
 # ``hbm_gbps`` estimates from the step FLOPs model (obs/spans.py).
-STEP_KINDS = ("prefill", "decode", "fused_block", "pp_stage", "compile",
-              "chain_break", "fault", "quarantine", "prefix",
-              "loop_stall")
-CHAIN_BREAK_REASONS = ("waiting", "pages", "shape", "spec", "finish")
+STEP_KINDS = ("prefill", "decode", "unified_step", "fused_block",
+              "pp_stage", "compile", "chain_break", "fault",
+              "quarantine", "prefix", "loop_stall")
+CHAIN_BREAK_REASONS = ("waiting", "pages", "shape", "spec", "finish",
+                       "reform")
 LOOP_STALL_REASONS = ("readback", "rebuild", "pages", "depth")
 
 
@@ -164,6 +177,9 @@ def summarize(events: List[dict]) -> dict:
     fused_steps = unfused_steps = 0
     fused_ms = unfused_ms = 0.0
     total_ms = 0.0
+    # unified-step composition (--unified-step): collected step events
+    # vs the share of them that carried at least one prefill row
+    step_events = unified_mixed = 0
     compiles = chain_breaks = 0
     break_reasons: Dict[str, int] = {}
     faults_total = quarantines = 0
@@ -251,9 +267,13 @@ def summarize(events: List[dict]) -> dict:
                 t_first_start = start
             if t_last_end is None or float(e["t"]) > t_last_end:
                 t_last_end = float(e["t"])
-        if k == "decode":
+        step_events += 1
+        if k == "decode" or (k == "unified_step"
+                             and e.get("mix") == "decode"):
             unfused_steps += 1
             unfused_ms += wall
+        elif k == "unified_step":
+            unified_mixed += 1
         elif k == "fused_block":
             fused_steps += int(e.get("k", 1))
             fused_ms += wall
@@ -283,6 +303,13 @@ def summarize(events: List[dict]) -> dict:
         # None when no block reported finish steps (ondevice_finish off)
         "dead_substep_frac": (round(dead_rows / exec_rows, 4)
                               if exec_rows else None),
+        # unified step (--unified-step): share of collected step
+        # dispatches that were MIXED unified batches (prefill rows
+        # riding the decode stream — chains absorbing arrivals); None
+        # when the window saw no unified_step events (flag off)
+        "mixed_step_frac": (round(unified_mixed / step_events, 4)
+                            if step_events and "unified_step" in kinds
+                            else None),
         # per-window prefix-cache hit rate by tier (None when the window
         # saw no admission probes — prefix caching off or pure decode)
         "prefix": ({

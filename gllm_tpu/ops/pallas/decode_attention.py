@@ -1,5 +1,14 @@
 """Pallas TPU paged decode attention.
 
+NOTE (unified step, docs/overlap_scheduling.md#unified-step): under
+``--unified-step`` every paged step — pure decode included — routes
+through the unified ragged kernel (ops/pallas/ragged_attention.py,
+``unified=True``), whose decode-class blocks reproduce this kernel's
+grouped round-robin fetch discipline inside the one program. This module
+is kept as the legacy dispatch path (flag off) and as the PARITY ORACLE
+the unified kernel's decode-class path is tested against
+(tests/test_unified_step.py).
+
 The decode half of the reference's core attention kernel
 (sgl_kernel ``flash_attn_with_kvcache`` — /root/reference/gllm/layers/
 attention.py:92-140; Triton split-K analogue in layers/ops/

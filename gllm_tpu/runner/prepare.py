@@ -56,6 +56,16 @@ class BatchBuilder:
         self.max_seqs = min(config.max_num_seqs,
                             sc.max_decode_seqs + sc.max_prefill_tokens)
         self.max_pages_per_seq = config.max_pages_per_seq
+        # Unified mixed-batch step (--unified-step): ONE signature family
+        # — max_q_len is pinned to the token bucket for every batch, so
+        # the compile key collapses to (pow2 row bucket × pow2 token
+        # bucket × pages) with no separate decode (q=1) population, and
+        # pure decode (T == S) lands on the same family at t == s.
+        # Inert for hybrid (GDN) models — the runner keeps the whole
+        # flag legacy there (kernels, signatures, engine absorb path)
+        # and warns.
+        self.unified = (bool(getattr(config, "unified_step", False))
+                        and not use_ssm)
 
     def shape_signature(self, batch: ScheduledBatch) -> Tuple[int, int, int,
                                                               int]:
@@ -69,7 +79,23 @@ class BatchBuilder:
         rows = [it.num_new_tokens + len(it.draft_tokens)
                 for it in batch.items]
         max_q = max(rows)
-        if max_q == 1:
+        if self.unified:
+            # ONE dispatch family (--unified-step): max_q rides the
+            # token bucket (no separate q=1 population), and every
+            # MIXED batch pads its token axis to the single schedulable
+            # maximum — max_prefill_tokens + the decode-seq rows — the
+            # natural geometry for token throttling to balance against.
+            # This kills the per-workload token LADDER the legacy split
+            # warms (each prefill composition its own compile): mixed
+            # steps compile once per (row, pages) bucket, and chunked
+            # prefill targets the budget anyway so the padding is small
+            # exactly when mixed steps dominate. Pure decode pins t to
+            # the seq bucket EXACTLY (one token per row — the fused
+            # chains and the chained token splice live here), the t == s
+            # point of the same q == t family.
+            t = s if max_q == 1 else self.max_tokens
+            q = t
+        elif max_q == 1:
             t, q = s, 1          # pure decode: one token per seq
         else:
             t = bucket_size(sum(rows), 16, self.max_tokens)

@@ -279,6 +279,32 @@ class ModelRunner:
             raise NotImplementedError(
                 "sp>1 with tp>1 needs jax.shard_map (jax >= 0.5)")
         self.attn_impl = self._pick_attn_impl()
+        # Unified mixed-batch step (--unified-step): every paged step
+        # routes through the ONE ragged kernel (decode rows are q_len=1
+        # rows of the ragged batch; per-row-class block geometry + AMLA
+        # rescaling inside it — ops/attention.py impl="unified"). The
+        # XLA fallback stays the oracle; hybrid (GDN) keeps its own
+        # impl threading (gdn_impl shares the attn_impl string).
+        self.fwd_attn_impl = (
+            "unified" if (getattr(config, "unified_step", False)
+                          and self.attn_impl == "pallas"
+                          and not model_cfg.use_hybrid)
+            else self.attn_impl)
+        if (getattr(config, "unified_step", False)
+                and not model_cfg.use_hybrid
+                and self.fwd_attn_impl != "unified"
+                and jax.default_backend() in ("tpu", "axon")):
+            # the signature collapse still applies (one dispatch family,
+            # the engine absorb path stays functional via the XLA/legacy
+            # kernels) but the unified Pallas kernel is not serving it —
+            # decode rows pay the legacy kernel's masked-row/gather cost.
+            # Announce it instead of silently regressing on chip. (For
+            # hybrid models the flag is inert end to end — the engine
+            # logs that instead.)
+            logger.warning(
+                "--unified-step without the unified kernel (attn_impl="
+                "%s): dispatch-shape collapse is active but attention "
+                "runs the legacy path", self.attn_impl)
         if self.kv_quant:
             self._check_kv_quant()
         # (Re)set the module-level TP shard context the attention dispatch
@@ -601,7 +627,7 @@ class ModelRunner:
         cfg = self.model_cfg
         fwd = self.model_def.forward
         logits_fn = self.model_def.compute_logits
-        attn_impl = self.attn_impl
+        attn_impl = self.fwd_attn_impl
 
         def lp_aux(params, cfg_, logits, tokens, hidden, residual, batch,
                    token_counts, logprobs_k, prompt_lp):
@@ -697,7 +723,7 @@ class ModelRunner:
                 kw = dict(max_q_len=max_q_len, logprobs_k=logprobs_k,
                           prompt_lp=prompt_lp, spec_sampled=spec_sampled,
                           all_greedy=all_greedy)
-                if attn_impl != "pallas" or mesh is None:
+                if attn_impl not in ("pallas", "unified") or mesh is None:
                     # XLA attention: plain vmap over stacked replicas —
                     # GSPMD partitions the batched program over the
                     # dp-sharded leading axis on its own.
@@ -1050,10 +1076,18 @@ class ModelRunner:
                  for r in range(len(ns))]
         return [host[r, :n] for r, n in enumerate(ns)], auxes
 
-    def step_async(self, sched_batch: ScheduledBatch):
+    def step_async(self, sched_batch: ScheduledBatch, prev_handle=None):
         """Launch one step; returns an opaque handle whose tokens are an
         uncommitted device future (jax async dispatch — the host does not
-        block until ``collect``)."""
+        block until ``collect``).
+
+        ``prev_handle``: chain this step off a previous entry's
+        ON-DEVICE sampled tokens — rows whose ``src_rows`` entry is >= 0
+        splice their input token from that array (``_splice_prev``).
+        Under the unified step the batch may be MIXED: promised decode
+        rows ride next to prefill chunks (whose tokens are host-known)
+        in one dispatch — the chain absorbing a prefill chunk instead
+        of breaking (docs/overlap_scheduling.md#unified-step)."""
         t_enter = time.monotonic()
         if self.model_cfg.use_mm:
             self._prepare_mm(sched_batch)
@@ -1063,8 +1097,11 @@ class ModelRunner:
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
         batch, max_q, token_counts = self.builder.build(sched_batch,
                                                         step_key)
+        if prev_handle is not None:
+            batch = self._splice_prev(batch, sched_batch, prev_handle[0])
         lp_k, want_plp = self._lp_flags(sched_batch)
-        ring = self._use_ring(sched_batch, batch.token_ids.shape[0])
+        ring = (prev_handle is None
+                and self._use_ring(sched_batch, batch.token_ids.shape[0]))
         spec_sampled = _spec_sampled(sched_batch.items)
         all_greedy = _all_greedy(sched_batch.items)
         self._note_kv_read(sched_batch.items)
@@ -1127,27 +1164,36 @@ class ModelRunner:
         return batch._replace(token_ids=prev_tokens)
 
     def _splice_mapped_tokens(self, batch: StepBatch, prev_tokens,
-                              src_rows):
+                              sched_batch: ScheduledBatch):
         """Input tokens for a speculatively RE-FORMED batch (pipelined
-        loop): row j takes the previous decode entry's on-device sampled
-        token at row ``src_rows[j]`` (a promised in-flight row), or
-        keeps the host-built value (-1: a joining decode-ready seq whose
-        last token is committed). Unlike :meth:`_splice_chain_tokens`
-        the two sides' row buckets may differ — membership changed —
-        so the splice is a tiny [S_new] gather over prev's row space
-        plus a select; no new jit-step variant. NOTE prev_tokens is NOT
-        donated into the new step: the previous entry's collect still
-        reads it (its async host copy may be in flight)."""
+        loop): item j takes the previous decode entry's on-device
+        sampled token at row ``src_rows[j]`` (a promised in-flight
+        row), or keeps the host-built value (-1: a joining decode-ready
+        seq, or — unified step — a prefill chunk whose tokens are all
+        committed). Unlike :meth:`_splice_chain_tokens` the two sides'
+        row buckets may differ (membership changed) and the batch may
+        be MIXED, so the splice is a tiny scatter into the flat token
+        axis at each promised item's row offset; no new jit-step
+        variant. NOTE prev_tokens is NOT donated into the new step: the
+        previous entry's collect still reads it (its async host copy
+        may be in flight)."""
         if prev_tokens.ndim == 2:
             prev_tokens = prev_tokens[-1]   # preceding multi-step block
-        s_pad = batch.token_ids.shape[0]
-        src = np.full(s_pad, -1, np.int32)
-        src[:len(src_rows)] = src_rows
-        src_j = jnp.asarray(src)
-        gathered = jnp.asarray(prev_tokens)[
-            jnp.clip(src_j, 0, prev_tokens.shape[0] - 1)]
-        return batch._replace(token_ids=jnp.where(
-            src_j >= 0, gathered, jnp.asarray(batch.token_ids)))
+        idx, rows = [], []
+        off = 0
+        for it, src in zip(sched_batch.items, sched_batch.src_rows):
+            if src >= 0:
+                # a promised row is always a single decode token at the
+                # item's flat offset (prefill chunks carry src -1)
+                idx.append(off)
+                rows.append(src)
+            off += it.num_new_tokens + len(it.draft_tokens)
+        if not idx:
+            return batch
+        vals = jnp.asarray(prev_tokens)[jnp.asarray(np.asarray(
+            rows, np.int32))]
+        return batch._replace(token_ids=jnp.asarray(batch.token_ids).at[
+            jnp.asarray(np.asarray(idx, np.int32))].set(vals))
 
     def _splice_prev(self, batch: StepBatch, sched_batch: ScheduledBatch,
                      prev_tokens):
@@ -1157,48 +1203,26 @@ class ModelRunner:
         identity chain splice (+ host_rows joins)."""
         if sched_batch.src_rows is not None:
             return self._splice_mapped_tokens(batch, prev_tokens,
-                                              sched_batch.src_rows)
+                                              sched_batch)
         return self._splice_chain_tokens(batch, prev_tokens,
                                          sched_batch.host_rows)
 
     def step_async_chained(self, sched_batch: ScheduledBatch, prev_handle):
-        """Launch a chained decode step whose input tokens are the PREVIOUS
+        """Launch a chained step whose input tokens are the PREVIOUS
         step's on-device sampled tokens (overlap scheduling: the reference's
         FutureMap placeholder resolution, async_utils.py:56-61, without the
         negative-id dance — the sampled-token array is simply spliced in as
-        the next step's token_ids)."""
+        the next step's token_ids). Delegates to :meth:`step_async` with
+        ``prev_handle`` — for a pure-decode chain the computed static
+        flags reduce to exactly the legacy chained dispatch; under the
+        unified step the same entry point serves mixed re-formed
+        batches."""
         prev_tokens, _, prev_n = prev_handle
         if sched_batch.src_rows is None:
             # re-formed batches (src_rows) legitimately change the seq
             # count across the edge; identity chains must not
             assert prev_n == sched_batch.num_seqs
-        t_enter = time.monotonic()
-        self._apply_ssm_intents()
-        self._apply_swap_intents()
-        self._step_count += 1
-        step_key = jax.random.fold_in(self.rng_key, self._step_count)
-        batch, max_q, token_counts = self.builder.build(sched_batch,
-                                                        step_key)
-        assert max_q == 1 and token_counts is None
-        batch = self._splice_prev(batch, sched_batch, prev_tokens)
-        lp_k, _ = self._lp_flags(sched_batch)
-        all_greedy = _all_greedy(sched_batch.items)
-        self._note_kv_read(sched_batch.items)
-        self._note_dispatch("step", batch,
-                            (1, lp_k, False, False, False, all_greedy),
-                            all_greedy)
-        t_build = time.monotonic()
-        from gllm_tpu.parallel.mesh import mesh_context
-        with mesh_context(self.mesh):
-            tokens, self.kv, aux = self._step_fn(
-                self.params, self.kv, batch, self.cos_sin, token_counts,
-                max_q_len=1, logprobs_k=lp_k,
-                all_greedy=all_greedy)
-        _start_host_copy((tokens, aux))
-        self.last_phases = {"build": t_build - t_enter,
-                            "dispatch": time.monotonic() - t_build,
-                            "kv_bytes": self._last_kv_read}
-        return tokens, aux, sched_batch.num_seqs
+        return self.step_async(sched_batch, prev_handle=prev_handle)
 
     def step_multi(self, chain, prev_handle=None):
         """Launch K chained decode steps as ONE device program (lax.scan
@@ -1227,7 +1251,11 @@ class ModelRunner:
         sig = self.builder.shape_signature(chain[-1])
         batch, max_q, token_counts = self.builder.build(
             chain[0], keys[0], force_signature=sig)
-        assert max_q == 1 and token_counts is None
+        # chains are all-decode by construction; under the unified
+        # signature max_q rides the token bucket (== seq bucket here)
+        # instead of pinning to 1
+        assert token_counts is None
+        assert all(it.num_new_tokens == 1 for it in chain[0].items)
         if prev_handle is not None:
             batch = self._splice_prev(batch, chain[0], prev_handle[0])
         # Per-row alive-link count: rows whose seq dies (length cap)
@@ -1278,7 +1306,7 @@ class ModelRunner:
         cfg = self.model_cfg
         fwd = self.model_def.forward
         logits_fn = self.model_def.compute_logits
-        attn_impl = self.attn_impl
+        attn_impl = self.fwd_attn_impl
         page = self.config.cache.page_size
 
         @functools.partial(jax.jit, static_argnames=("num_steps",
@@ -1494,5 +1522,19 @@ class ModelRunner:
             mixed += 1
         logger.info("[startup] phase=warmup seconds=%.2f buckets=%d",
                     time.monotonic() - _t_warm, len(combos) + mixed)
-        logger.info("warmed %d decode + %d mixed shape buckets",
-                    len(combos), mixed)
+        if self.builder.unified:
+            # one signature family (q == t): the decode and mixed passes
+            # above warm points of the SAME program population
+            logger.info("warmed %d unified shape buckets (one family)",
+                        len(combos) + mixed)
+        else:
+            logger.info("warmed %d decode + %d mixed shape buckets",
+                        len(combos), mixed)
+
+    @property
+    def num_shape_signatures(self) -> int:
+        """Distinct (kind, shape-bucket, static-flag) dispatch signatures
+        seen so far — the shape-bucket population this runner warmed or
+        compiled at first sight (bench.py promotes it: the unified step
+        must shrink it, docs/overlap_scheduling.md#unified-step)."""
+        return len(self._seen_sigs)

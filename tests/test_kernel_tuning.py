@@ -31,6 +31,10 @@ def test_builtin_defaults():
     _reset_caches()
     assert tuning.get("ragged") == {"q_block": 128, "kv_block": 256}
     assert tuning.get("decode") == {"kv_block": 256}
+    # the unified mixed-batch kernel (--unified-step) resolves its own
+    # geometry: block sizes + the decode-class DMA interleave depth
+    assert tuning.get("unified") == {"q_block": 128, "kv_block": 256,
+                                     "group": 4}
 
 
 def test_env_override_layering(tmp_path, monkeypatch):
@@ -190,8 +194,11 @@ def test_sweep_bodies_close_over_no_buffers():
     kt = _load_kernel_tune()
     run_r, args_r = kt.build_ragged(64, 64, T=128, S=4, ctx=256)
     run_d, args_d = kt.build_decode(64, gsz=2, S=8, ctx=256)
+    run_u, args_u = kt.build_unified(64, 64, gsz=2, mix="balanced",
+                                     shrink=True)
     for name, run, args in (("ragged", run_r, args_r),
-                            ("decode", run_d, args_d)):
+                            ("decode", run_d, args_d),
+                            ("unified", run_u, args_u)):
         # the caches must be in the argument list...
         assert len(args) == 3, name
         # ...and nothing buffer-sized may ride the jaxpr as a constant
