@@ -158,29 +158,36 @@ def build_ragged(q_block, kv_block, kv_dtype="auto", **workload):
 UNIFIED_MIXES = ("decode", "balanced", "prefill")
 
 
+# q_len of a fused-speculation VERIFY row (spec_k + 1 with the default
+# --spec-k 4, docs/speculative_decoding.md#fused): the committed token
+# plus k draft rows ride the unified kernel as one short chunk.
+VERIFY_Q = 5
+
+
 def _unified_workload(mix="balanced", Hq=32, Hkv=8, D=128, page=16,
                       ctx=1024, kv_dtype="auto", shrink=False):
     """Representative UNIFIED mixed batch for the --unified-step kernel:
-    a decode prefix (one token per sequence) followed by prefill chunks,
-    in the three row mixes the serving loop actually emits —
-    decode-heavy (a chain absorbing one arrival), balanced, and
-    prefill-heavy (ramp-up). Returns the same tuple shape as
-    ``_mixed_workload``."""
+    a decode prefix (one token per sequence), a VERIFY class
+    (q_len=spec_k+1 draft+verify rows — the fused-speculation geometry,
+    long context behind a short chunk), and prefill chunks, in the three
+    row mixes the serving loop actually emits — decode-heavy (a chain
+    absorbing one arrival), balanced, and prefill-heavy (ramp-up).
+    Returns the same tuple shape as ``_mixed_workload``."""
     import jax
     import jax.numpy as jnp
     shapes = {
-        # (decode rows, prefill chunk lengths)
-        "decode": (120, (128,)),
-        "balanced": (64, (256, 256)),
-        "prefill": (8, (512, 512)),
+        # (decode rows, verify rows, prefill chunk lengths)
+        "decode": (120, 16, (128,)),
+        "balanced": (64, 32, (256, 256)),
+        "prefill": (8, 8, (512, 512)),
     }[mix]
     if shrink:                     # interpret-mode smoke geometry
-        shapes = {"decode": (24, (16,)), "balanced": (8, (32, 32)),
-                  "prefill": (2, (64, 64))}[mix]
+        shapes = {"decode": (24, 4, (16,)), "balanced": (8, 4, (32, 32)),
+                  "prefill": (2, 2, (64, 64))}[mix]
         ctx = min(ctx, 256)
-    nd, chunks = shapes
-    T = nd + sum(chunks)
-    S = nd + len(chunks)
+    nd, nv, chunks = shapes
+    T = nd + nv * VERIFY_Q + sum(chunks)
+    S = nd + nv + len(chunks)
     P = S * (ctx // page) + 1
     key = jax.random.key(0)
     q = jax.random.normal(key, (T, Hq, D), jnp.bfloat16)
@@ -193,13 +200,13 @@ def _unified_workload(mix="balanced", Hq=32, Hkv=8, D=128, page=16,
     else:
         caches = (jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16),
                   jax.random.normal(key, (P, page, Hkv, D), jnp.bfloat16))
-    lens = [1] * nd + list(chunks)
+    lens = [1] * nd + [VERIFY_Q] * nv + list(chunks)
     cu = [0]
     for n in lens:
         cu.append(cu[-1] + n)
     cu = jnp.asarray(cu, jnp.int32)
-    kv_lens = jnp.asarray([ctx] * nd + [ctx + c for c in chunks],
-                          jnp.int32)
+    kv_lens = jnp.asarray([ctx] * nd + [ctx + VERIFY_Q] * nv
+                          + [ctx + c for c in chunks], jnp.int32)
     mp = max(-(-int(kv) // page) for kv in kv_lens)
     pt = (jnp.arange(S * mp, dtype=jnp.int32).reshape(S, mp)
           % (P - 1)) + 1

@@ -225,6 +225,27 @@ class EngineConfig:
     spec_decode: Optional[str] = None        # None | "ngram"
     spec_k: int = 4
     spec_ngram: int = 2
+    # On-device speculation (--spec-fused, requires spec_decode="ngram";
+    # docs/speculative_decoding.md#fused): draft → verify →
+    # accept/reject → correction-token emission run INSIDE the jitted
+    # multi-step program, so a decode chain of K sub-steps emits up to
+    # K·(spec_k+1) tokens in one dispatch. The runner keeps a bounded
+    # per-slot recent-token ring on device (seeded from committed tokens
+    # at chain splice time, then advanced by the loop carry), a
+    # vectorized n-gram match proposes drafts without host readback, and
+    # verify rows ride the ragged kernel as q_len=k+1 rows with
+    # on-device acceptance. Speculation and chained dispatch stop being
+    # mutually exclusive: schedule_chain accepts spec rows (the
+    # chain_breaks reason="spec" class is retired) and the FutureMap's
+    # scheduled frontiers become token-count UPPER bounds trimmed to the
+    # actual accepted counts at collect. Greedy token streams stay
+    # byte-identical to host-driven spec decode AND to plain decode;
+    # sampled rows keep the rejection-sampling distribution guarantee
+    # (draws keyed by fold_in(seed, out_step)). Inert (warned) for
+    # hybrid GDN, multimodal, pp>1 and dp>1 — those keep the host-driven
+    # snapshot path. Implies overlap_scheduling; off = byte-identical
+    # host-driven speculation.
+    spec_fused: bool = False
     # Quantization: None | "int8" | "fp8" | "int4" (weight-only,
     # per-output-channel, XLA-fused dequant) | "w8a8" (int8 weights +
     # per-token int8 activations on the MXU) — reference quantization
@@ -315,6 +336,7 @@ class EngineConfig:
             self.decode_slot_batching = False
             self.chain_under_prefill = 0
             self.pipelined_loop = False
+            self.spec_fused = False
         if self.pipelined_loop and not self.overlap_scheduling:
             # the pipelined loop is the overlap machinery run one step
             # further ahead — chains are its primary edge; lifting the
@@ -340,10 +362,36 @@ class EngineConfig:
         if self.overlap_depth < 1:
             raise ValueError("overlap_depth (--inflight-depth) must be "
                              ">= 1")
+        if self.spec_fused:
+            if self.spec_decode != "ngram":
+                raise ValueError(
+                    "spec_fused (--spec-fused) requires "
+                    "spec_decode='ngram'")
+            if self.parallel.pp > 1 or self.parallel.dp > 1:
+                # topology-inert cases KNOWN at config time clear the
+                # flag BEFORE its side effects (implied overlap, the
+                # chain-length lift below) so the command behaves
+                # exactly like the same command without the flag; the
+                # model-dependent gates (hybrid GDN, multimodal) live in
+                # the engine and only disable the fused path itself
+                import logging
+                logging.getLogger(__name__).warning(
+                    "--spec-fused is inert for pp/dp > 1: host-driven "
+                    "speculation retained")
+                self.spec_fused = False
+            elif not self.overlap_scheduling:
+                # fused draft+verify lives in the chained dispatch body —
+                # lifting the flag keeps "--spec-fused" a one-flag opt-in
+                # (same discipline as pipelined_loop)
+                self.overlap_scheduling = True
         if self.decode_chain_len is not None:
             if self.decode_chain_len < 1:
                 raise ValueError("decode_chain_len must be >= 1")
             self.multi_step_decode = self.decode_chain_len
+        elif (self.spec_fused and self.multi_step_decode == 1):
+            # one fused block should amortize several verify rounds per
+            # dispatch; page feasibility still shortens individual blocks
+            self.multi_step_decode = 8
         elif (self.ondevice_finish and self.overlap_scheduling
                 and self.multi_step_decode == 1):
             # with post-EOS waste gone, the conservative single-step

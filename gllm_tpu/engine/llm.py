@@ -104,6 +104,16 @@ _M_ONDEV_FINISH = obs.counter(
 _M_DEAD_FRAC = obs.gauge(
     "gllm_dead_substep_frac",
     "wasted (dead-row) sub-step fraction of the latest fused block")
+# Fused on-device speculation (config.spec_fused,
+# docs/speculative_decoding.md#fused): tokens moving through fused
+# draft+verify blocks, by what they were — accepted drafts (the
+# dispatch-amortization win), rejected drafts (wasted verify rows), and
+# corrections (the per-sub-step resample/bonus token every emitting
+# sub-step contributes).
+_M_SPEC_FUSED = obs.counter(
+    "gllm_spec_fused_tokens_total",
+    "tokens through fused speculation blocks by kind "
+    "(accepted|rejected|correction)", ("kind",))
 # Performance attribution (docs/observability.md#tracing): per-step MFU
 # from the obs/spans.py FLOPs model against the device wall, the share
 # of that device wall hidden under host work (1 = never blocked), and
@@ -265,6 +275,34 @@ class LLM:
             # already rejected any other spec_decode value.
             for s in self.schedulers:
                 s.spec_cfg = (config.spec_ngram, config.spec_k)
+        # Fused on-device speculation (--spec-fused,
+        # docs/speculative_decoding.md#fused): draft+verify move inside
+        # the chained multi-step dispatch — schedule_chain accepts spec
+        # rows (reason="spec" breaks retired), the runner's block driver
+        # drafts from a device-resident recent-token ring and verifies
+        # in-loop, and one dispatch emits up to K·(spec_k+1) tokens.
+        # Inert (host-driven speculation retained, warned) for hybrid
+        # GDN (cumulative SSM state), multimodal (mrope not in the spec
+        # carry), pp>1 and dp>1 (no chained block path there).
+        self.spec_fused = (bool(getattr(config, "spec_fused", False))
+                           and config.spec_decode == "ngram"
+                           and not model_cfg.use_hybrid
+                           and not model_cfg.use_mm
+                           and config.parallel.pp == 1 and self.dp == 1)
+        if getattr(config, "spec_fused", False) and not self.spec_fused:
+            logger.warning(
+                "--spec-fused is inert for %s: host-driven speculation "
+                "retained",
+                "hybrid (GDN) models" if model_cfg.use_hybrid
+                else "multimodal models" if model_cfg.use_mm
+                else "pp/dp > 1" if (config.parallel.pp > 1
+                                     or self.dp > 1)
+                else "this configuration")
+        # worst-case tokens one spec sub-step may emit (drafts + the
+        # correction/bonus token) — the scheduler's token-unit stride
+        self.spec_mult = (config.spec_k + 1) if self.spec_fused else 1
+        for s in self.schedulers:
+            s.spec_fused = self.spec_fused
         self._rr = 0
         self._seq_replica: dict = {}
         # Persistent-slot decode batching (config.decode_slot_batching):
@@ -721,7 +759,17 @@ class LLM:
                         self._chained_under_pressure += len(chain)
                     self._yield_noted = False
                     t_sched = time.monotonic()
-                    if len(chain) > 1:
+                    if getattr(chain[0], "spec_block", False):
+                        # fused on-device speculation: even a 1-link
+                        # chain runs the draft+verify block driver (it
+                        # emits up to spec_k+1 tokens per dispatch)
+                        entry = InFlight(
+                            chain, self.runner.step_spec_multi(
+                                chain, prev_handle),
+                            time.monotonic(),
+                            self._entry_phases(t_enter, t_sched),
+                            chained=True)
+                    elif len(chain) > 1:
                         entry = InFlight(
                             chain, self.runner.step_multi(chain,
                                                           prev_handle),
@@ -764,13 +812,26 @@ class LLM:
                 if links:
                     au = links[0].active_until
                     k = 1 + len(links)
-                    first = dataclasses.replace(
-                        batch, active_until=(
-                            [min(d + 1, k) for d in au]
-                            if au is not None else None))
+                    spec_chain = getattr(links[0], "spec_block", False)
+                    if spec_chain:
+                        # token-unit budget merge: the sync batch rides
+                        # as sub-step 0, adding one token of budget in
+                        # front of the links' (uncapped, carried-across-
+                        # blocks) remaining budgets
+                        first = dataclasses.replace(
+                            batch, spec_block=True,
+                            active_until=[d + 1 for d in au])
+                    else:
+                        first = dataclasses.replace(
+                            batch, active_until=(
+                                [min(d + 1, k) for d in au]
+                                if au is not None else None))
                     chain = [first] + links
                     t_sched = time.monotonic()
-                    entry = InFlight(chain, self.runner.step_multi(chain),
+                    entry = InFlight(chain,
+                                     self.runner.step_spec_multi(chain)
+                                     if spec_chain
+                                     else self.runner.step_multi(chain),
                                      time.monotonic(),
                                      self._entry_phases(t_enter, t_sched),
                                      roots=True)
@@ -835,8 +896,14 @@ class LLM:
         if isinstance(batch, list) and aux.get("finish") is not None:
             extra = self._ondevice_block_stats(
                 aux["finish"][0][:batch[0].num_seqs])
+        if isinstance(batch, list) and aux.get("spec_counts") is not None:
+            extra = self._spec_block_stats(batch, aux)
         self._record_step(batch, t0, t_dispatch, extra, phases)
         if isinstance(batch, list):
+            if aux.get("spec_counts") is not None:
+                # fused speculation block: variable per-sub-step commits
+                return self._commit_outputs(
+                    self._commit_spec_block(batch, tokens, aux))
             # multi-step block: tokens [K, S]; advance K scheduler steps
             outs = []
             for b, row in zip(batch, tokens):
@@ -994,6 +1061,84 @@ class LLM:
             _M_DEAD_FRAC.set(dead / (k_exec * finish_step.size))
         return {"k_exec": k_exec, "dead_substeps": dead}
 
+    def _spec_block_stats(self, chain, aux) -> dict:
+        """Host bookkeeping over a fused-speculation block's aux: the
+        actually-committed token count (the scheduled 1-per-link count
+        is meaningless under variable emission), executed sub-steps
+        (every executed sub-step emits at least one token on some live
+        row, so the zero tail marks the early exit), dead-row shares,
+        and the window accounting summarize() turns into
+        spec_accept_rate / tokens_per_dispatch (k_drafted /
+        k_accepted)."""
+        n = chain[0].num_seqs
+        counts = aux["spec_counts"][0][:, :n]
+        d_arr, a_arr = aux["spec_totals"]
+        k_exec = int((counts > 0).any(axis=1).sum())
+        dead = int((counts[:k_exec] == 0).sum()) if k_exec else 0
+        if k_exec and n:
+            _M_DEAD_FRAC.set(dead / (k_exec * n))
+        return {"k_exec": k_exec, "dead_substeps": dead,
+                "k_drafted": int(d_arr[:n].sum()),
+                "k_accepted": int(a_arr[:n].sum()),
+                "spec_tokens": int(counts.sum())}
+
+    def _commit_spec_block(self, chain, toks, aux):
+        """Commit one collected fused-speculation block
+        (docs/speculative_decoding.md#fused): sub-step k of row i
+        commits ``counts[k, i]`` of its k+1 verify tokens (the accepted
+        run + the correction/bonus token, possibly truncated by the
+        budget or an on-device stop hit). The scheduled per-link
+        ``computed_before`` values were worst-case UPPER bounds — each
+        link re-anchors on the sequence's committed state before
+        process_output_multi advances it, in-flight descendants' bounds
+        trim to the actuals (FutureMap.trim_overpromise), and the AIMD
+        draft length + acceptance stats reconcile from the handle aux."""
+        from gllm_tpu.sequence import HOLE_SEQ_ID, SequenceStatus
+        counts = aux["spec_counts"][0]
+        n = chain[0].num_seqs
+        outs = []
+        for k, b in enumerate(chain):
+            items, lists = [], []
+            for i, it in enumerate(b.items):
+                seq = it.seq
+                if (seq.seq_id != HOLE_SEQ_ID
+                        and seq.status is SequenceStatus.RUNNING):
+                    # upper-bound → actual: the device carried the real
+                    # frontier; the host adopts it from committed state
+                    it = dataclasses.replace(
+                        it, computed_before=seq.num_computed_tokens)
+                items.append(it)
+                c = int(counts[k, i])
+                lists.append([int(t) for t in toks[k, i, :c]])
+            nb = dataclasses.replace(b, items=items)
+            outs.extend(self.scheduler.process_output_multi(
+                nb, lists, self.eos_token_ids))
+        d_arr, a_arr = aux["spec_totals"]
+        drafted, accepted = int(d_arr[:n].sum()), int(a_arr[:n].sum())
+        tok = int(counts[:, :n].sum())
+        self.scheduler.spec_stats["proposed"] += drafted
+        self.scheduler.spec_stats["accepted"] += accepted
+        if drafted:
+            _M_SPEC_FUSED.inc(accepted, kind="accepted")
+            _M_SPEC_FUSED.inc(drafted - accepted, kind="rejected")
+        if tok > accepted:
+            _M_SPEC_FUSED.inc(tok - accepted, kind="correction")
+        kc = aux["spec_kcur"][0]
+        frontiers = {}
+        for i, it in enumerate(chain[0].items):
+            seq = it.seq
+            if seq.seq_id == HOLE_SEQ_ID:
+                continue
+            if i < n:
+                seq.spec_k_cur = max(1, min(int(kc[i]),
+                                            self.config.spec_k))
+            frontiers[seq.seq_id] = seq.num_computed_tokens
+        if self._in_flight:
+            self.futures.trim_overpromise(self._in_flight, frontiers)
+        if self.config.ondevice_finish:
+            self._count_ondevice_finishes(outs)
+        return outs
+
     def _count_ondevice_finishes(self, outs) -> None:
         """gllm_ondevice_finish_total{kind}: finishes that committed out
         of an on-device-finish fused block, classified the way the device
@@ -1032,7 +1177,13 @@ class LLM:
             k = (extra or {}).get("k_exec") or len(batch)
             ctxs = [it.computed_before for it in batch[0].items
                     if it.seq.seq_id != HOLE_SEQ_ID]
-            return fm.block_flops(ctxs, k)
+            f = fm.block_flops(ctxs, k)
+            if getattr(batch[0], "spec_block", False):
+                # fused speculation: each sub-step feeds up to
+                # spec_k+1 verify rows instead of one decode token
+                # (upper bound — garbage draft rows still compute)
+                f *= self.spec_mult
+            return f
         return fm.step_flops(
             (it.num_new_tokens, it.computed_before, it.samples)
             for it in batch.items if it.seq.seq_id != HOLE_SEQ_ID)
@@ -1086,6 +1237,11 @@ class LLM:
         if fused:
             kind = "fused_block"
             tokens = sum(x.total_tokens for x in batch)
+            if extra and extra.get("spec_tokens") is not None:
+                # fused speculation: the block committed a variable
+                # token count (scheduled 1/link is only an upper-bound
+                # anchor) — report what actually emitted
+                tokens = extra["spec_tokens"]
         elif self.unified:
             # one step kind for the one dispatch family
             # (docs/observability.md: decode/prefill retired under the
@@ -1234,8 +1390,11 @@ class LLM:
         device draws advance with the scan); penalties / logit_bias /
         logprobs / stop-strings / hybrid-SSM fall back to single chained
         steps."""
-        k_max = multi if self._fuse_ok(prev_batch) else 1
-        return self.scheduler.schedule_chain(prev_batch, k_max)
+        fusable = self._fuse_ok(prev_batch)
+        k_max = multi if fusable else 1
+        return self.scheduler.schedule_chain(
+            prev_batch, k_max,
+            spec_mult=self.spec_mult if fusable else 1)
 
     def _fuse_ok(self, batch) -> bool:
         """May ``batch``'s sequences ride a fused multi-step block?
@@ -1263,7 +1422,8 @@ class LLM:
         if k_max < 1 or not self._fuse_ok(batch):
             return []
         return self.scheduler.schedule_chain(batch, k_max,
-                                             include_prev=True)
+                                             include_prev=True,
+                                             spec_mult=self.spec_mult)
 
     def _step_dp(self) -> List[SeqOutput]:
         """One synchronous step over all DP replicas (single jit program;

@@ -122,3 +122,61 @@ class FutureMap:
         if hit:
             self.divergences += 1
         return hit
+
+    @staticmethod
+    def trim_overpromise(in_flight, frontiers) -> int:
+        """Fused speculation (config.spec_fused): a spec block's chained
+        descendants were scheduled off worst-case token-count UPPER
+        bounds (every sub-step may emit spec_k+1 tokens); when the block
+        collects, the committed counts are known and any over-promise is
+        trimmed — each still-in-flight spec entry's per-link
+        ``computed_before`` values rebase onto the committed frontier.
+
+        This is pure host bookkeeping: the device already carries the
+        ACTUAL frontier across blocks (the spec state in the handle), so
+        the trim never touches token content — it tightens the
+        allocation/feasibility arithmetic later ``schedule_chain``
+        extensions run off these entries' items, exactly the
+        invalidate-and-rebuild discipline's bookkeeping half.
+
+        ``frontiers`` maps seq_id → committed ``num_computed_tokens``.
+        Returns the total number of over-promised tokens trimmed.
+
+        Descendant entries rebase by the SAME per-seq delta as the
+        oldest in-flight entry: the over-promise accrued exactly once at
+        the collected block's boundary, and the later entries' strides
+        (scheduled relative to their parent) remain upper bounds of
+        whatever the parent actually emits — collapsing them all onto
+        the committed frontier would UNDER-bound page needs."""
+        trimmed = 0
+        applied = {}        # seq_id -> delta fixed at the oldest entry
+        for e in in_flight:
+            if e.invalid or not e.chained:
+                continue
+            chain = e.batch if isinstance(e.batch, list) else [e.batch]
+            if not getattr(chain[0], "spec_block", False):
+                continue
+            deltas = {}
+            for it in chain[0].items:
+                sid = it.seq.seq_id
+                if sid not in applied:
+                    f = frontiers.get(sid)
+                    if f is None:
+                        continue
+                    # anchor at the OLDEST entry even when the delta is
+                    # zero — descendants must never re-derive their own
+                    # (their elevation over the committed frontier is
+                    # their parent's still-unknown emission, not an
+                    # over-promise)
+                    applied[sid] = max(0, it.computed_before - f)
+                    trimmed += applied[sid]
+                if applied[sid]:
+                    deltas[sid] = applied[sid]
+            if not deltas:
+                continue
+            for b in chain:
+                for it in b.items:
+                    d = deltas.get(it.seq.seq_id)
+                    if d:
+                        it.computed_before -= d
+        return trimmed

@@ -758,6 +758,7 @@ def build_engine_config(args) -> EngineConfig:
         spec_decode=args.spec_decode,
         spec_k=args.spec_k,
         spec_ngram=args.spec_ngram,
+        spec_fused=args.spec_fused,
         quantization=args.quantization,
         sp_ring_threshold=args.sp_ring_threshold,
         mm_processor_min_pixels=args.mm_processor_min_pixels,
@@ -937,6 +938,14 @@ def make_parser() -> argparse.ArgumentParser:
                         "requests only; byte-identical outputs)")
     p.add_argument("--spec-k", type=int, default=4)
     p.add_argument("--spec-ngram", type=int, default=2)
+    p.add_argument("--spec-fused", action="store_true",
+                   help="fuse draft+verify into the chained multi-step "
+                        "dispatch (requires --spec-decode ngram): the "
+                        "device drafts from a carried recent-token ring "
+                        "and one dispatch emits up to K*(spec_k+1) "
+                        "tokens; greedy streams byte-identical, chains "
+                        "and speculation compose "
+                        "(docs/speculative_decoding.md)")
     p.add_argument("--mm-processor-min-pixels", type=int, default=None,
                    help="lower bound on image/video resolution fed to the "
                         "multimodal processor (reference "

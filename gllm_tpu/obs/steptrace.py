@@ -38,7 +38,12 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 #                  composition readable (summarize() folds mix=decode
 #                  into the unfused-decode accounting and reports
 #                  mixed_step_frac over the window)
-#   fused_block  - multi-step decode block (one dispatch, K sub-steps)
+#   fused_block  - multi-step decode block (one dispatch, K sub-steps);
+#                  under fused on-device speculation
+#                  (config.spec_fused) the event also carries
+#                  ``k_drafted`` / ``k_accepted`` (draft rows proposed /
+#                  accepted on device) and ``tokens`` counts the
+#                  actually-committed emission (up to K·(spec_k+1))
 #   pp_stage     - one pipeline-stage dispatch of a microbatch
 #   compile      - first dispatch of a new (shape-bucket, static-flag)
 #                  signature (an XLA compile unless the persistent cache
@@ -47,8 +52,9 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 #                  carries a ``reason`` field (docs/overlap_scheduling.md
 #                  taxonomy): waiting (prefill pressure / unseated ready
 #                  seqs), pages (KV pool), shape (compaction, non-decode
-#                  batch, host-work features), spec (speculation owns
-#                  dispatch), finish (legacy membership loss — zero under
+#                  batch, host-work features), spec (host-driven
+#                  speculation owns dispatch — retired, zero, under
+#                  --spec-fused), finish (legacy membership loss — zero under
 #                  --decode-slot-batching), reform (unified step: the
 #                  chain re-formed through a mixed/grown batch instead
 #                  of waiting — 'waiting' is retired, zero with
@@ -193,6 +199,11 @@ def summarize(events: List[dict]) -> dict:
     # dead_substeps when config.ondevice_finish is on): wasted sub-step
     # share of all executed row-sub-steps over the window
     dead_rows = exec_rows = 0
+    # fused on-device speculation (config.spec_fused; fused_block
+    # events carry k_drafted / k_accepted): window acceptance rate +
+    # committed tokens per device dispatch
+    spec_drafted = spec_accepted = 0
+    total_tokens = dispatches = 0
     # prefix-cache attribution: per-window hit rate + tier split
     pfx_queries = pfx_query_tokens = pfx_hit_tokens = 0
     pfx_pages: Dict[str, int] = {}
@@ -246,6 +257,11 @@ def summarize(events: List[dict]) -> dict:
         row["wall_ms"] += wall
         total_ms += wall
         row["tokens"] += int(e.get("tokens", 0))
+        total_tokens += int(e.get("tokens", 0))
+        dispatches += 1
+        if e.get("k_drafted") is not None:
+            spec_drafted += int(e["k_drafted"])
+            spec_accepted += int(e.get("k_accepted", 0))
         ph = e.get("ph")
         if isinstance(ph, dict):
             for name, ms in ph.items():
@@ -303,6 +319,14 @@ def summarize(events: List[dict]) -> dict:
         # None when no block reported finish steps (ondevice_finish off)
         "dead_substep_frac": (round(dead_rows / exec_rows, 4)
                               if exec_rows else None),
+        # fused on-device speculation (config.spec_fused): window draft
+        # acceptance rate (None when no block drafted) and committed
+        # tokens per collected device dispatch — the dispatch-
+        # amortization headline the fused path must raise
+        "spec_accept_rate": (round(spec_accepted / spec_drafted, 4)
+                             if spec_drafted else None),
+        "tokens_per_dispatch": (round(total_tokens / dispatches, 2)
+                                if dispatches else None),
         # unified step (--unified-step): share of collected step
         # dispatches that were MIXED unified batches (prefill rows
         # riding the decode stream — chains absorbing arrivals); None

@@ -451,6 +451,52 @@ def spec_verify(logits_mat: jnp.ndarray, drafts: jnp.ndarray,
     return tok_mat, accept
 
 
+def ngram_propose(ring: jnp.ndarray, ring_len: jnp.ndarray, *,
+                  n: int, k: int) -> jnp.ndarray:
+    """On-device prompt-lookup proposal (the fused-speculation half of
+    ``propose_ngram_drafts``): for each row of a right-aligned recent-
+    token ring (``ring[s, R-1]`` is the newest token, ``ring_len[s]``
+    valid entries, -1 elsewhere), find the most recent EARLIER
+    occurrence of the last-``n``-token pattern and return its
+    continuation, up to ``k`` tokens — one vectorized sliding-window
+    compare, no host readback. Returns [S, k] int32 drafts with -1
+    padding (rows with no match, short rings, and continuation tails
+    past the ring are all -1). ``n``/``k`` are trace-time constants (the
+    compare unrolls over the n pattern positions)."""
+    S, R = ring.shape
+    if R <= n:
+        return jnp.full((S, k), -1, jnp.int32)
+    pattern = ring[:, R - n:]                          # [S, n]
+    m = R - n                  # window starts 0..m-1 (start m IS the
+    match = jnp.ones((S, m), bool)                     # pattern itself)
+    for d in range(n):
+        match = match & (ring[:, d:d + m] == pattern[:, d:d + 1])
+    # a window is only real when it sits fully inside the valid region
+    starts = jnp.arange(m, dtype=jnp.int32)[None, :]
+    match = match & (starts >= (R - ring_len)[:, None])
+    has = match.any(axis=1)
+    # most recent match = highest start index
+    j = (m - 1) - jnp.argmax(match[:, ::-1], axis=1)   # [S]
+    idx = j[:, None] + n + jnp.arange(k, dtype=jnp.int32)[None, :]
+    cont = jnp.take_along_axis(ring, jnp.minimum(idx, R - 1), axis=1)
+    valid = (idx < R) & has[:, None] & (cont >= 0)
+    return jnp.where(valid, cont, -1).astype(jnp.int32)
+
+
+def ring_shift_in(ring: jnp.ndarray, ring_len: jnp.ndarray,
+                  toks: jnp.ndarray, counts: jnp.ndarray):
+    """Append ``counts[s]`` tokens of ``toks[s]`` (left-to-right) to each
+    row of a right-aligned ring: the whole row shifts left by its count
+    so ``ring[s, R-1]`` stays the newest token. ``counts`` may be 0
+    (identity) up to toks.shape[1]; entries of ``toks`` past a row's
+    count never enter the ring. Returns (ring, ring_len)."""
+    S, R = ring.shape
+    ext = jnp.concatenate([ring, toks.astype(ring.dtype)], axis=1)
+    idx = jnp.arange(R, dtype=jnp.int32)[None, :] + counts[:, None]
+    return (jnp.take_along_axis(ext, idx, axis=1),
+            jnp.minimum(ring_len + counts, R))
+
+
 def stop_token_hit(tokens: jnp.ndarray, md: "SamplingMetadata",
                    sub_step) -> jnp.ndarray:
     """[S] bool — did row s's sampled token land in its stop set?

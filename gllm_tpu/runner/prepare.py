@@ -183,7 +183,8 @@ class BatchBuilder:
         mask[np.asarray(host_rows, np.int64)] = True
         return mask
 
-    def stop_sets(self, items, s_bucket: int, eos_token_ids):
+    def stop_sets(self, items, s_bucket: int, eos_token_ids,
+                  absolute: bool = False):
         """On-device finish detection inputs for a fused multi-step
         block: ([S, E] padded per-row EOS/stop-token-id sets, [S] arming
         sub-step) for ``SamplingMetadata.stop_ids`` / ``stop_from``.
@@ -198,6 +199,16 @@ class BatchBuilder:
         (None, None) when no row carries any stop id (e.g. ignore_eos
         benchmarks) — the device program then skips the compare and
         on-device deaths come only from the active_until length bound.
+
+        ``absolute=True`` (fused on-device speculation, whose carried
+        frontier makes sub-step indices meaningless across blocks):
+        ``stop_from`` becomes the ABSOLUTE position threshold
+        ``min_tokens + prompt_len - 2`` — the device arms the check when
+        the emitted token's feed position ``pos + j`` reaches it, which
+        is the same inequality the relative form encodes (legacy:
+        sub-step k at position cb + k armed when k >= mt + prompt - cb
+        - 2 ⟺ cb + k >= mt + prompt - 2). Rows without min_tokens get
+        a large negative threshold (always armed).
         """
         from gllm_tpu.sequence import HOLE_SEQ_ID
         from gllm_tpu.utils import next_pow2
@@ -212,11 +223,15 @@ class BatchBuilder:
             return None, None
         E = max(8, next_pow2(max(len(s) for s in sets)))
         stop_ids = np.full((s_bucket, E), -1, np.int32)
-        stop_from = np.zeros(s_bucket, np.int32)
+        stop_from = np.full(s_bucket, -(1 << 30) if absolute else 0,
+                            np.int32)
         for i, (it, ids) in enumerate(zip(items, sets)):
             stop_ids[i, :len(ids)] = ids
             mt = it.seq.sampling_params.min_tokens
-            if mt:
+            if absolute:
+                stop_from[i] = (mt + it.seq.prompt_len - 2 if mt
+                                else -(1 << 30))
+            elif mt:
                 stop_from[i] = max(0, mt + it.seq.prompt_len
                                    - it.computed_before - 2)
         return stop_ids, stop_from

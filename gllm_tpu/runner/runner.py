@@ -259,6 +259,17 @@ def resolve_kv_quant(config: EngineConfig, model_cfg: ModelConfig):
 
 
 class ModelRunner:
+    # Total runner dispatches (every step path notes exactly one per
+    # device program launched via _note_dispatch) — the denominator-free
+    # half of the dispatches-per-token acceptance metric (bench/tests).
+    # Class default so subclasses sharing _note_dispatch (PPModelRunner)
+    # count too; first increment creates the instance attribute.
+    num_dispatches = 0
+    # PPModelRunner never builds the spec block driver (the engine gates
+    # --spec-fused to pp == dp == 1); class default keeps the attribute
+    # readable there.
+    spec_fused = False
+
     def __init__(self, config: EngineConfig, model_cfg: ModelConfig,
                  params=None, mesh=None):
         self.config = config
@@ -463,8 +474,21 @@ class ModelRunner:
         # layer stack through the same sizing arithmetic
         self._kv_rd_tok_bytes = (self._kv_bytes_per_page()
                                  / config.cache.page_size)
+        # Fused on-device speculation (config.spec_fused,
+        # docs/speculative_decoding.md#fused): draft+verify inside the
+        # multi-step block driver. Gated off hybrid (cumulative SSM
+        # state can't rewind over rejected rows) and multimodal (mrope
+        # extrapolation not threaded through the spec carry); pp/dp
+        # topologies never reach this runner's block path. The engine
+        # mirrors the same gate and warns when the flag goes inert.
+        self.spec_fused = (bool(getattr(config, "spec_fused", False))
+                           and config.spec_decode == "ngram"
+                           and not model_cfg.use_hybrid
+                           and not model_cfg.use_mm)
         self._step_fn = self._build_step_fn()
         self._multi_step_fn = self._build_multi_step_fn()
+        self._spec_multi_fn = (self._build_spec_multi_step_fn()
+                               if self.spec_fused else None)
 
     # ---- setup ------------------------------------------------------------
 
@@ -931,6 +955,7 @@ class ModelRunner:
         compile event on the first sighting of a (padded-shape,
         static-flag) signature. Reads only shapes of already-built host
         arrays — never forces a device sync."""
+        self.num_dispatches += 1
         _M_SAMPLER.inc(program="greedy" if all_greedy else "sampled")
         key = (kind, batch.token_ids.shape,
                batch.attn.page_table.shape) + static_flags
@@ -1418,14 +1443,364 @@ class ModelRunner:
 
         return step_multi
 
+    # ---- fused on-device speculation (config.spec_fused) -------------------
+
+    def _build_spec_multi_step_fn(self):
+        """K draft+verify sub-steps as ONE device program
+        (docs/speculative_decoding.md#fused): each sub-step proposes up
+        to k drafts per row from a carried recent-token ring (vectorized
+        n-gram match — ops/sampling.ngram_propose), feeds the committed
+        token + drafts as a q_len=k+1 verify row through the ragged
+        attention path, accepts on device (greedy cumprod / rejection
+        sampling — the SAME spec_verify the host-driven path uses, keyed
+        by fold_in(seed, out_step)), and advances per-row positions by
+        the variable emitted counts. The carried state (ring, frontier,
+        token budget, AIMD k) crosses block boundaries through the
+        handle, so chained blocks run off ACTUAL device frontiers while
+        the host schedules worst-case upper bounds. Rejected rows' KV
+        writes land at positions the real tokens overwrite later (the
+        host-driven precedent); dead rows freeze on the dummy page."""
+        cfg = self.model_cfg
+        fwd = self.model_def.forward
+        attn_impl = self.fwd_attn_impl
+        page = self.config.cache.page_size
+        ngram_n = self.config.spec_ngram
+
+        from gllm_tpu.models.dense import compute_full_logits
+        from gllm_tpu.ops.sampling import (ngram_propose, ring_shift_in,
+                                           spec_verify)
+
+        @functools.partial(jax.jit,
+                           static_argnames=("num_steps", "k_draft",
+                                            "all_greedy"),
+                           compiler_options=tpu_compiler_options(),
+                           donate_argnums=(1,))
+        def step_spec(params, kv, batch: StepBatch, cos_sin, keys, state,
+                      *, num_steps: int, k_draft: int,
+                      all_greedy: bool = False):
+            ring0, rlen0, last0, pos0, alive0, ostep0, kcur0 = state
+            S = ring0.shape[0]
+            K1 = k_draft + 1
+            iota = jnp.arange(K1, dtype=jnp.int32)[None, :]   # [1, K1]
+            pt_width = batch.attn.page_table.shape[1]
+            cu = jnp.arange(S + 1, dtype=jnp.int32) * K1
+            karr = jnp.arange(k_draft, dtype=jnp.int32)[None, :]
+
+            def substep(kv, ring, rlen, last, pos, alive, ostep, kcur,
+                        key):
+                alive_b = alive > 0
+                # a row may emit at most ``alive`` tokens, so at most
+                # alive-1 drafts are worth verifying (AIMD k_cur caps
+                # further; -1 drafts never accept)
+                allow = jnp.clip(jnp.minimum(kcur, alive - 1), 0,
+                                 k_draft)
+                drafts = ngram_propose(ring, rlen, n=ngram_n, k=k_draft)
+                drafts = jnp.where(karr < allow[:, None], drafts, -1)
+                # what was REALLY proposed (the n-gram may find no match
+                # or a short continuation — valid drafts are a prefix
+                # run): drafted/accepted ACCOUNTING runs on this, like
+                # the host path, where a no-match row proposes nothing
+                # and never counts toward spec_stats / the accept-rate
+                # denominator (a draft-hostile window reads None, not 0)
+                prop = (drafts >= 0).sum(axis=1, dtype=jnp.int32)
+                tok_row = jnp.concatenate(
+                    [last[:, None], jnp.maximum(drafts, 0)], axis=1)
+                # dead rows freeze (position stays, writes → dummy page);
+                # garbage draft rows (past ``allow``) also write dummy —
+                # their positions may exceed the allocated frontier
+                prow = pos[:, None] + jnp.where(alive_b[:, None], iota, 0)
+                write = alive_b[:, None] & (iota <= allow[:, None])
+                pidx = jnp.take_along_axis(
+                    batch.attn.page_table,
+                    jnp.minimum(prow // page, pt_width - 1), axis=1)
+                slots = jnp.where(write, pidx * page + prow % page, 0)
+                kvl = jnp.where(alive_b, pos + 1 + k_draft, K1)
+                md = batch.sampling._replace(
+                    step_key=key,
+                    out_step=ostep if ostep0 is not None else None)
+                b = batch._replace(
+                    token_ids=tok_row.reshape(-1),
+                    positions=prow.reshape(-1),
+                    slot_mapping=slots.reshape(-1),
+                    attn=batch.attn._replace(cu_q_lens=cu, kv_lens=kvl),
+                    sampling=md)
+                hidden, residual, kv = fwd(params, kv, b, cfg,
+                                           cos_sin=cos_sin,
+                                           attn_impl=attn_impl,
+                                           max_q_len=K1)
+                # verify-row logits: T == S*(k+1) exactly, so the full-
+                # position projection IS the verify gather (same size
+                # the host-driven spec_aux materializes)
+                logits = compute_full_logits(params, hidden, residual,
+                                             cfg)
+                tok_mat, accept = spec_verify(
+                    logits.reshape(S, K1, -1), drafts, md,
+                    sampled=not all_greedy)
+                emitted = jnp.minimum(accept + 1, alive)   # 0 when dead
+                hit_any = jnp.zeros(S, bool)
+                if batch.sampling.stop_ids is not None:
+                    # on-device EOS/stop scan over the WHOLE accepted
+                    # run: first hit truncates the emission and kills
+                    # the row (stop_from is the absolute min_tokens
+                    # position threshold — prepare.stop_sets(absolute))
+                    hitm = (tok_mat[:, :, None]
+                            == batch.sampling.stop_ids[:, None, :]
+                            ).any(-1)
+                    armed = ((pos[:, None] + iota)
+                             >= batch.sampling.stop_from[:, None])
+                    hm = hitm & armed & (iota < emitted[:, None])
+                    hit_any = hm.any(axis=1)
+                    first = jnp.argmax(hm, axis=1)
+                    emitted = jnp.where(hit_any, first + 1, emitted)
+                new_last = jnp.take_along_axis(
+                    tok_mat, jnp.maximum(emitted - 1, 0)[:, None],
+                    axis=1)[:, 0]
+                last = jnp.where(emitted > 0, new_last, last)
+                pos = pos + emitted
+                ring, rlen = ring_shift_in(ring, rlen, tok_mat, emitted)
+                alive = jnp.where(hit_any, 0, alive - emitted)
+                if ostep0 is not None:
+                    ostep = ostep + emitted
+                # AIMD: a clean sweep of the ALLOWANCE grows k by one
+                # (cap k_draft), anything less collapses to the accepted
+                # run length. Deliberately stricter than the host rule
+                # (which skips no-proposal rounds): in-loop, a no-match
+                # or short-continuation sub-step is a draft-dry signal —
+                # collapsing k and re-probing via clean sweeps keeps the
+                # tail of a draft-dry stream from fragmenting into
+                # 1-2-token blocks (measured: the dispatch-drop headline
+                # regresses under the host gate)
+                kcur = jnp.where(
+                    (emitted > 0) & (allow > 0),
+                    jnp.where(accept >= allow,
+                              jnp.minimum(kcur + 1, jnp.int32(k_draft)),
+                              jnp.maximum(accept, 1)),
+                    kcur)
+                n_acc = jnp.where(alive_b, jnp.minimum(accept, prop), 0)
+                n_drf = jnp.where(alive_b, prop, 0)
+                return (kv, ring, rlen, last, pos, alive, ostep, kcur,
+                        tok_mat, emitted, n_drf, n_acc)
+
+            out0 = jnp.zeros((num_steps, S, K1), jnp.int32)
+            cnt0 = jnp.zeros((num_steps, S), jnp.int32)
+
+            def cond(carry):
+                alive, k = carry[5], carry[-1]
+                return (k < num_steps) & jnp.any(alive > 0)
+
+            def wbody(carry):
+                (kv, ring, rlen, last, pos, alive, ostep, kcur, out,
+                 counts, drafted, accepted, k) = carry
+                (kv, ring, rlen, last, pos, alive, ostep, kcur, tok_mat,
+                 emitted, n_drf, n_acc) = substep(
+                    kv, ring, rlen, last, pos, alive, ostep, kcur,
+                    keys[k])
+                out = jax.lax.dynamic_update_index_in_dim(
+                    out, tok_mat, k, 0)
+                counts = jax.lax.dynamic_update_index_in_dim(
+                    counts, emitted, k, 0)
+                return (kv, ring, rlen, last, pos, alive, ostep, kcur,
+                        out, counts, drafted + n_drf, accepted + n_acc,
+                        k + 1)
+
+            z = jnp.zeros(S, jnp.int32)
+            (kv, ring, rlen, last, pos, alive, ostep, kcur, out, counts,
+             drafted, accepted, k_exec) = jax.lax.while_loop(
+                cond, wbody,
+                (kv, ring0, rlen0, last0, pos0, alive0, ostep0, kcur0,
+                 out0, cnt0, z, z, jnp.int32(0)))
+            state_out = (ring, rlen, last, pos, alive, ostep, kcur)
+            return out, counts, (drafted, accepted), kcur, state_out, kv
+
+        return step_spec
+
+    # On-device recent-token ring width (per row): bounds the n-gram
+    # lookup window like the host proposer's ``window`` argument —
+    # repetitive/structured output (the regime where prompt-lookup pays)
+    # recurs well inside 128 tokens; [S, R] int32 is a few KB per row.
+    SPEC_RING = 128
+
+    def step_spec_multi(self, chain, prev_handle=None):
+        """Launch K fused draft+verify sub-steps as ONE device program:
+        one dispatch may emit up to K·(spec_k+1) tokens per row. The
+        handle's aux carries the per-sub-step emitted counts (host
+        commit), drafted/accepted totals + final AIMD k (host
+        reconciliation), and — under the ``_``-prefixed key collect
+        skips — the device-resident carry state the NEXT chained block
+        seeds from (actual frontiers; the host's scheduled bounds are
+        upper bounds only)."""
+        K = len(chain)
+        t_enter = time.monotonic()
+        self._apply_swap_intents()
+        keys = _fold_in_range(self.rng_key, self._step_count + 1, k=K)
+        self._step_count += K
+        sig = self.builder.shape_signature(chain[-1])
+        batch, _, token_counts = self.builder.build(chain[0], keys[0],
+                                                    force_signature=sig)
+        assert token_counts is None, "penalties never reach spec chains"
+        assert all(it.num_new_tokens == 1 for it in chain[0].items)
+        k_draft = self.config.spec_k
+        s_bucket = batch.attn.page_table.shape[0]
+        n = chain[0].num_seqs
+        au_np = np.zeros(s_bucket, np.int32)
+        au_np[:n] = chain[0].active_until    # token budgets (spec chain)
+        e_bucket = 0
+        if self.config.ondevice_finish:
+            stop_ids, stop_from = self.builder.stop_sets(
+                chain[0].items, s_bucket, self.eos_token_ids,
+                absolute=True)
+            if stop_ids is not None:
+                e_bucket = stop_ids.shape[1]
+                batch = batch._replace(sampling=batch.sampling._replace(
+                    stop_ids=jnp.asarray(stop_ids),
+                    stop_from=jnp.asarray(stop_from)))
+        state = self._spec_seed_state(batch, chain[0], au_np,
+                                      prev_handle)
+        all_greedy = _all_greedy(chain[0].items)
+        self._note_kv_read(chain[0].items, steps=K)
+        self._note_dispatch("spec_block", batch,
+                            (K, k_draft, all_greedy, e_bucket),
+                            all_greedy)
+        t_build = time.monotonic()
+        from gllm_tpu.parallel.mesh import mesh_context
+        with mesh_context(self.mesh):
+            tokens, counts, totals, kcur, state_out, self.kv = \
+                self._spec_multi_fn(self.params, self.kv, batch,
+                                    self.cos_sin, keys, state,
+                                    num_steps=K, k_draft=k_draft,
+                                    all_greedy=all_greedy)
+        aux = {"spec_counts": (counts,), "spec_totals": totals,
+               "spec_kcur": (kcur,), "_spec_state": state_out}
+        _start_host_copy((tokens, {k: v for k, v in aux.items()
+                                   if not k.startswith("_")}))
+        self.last_phases = {"build": t_build - t_enter,
+                            "dispatch": time.monotonic() - t_build,
+                            "kv_bytes": self._last_kv_read}
+        return tokens, aux, n
+
+    def _spec_seed_state(self, batch: StepBatch, sched0, au_np,
+                         prev_handle):
+        """Carry state for a spec block: (ring, ring_len, last_tok, pos,
+        alive, out_step, k_cur), each [S_bucket].
+
+        Seeding discipline (docs/speculative_decoding.md#fused): rows
+        whose link-0 token is HOST-known (chain roots, slot joins) seed
+        fully from committed ``token_ids``; rows chaining off a sync
+        single-step splice the previous entry's on-device sampled token
+        into the ring tail; rows chaining off a previous SPEC block
+        carry its device state wholesale (the actual frontier — the
+        host's scheduled bounds stay upper bounds). HOLE rows and rows
+        the host has since finished are forced dead (alive 0)."""
+        from gllm_tpu.sequence import HOLE_SEQ_ID, SequenceStatus
+        R = self.SPEC_RING
+        items = sched0.items
+        s_bucket = au_np.shape[0]
+        n = len(items)
+        ring = np.full((s_bucket, R), -1, np.int32)
+        rlen = np.zeros(s_bucket, np.int32)
+        last = np.zeros(s_bucket, np.int32)
+        pos = np.zeros(s_bucket, np.int32)
+        seeded = batch.sampling.out_step is not None
+        ostep = np.zeros(s_bucket, np.int32) if seeded else None
+        kcur = np.ones(s_bucket, np.int32)
+        host_known = np.ones(s_bucket, bool)
+        dead = np.zeros(s_bucket, bool)
+        join_rows = set(sched0.host_rows or ())
+        for i, it in enumerate(items):
+            seq = it.seq
+            if (seq.seq_id == HOLE_SEQ_ID
+                    or seq.status is not SequenceStatus.RUNNING):
+                dead[i] = True
+                continue
+            cb = it.computed_before
+            toks = seq.token_ids
+            kcur[i] = min(getattr(seq, "spec_k_cur", None)
+                          or self.config.spec_k, self.config.spec_k)
+            if seeded and seq.sampling_params.seed is not None:
+                ostep[i] = cb + 1 - seq.prompt_len
+            pos[i] = cb
+            if cb < seq.num_tokens:
+                # fully host-known (root / join): ring covers tokens
+                # [0, cb] INCLUDING the link-0 input token
+                tail = toks[max(0, cb + 1 - R):cb + 1]
+                last[i] = toks[cb]
+            else:
+                # the link-0 token is the previous entry's on-device
+                # sample — ring holds everything committed; the splice
+                # below appends the device token
+                tail = toks[max(0, len(toks) - R):]
+                host_known[i] = False
+            ring[i, R - len(tail):] = tail
+            rlen[i] = len(tail)
+        dead[n:] = True
+        alive = np.where(dead, 0, au_np).astype(np.int32)
+        prev_state = None
+        prev_tokens = None
+        if prev_handle is not None:
+            prev_aux = prev_handle[1] or {}
+            prev_state = prev_aux.get("_spec_state")
+            if prev_state is None:
+                prev_tokens = prev_handle[0]
+
+        from gllm_tpu.ops.sampling import ring_shift_in
+        ring = jnp.asarray(ring)
+        rlen = jnp.asarray(rlen)
+        last = jnp.asarray(last)
+        pos = jnp.asarray(pos)
+        alive = jnp.asarray(alive)
+        ostep_j = jnp.asarray(ostep) if seeded else None
+        kcur = jnp.asarray(kcur)
+        if prev_state is not None:
+            # chained off a previous spec block: carry its device state;
+            # joins/holes re-seed from the host arrays built above
+            (ring_c, rlen_c, last_c, pos_c, alive_c, ostep_c,
+             kcur_c) = prev_state
+            assert ring_c.shape[0] == s_bucket, \
+                (ring_c.shape, s_bucket)    # identity membership
+            reseed = np.zeros(s_bucket, bool)
+            for i in sorted(join_rows):
+                reseed[i] = True
+            rs = jnp.asarray(reseed)
+            rs2 = rs[:, None]
+            dd = jnp.asarray(dead)
+            ring = jnp.where(rs2, ring, ring_c)
+            rlen = jnp.where(rs, rlen, rlen_c)
+            last = jnp.where(rs, last, last_c)
+            pos = jnp.where(rs, pos, pos_c)
+            alive = jnp.where(dd, 0, jnp.where(rs, alive, alive_c))
+            kcur = jnp.where(rs, kcur, kcur_c)
+            if seeded:
+                ostep_j = (jnp.where(rs, ostep_j, ostep_c)
+                           if ostep_c is not None else ostep_j)
+        elif prev_tokens is not None:
+            # chained off a sync single step: splice its on-device
+            # sampled token as the ring tail + link-0 input for every
+            # row the host doesn't know (shift-in count 0 = identity)
+            pt = prev_tokens[-1] if prev_tokens.ndim == 2 else prev_tokens
+            pt = jnp.asarray(pt).astype(jnp.int32)
+            assert pt.shape[0] == s_bucket, (pt.shape, s_bucket)
+            hk = jnp.asarray(host_known)
+            cnt = jnp.where(hk, 0, 1).astype(jnp.int32)
+            ring, rlen = ring_shift_in(ring, rlen, pt[:, None], cnt)
+            last = jnp.where(hk, last, pt)
+        else:
+            assert host_known[:n].all(), \
+                "spec chain root with device-only tokens but no handle"
+        return (ring, rlen, last, pos, alive, ostep_j, kcur)
+
     def collect(self, handle):
-        """(sampled tokens [n] or [K, n], aux dict of host arrays)."""
+        """(sampled tokens [n] / [K, n] / [K, n, k+1], aux dict of host
+        arrays). Aux keys starting with ``_`` are device-resident carry
+        state (fused speculation) — never fetched to host here; the next
+        chained dispatch consumes them directly."""
         tokens, aux, n = handle
         out_aux = {}
         if aux:
             out_aux = {k: tuple(_to_host(a) for a in v)
-                       for k, v in aux.items()}
+                       for k, v in aux.items() if not k.startswith("_")}
         host = _to_host(tokens)
+        if host.ndim == 3:              # spec block: [K, S, k+1]
+            return host[:, :n, :], out_aux
         return (host[..., :n] if host.ndim == 2 else host[:n]), out_aux
 
     def step(self, sched_batch: ScheduledBatch) -> np.ndarray:

@@ -153,6 +153,14 @@ class ScheduledBatch:
     # decode-ready seq). None = not a re-formed batch (chains use the
     # identity mapping + host_rows instead).
     src_rows: Optional[List[int]] = None
+    # Fused on-device speculation (config.spec_fused): this chain link
+    # belongs to a spec block — the runner runs the draft+verify block
+    # driver, ``active_until`` is a per-row TOKEN budget (not a link
+    # count), and per-link ``computed_before`` values are worst-case
+    # UPPER bounds (each sub-step may emit up to spec_k+1 tokens) that
+    # the collect fixes up from the actual accepted counts
+    # (FutureMap.trim_overpromise trims in-flight descendants).
+    spec_block: bool = False
 
     @property
     def num_seqs(self) -> int:
@@ -213,6 +221,13 @@ class Scheduler:
         # hybrid GDN via SSM snapshot-rollback); None disables proposals
         self.spec_cfg = None
         self.spec_stats = {"proposed": 0, "accepted": 0}
+        # Fused on-device speculation (config.spec_fused; set by the
+        # engine after gating inert topologies): host-side drafting is
+        # disabled — the runner drafts from an on-device recent-token
+        # ring inside fused blocks — and schedule_chain accepts
+        # spec-eligible rows instead of refusing with reason="spec"
+        # (that break class is retired under the flag).
+        self.spec_fused = False
         # Persistent-slot decode batching (config.decode_slot_batching):
         # shared dead-row sentinel for holes, the seq-bucket cap the
         # compaction check shares with BatchBuilder.max_seqs, and the
@@ -447,7 +462,9 @@ class Scheduler:
         trims over-committed tokens, so a draft run can overshoot by at
         most the (small) cap without the client ever seeing past the
         match."""
-        if self.spec_cfg is None:
+        if self.spec_cfg is None or self.spec_fused:
+            # fused mode moves drafting ON DEVICE (the block driver's
+            # n-gram ring) — sync decode steps run plain and root chains
             return ()
         sp = seq.sampling_params
         n, k = self.spec_cfg
@@ -598,7 +615,8 @@ class Scheduler:
             self.waiting.appendleft(seq)
 
     def schedule_chain(self, prev: ScheduledBatch, k_max: int,
-                       include_prev: bool = False) -> List[ScheduledBatch]:
+                       include_prev: bool = False,
+                       spec_mult: int = 1) -> List[ScheduledBatch]:
         """Atomically schedule up to ``k_max`` chained decode steps off
         ``prev``, before ``prev``'s sampled tokens have reached the host.
 
@@ -626,17 +644,33 @@ class Scheduler:
         decode-ready sequences join vacant holes at this boundary (their
         link-0 token comes from the host — ``host_rows``); the chain
         only re-forms when live occupancy drops below the seq bucket
-        (compaction) or ready sequences can't fit the current slots."""
+        (compaction) or ready sequences can't fit the current slots.
+
+        FUSED SPECULATION (config.spec_fused; ``spec_mult`` =
+        spec_k + 1 > 1): every chain link becomes a draft+verify
+        sub-step that may emit up to ``spec_mult`` tokens, so the
+        accounting moves to TOKEN units — ``deaths`` (already computed
+        in tokens) become per-row budgets carried as ``active_until``,
+        page allocation covers the worst-case frontier
+        cn0 + min(links·spec_mult, budget), and per-link
+        ``computed_before`` values are upper bounds the collect trims to
+        actual accepted counts. The device carries the ACTUAL frontier
+        across blocks (the spec state in the handle), so the host's
+        conservative bounds only steer allocation and break decisions —
+        never token content."""
         self.chain_break_reason = None
-        if self.spec_cfg is not None:
-            # Speculation and chaining are competing dispatch-hiding
-            # mechanisms, and drafting needs the committed token VALUES
-            # (prompt-lookup over token_ids) which a chained step leaves
-            # on device — so when spec is on it owns decode dispatch:
-            # every decode schedules synchronously with drafts, each
-            # accepted draft removing a dispatch round trip the chain
-            # would have hidden.
+        if self.spec_cfg is not None and not self.spec_fused:
+            # Host-driven speculation and chaining are competing
+            # dispatch-hiding mechanisms, and host drafting needs the
+            # committed token VALUES (prompt-lookup over token_ids)
+            # which a chained step leaves on device — so when spec is on
+            # WITHOUT the fused path it owns decode dispatch: every
+            # decode schedules synchronously with drafts. Under
+            # config.spec_fused drafting happens on device and this
+            # break class is retired.
             return self._chain_fail("spec")
+        spec = self.spec_fused and spec_mult > 1
+        mult = spec_mult if spec else 1
         slots = self.config.decode_slot_batching
         base: List[Tuple[Sequence, int]] = []
         hole_rows: List[int] = []
@@ -685,7 +719,15 @@ class Scheduler:
             if (sp.repetition_penalty != 1.0 or sp.presence_penalty != 0.0
                     or sp.frequency_penalty != 0.0):
                 return self._chain_fail("shape")  # host-built counts
-            base.append((seq, it.computed_before + it.num_new_tokens))
+            cn0 = it.computed_before + it.num_new_tokens
+            if spec and prev.spec_block:
+                # ``prev``'s last sub-step may itself emit up to ``mult``
+                # tokens (its computed_before is already the block's
+                # upper-bound base) — the new block's base frontier must
+                # cover that worst case; the device carries the actual
+                # frontier, so this only steers allocation/feasibility
+                cn0 += mult - 1
+            base.append((seq, cn0))
         host_rows: List[int] = []
         if slots:
             host_rows = self._join_ready_into_holes(base, hole_rows)
@@ -727,6 +769,11 @@ class Scheduler:
             return self._chain_fail("finish")
         if slots and max(deaths) < 1:
             return self._chain_fail("shape")  # nothing can take a link
+        # Fused speculation: each link may emit up to ``mult`` tokens,
+        # so pages must cover the worst-case frontier; with include_prev
+        # the sync batch rides as the block's first sub-step and may
+        # itself emit mult tokens before link 0 runs (extra headroom).
+        extra = (mult - 1) if (spec and include_prev) else 0
         feasible = 0
         while feasible < min(k_max, max(deaths)):
             j = feasible
@@ -735,7 +782,7 @@ class Scheduler:
             # near a full pool yet exhaust it mid-allocation. Dead links
             # allocate nothing.
             need_cum = sum(
-                max(0, cdiv(cn0 + min(j + 1, d), page)
+                max(0, cdiv(cn0 + min((j + 1) * mult + extra, d), page)
                     - len(seq.page_table))
                 for (seq, cn0), d in zip(base, deaths))
             if not self.mm.can_allocate(need_cum):
@@ -757,19 +804,40 @@ class Scheduler:
         for j in range(k):
             # dead links freeze computed_before at the death position —
             # the NEXT chain attempt off this batch then fails the
-            # link-0 gate above, forcing the sync re-form
-            items = [ScheduledSeq(seq, 1, cn0 + min(j, d))
+            # link-0 gate above, forcing the sync re-form. Spec blocks
+            # stride the (upper-bound) frontier by mult per link,
+            # clamped under max_model_len: a frozen upper bound at the
+            # model-length cap would overflow the page bucket (the
+            # shape-signature prices computed_before + 1), and the
+            # collect re-anchors on committed state anyway.
+            mml1 = self.config.max_model_len - 1
+            items = [ScheduledSeq(seq, 1,
+                                  min(cn0 + min(j * mult, d), mml1)
+                                  if spec else cn0 + min(j, d))
                      for (seq, cn0), d in zip(base, deaths)]
-            for it, ((seq, _), d) in zip(items, zip(base, deaths)):
-                if j < d:
-                    # cover tokens [0, computed_before+1) —
+            for it, ((seq, cn0), d) in zip(items, zip(base, deaths)):
+                if j * mult < d:
+                    # cover tokens [0, worst-case frontier) —
                     # num_computed_tokens hasn't advanced yet (prev is
-                    # still in flight)
-                    cover = it.computed_before + 1 - seq.num_computed_tokens
+                    # still in flight); a table longer than the actual
+                    # emission needs is legal (spec-decode precedent)
+                    cover = (cn0 + min((j + 1) * mult + extra, d)
+                             - seq.num_computed_tokens)
                     self.mm.allocate_seq_pages(seq, cover)
                 seq.num_in_flight += 1
-            chain.append(ScheduledBatch(items))
-        if any(d < k for d in deaths) or host_rows:
+            chain.append(ScheduledBatch(items, spec_block=spec))
+        if spec:
+            # active_until carries the per-row TOKEN budget (the device
+            # seeds its carried alive count from it at chain root; holes
+            # and joins re-seed from it mid-chain) — always attached,
+            # and NEVER capped at the block's worst-case emission: the
+            # budget is carried ACROSS blocks (the while_loop bounds one
+            # block's sub-steps; the budget bounds the sequence)
+            chain[0] = dataclasses.replace(
+                chain[0],
+                active_until=[max(d, 0) for d in deaths],
+                host_rows=host_rows or None, spec_block=True)
+        elif any(d < k for d in deaths) or host_rows:
             chain[0] = dataclasses.replace(
                 chain[0],
                 active_until=([min(d, k) for d in deaths]

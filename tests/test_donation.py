@@ -138,10 +138,11 @@ def test_no_donation_warnings_on_hot_path(model_cfg):
 
 
 def test_all_dispatch_paths_declare_kv_donation():
-    """Source guard: the four jitted dispatch paths — runner.py's step /
-    step_dp / step_multi and pp_runner.py's stage fn — must declare
-    ``donate_argnums=(1,)`` (kv is argument 1 on each). Source scan so
-    the pp path is audited without building a pipeline on CPU."""
+    """Source guard: the five jitted dispatch paths — runner.py's step /
+    step_dp / step_multi / step_spec (fused speculation) and
+    pp_runner.py's stage fn — must declare ``donate_argnums=(1,)`` (kv
+    is argument 1 on each). Source scan so the pp path is audited
+    without building a pipeline on CPU."""
     import os
     root = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "gllm_tpu", "runner")
@@ -162,7 +163,7 @@ def test_all_dispatch_paths_declare_kv_donation():
         return found
 
     runner = jit_sites(os.path.join(root, "runner.py"),
-                       ["step", "step_dp", "step_multi"])
+                       ["step", "step_dp", "step_multi", "step_spec"])
     pp = jit_sites(os.path.join(root, "pp_runner.py"), ["stage"])
     missing = [n for n, ok in {**runner, **pp}.items() if not ok]
     assert not missing, f"dispatch paths without kv donation: {missing}"

@@ -199,6 +199,13 @@ def test_sweep_bodies_close_over_no_buffers():
     for name, run, args in (("ragged", run_r, args_r),
                             ("decode", run_d, args_d),
                             ("unified", run_u, args_u)):
+        # the unified workload's token axis must include the verify
+        # class (fused-speculation q_len=spec_k+1 rows priced by the
+        # sweep — ISSUE 13); structural check rides the closure trace
+        if name == "unified":
+            nd, nv, chunks = 8, 4, (32, 32)   # shrink "balanced"
+            assert args[0].shape[0] == (nd + nv * kt.VERIFY_Q
+                                        + sum(chunks)), args[0].shape
         # the caches must be in the argument list...
         assert len(args) == 3, name
         # ...and nothing buffer-sized may ride the jaxpr as a constant
