@@ -1002,6 +1002,99 @@ def main():
             f"disk={arms['disk']['ttft_p50_s']} vs "
             f"no_tier={arms['no_tier']['ttft_p50_s']}")
 
+    # Self-healing chaos lever (ISSUE 14, GLLM_BENCH_CHAOS=1): the
+    # recovery acceptance run inside bench — a ServingEngine with
+    # --engine-recovery serves the same greedy workload twice (a clean
+    # arm, then an arm with an injected engine_hard_crash mid-pass), and
+    # throughput degradation + recovery_s land FIRST-CLASS in the result
+    # JSON. Greedy + ignore_eos makes every request replay-safe, so the
+    # faulted arm must still emit every token (asserted) — the cost of
+    # the crash shows up as wall clock, never as lost output.
+    chaos_result = None
+    if os.environ.get("GLLM_BENCH_CHAOS", "0") not in ("", "0"):
+        phase("chaos_pass")
+        import dataclasses as _dc
+        import threading as _th
+        from gllm_tpu.engine.serving_engine import ServingEngine
+        from gllm_tpu.faults import FAULTS
+        from gllm_tpu.sampling_params import SamplingParams
+        ch_cfg = _dc.replace(engine_cfg, engine_recovery=True,
+                             max_step_failures=1,
+                             rebuild_backoff_s=0.05,
+                             rebuild_backoff_max_s=1.0)
+        n_chaos = min(n_requests, 8 if args.tiny else 32)
+        ch_prompts = [list(p) for p in prompts[:n_chaos]]
+        ch_tokens = [min(p.max_tokens, 64) for p in params[:n_chaos]]
+
+        def chaos_arm(fault_delay_s=None):
+            llm_c = LLM(config=ch_cfg, model_cfg=model_cfg)
+            eng = ServingEngine(llm_c)
+            counts = [0] * n_chaos
+            timer = None
+            try:
+                if fault_delay_s is not None:
+                    # time-based so the crash lands MID-pass on every
+                    # profile (a fused engine drains the workload in
+                    # too few loop passes for pass-counting to work)
+                    timer = _th.Timer(
+                        fault_delay_s,
+                        lambda: FAULTS.arm("engine_hard_crash:0:1"))
+                    timer.daemon = True
+                    timer.start()
+                t0 = time.monotonic()
+                handles = [eng.submit(p, SamplingParams(
+                    temperature=0.0, max_tokens=mt, ignore_eos=True))
+                    for p, mt in zip(ch_prompts, ch_tokens)]
+
+                def drain(i, h):
+                    for c in h:
+                        if c.token_id is not None:
+                            counts[i] += 1
+
+                ts = [_th.Thread(target=drain, args=(i, h), daemon=True)
+                      for i, h in enumerate(handles)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=600)
+                    assert not t.is_alive(), "chaos-arm stream hung"
+                dt_arm = time.monotonic() - t0
+            finally:
+                if timer is not None:
+                    timer.cancel()
+                FAULTS.reset()
+                eng.shutdown()
+            sup = eng.supervisor
+            return {"tok": sum(counts), "dt": dt_arm,
+                    "recoveries": sup.recoveries if sup else 0,
+                    "recovery_s": (sup.last_recovery_s
+                                   if sup else None)}
+
+        clean = chaos_arm(None)
+        # the crash lands ~40% into the measured window (sized off the
+        # clean arm), mid-stream on every profile
+        faulted = chaos_arm(max(0.02, 0.4 * clean["dt"]))
+        assert faulted["tok"] == clean["tok"], (
+            "recovery dropped tokens: the greedy replay-safe workload "
+            f"must re-emit every token ({faulted['tok']} vs "
+            f"{clean['tok']})")
+        tps_clean = clean["tok"] / clean["dt"]
+        tps_fault = faulted["tok"] / faulted["dt"]
+        chaos_result = {
+            "requests": n_chaos,
+            "output_tok_s": round(tps_fault, 2),
+            "output_tok_s_clean": round(tps_clean, 2),
+            "degradation_frac": round(1.0 - tps_fault / tps_clean, 4),
+            "recoveries": faulted["recoveries"],
+            "recovery_s": (round(faulted["recovery_s"], 3)
+                           if faulted["recovery_s"] is not None
+                           else None),
+        }
+        log(f"chaos pass: {tps_clean:.1f} tok/s clean -> "
+            f"{tps_fault:.1f} tok/s under an injected hard crash "
+            f"({faulted['recoveries']} recoveries, recovery_s="
+            f"{chaos_result['recovery_s']})")
+
     phase("report")
     # MFU: every processed token (prompt + output) makes one forward pass.
     total_proc = total_in + total_out
@@ -1084,6 +1177,11 @@ def main():
         # full recompute — first-class so the trajectory tracks it
         result["prefix"] = prefix_result
         result["prefix_tiers"] = True
+    if chaos_result is not None:
+        # self-healing recovery (ISSUE 14, GLLM_BENCH_CHAOS=1): serving
+        # throughput under an injected hard crash vs clean, and the
+        # latch-to-ready recovery wall — first-class
+        result["chaos"] = chaos_result
     print(json.dumps(result))
 
 

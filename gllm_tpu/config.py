@@ -299,6 +299,33 @@ class EngineConfig:
     # shutdown(drain=True): how long to wait for in-flight requests
     # before aborting them with terminal chunks.
     drain_timeout_s: float = 5.0
+    # ---- self-healing recovery (docs/robustness.md#recovery-lifecycle) ----
+    # Supervised in-process rebuild (--engine-recovery): when the
+    # unhealthy latch fires (max_step_failures consecutive failures, an
+    # engine-loop death, or a watchdog HARD stall), an EngineSupervisor
+    # tears the engine down and rebuilds it in-process — /readyz reports
+    # "recovering" with Retry-After, journaled retry-safe requests
+    # (seeded or greedy) replay onto the rebuilt engine and continue
+    # from their committed prefix, and the rebuilt engine warms from the
+    # disk prefix tier + the persistent compile cache. False = today's
+    # one-way latch (permanent unhealthy until process restart).
+    engine_recovery: bool = False
+    # Crash-loop latch: this many FAILED rebuild attempts within
+    # rebuild_window_s seconds latch the permanent unhealthy state (the
+    # pre-recovery behavior is the bounded fallback — never an infinite
+    # rebuild loop).
+    max_rebuilds: int = 3
+    rebuild_window_s: float = 300.0
+    # Exponential backoff between rebuild attempts: first retry waits
+    # rebuild_backoff_s, doubling per failure, capped at
+    # rebuild_backoff_max_s. (The first attempt runs immediately.)
+    rebuild_backoff_s: float = 0.25
+    rebuild_backoff_max_s: float = 30.0
+    # Watchdog HARD stall: a heartbeat older than this abandons the
+    # wedged engine thread and triggers the supervised rebuild (the soft
+    # watchdog_stall_s threshold only flips readiness). Requires
+    # engine_recovery and a running watchdog; 0 = soft flips only.
+    watchdog_hard_stall_s: float = 0.0
     # Deterministic fault injection spec (gllm_tpu/faults.py grammar:
     # "point[:after_n[:count]][,...]"), armed when the serving engine
     # starts; also armable via GLLM_FAULT_INJECT. Empty = disarmed.
@@ -459,6 +486,28 @@ class EngineConfig:
             raise ValueError("robustness timeouts must be >= 0")
         if self.max_step_failures < 1:
             raise ValueError("max_step_failures must be >= 1")
+        if self.max_rebuilds < 1:
+            raise ValueError("max_rebuilds must be >= 1")
+        if self.rebuild_window_s <= 0 or self.rebuild_backoff_s < 0 \
+                or self.rebuild_backoff_max_s < self.rebuild_backoff_s:
+            raise ValueError(
+                "rebuild_window_s must be > 0 and 0 <= rebuild_backoff_s "
+                "<= rebuild_backoff_max_s")
+        if self.watchdog_hard_stall_s < 0:
+            raise ValueError("watchdog_hard_stall_s must be >= 0")
+        if self.watchdog_hard_stall_s > 0:
+            if not self.engine_recovery:
+                raise ValueError(
+                    "watchdog_hard_stall_s needs --engine-recovery (the "
+                    "hard-stall escalation IS a supervised rebuild)")
+            if self.watchdog_stall_s <= 0:
+                raise ValueError(
+                    "watchdog_hard_stall_s needs --watchdog-stall-s > 0 "
+                    "(the watchdog thread detects the stall)")
+            if self.watchdog_hard_stall_s < self.watchdog_stall_s:
+                raise ValueError(
+                    "watchdog_hard_stall_s must be >= watchdog_stall_s "
+                    "(soft flip first, then the hard escalation)")
         if self.fault_inject:
             # fail fast on a bad spec instead of at first fire
             from gllm_tpu.faults import FaultInjector

@@ -490,6 +490,24 @@ class LLM:
         mm._seq_chain.clear()
         return moved
 
+    def close(self) -> None:
+        """Release the resources a SUCCESSOR engine needs to re-adopt
+        (docs/robustness.md#recovery-lifecycle): stop serving prefix
+        peers and drain pending disk writes — the serve port frees for
+        the rebuilt engine and the disk tier's content-addressed pages
+        survive for its construction-time adoption. Device buffers are
+        NOT touched here (a wedged dispatch may still hold them); they
+        free with the object. Idempotent."""
+        tiers = getattr(self, "prefix_tiers", None)
+        self.prefix_tiers = None
+        if self.swap_manager is not None:
+            self.swap_manager.tiers = None
+        if tiers is not None:
+            try:
+                tiers.close()
+            except Exception:  # pragma: no cover - teardown must finish
+                logger.exception("prefix tier close failed")
+
     def init_disagg(self, disagg_cfg) -> None:
         """Become a disagg LM node: start the coordinator (slot pool,
         discovery, meta server). Reference Worker._maybe_init_disagg."""

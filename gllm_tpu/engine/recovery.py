@@ -1,0 +1,360 @@
+"""Self-healing engine: supervised in-process recovery + request replay.
+
+The reference gLLM column drivers assume *process* supervision — a
+crashed worker is killed and restarted from outside, and its peers
+re-queue. PR 7 gave the single-controller engine the first half of that
+model (quarantine → latched unhealthy, clean handoff), but no supervisor
+exists in-tree, so a latched replica stays a brick until a human
+restarts the process. This module is the missing supervisor, moved
+in-process where it can exploit two things an external restart cannot:
+
+- the warm lower tiers survive the rebuild for free — the disk prefix
+  tier re-adopts its pages at construction (kvstore/disk.py) and the
+  persistent XLA compilation cache replays every compiled program
+  (engine/llm.py), so a rebuilt engine is seconds from serving, not
+  minutes;
+- the request streams survive too: every accepted request journals its
+  immutable submission (prompt / sampling params / seed) plus the
+  output tokens actually DELIVERED to its stream, so retry-safe
+  requests (seeded or greedy) resubmit onto the rebuilt engine and
+  continue from their committed prefix — the stream the client holds
+  never drops a token and never hangs.
+
+Three pieces:
+
+``RequestJournal``
+    Per-open-request log of the immutable submission + committed output
+    token ids (appended as chunks are DELIVERED, i.e. at collect — a
+    token computed but never collected is not committed). Bounded by
+    the number of resident requests; entries drop at finish.
+
+``JournalEntry.unsafe_reason``
+    The replay-safety rule (docs/robustness.md#recovery-lifecycle):
+    a request replays iff its continuation is deterministic from the
+    committed prefix — greedy (argmax) or seeded (per-row sampling keys
+    are a pure function of ``(seed, out_step)``, and replay preserves
+    ``out_step`` by re-submitting ``prompt + committed`` with the
+    ORIGINAL prompt_len). Unseeded sampled requests fold the engine
+    step key (restarts with the runner) → unsafe. Multimodal / disagg
+    state is not journaled → unsafe. Stop strings / prompt_logprobs
+    carry detok-boundary state → unsafe (conservative). A partial
+    tool-call delta already streamed vetoes replay via
+    ``RequestHandle.replay_safe`` (the api_server clears it).
+
+``EngineSupervisor``
+    Owns the rebuild ladder on its own thread: trigger → tear down the
+    old engine (quarantine + tier close; a WEDGED engine thread is
+    abandoned behind a generation bump) → factory() a replacement with
+    bounded exponential backoff (``rebuild_fail`` injectable) → replay
+    the journal → flip /readyz back to ready. K failed rebuilds within
+    ``rebuild_window_s`` latch the CRASH-LOOP state — today's permanent
+    unhealthy is the bounded fallback, never an infinite rebuild loop.
+
+No jax imports: host bookkeeping only.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import logging
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from gllm_tpu import faults
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.obs.steptrace import TRACE
+
+logger = logging.getLogger(__name__)
+
+_M_REBUILDS = obs.counter(
+    "gllm_engine_rebuilds_total",
+    "supervised in-process engine rebuild attempts by outcome "
+    "(ok|fail)", ("outcome",))
+_M_RECOVERY_S = obs.histogram(
+    "gllm_engine_recovery_seconds",
+    "latch-to-ready wall time of a supervised in-process recovery")
+_M_REPLAYED = obs.counter(
+    "gllm_requests_replayed_total",
+    "journaled requests at recovery by outcome (replayed = resubmitted "
+    "onto the rebuilt engine; unsafe = terminal error chunk with "
+    "Retry-After; expired = deadline passed during the rebuild; "
+    "aborted = client went away mid-recovery)", ("outcome",))
+_M_RECOVERING = obs.gauge(
+    "gllm_engine_recovering",
+    "1 while a supervised rebuild is in progress (/readyz 503 "
+    "'recovering'); 0 otherwise")
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """Immutable submission + committed-delivery state of one open
+    request. ``committed`` holds the output token ids whose chunks were
+    DELIVERED to the stream; replay resubmits ``prompt + committed``
+    with the original prompt_len so max_tokens / min_tokens / penalties
+    / seeded out_step all continue exactly where the stream stopped."""
+
+    seq_id: int
+    prompt: Tuple[int, ...]
+    sampling: object                       # SamplingParams deep copy
+    mm: bool = False
+    disagg: bool = False
+    target_dp: Optional[int] = None
+    committed: List[int] = dataclasses.field(default_factory=list)
+    # filled at recovery-partition time
+    handle: object = None
+    deadline: Optional[float] = None       # absolute monotonic
+    aborted: bool = False                  # client left mid-recovery
+
+    def unsafe_reason(self) -> Optional[str]:
+        """None = retry-safe; otherwise why the request cannot replay
+        with a byte-identical continuation."""
+        sp = self.sampling
+        if self.mm:
+            return "multimodal state is not journaled"
+        if self.disagg:
+            return "disagg requests are not journaled"
+        if not (sp.temperature == 0.0 or sp.seed is not None):
+            return ("unseeded sampling folds the engine step key — the "
+                    "continuation is not deterministic across a rebuild")
+        if sp.stop:
+            return "stop strings may span the crash boundary"
+        if sp.prompt_logprobs is not None:
+            return "prompt logprobs are not journaled"
+        h = self.handle
+        if h is not None and not getattr(h, "replay_safe", True):
+            return "a partial tool-call stream was already delivered"
+        return None
+
+
+class RequestJournal:
+    """Thread-safe seq_id → JournalEntry map. Writes come from the
+    submit path (record) and the engine thread's delivery loop
+    (commit); the supervisor snapshots + rebinds at recovery."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[int, JournalEntry] = {}
+
+    def record(self, seq_id: int, token_ids, sampling_params, *,
+               mm: bool = False, disagg: bool = False,
+               target_dp: Optional[int] = None) -> None:
+        entry = JournalEntry(
+            seq_id=seq_id, prompt=tuple(int(t) for t in token_ids),
+            sampling=copy.deepcopy(sampling_params), mm=mm,
+            disagg=disagg, target_dp=target_dp)
+        with self._lock:
+            self._entries[seq_id] = entry
+
+    def commit(self, seq_id: int, token_id: int) -> None:
+        with self._lock:
+            e = self._entries.get(seq_id)
+            if e is not None:
+                e.committed.append(int(token_id))
+
+    def pop(self, seq_id: int) -> Optional[JournalEntry]:
+        with self._lock:
+            return self._entries.pop(seq_id, None)
+
+    def adopt(self, new_seq_id: int, entry: JournalEntry) -> None:
+        """Re-key a replayed entry under its rebuilt-engine seq id so a
+        SECOND crash replays the same request again (committed tokens
+        accumulated so far included)."""
+        entry.seq_id = new_seq_id
+        with self._lock:
+            self._entries[new_seq_id] = entry
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class EngineSupervisor:
+    """In-process analogue of the external process supervisor the
+    reference design assumes. One per ServingEngine; owns the rebuild
+    thread and the crash-loop accounting."""
+
+    def __init__(self, serving, factory: Callable[[], object], *,
+                 max_rebuilds: int = 3, rebuild_window_s: float = 300.0,
+                 backoff_s: float = 0.25, backoff_max_s: float = 30.0):
+        self.serving = serving
+        self.factory = factory
+        self.max_rebuilds = max(1, int(max_rebuilds))
+        self.rebuild_window_s = float(rebuild_window_s)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.rebuilds_ok = 0
+        self.rebuilds_failed = 0
+        self.recoveries = 0
+        self.last_recovery_s: Optional[float] = None
+        self._fail_times: deque = deque()     # monotonic failed-rebuild
+        self._recovery_times: deque = deque()  # monotonic completed
+        self._consecutive_fails = 0
+        self._trigger = threading.Event()
+        self._why = ("", "")
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="gllm-supervisor")
+        self._thread.start()
+
+    # ---- crash-loop accounting (any thread) -------------------------------
+
+    def _recent(self, dq: deque) -> int:
+        now = time.monotonic()
+        while dq and now - dq[0] > self.rebuild_window_s:
+            dq.popleft()
+        return len(dq)
+
+    def _recent_failures(self) -> int:
+        return self._recent(self._fail_times)
+
+    def may_recover(self) -> bool:
+        """False once the crash-loop budget is spent — the caller falls
+        through to the permanent latch. BOTH failed rebuilds and
+        COMPLETED recoveries count against the window budget: a replica
+        that keeps latching right after every successful rebuild (e.g.
+        a hard-stall threshold below the post-rebuild compile time) is
+        crash-looping just as surely as one whose factory raises, and
+        an unbounded recover-latch-recover storm would otherwise never
+        terminate."""
+        return (not self._stop
+                and self._recent(self._fail_times) < self.max_rebuilds
+                and self._recent(self._recovery_times)
+                < self.max_rebuilds)
+
+    def eta_s(self) -> float:
+        """Retry-After estimate for /readyz while recovering: the next
+        rebuild attempt's backoff (plus one attempt's worth of build)."""
+        n = max(0, self._consecutive_fails)
+        if n == 0:
+            return max(1.0, self.backoff_s)
+        return max(1.0, min(self.backoff_max_s,
+                            self.backoff_s * (2 ** (n - 1))))
+
+    # ---- trigger / shutdown ------------------------------------------------
+
+    def trigger(self, cls: str, why: str) -> None:
+        self._why = (cls, why)
+        self._trigger.set()
+
+    def close(self) -> None:
+        self._stop = True
+        self._trigger.set()
+        self._thread.join(timeout=5)
+
+    # ---- the rebuild ladder (supervisor thread) ---------------------------
+
+    def _loop(self) -> None:
+        while not self._stop:
+            self._trigger.wait(timeout=0.2)
+            if self._stop:
+                return
+            if not self._trigger.is_set():
+                continue
+            self._trigger.clear()
+            try:
+                self._recover(*self._why)
+            except Exception:  # pragma: no cover - last-resort contain
+                logger.exception("supervisor recovery pass died")
+                self.serving._crash_loop_latch(
+                    "supervisor recovery pass raised")
+
+    def _recover(self, cls: str, why: str) -> None:
+        s = self.serving
+        t_begin = time.monotonic()
+        logger.warning("engine recovery begins (%s): %s", cls, why)
+
+        # 1. Tear down / abandon the old engine. The generation bump in
+        # _maybe_recover already superseded the loop; a cooperative
+        # thread exits within one pass, a WEDGED one (hard stall) is
+        # abandoned — its gen checks keep it from ever touching shared
+        # state again, and the old LLM goes to GC with it.
+        # A cooperative thread exits within one loop pass; only a
+        # wedged one needs the timeout — and a hard-stall trigger has
+        # ALREADY watched the heartbeat go stale past the hard
+        # threshold, so waiting longer just delays recovery.
+        old_thread, old_llm = s._thread, s.llm
+        old_thread.join(timeout=1.0 if cls == "stall" else 5.0)
+        wedged = old_thread.is_alive()
+        if wedged:
+            logger.error("old engine thread still wedged after 5s — "
+                         "abandoning it (generation %d)", s._gen)
+        else:
+            try:
+                old_llm.quarantine_step_failure(everything=True)
+            except Exception:
+                logger.exception("old-engine quarantine failed (state "
+                                 "is discarded anyway)")
+        try:
+            # releases the prefix-peer serve port + drains disk writes
+            # so the successor can re-adopt the tier; touches only the
+            # kvstore plane, safe even behind a wedged dispatch
+            old_llm.close()
+        except Exception:
+            logger.exception("old-engine close failed")
+
+        # 2. Partition the open streams: retry-safe entries wait for the
+        # rebuilt engine, everything else ends NOW with a terminal error
+        # chunk carrying Retry-After.
+        entries = s._partition_for_replay()
+
+        # 3. Rebuild with bounded exponential backoff; K failures within
+        # the window latch the crash loop.
+        while not self._stop:
+            if not self.may_recover():
+                TRACE.record("recovery", phase="crash_loop",
+                             failed_rebuilds=self._recent_failures())
+                s._crash_loop_latch(
+                    f"{self._recent_failures()} failed rebuilds within "
+                    f"{self.rebuild_window_s:.0f}s (last trigger: {why})")
+                return
+            if self._consecutive_fails:
+                delay = min(self.backoff_max_s, self.backoff_s *
+                            (2 ** (self._consecutive_fails - 1)))
+                logger.warning("rebuild backoff %.2fs (attempt %d)",
+                               delay, self._consecutive_fails + 1)
+                deadline = time.monotonic() + delay
+                while not self._stop and time.monotonic() < deadline:
+                    time.sleep(min(0.05, delay))
+            if self._stop:
+                return
+            try:
+                faults.FAULTS.maybe_raise("rebuild_fail")
+                t0 = time.monotonic()
+                new_llm = self.factory()
+            except Exception as e:
+                self.rebuilds_failed += 1
+                self._consecutive_fails += 1
+                self._fail_times.append(time.monotonic())
+                _M_REBUILDS.inc(outcome="fail")
+                TRACE.record("recovery", phase="rebuild_fail",
+                             error=f"{type(e).__name__}: {e}"[:200])
+                logger.exception("engine rebuild failed")
+                continue
+            self.rebuilds_ok += 1
+            self._consecutive_fails = 0
+            _M_REBUILDS.inc(outcome="ok")
+            logger.warning("engine rebuilt in %.2fs",
+                           time.monotonic() - t0)
+            if self._stop:
+                # shutdown raced the rebuild: it already closed the
+                # parked handles — never adopt/replay after stop
+                return
+            replayed, dropped = s._adopt_llm(new_llm, entries)
+            self.recoveries += 1
+            self._recovery_times.append(time.monotonic())
+            self.last_recovery_s = time.monotonic() - t_begin
+            _M_RECOVERY_S.observe(self.last_recovery_s)
+            TRACE.record("recovery", phase="ready",
+                         recovery_s=round(self.last_recovery_s, 3),
+                         replayed=replayed, dropped=dropped)
+            logger.warning(
+                "engine recovered in %.2fs (%d requests replayed, %d "
+                "dropped)", self.last_recovery_s, replayed, dropped)
+            return

@@ -33,10 +33,22 @@ outside. A single-controller engine must survive them in-process instead:
 - **graceful drain**: ``shutdown(drain=True)`` stops admitting, lets
   in-flight requests finish (bounded), then closes every open handle
   with a terminal chunk before joining — no client blocks forever.
+- **self-healing recovery** (``config.engine_recovery``,
+  docs/robustness.md#recovery-lifecycle): the unhealthy latch (or a
+  watchdog HARD stall, or an engine-loop death) hands the lifecycle to
+  an in-process :class:`~gllm_tpu.engine.recovery.EngineSupervisor`
+  instead of bricking the replica — the engine is torn down and rebuilt
+  in-process with bounded exponential backoff (K failed rebuilds within
+  a window latch the crash-loop state, today's permanent unhealthy),
+  ``/readyz`` reports ``recovering`` with Retry-After, and journaled
+  retry-safe requests (seeded or greedy) replay onto the rebuilt engine
+  from their committed prefix — no stream hangs, no stream silently
+  drops or repeats a token.
 """
 
 from __future__ import annotations
 
+import copy
 import dataclasses
 import logging
 import queue
@@ -61,7 +73,8 @@ _M_ABORTED = obs.counter("gllm_requests_aborted_total",
 _M_REJECTED = obs.counter(
     "gllm_requests_rejected_total",
     "submits rejected by admission control, by reason "
-    "(queue_full/resident_limit/unhealthy/draining)", ("reason",))
+    "(queue_full/resident_limit/unhealthy/recovering/draining)",
+    ("reason",))
 _M_DEADLINE = obs.counter(
     "gllm_request_deadline_exceeded_total",
     "requests aborted because their wall-clock deadline/TTL expired")
@@ -74,6 +87,16 @@ _M_HEALTHY = obs.gauge(
 _M_HB_AGE = obs.gauge(
     "gllm_engine_heartbeat_age_seconds",
     "age of the engine thread's last loop-iteration heartbeat")
+# Info-style reason metric (value 1 on the current class, 0 on stale
+# ones) so a fleet supervisor / router can tell a step-failure latch
+# from a watchdog stall from a crash loop without scraping logs.
+_M_UNHEALTHY_REASON = obs.gauge(
+    "gllm_engine_unhealthy_reason",
+    "why this engine is not ready: 1 on the active reason class "
+    "(step_failures|stall|loop_death|crash_loop), 0 otherwise; all 0 "
+    "while healthy", ("reason",))
+_UNHEALTHY_REASON_CLASSES = ("step_failures", "stall", "loop_death",
+                             "crash_loop")
 
 
 class RequestRejected(Exception):
@@ -108,6 +131,10 @@ class StreamChunk:
     # terminal failure detail (quarantine / shutdown / engine death) —
     # the finish_reason says what class of end this is, error says why
     error: Optional[str] = None
+    # retry hint in seconds on terminal error chunks whose failure is
+    # transient (a request dropped as not-replay-safe during a
+    # supervised recovery): the client may resubmit after this long
+    retry_after: Optional[float] = None
 
 
 class RequestHandle:
@@ -121,6 +148,11 @@ class RequestHandle:
         # when set, __iter__ polls engine liveness instead of blocking
         # forever on a queue a dead engine thread will never feed
         self._engine = engine
+        # replay veto (docs/robustness.md#recovery-lifecycle): the
+        # api_server clears this once a partial tool-call delta has
+        # been streamed — a replayed continuation could then re-emit or
+        # contradict already-delivered structured output
+        self.replay_safe = True
 
     def __iter__(self):
         while True:
@@ -190,7 +222,9 @@ class ServingEngine:
                  request_deadline_s: Optional[float] = None,
                  max_step_failures: Optional[int] = None,
                  watchdog_stall_s: Optional[float] = None,
-                 drain_timeout_s: Optional[float] = None):
+                 drain_timeout_s: Optional[float] = None,
+                 engine_recovery: Optional[bool] = None,
+                 llm_factory=None):
         self.llm = llm
         cfg = getattr(llm, "config", None)
 
@@ -213,6 +247,10 @@ class ServingEngine:
                                      "watchdog_stall_s", 0.0)
         self.drain_timeout_s = knob(drain_timeout_s, "drain_timeout_s",
                                     5.0)
+        self.engine_recovery = bool(knob(engine_recovery,
+                                         "engine_recovery", False))
+        self.watchdog_hard_stall_s = knob(None, "watchdog_hard_stall_s",
+                                          0.0)
         if cfg is not None and getattr(cfg, "fault_inject", ""):
             faults.FAULTS.arm(cfg.fault_inject)
 
@@ -229,10 +267,35 @@ class ServingEngine:
         self._stalled = False
         self._failed_steps = 0          # consecutive; reset on success
         self._heartbeat = time.monotonic()
+        # ---- self-healing recovery (docs/robustness.md) ----
+        # _gen supersedes engine threads: every loop pass checks its own
+        # generation and a stale (abandoned or exiting) thread can never
+        # touch shared state again — the mechanism that makes abandoning
+        # a WEDGED thread safe. _recovering gates readiness ("recovering"
+        # + Retry-After) and admission; the journal + supervisor exist
+        # only under the flag (off = byte-identical legacy lifecycle).
+        self._gen = 0
+        self._recovering = False
+        self._recover_mu = threading.Lock()
+        self._unhealthy_reason = ""          # human detail for /readyz
+        self._unhealthy_class = ""           # metric reason class
+        self._pending_replay: dict = {}      # old seq_id → JournalEntry
+        self._journal = None
+        self.supervisor = None
+        if self.engine_recovery:
+            from gllm_tpu.engine.recovery import (EngineSupervisor,
+                                                  RequestJournal)
+            self._journal = RequestJournal()
+            self.supervisor = EngineSupervisor(
+                self, llm_factory or self._default_factory(),
+                max_rebuilds=knob(None, "max_rebuilds", 3),
+                rebuild_window_s=knob(None, "rebuild_window_s", 300.0),
+                backoff_s=knob(None, "rebuild_backoff_s", 0.25),
+                backoff_max_s=knob(None, "rebuild_backoff_max_s", 30.0))
         _M_HEALTHY.set(1)
-        self._thread = threading.Thread(target=self._run, daemon=True,
-                                        name="gllm-engine")
-        self._thread.start()
+        for c in _UNHEALTHY_REASON_CLASSES:
+            _M_UNHEALTHY_REASON.set(0, reason=c)
+        self._thread = self._spawn_engine_thread()
         self._watchdog: Optional[threading.Thread] = None
         if self.watchdog_stall_s > 0:
             self._watchdog = threading.Thread(target=self._watch,
@@ -240,12 +303,39 @@ class ServingEngine:
                                               name="gllm-watchdog")
             self._watchdog.start()
 
+    def _default_factory(self):
+        """Rebuild recipe for the supervisor: a fresh LLM from the same
+        (already-validated) config. model_cfg and tokenizer are pure
+        host objects and carry over; weights reload from the checkpoint
+        — after a hard fault the old device state is suspect by
+        definition. The persistent XLA compile cache and the disk
+        prefix tier make the rebuild warm (docs/robustness.md)."""
+        cfg, model_cfg = self.llm.config, self.llm.model_cfg
+        tokenizer = self.llm.tokenizer
+
+        def build():
+            return LLM(config=cfg, model_cfg=model_cfg,
+                       tokenizer=tokenizer)
+
+        return build
+
+    def _spawn_engine_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self._run, args=(self._gen,),
+                             daemon=True, name="gllm-engine")
+        t.start()
+        return t
+
     # ---- health / readiness (any thread) -----------------------------------
 
     @property
     def is_alive(self) -> bool:
-        """Liveness: the engine thread is running (/healthz)."""
-        return self._thread.is_alive() and not self._stop
+        """Liveness: the engine thread is running (/healthz). A
+        supervised rebuild counts as alive — the whole point of
+        in-process recovery is that the external supervisor must NOT
+        restart the process while the internal one is mid-rebuild."""
+        if self._stop:
+            return False
+        return self._thread.is_alive() or self._recovering
 
     @property
     def heartbeat_age(self) -> float:
@@ -257,6 +347,8 @@ class ServingEngine:
         the supervisor does not kill it unless /healthz also fails."""
         if not self.is_alive:
             return False, "dead"
+        if self._recovering:
+            return False, "recovering"
         if not self._healthy:
             return False, "unhealthy"
         if self._draining:
@@ -265,19 +357,37 @@ class ServingEngine:
             return False, "stalled"
         return True, "ok"
 
+    def retry_after_s(self) -> float:
+        """Retry-After hint matching the current readiness state: the
+        supervisor's next-attempt ETA while recovering, a long backoff
+        for the (permanent) unhealthy latch, short otherwise."""
+        if self._recovering and self.supervisor is not None:
+            return max(1.0, self.supervisor.eta_s())
+        if not self._healthy:
+            return 30.0
+        return 5.0
+
     def health(self) -> dict:
         age = self.heartbeat_age
         _M_HB_AGE.set(age)
         ready, why = self.readiness()
         with self._lock:
             resident = len(self._handles)
-        return {"alive": self.is_alive, "ready": ready, "reason": why,
-                "healthy": self._healthy, "draining": self._draining,
-                "stalled": self._stalled,
-                "heartbeat_age_s": round(age, 3),
-                "consecutive_step_failures": self._failed_steps,
-                "resident_requests": resident,
-                "queued_requests": self._intake.qsize()}
+        out = {"alive": self.is_alive, "ready": ready, "reason": why,
+               "healthy": self._healthy, "draining": self._draining,
+               "stalled": self._stalled,
+               "recovering": self._recovering,
+               "unhealthy_reason": self._unhealthy_class or None,
+               "unhealthy_detail": self._unhealthy_reason or None,
+               "retry_after_s": round(self.retry_after_s(), 2),
+               "heartbeat_age_s": round(age, 3),
+               "consecutive_step_failures": self._failed_steps,
+               "resident_requests": resident,
+               "queued_requests": self._intake.qsize()}
+        if self.supervisor is not None:
+            out["recoveries"] = self.supervisor.recoveries
+            out["rebuilds_failed"] = self.supervisor.rebuilds_failed
+        return out
 
     # ---- client-facing (any thread) ---------------------------------------
 
@@ -290,6 +400,12 @@ class ServingEngine:
             raise RequestRejected(
                 "queue_full", "intake queue full (injected burst)",
                 status=429, retry_after=1.0)
+        if self._recovering:
+            _M_REJECTED.inc(reason="recovering")
+            raise RequestRejected(
+                "recovering", "engine is rebuilding after a fault; "
+                "retry shortly", status=503,
+                retry_after=self.retry_after_s())
         if not self._healthy:
             _M_REJECTED.inc(reason="unhealthy")
             raise RequestRejected(
@@ -355,6 +471,14 @@ class ServingEngine:
             self._seqs[seq.seq_id] = seq
             if ttl and ttl > 0:
                 self._deadlines[seq.seq_id] = time.monotonic() + ttl
+            if self._journal is not None:
+                # immutable submission for crash replay — committed
+                # token ids append as chunks are delivered
+                self._journal.record(
+                    seq.seq_id, token_ids, sampling_params,
+                    mm=mm_state is not None,
+                    disagg=disagg_items is not None,
+                    target_dp=target_dp)
             _M_SUBMITTED.inc()
             _M_ACTIVE.set(len(self._handles))
         self._intake.put(seq)
@@ -362,6 +486,12 @@ class ServingEngine:
         return handle
 
     def abort(self, seq_id: int) -> None:
+        entry = self._pending_replay.get(seq_id)
+        if entry is not None:
+            # client went away while its request waited for the rebuild:
+            # mark the journal entry so _adopt_llm skips the replay
+            entry.aborted = True
+            return
         self.llm.abort(seq_id)
         self._wake.set()
 
@@ -383,42 +513,73 @@ class ServingEngine:
                 time.sleep(0.01)
         self._stop = True
         self._wake.set()
+        if self.supervisor is not None:
+            self.supervisor.close()
         self._thread.join(timeout=5)
         # the loop's finally already closed the handles if the thread
         # exited; this is the backstop for a hung/killed thread
         self._close_open_handles("abort", "engine shutdown")
-        tiers = getattr(self.llm, "prefix_tiers", None)
-        if tiers is not None:
-            # stop serving peers, drain pending disk writes; host-tier
-            # pages are NOT force-demoted here (an operator who wants
-            # the warm cache persisted calls flush_host_to_disk first)
-            try:
-                tiers.close()
-            except Exception:  # pragma: no cover - shutdown must finish
-                logger.exception("prefix store close failed")
+        # requests still parked for replay (shutdown raced a recovery)
+        for entry in self._take_pending():
+            h = entry.handle
+            if h is not None:
+                _M_ABORTED.inc()
+                h.chunks.put(StreamChunk(None, "", "abort",
+                                         error="engine shutdown"))
+        # stop serving peers, drain pending disk writes; host-tier
+        # pages are NOT force-demoted here (an operator who wants the
+        # warm cache persisted calls flush_host_to_disk first)
+        close = getattr(self.llm, "close", None)
+        if callable(close):
+            close()
 
     # ---- engine thread ----------------------------------------------------
 
-    def _run(self) -> None:
+    def _run(self, gen: int) -> None:
         try:
-            self._run_loop()
-        except Exception:  # pragma: no cover - last-resort containment
+            self._run_loop(gen)
+        except Exception as e:  # pragma: no cover on the latch branch
             logger.exception("engine loop died")
-            self._healthy = False
-            _M_HEALTHY.set(0)
+            detail = f"engine loop died: {type(e).__name__}: {e}"
+            if not self._maybe_recover("loop_death", detail):
+                if self._healthy:
+                    # keep an earlier latch's reason class (e.g. the
+                    # crash-loop idle thread dying must not relabel it)
+                    self._set_unhealthy_reason("loop_death", detail)
+                self._healthy = False
+                _M_HEALTHY.set(0)
         finally:
-            self._close_open_handles("abort", "engine stopped")
+            # a SUPERSEDED loop (recovery bumped the generation) must
+            # not close the handles — the supervisor owns them now and
+            # retry-safe streams will continue on the rebuilt engine
+            if self._gen == gen:
+                self._close_open_handles("abort", "engine stopped")
 
-    def _run_loop(self) -> None:
+    def _run_loop(self, gen: int) -> None:
         llm = self.llm
-        while not self._stop:
+        while not self._stop and self._gen == gen:
             self._heartbeat = time.monotonic()
+            # chaos point (docs/robustness.md#recovery-lifecycle): dies
+            # OUTSIDE the per-step quarantine try, the way an unhandled
+            # runner/driver fault would — exercises the supervised
+            # rebuild, not the batch quarantine
+            faults.FAULTS.maybe_raise("engine_hard_crash")
             drained = False
             while True:
                 try:
                     seq = self._intake.get_nowait()
                 except queue.Empty:
                     break
+                if self._seqs.get(seq.seq_id) is not seq:
+                    # a recovery partition cleared/re-keyed this request
+                    # while its submit raced the trigger (the put landed
+                    # after the partition's intake drain): the journal
+                    # replay owns it now — admitting the stale
+                    # old-engine Sequence would compute it twice, and
+                    # its old seq id can collide with a rebuilt-engine
+                    # id (identity check, not membership: a replayed
+                    # request may hold the same id on a NEW Sequence)
+                    continue
                 try:
                     items = getattr(seq, "_disagg_items", None)
                     if items is not None:
@@ -426,7 +587,7 @@ class ServingEngine:
                     else:
                         llm.add_seq(seq)
                 except ValueError as e:
-                    self._deliver_error(seq.seq_id, str(e))
+                    self._deliver_error(seq.seq_id, "error", str(e))
                 drained = True
             self._expire_deadlines()
             if not llm.has_unfinished:
@@ -437,15 +598,30 @@ class ServingEngine:
             try:
                 outputs = llm.step()
             except Exception as e:
+                if self._gen != gen:
+                    return        # superseded while blocked in step
                 logger.exception("engine step failed")
                 self._on_step_failure(e)
                 continue
+            if self._gen != gen:
+                # a hard-stall recovery abandoned this thread while it
+                # was blocked in step — the rebuilt engine owns the
+                # handles; delivering now would corrupt their streams
+                return
             self._failed_steps = 0
             for out in outputs:
                 handle = self._handles.get(out.seq.seq_id)
                 if handle is None:
                     continue
                 deliver_output(llm, out, handle, self._emitted)
+                if self._journal is not None:
+                    if out.new_token_id is not None:
+                        # DELIVERED = committed: replay continues from
+                        # exactly what the client's stream already holds
+                        self._journal.commit(out.seq.seq_id,
+                                             out.new_token_id)
+                    if out.finish_reason is not None:
+                        self._journal.pop(out.seq.seq_id)
                 if out.finish_reason is not None:
                     with self._lock:
                         self._handles.pop(out.seq.seq_id, None)
@@ -483,21 +659,268 @@ class ServingEngine:
             self._latch_unhealthy(
                 f"{self._failed_steps} consecutive step failures "
                 f"(last: {detail})")
+            if self._recovering:
+                # the latch became a supervised rebuild: the failed
+                # batch's streams stay OPEN — the supervisor partitions
+                # them, and the retry-safe ones replay from their
+                # committed prefix instead of dying here
+                return
         for sid in failed:
             self._deliver_error(sid, "error", detail)
 
-    def _latch_unhealthy(self, why: str) -> None:
+    def _latch_unhealthy(self, why: str, cls: str = "step_failures",
+                         quarantine: bool = True) -> None:
+        """quarantine=False when another thread still owns the LLM (a
+        WEDGED engine thread mid-dispatch): only host-side state is
+        touched — handles close, and a later wake finds nothing to
+        feed."""
+        if self._maybe_recover(cls, why):
+            return           # the supervisor owns the lifecycle now
         if not self._healthy:
             return
         logger.error("engine latched unhealthy: %s", why)
+        self._set_unhealthy_reason(cls, why)
         self._healthy = False
         _M_HEALTHY.set(0)
         TRACE.record("fault", point="engine_unhealthy", error=why[:200])
-        try:
-            self.llm.quarantine_step_failure(everything=True)
-        except Exception:  # pragma: no cover
-            logger.exception("full quarantine failed")
+        if quarantine:
+            try:
+                self.llm.quarantine_step_failure(everything=True)
+            except Exception:  # pragma: no cover
+                logger.exception("full quarantine failed")
         self._close_open_handles("error", why)
+
+    # ---- self-healing recovery (docs/robustness.md#recovery-lifecycle) ----
+
+    def _set_unhealthy_reason(self, cls: str, detail: str) -> None:
+        self._unhealthy_class = cls
+        self._unhealthy_reason = detail
+        for c in _UNHEALTHY_REASON_CLASSES:
+            _M_UNHEALTHY_REASON.set(1 if c == cls else 0, reason=c)
+
+    def _clear_unhealthy_reason(self) -> None:
+        self._unhealthy_class = self._unhealthy_reason = ""
+        for c in _UNHEALTHY_REASON_CLASSES:
+            _M_UNHEALTHY_REASON.set(0, reason=c)
+
+    def _maybe_recover(self, cls: str, why: str) -> bool:
+        """Route a would-be unhealthy latch into a supervised rebuild.
+        True = recovery owns the lifecycle (begun now, or already in
+        progress); False = fall through to the permanent latch (no
+        supervisor, stopping, or the crash-loop budget is spent)."""
+        sup = self.supervisor
+        if sup is None or self._stop or not self._healthy:
+            return False
+        with self._recover_mu:
+            if self._recovering:
+                return True
+            if not sup.may_recover():
+                return False
+            self._recovering = True
+            self._set_unhealthy_reason(cls, why)
+            from gllm_tpu.engine import recovery as _rec
+            _rec._M_RECOVERING.set(1)
+            TRACE.record("recovery", phase="begin", reason=cls)
+            # supersede the current engine thread BEFORE the supervisor
+            # joins it: a cooperative loop exits next pass, a wedged one
+            # is abandoned behind the bump either way
+            self._gen += 1
+        self._wake.set()
+        sup.trigger(cls, why)
+        return True
+
+    def _crash_loop_latch(self, why: str) -> None:
+        """Terminal state of the rebuild ladder: K failed rebuilds
+        within the window — permanent unhealthy (exactly the
+        pre-recovery latch), pending-replay streams get terminal error
+        chunks, the external supervisor takes over via /healthz."""
+        logger.error("engine crash-loop latched: %s", why)
+        with self._recover_mu:
+            self._recovering = False
+            self._set_unhealthy_reason("crash_loop", why)
+            self._healthy = False
+            self._gen += 1
+        _M_HEALTHY.set(0)
+        # Liveness stays up exactly like the legacy latch — /healthz
+        # 200 so the balancer drains while the EXTERNAL supervisor
+        # decides, /readyz 503 with reason class crash_loop. The thread
+        # is a pure heartbeat idler, NOT a _run loop: self.llm is still
+        # the torn-down engine (the rebuild failed), possibly with a
+        # wedged thread inside step() — a second stepper on the same
+        # object would race it.
+        self._heartbeat = time.monotonic()
+        self._thread = threading.Thread(target=self._idle_loop,
+                                        args=(self._gen,), daemon=True,
+                                        name="gllm-engine")
+        self._thread.start()
+        from gllm_tpu.engine import recovery as _rec
+        _rec._M_RECOVERING.set(0)
+        TRACE.record("fault", point="engine_unhealthy", error=why[:200])
+        for entry in self._take_pending():
+            h = entry.handle
+            if h is None:
+                continue
+            _M_ABORTED.inc()
+            h.chunks.put(StreamChunk(
+                None, "", "error",
+                error=f"engine crash-looped during recovery: {why}"))
+        self._close_open_handles("error", why)
+
+    def _idle_loop(self, gen: int) -> None:
+        """Crash-loop liveness thread: keeps /healthz 200 (and the
+        heartbeat fresh) without ever touching the torn-down LLM.
+        Admission is closed and nothing is resident, so there is no
+        work it could miss."""
+        while not self._stop and self._gen == gen:
+            self._heartbeat = time.monotonic()
+            self._wake.wait(timeout=0.2)
+            self._wake.clear()
+
+    def _take_pending(self) -> list:
+        with self._lock:
+            pending = list(self._pending_replay.values())
+            self._pending_replay.clear()
+        return pending
+
+    def _partition_for_replay(self) -> list:
+        """Called by the supervisor once the old engine is down: snap
+        every open stream against the journal. Retry-safe entries are
+        parked in _pending_replay (their handles stay open — the client
+        keeps polling liveness, which recovery keeps True); everything
+        else ends now with a terminal error chunk carrying Retry-After.
+        Returns the parked entries."""
+        from gllm_tpu.engine.recovery import _M_REPLAYED
+        with self._lock:
+            handles = dict(self._handles)
+            self._handles.clear()
+            self._seqs.clear()
+            deadlines = dict(self._deadlines)
+            self._deadlines.clear()
+            _M_ACTIVE.set(0)
+        self._emitted.clear()
+        # stale intake: never-admitted seqs are journaled too — replay
+        # reconstructs them, the old Sequence objects are discarded
+        while True:
+            try:
+                self._intake.get_nowait()
+            except queue.Empty:
+                break
+        retry = self.retry_after_s()
+        entries = []
+        for sid, handle in handles.items():
+            entry = self._journal.pop(sid) if self._journal is not None \
+                else None
+            if entry is not None:
+                entry.handle = handle
+                entry.deadline = deadlines.get(sid)
+            why = entry.unsafe_reason() if entry is not None \
+                else "request predates the journal"
+            if why is None:
+                with self._lock:
+                    self._pending_replay[sid] = entry
+                entries.append(entry)
+                continue
+            _M_REPLAYED.inc(outcome="unsafe")
+            _M_ABORTED.inc()
+            handle.chunks.put(StreamChunk(
+                None, "", "error",
+                error=("engine is rebuilding after a fault and this "
+                       f"request is not replay-safe ({why}); retry "
+                       f"after ~{retry:.0f}s"),
+                retry_after=retry))
+        TRACE.record("recovery", phase="partition",
+                     replayable=len(entries),
+                     dropped=len(handles) - len(entries))
+        return entries
+
+    def _adopt_llm(self, llm, entries: list) -> tuple:
+        """Swap in the rebuilt engine, replay the parked entries, and
+        restart the loop. Returns (replayed, dropped). Runs on the
+        supervisor thread — no engine thread is alive for this
+        generation, so the scheduler is single-owner here."""
+        from gllm_tpu.engine.recovery import _M_REPLAYED
+        from gllm_tpu.engine import recovery as _rec
+        with self._lock:
+            # a submit that slipped past _admit in the instant before
+            # the recovering flag set may have allocated an old-engine
+            # seq: seed the rebuilt engine's id counter past EVERY id
+            # the old engine ever handed out (submit allocates under
+            # this same lock, so inside it the swap is atomic — any
+            # later submit allocates from the new llm) so a replayed
+            # or new seq can never collide with a stale one
+            llm._next_seq_id = max(llm._next_seq_id,
+                                   self.llm._next_seq_id,
+                                   max(self._handles.keys(),
+                                       default=-1) + 1)
+            self.llm = llm
+        now = time.monotonic()
+        replayed = dropped = 0
+        for entry in entries:
+            with self._lock:
+                parked = self._pending_replay.pop(entry.seq_id, None)
+            if parked is None:
+                # a concurrent shutdown already closed this stream —
+                # replaying would deliver past its terminal chunk
+                dropped += 1
+                continue
+            h = entry.handle
+            if entry.aborted:
+                dropped += 1
+                _M_REPLAYED.inc(outcome="aborted")
+                _M_ABORTED.inc()
+                h.chunks.put(StreamChunk(None, "", "abort"))
+                continue
+            if entry.deadline is not None and now >= entry.deadline:
+                dropped += 1
+                _M_REPLAYED.inc(outcome="expired")
+                _M_DEADLINE.inc()
+                _M_ABORTED.inc()
+                h.chunks.put(StreamChunk(None, "", "deadline"))
+                continue
+            sp = copy.deepcopy(entry.sampling)
+            with self._lock:
+                # prompt + committed resubmits with the ORIGINAL
+                # prompt_len: num_output_tokens counts the committed
+                # tokens, so max_tokens / min_tokens / penalties and
+                # the seeded sampling out_step all continue exactly
+                # where the delivered stream stopped — byte-identical
+                # continuation for greedy and seeded requests
+                seq = llm._allocate_seq(
+                    list(entry.prompt) + list(entry.committed), sp)
+                seq.prompt_len = len(entry.prompt)
+                if entry.target_dp is not None:
+                    seq.target_dp = entry.target_dp
+                if llm.tokenizer is not None and entry.committed:
+                    # reconstruct the committed output text so the
+                    # handle's char cursor (and final_text) line up
+                    # with what was already streamed
+                    seq.detok_prefix_offset = max(
+                        0, len(entry.prompt) - 6)
+                    seq.detok_read_offset = len(entry.prompt)
+                    llm._stream_detokenize(seq)
+                    self._emitted[seq.seq_id] = len(seq.output_text)
+                h.seq_id = seq.seq_id
+                self._handles[seq.seq_id] = h
+                self._seqs[seq.seq_id] = seq
+                if entry.deadline is not None:
+                    self._deadlines[seq.seq_id] = entry.deadline
+                if self._journal is not None:
+                    self._journal.adopt(seq.seq_id, entry)
+                _M_ACTIVE.set(len(self._handles))
+            self._intake.put(seq)
+            _M_REPLAYED.inc(outcome="replayed")
+            replayed += 1
+        # fresh loop under the bumped generation
+        self._failed_steps = 0
+        self._heartbeat = time.monotonic()
+        self._stalled = False
+        self._thread = self._spawn_engine_thread()
+        with self._recover_mu:
+            self._recovering = False
+            self._clear_unhealthy_reason()
+        _rec._M_RECOVERING.set(0)
+        self._wake.set()
+        return replayed, dropped
 
     def _expire_deadlines(self) -> None:
         """Abort requests past their wall-clock budget — including ones
@@ -537,6 +960,8 @@ class ServingEngine:
             self._deadlines.pop(seq_id, None)
             _M_ACTIVE.set(len(self._handles))
         self._emitted.pop(seq_id, None)
+        if self._journal is not None:
+            self._journal.pop(seq_id)
         if handle is not None:
             _M_ABORTED.inc()
             handle.chunks.put(StreamChunk(None, "", reason or "error",
@@ -554,6 +979,8 @@ class ServingEngine:
             self._emitted.clear()
             self._deadlines.clear()
             _M_ACTIVE.set(0)
+        if self._journal is not None:
+            self._journal.clear()
         if handles:
             _M_ABORTED.inc(len(handles))
         if getattr(self.llm.config, "tracing", True):
@@ -570,11 +997,24 @@ class ServingEngine:
         """Detect a wedged engine thread (hung device dispatch blocks the
         loop inside collect, so the heartbeat goes stale) and flip
         readiness while it lasts. Liveness is untouched: the supervisor
-        restarts on /healthz, the balancer routes on /readyz."""
+        restarts on /healthz, the balancer routes on /readyz.
+
+        With ``watchdog_hard_stall_s`` > 0 (requires engine_recovery),
+        a heartbeat past the HARD threshold escalates to the supervised
+        rebuild: the wedged thread is abandoned behind a generation
+        bump and a fresh engine takes over — a dead TPU tunnel no
+        longer bricks the replica until a human restarts it."""
         stall = self.watchdog_stall_s
+        hard = self.watchdog_hard_stall_s
         interval = max(0.02, min(stall / 4.0, 1.0))
-        while not self._stop and self._thread.is_alive():
+        while not self._stop:
             time.sleep(interval)
+            if self._recovering:
+                continue      # heartbeat is expectedly stale mid-rebuild
+            if not self._thread.is_alive():
+                if self.supervisor is None:
+                    return    # loop died permanently; nothing to watch
+                continue      # between generations
             age = time.monotonic() - self._heartbeat
             _M_HB_AGE.set(age)
             if age > stall:
@@ -585,6 +1025,16 @@ class ServingEngine:
                     logger.error(
                         "engine heartbeat stale %.2fs (> %.2fs) — "
                         "readiness off", age, stall)
+                if hard > 0 and age > hard:
+                    why = (f"engine heartbeat stale {age:.2f}s (hard "
+                           f"threshold {hard:.2f}s) — abandoning the "
+                           "wedged engine thread")
+                    # _latch_unhealthy tries _maybe_recover first;
+                    # budget spent → permanent latch WITHOUT
+                    # quarantining (the wedged thread still owns the
+                    # LLM)
+                    self._latch_unhealthy(why, cls="stall",
+                                          quarantine=False)
             elif self._stalled:
                 self._stalled = False
                 logger.info("engine heartbeat recovered — readiness on")

@@ -38,7 +38,7 @@ class ServerState:
                  tool_parser: Optional[str] = None, engine=None,
                  pin_dp: Optional[int] = None):
         from gllm_tpu.entrypoints.tool_parsers import get_tool_parser
-        self.llm = llm
+        self._llm = llm
         self.engine = engine if engine is not None else ServingEngine(llm)
         self.served_model = served_model
         # per-DP-replica endpoint: every request this state admits is
@@ -57,6 +57,14 @@ class ServerState:
         self.tool_parser = get_tool_parser(
             tool_parser, llm.config.model or served_model,
             architecture=getattr(llm.model_cfg, "architecture", "") or "")
+
+    @property
+    def llm(self):
+        """The engine's CURRENT LLM: a supervised in-process rebuild
+        (docs/robustness.md#recovery-lifecycle) swaps ServingEngine.llm,
+        and every HTTP route must follow the swap instead of serving a
+        torn-down engine's state."""
+        return getattr(self.engine, "llm", self._llm)
 
     # ---- request handling -------------------------------------------------
 
@@ -220,7 +228,12 @@ class Handler(BaseHTTPRequestHandler):
                 body.update(health())
             self._json(body, code=200 if alive else 503)
         elif self.path == "/readyz":
-            # READINESS: may this instance be sent new requests?
+            # READINESS: may this instance be sent new requests? The
+            # body carries the latch reason CLASS (step_failures /
+            # stall / loop_death / crash_loop — also the
+            # gllm_engine_unhealthy_reason info metric) + human detail,
+            # so a router can tell a recovering replica (come back
+            # after Retry-After) from a crash-looped one (reschedule).
             eng = st.engine
             readiness = getattr(eng, "readiness", None)
             ready, why = readiness() if callable(readiness) \
@@ -228,8 +241,16 @@ class Handler(BaseHTTPRequestHandler):
             if ready:
                 self._json({"status": "ok"})
             else:
-                self._json({"status": "unavailable", "reason": why},
-                           code=503, headers={"Retry-After": "5"})
+                body = {"status": "unavailable", "reason": why}
+                cls = getattr(eng, "_unhealthy_class", "")
+                if cls:
+                    body["unhealthy_reason"] = cls
+                    body["detail"] = getattr(eng, "_unhealthy_reason",
+                                             "")
+                retry_fn = getattr(eng, "retry_after_s", None)
+                retry = retry_fn() if callable(retry_fn) else 5.0
+                self._json(body, code=503, headers={
+                    "Retry-After": str(max(1, int(round(retry))))})
         elif self.path == "/metrics":
             # Prometheus text exposition (gllm_tpu/obs/metrics.py):
             # request-latency histograms (TTFT/TPOT/ITL/e2e/queue),
@@ -308,6 +329,14 @@ class Handler(BaseHTTPRequestHandler):
                         if getattr(st.llm, "prefix_tiers", None)
                         is not None
                         and st.llm.prefix_tiers.server is not None
+                        else None),
+                    # per-peer circuit-breaker health (state / trips /
+                    # failure counters, docs/robustness.md)
+                    "peer_health": (
+                        st.llm.prefix_tiers.client.peer_health()
+                        if getattr(st.llm, "prefix_tiers", None)
+                        is not None
+                        and st.llm.prefix_tiers.client is not None
                         else None),
                 },
                 "parallel": {"tp": cfg.parallel.tp, "dp": cfg.parallel.dp,
@@ -541,6 +570,10 @@ class Handler(BaseHTTPRequestHandler):
                     self._sse(proto.chat_completion_chunk(rid, req.model,
                                                           text, None))
                 for d in deltas:
+                    # a structured tool-call delta is on the wire: this
+                    # stream can no longer replay across a supervised
+                    # engine rebuild (docs/robustness.md#replay-safety)
+                    handle.replay_safe = False
                     chunk = proto.chat_completion_chunk(rid, req.model,
                                                         None, None)
                     chunk["choices"][0]["delta"]["tool_calls"] = [d]
@@ -770,6 +803,12 @@ def build_engine_config(args) -> EngineConfig:
         max_step_failures=args.max_step_failures,
         watchdog_stall_s=args.watchdog_stall_s,
         drain_timeout_s=args.drain_timeout_s,
+        engine_recovery=args.engine_recovery,
+        max_rebuilds=args.max_rebuilds,
+        rebuild_window_s=args.rebuild_window_s,
+        rebuild_backoff_s=args.rebuild_backoff_s,
+        rebuild_backoff_max_s=args.rebuild_backoff_max_s,
+        watchdog_hard_stall_s=args.watchdog_hard_stall_s,
         fault_inject=args.fault_inject,
         scheduler=SchedulerConfig(
             schedule_method=args.schedule_method,
@@ -991,6 +1030,30 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--drain-timeout-s", type=float, default=5.0,
                    help="graceful-shutdown budget for in-flight requests "
                         "before they are aborted with terminal chunks")
+    p.add_argument("--engine-recovery", action="store_true",
+                   help="supervised in-process recovery "
+                        "(docs/robustness.md): an unhealthy latch / "
+                        "engine-loop death / watchdog hard stall tears "
+                        "the engine down and rebuilds it in-process — "
+                        "/readyz reports 'recovering' with Retry-After "
+                        "and retry-safe (seeded or greedy) requests "
+                        "replay from their committed prefix")
+    p.add_argument("--max-rebuilds", type=int, default=3,
+                   help="crash-loop latch: this many FAILED rebuilds "
+                        "within --rebuild-window-s latch the permanent "
+                        "unhealthy state (never an infinite rebuild "
+                        "loop)")
+    p.add_argument("--rebuild-window-s", type=float, default=300.0)
+    p.add_argument("--rebuild-backoff-s", type=float, default=0.25,
+                   help="first-retry rebuild backoff; doubles per "
+                        "failure up to --rebuild-backoff-max-s")
+    p.add_argument("--rebuild-backoff-max-s", type=float, default=30.0)
+    p.add_argument("--watchdog-hard-stall-s", type=float, default=0.0,
+                   help="heartbeat age that ESCALATES a watchdog stall "
+                        "to a supervised rebuild (abandons the wedged "
+                        "engine thread; needs --engine-recovery and "
+                        "--watchdog-stall-s; 0 = soft readiness flips "
+                        "only)")
     p.add_argument("--fault-inject", default="",
                    help="deterministic fault injection spec "
                         "'point[:after_n[:count]][,...]' "

@@ -40,6 +40,19 @@ Points and their wired sites:
 - ``peer_prefix_timeout`` makes one ``PrefixClient.fetch`` behave as a
                          peer deadline expiry → exercises the
                          bounded-timeout miss (next tier, never a stall)
+- ``engine_hard_crash``  raises at the TOP of the serving-engine loop,
+                         OUTSIDE the per-step quarantine try — the loop
+                         dies the way an unhandled runner/driver fault
+                         would → exercises the supervised in-process
+                         rebuild (docs/robustness.md#recovery)
+- ``rebuild_fail``       raises inside ``EngineSupervisor`` before the
+                         replacement engine is constructed → exercises
+                         the bounded-backoff retry and the crash-loop
+                         latch (K failed rebuilds → permanent unhealthy)
+- ``peer_flap``          makes one ``PrefixClient.fetch`` peer attempt
+                         behave as a transport failure → drives the
+                         per-peer circuit breaker (open → half-open →
+                         closed) deterministically
 
 Firing a point records a ``fault`` event on the steptrace ring. Everything
 here is stdlib-only and cheap when disarmed: ``fire()`` is one attribute
@@ -68,6 +81,9 @@ POINTS = (
     "intake_burst",
     "disk_read_corrupt",
     "peer_prefix_timeout",
+    "engine_hard_crash",
+    "rebuild_fail",
+    "peer_flap",
 )
 
 

@@ -19,9 +19,16 @@ pages instead of media blobs — which adds two obligations:
 
 Probe-latency contract: ``fetch`` is bounded by ``timeout_s`` per live
 peer (connect + request + response all under one socket deadline) and a
-failed/slow peer backs off, so the scheduler's match_prefix walk can
-never stall on the network — the ``peer_prefix_timeout`` chaos point
-proves the degrade path in tests.
+failing peer trips a real per-peer CIRCUIT BREAKER — exponential
+backoff with jitter, half-open single-probe recovery, per-peer health
+counters — so the scheduler's match_prefix walk can never stall on the
+network and a FLAPPING peer costs one probe per backoff window instead
+of a periodic stall-and-retry. The ``peer_prefix_timeout`` and
+``peer_flap`` chaos points prove the degrade and breaker paths in
+tests; knobs: ``GLLM_PREFIX_PEER_BACKOFF_S`` (base, default 30),
+``GLLM_PREFIX_PEER_BACKOFF_MAX_S`` (cap, default 300),
+``GLLM_PREFIX_PEER_FAILS`` (consecutive failures to trip, default 1),
+``GLLM_PREFIX_PEER_JITTER`` (fraction, default 0.1).
 
 Wire framing is deliberately NOT the pickle framing of
 ``disagg/wire.py`` (that plane runs between mutually trusting processes
@@ -120,6 +127,102 @@ def _recv_payload(sock: socket.socket, limit: int,
     return _recv_exact(sock, n, deadline)
 
 
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        logger.warning("bad %s=%r; using %s", name,
+                       os.environ.get(name), default)
+        return default
+
+
+class PeerBreaker:
+    """Per-peer circuit breaker (docs/robustness.md#peer-breakers).
+
+    closed → (``threshold`` consecutive failures) → open for
+    ``base_s · 2^(trips-1)`` seconds ±``jitter`` (capped at ``max_s``)
+    → half-open: exactly ONE probe is admitted — success closes and
+    resets the backoff ladder, failure re-opens with the next-longer
+    window. The jitter de-synchronizes a fleet of replicas hammering
+    the same recovering peer.
+
+    Single-threaded by contract (the engine thread owns all probing);
+    ``now`` injection keeps the chaos tests clock-free.
+    """
+
+    def __init__(self, base_s: float = 30.0, max_s: float = 300.0,
+                 threshold: int = 1, jitter: float = 0.1):
+        self.base_s = max(0.001, float(base_s))
+        self.max_s = max(self.base_s, float(max_s))
+        self.threshold = max(1, int(threshold))
+        self.jitter = max(0.0, min(1.0, float(jitter)))
+        self.state = "closed"            # closed | open | half_open
+        self.trips = 0                   # consecutive opens (backoff rung)
+        self._fails = 0                  # consecutive failures while closed
+        self._until = 0.0                # open-state expiry (monotonic)
+        # lifetime health counters (surfaced by PrefixClient.peer_health)
+        self.failures = 0
+        self.successes = 0
+        self.opens = 0
+        self.probes = 0                  # half-open recovery probes
+
+    def allow(self, now: Optional[float] = None) -> bool:
+        """May the caller probe this peer now? The True returned after
+        an open window expires IS the single half-open probe — further
+        calls return False until success()/failure() resolves it."""
+        if self.state == "closed":
+            return True
+        if self.state == "half_open":
+            return False
+        now = time.monotonic() if now is None else now
+        if now >= self._until:
+            self.state = "half_open"
+            self.probes += 1
+            return True
+        return False
+
+    def success(self) -> None:
+        self.successes += 1
+        self.state = "closed"
+        self._fails = 0
+        self.trips = 0
+
+    def failure(self, now: Optional[float] = None) -> None:
+        self.failures += 1
+        if self.state == "half_open":
+            self._open(now)              # the recovery probe failed
+            return
+        if self.state == "open":
+            return                       # already backing off
+        self._fails += 1
+        if self._fails >= self.threshold:
+            self._open(now)
+
+    def _open(self, now: Optional[float]) -> None:
+        now = time.monotonic() if now is None else now
+        self.trips += 1
+        self._fails = 0
+        self.opens += 1
+        self.state = "open"
+        back = min(self.max_s, self.base_s * (2 ** (self.trips - 1)))
+        if self.jitter:
+            import random
+            back *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
+        self._until = now + back
+
+    def down_for(self, now: Optional[float] = None) -> float:
+        if self.state != "open":
+            return 0.0
+        now = time.monotonic() if now is None else now
+        return max(0.0, self._until - now)
+
+    def health(self) -> dict:
+        return {"state": self.state, "trips": self.trips,
+                "failures": self.failures, "successes": self.successes,
+                "opens": self.opens, "probes": self.probes,
+                "down_for_s": round(self.down_for(), 2)}
+
+
 def parse_peer_addr(addr: str) -> Tuple[str, int]:
     """``host:port`` → validated pair; raises ``ValueError`` on a
     malformed entry (checked at construction/config time so a typo in
@@ -212,16 +315,21 @@ class PrefixClient:
     """Fetch-by-digest against a list of peer replicas.
 
     Peers are tried in order; each attempt is deadline-bounded and a
-    peer that times out / errors backs off for ``BACKOFF_S`` (a
-    geometry-mismatched peer is disabled permanently). Thread-safe for
-    the single engine thread that probes it; sockets are cached per
+    peer that times out / errors trips its :class:`PeerBreaker`
+    (exponential backoff with jitter, half-open single-probe recovery;
+    a geometry-mismatched peer is disabled permanently). Thread-safe
+    for the single engine thread that probes it; sockets are cached per
     peer.
     """
 
-    BACKOFF_S = 30.0
+    BACKOFF_S = 30.0      # default breaker base (GLLM_PREFIX_PEER_BACKOFF_S)
 
     def __init__(self, peers: Sequence[str], geometry: dict,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 backoff_s: Optional[float] = None,
+                 backoff_max_s: Optional[float] = None,
+                 fail_threshold: Optional[int] = None,
+                 jitter: Optional[float] = None):
         self.geometry = geometry
         # expected payload size: geometry is fixed, so anything larger
         # than the page bytes + header slack is hostile/corrupt
@@ -229,17 +337,27 @@ class PrefixClient:
         self._payload_limit = geometry_bytes(geometry) + 4096
         self.timeout_s = (timeout_s if timeout_s is not None else float(
             os.environ.get("GLLM_PREFIX_PEER_TIMEOUT_S", "2.0")))
+        base = (backoff_s if backoff_s is not None
+                else _env_f("GLLM_PREFIX_PEER_BACKOFF_S", self.BACKOFF_S))
+        cap = (backoff_max_s if backoff_max_s is not None
+               else _env_f("GLLM_PREFIX_PEER_BACKOFF_MAX_S",
+                           max(300.0, base)))
+        thresh = int(fail_threshold if fail_threshold is not None
+                     else _env_f("GLLM_PREFIX_PEER_FAILS", 1))
+        jit = (jitter if jitter is not None
+               else _env_f("GLLM_PREFIX_PEER_JITTER", 0.1))
         # guards peer/socket state: fetch() runs on the engine thread,
         # close() on whatever thread drives shutdown
         self._lock = threading.Lock()
         self._closed = False
         # addr -> {sock, negotiated (None=not yet, False=refused),
-        #          down_until}; parse up front so a malformed
+        #          breaker}; parse up front so a malformed
         #          --prefix-peers entry fails construction, not the
         #          first scheduling probe
         self._peers: Dict[Tuple[str, int], dict] = {
-            parse_peer_addr(a): {"sock": None, "negotiated": None,
-                                 "down_until": 0.0}
+            parse_peer_addr(a): {
+                "sock": None, "negotiated": None,
+                "breaker": PeerBreaker(base, cap, thresh, jit)}
             for a in peers if a.strip()}
         if not self._peers:
             raise ValueError("prefix client needs at least one peer")
@@ -259,7 +377,28 @@ class PrefixClient:
             except OSError:
                 pass
         if backoff:
-            st["down_until"] = time.monotonic() + self.BACKOFF_S
+            br = st["breaker"]
+            was_open = br.state == "open"
+            br.failure()
+            if br.state == "open" and not was_open:
+                stats.PEER_BREAKER_OPENS.inc(peer=f"{addr[0]}:{addr[1]}")
+                logger.warning(
+                    "prefix peer %s breaker OPEN for %.1fs (%d "
+                    "consecutive trips)", addr, br.down_for(), br.trips)
+            self._set_open_gauge()
+
+    def _set_open_gauge(self) -> None:
+        stats.PEER_BREAKER_OPEN.set(sum(
+            1 for st in self._peers.values()
+            if st["breaker"].state == "open"))
+
+    def peer_health(self) -> Dict[str, dict]:
+        """Per-peer breaker/health counters (surfaced on /server_info
+        and read by the chaos tests)."""
+        with self._lock:
+            return {f"{h}:{p}": dict(st["breaker"].health(),
+                                     negotiated=st["negotiated"])
+                    for (h, p), st in self._peers.items()}
 
     def _negotiate(self, addr, st: dict, sock: socket.socket,
                    deadline: Optional[float] = None) -> bool:
@@ -296,11 +435,16 @@ class PrefixClient:
             stats.PEER_TIMEOUTS.inc()
             stats.MISSES.inc(tier="peer")
             return None
-        now = time.monotonic()
         with self._lock:
             peers = list(self._peers.items())
         for addr, st in peers:
-            if st["negotiated"] is False or now < st["down_until"]:
+            if st["negotiated"] is False or not st["breaker"].allow():
+                continue
+            if FAULTS.fire("peer_flap"):
+                # chaos point: this peer attempt behaves as a transport
+                # failure — drives the breaker ladder (open → half-open
+                # → closed) deterministically under test
+                self._drop(addr, st)
                 continue
             # ONE wall-clock budget covers connect + hello + request +
             # full response for this peer — a dribbling sender can't
@@ -346,6 +490,15 @@ class PrefixClient:
                     self._drop(addr, st, backoff=fresh)
                     if fresh:
                         break
+            if hdr is not None:
+                # ANY well-formed reply (hit or clean miss) is a healthy
+                # peer: close the breaker and reset its backoff ladder
+                br = st["breaker"]
+                if br.state != "closed":
+                    logger.info("prefix peer %s recovered (half-open "
+                                "probe succeeded)", addr)
+                br.success()
+                self._set_open_gauge()
             if not (hdr and hdr.get("hit") and raw):
                 continue        # clean miss or transport failure here
             try:

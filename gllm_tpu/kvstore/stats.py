@@ -44,3 +44,11 @@ PEER_TIMEOUTS = obs.counter(
 PEER_SERVED = obs.counter(
     "gllm_kvstore_peer_served_total",
     "prefix pages this replica served to peers")
+PEER_BREAKER_OPENS = obs.counter(
+    "gllm_kvstore_peer_breaker_opens_total",
+    "per-peer circuit-breaker trips (closed/half-open → open): the "
+    "peer's probes are skipped for an exponentially-backed-off window "
+    "with jitter, then ONE half-open probe decides recovery", ("peer",))
+PEER_BREAKER_OPEN = obs.gauge(
+    "gllm_kvstore_peer_breaker_open",
+    "peers currently held open (skipped) by their circuit breaker")

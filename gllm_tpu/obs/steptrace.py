@@ -89,7 +89,15 @@ __all__ = ["StepTrace", "TRACE", "summarize"]
 # ``hbm_gbps`` estimates from the step FLOPs model (obs/spans.py).
 STEP_KINDS = ("prefill", "decode", "unified_step", "fused_block",
               "pp_stage", "compile", "chain_break", "fault",
-              "quarantine", "prefix", "loop_stall")
+              "quarantine", "prefix", "loop_stall", "recovery")
+# recovery (config.engine_recovery, docs/robustness.md#recovery-
+# lifecycle) event phases: begin (latch handed to the supervisor),
+# partition (streams split into replayable vs dropped), rebuild_fail
+# (one factory attempt raised; backoff doubles), ready (rebuilt engine
+# adopted — carries recovery_s/replayed/dropped), crash_loop (K failed
+# rebuilds within the window → permanent unhealthy).
+RECOVERY_PHASES = ("begin", "partition", "rebuild_fail", "ready",
+                   "crash_loop")
 CHAIN_BREAK_REASONS = ("waiting", "pages", "shape", "spec", "finish",
                        "reform")
 LOOP_STALL_REASONS = ("readback", "rebuild", "pages", "depth")
@@ -190,6 +198,11 @@ def summarize(events: List[dict]) -> dict:
     break_reasons: Dict[str, int] = {}
     faults_total = quarantines = 0
     fault_points: Dict[str, int] = {}
+    # self-healing recovery (config.engine_recovery): completed
+    # supervised rebuilds over the window, requests replayed across
+    # them, failed rebuild attempts, and total latch-to-ready wall
+    recoveries = rebuild_failures = requests_replayed = 0
+    recovery_s_total = 0.0
     # pipelined-loop stalls (loop_stall events) + the sustained run-ahead
     # depth (the ``inflight`` field step events carry)
     loop_stalls = 0
@@ -239,6 +252,16 @@ def summarize(events: List[dict]) -> dict:
             continue
         if k == "quarantine":
             quarantines += 1
+            continue
+        if k == "recovery":
+            ph_name = e.get("phase", "")
+            if ph_name == "ready":
+                recoveries += 1
+                requests_replayed += int(e.get("replayed", 0))
+                if e.get("recovery_s") is not None:
+                    recovery_s_total += float(e["recovery_s"])
+            elif ph_name == "rebuild_fail":
+                rebuild_failures += 1
             continue
         if k == "loop_stall":
             loop_stalls += 1
@@ -389,4 +412,13 @@ def summarize(events: List[dict]) -> dict:
         "faults": faults_total,
         "faults_by_point": fault_points,
         "quarantines": quarantines,
+        # supervised in-process recovery (config.engine_recovery):
+        # completed rebuilds over the window, their total latch-to-ready
+        # wall, failed rebuild attempts, and requests replayed across
+        # the rebuilds (docs/robustness.md#recovery-lifecycle)
+        "recoveries": recoveries,
+        "recovery_s": (round(recovery_s_total, 3) if recoveries
+                       else None),
+        "rebuild_failures": rebuild_failures,
+        "requests_replayed": requests_replayed,
     }

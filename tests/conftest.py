@@ -31,3 +31,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "chaos: deterministic fault-injection tests (docs/robustness.md)")
+    # soak: multi-minute deterministic chaos runs (tests/test_soak_chaos
+    # .py) — sustained fault injection under concurrent traffic with
+    # leak/recovery-time acceptance. Every soak test is ALSO marked slow
+    # so tier-1 ("not slow") never pays for it; run with -m soak.
+    config.addinivalue_line(
+        "markers",
+        "soak: deterministic multi-minute chaos soak (always also slow)")

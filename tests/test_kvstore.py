@@ -236,14 +236,132 @@ def test_peer_addr_validation_fails_at_startup():
 
 def test_peer_dead_peer_is_bounded_and_backs_off():
     pool = _pool()
-    # nothing listens on this port: connect must fail fast, mark the
-    # peer down, and miss — never stall the probe
+    # nothing listens on this port: connect must fail fast, trip the
+    # breaker open, and miss — never stall the probe
     client = PrefixClient(["127.0.0.1:1"], _geom(pool), timeout_s=0.5)
     t0 = time.monotonic()
     assert client.fetch(_digest(1), list(CANARY)) is None
     assert time.monotonic() - t0 < 2.0
-    assert list(client._peers.values())[0]["down_until"] > time.monotonic()
+    br = list(client._peers.values())[0]["breaker"]
+    assert br.state == "open" and br.down_for() > 0
+    # while open the peer is skipped outright: the next probe is a
+    # local-bookkeeping miss, no connect attempt, near-instant
+    t0 = time.monotonic()
+    assert client.fetch(_digest(1), list(CANARY)) is None
+    assert time.monotonic() - t0 < 0.05
     client.close()
+
+
+def test_peer_breaker_unit_ladder():
+    """closed → open (exponential, jittered, capped) → half-open single
+    probe → closed on success / re-open with a longer window."""
+    from gllm_tpu.kvstore.peer import PeerBreaker
+    br = PeerBreaker(base_s=10.0, max_s=35.0, threshold=2, jitter=0.0)
+    now = 1000.0
+    assert br.allow(now)
+    br.failure(now)
+    assert br.state == "closed"            # threshold 2: one is not enough
+    assert br.allow(now)
+    br.failure(now)
+    assert br.state == "open" and br.opens == 1
+    assert not br.allow(now + 9.9)         # base window
+    assert br.allow(now + 10.1)            # → half-open: THE single probe
+    assert br.state == "half_open" and br.probes == 1
+    assert not br.allow(now + 10.2)        # no second concurrent probe
+    br.failure(now + 10.2)                 # probe failed → longer window
+    assert br.state == "open" and br.trips == 2
+    assert not br.allow(now + 10.2 + 19.9)     # 10 * 2^1
+    assert br.allow(now + 10.2 + 20.1)
+    br.failure(now + 31.0)                 # trips=3 → min(40, 35) = cap
+    assert not br.allow(now + 31.0 + 34.9)
+    assert br.allow(now + 31.0 + 35.1)
+    br.success()                           # recovery resets the ladder
+    assert br.state == "closed" and br.trips == 0
+    br.failure(now + 100.0)
+    br.failure(now + 100.0)                # fresh threshold count
+    assert br.state == "open"
+    assert not br.allow(now + 100.0 + 9.9)     # back at the base window
+    h = br.health()
+    assert h["opens"] == 4 and h["successes"] == 1 and h["failures"] == 6
+
+
+def test_peer_breaker_knobs_env(monkeypatch):
+    monkeypatch.setenv("GLLM_PREFIX_PEER_BACKOFF_S", "3.5")
+    monkeypatch.setenv("GLLM_PREFIX_PEER_BACKOFF_MAX_S", "42")
+    monkeypatch.setenv("GLLM_PREFIX_PEER_FAILS", "4")
+    monkeypatch.setenv("GLLM_PREFIX_PEER_JITTER", "0")
+    pool = _pool()
+    client = PrefixClient(["127.0.0.1:1"], _geom(pool), timeout_s=0.5)
+    br = list(client._peers.values())[0]["breaker"]
+    assert br.base_s == 3.5 and br.max_s == 42.0
+    assert br.threshold == 4 and br.jitter == 0.0
+    client.close()
+    # explicit ctor kwargs win over env
+    client = PrefixClient(["127.0.0.1:1"], _geom(pool), timeout_s=0.5,
+                          backoff_s=1.0, backoff_max_s=2.0,
+                          fail_threshold=1, jitter=0.5)
+    br = list(client._peers.values())[0]["breaker"]
+    assert br.base_s == 1.0 and br.threshold == 1 and br.jitter == 0.5
+    client.close()
+
+
+@pytest.mark.chaos
+def test_chaos_peer_flap_costs_one_probe_per_window(tmp_path):
+    """peer_flap: a flapping peer trips the breaker — while the window
+    is open, probes are skipped entirely (one probe per window instead
+    of a periodic stall-and-retry), and the half-open probe recovers
+    the peer the moment it behaves."""
+    pool, tiers, srv = _tiers_with_server(tmp_path)
+    tiers.disk.put(_digest(1), CANARY, None, _leaves())
+    tiers.disk.flush()
+    client = PrefixClient([f"127.0.0.1:{srv.port}"], tiers.geometry,
+                          backoff_s=0.3, backoff_max_s=1.0,
+                          fail_threshold=1, jitter=0.0)
+    opens = obs.REGISTRY.get("gllm_kvstore_peer_breaker_opens_total")
+    o0 = opens.get(peer=f"127.0.0.1:{srv.port}")
+    FAULTS.arm("peer_flap:0:1")
+    assert client.fetch(_digest(1), list(CANARY)) is None   # flap → open
+    br = list(client._peers.values())[0]["breaker"]
+    assert br.state == "open"
+    assert opens.get(peer=f"127.0.0.1:{srv.port}") == o0 + 1
+    assert obs.REGISTRY.get("gllm_kvstore_peer_breaker_open").get() == 1
+    # inside the window: misses without touching the network, and the
+    # flap point does NOT fire again (the breaker skips the peer first)
+    FAULTS.arm("peer_flap:0:1")
+    for _ in range(5):
+        assert client.fetch(_digest(1), list(CANARY)) is None
+    assert FAULTS.hits.get("peer_flap") == 1
+    FAULTS.reset()
+    # window expires → ONE half-open probe → healthy reply closes the
+    # breaker and the fetch hits
+    time.sleep(0.35)
+    assert client.fetch(_digest(1), list(CANARY)) is not None
+    assert br.state == "closed" and br.probes == 1
+    assert obs.REGISTRY.get("gllm_kvstore_peer_breaker_open").get() == 0
+    health = client.peer_health()[f"127.0.0.1:{srv.port}"]
+    assert health["state"] == "closed" and health["opens"] == 1
+    client.close()
+    tiers.close()
+
+
+@pytest.mark.chaos
+def test_chaos_peer_flap_half_open_failure_doubles_window(tmp_path):
+    pool, tiers, srv = _tiers_with_server(tmp_path)
+    tiers.disk.put(_digest(1), CANARY, None, _leaves())
+    tiers.disk.flush()
+    client = PrefixClient([f"127.0.0.1:{srv.port}"], tiers.geometry,
+                          backoff_s=0.2, backoff_max_s=5.0,
+                          fail_threshold=1, jitter=0.0)
+    br = list(client._peers.values())[0]["breaker"]
+    FAULTS.arm("peer_flap:0:2")       # the initial failure AND the probe
+    assert client.fetch(_digest(1), list(CANARY)) is None
+    assert br.state == "open" and br.trips == 1
+    time.sleep(0.25)
+    assert client.fetch(_digest(1), list(CANARY)) is None   # probe flaps
+    assert br.state == "open" and br.trips == 2
+    assert br.down_for() > 0.25       # 0.2 * 2^1 window
+    client.close()
+    tiers.close()
 
 
 @pytest.mark.chaos

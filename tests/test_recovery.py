@@ -1,0 +1,497 @@
+"""Self-healing engine chaos suite (docs/robustness.md#recovery-lifecycle).
+
+The recovery ladder — healthy → quarantine → latch → rebuilding →
+{ready, crash-loop} — driven end to end through the deterministic fault
+harness:
+
+- latch→rebuild→replay e2e: a replica that latches unhealthy under an
+  injected step-failure burst returns to /readyz-ready WITHOUT a process
+  restart, and in-flight GREEDY and SEEDED requests replayed across the
+  rebuild produce byte-identical token streams (the acceptance
+  headline);
+- engine_hard_crash (loop death outside the quarantine try) takes the
+  same path;
+- crash-loop: K consecutive rebuild_fail injections latch the permanent
+  unhealthy state — the bounded fallback, never an infinite rebuild
+  loop;
+- /readyz state transitions ready→recovering→ready and
+  ready→recovering→unhealthy, with the reason CLASS on the body and the
+  gllm_engine_unhealthy_reason info metric;
+- watchdog HARD stall: a wedged engine thread is abandoned behind a
+  generation bump and the replica recovers;
+- replay-safety partition units (unseeded sampled / mm / tool-stream
+  veto → terminal error chunks carrying Retry-After);
+- journal unit semantics; recovery-off legacy latch unchanged.
+"""
+
+import threading
+import time
+
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.engine import serving_engine as se
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.engine.recovery import JournalEntry, RequestJournal
+from gllm_tpu.engine.serving_engine import (RequestHandle, RequestRejected,
+                                            ServingEngine)
+from gllm_tpu.faults import FAULTS
+from gllm_tpu.sampling_params import SamplingParams
+
+TINY = dict(
+    vocab_size=128, hidden_size=64, num_hidden_layers=2,
+    num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+    max_position_embeddings=512, rms_norm_eps=1e-6, rope_theta=10000.0,
+    tie_word_embeddings=False, eos_token_id=0, bos_token_id=1,
+)
+PROMPT = [5, 17, 93, 41]
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(11)
+    model = LlamaForCausalLM(LlamaConfig(**TINY, attention_bias=False))
+    d = tmp_path_factory.mktemp("recovery_model")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def make_llm(model_dir, **over):
+    cfg = EngineConfig(
+        model=model_dir, dtype="float32", max_model_len=256,
+        scheduler=SchedulerConfig(),
+        cache=CacheConfig(page_size=4, num_pages=128),
+    )
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    cfg.validate()
+    return LLM(config=cfg)
+
+
+def make_recovering(model_dir, **over):
+    over.setdefault("engine_recovery", True)
+    over.setdefault("rebuild_backoff_s", 0.02)
+    over.setdefault("rebuild_backoff_max_s", 0.2)
+    return make_llm(model_dir, **over)
+
+
+@pytest.fixture
+def engines():
+    made = []
+
+    def make(llm, **kw):
+        eng = ServingEngine(llm, **kw)
+        made.append(eng)
+        return eng
+
+    yield make
+    for eng in made:
+        eng.shutdown()
+
+
+def wait_until(cond, timeout=60.0, interval=0.005, what="condition"):
+    limit = time.monotonic() + timeout
+    while time.monotonic() < limit:
+        if cond():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def collect(handle, timeout=90.0):
+    out = []
+    box = {}
+
+    def run():
+        try:
+            for c in handle:
+                out.append(c)
+        except Exception as e:  # pragma: no cover - surfaced below
+            box["err"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout)
+    assert not t.is_alive(), "stream never terminated"
+    if "err" in box:
+        raise box["err"]
+    return out
+
+
+def toks(chunks):
+    return [c.token_id for c in chunks if c.token_id is not None]
+
+
+GREEDY = dict(temperature=0.0, max_tokens=24, ignore_eos=True)
+SEEDED = dict(temperature=0.8, top_p=0.9, seed=1234, max_tokens=24,
+              ignore_eos=True)
+
+
+# ---- journal / replay-safety units -----------------------------------------
+
+def test_journal_semantics():
+    j = RequestJournal()
+    j.record(7, PROMPT, SamplingParams(**GREEDY))
+    j.commit(7, 42)
+    j.commit(7, 43)
+    j.commit(99, 1)                      # unknown seq: ignored
+    e = j.pop(7)
+    assert e.prompt == tuple(PROMPT) and e.committed == [42, 43]
+    assert j.pop(7) is None
+    # adopt re-keys for a second crash
+    j.adopt(12, e)
+    assert len(j) == 1 and j.pop(12) is e
+    j.record(1, PROMPT, SamplingParams(**GREEDY))
+    j.clear()
+    assert len(j) == 0
+
+
+def test_replay_safety_rules():
+    def entry(sp=None, **kw):
+        return JournalEntry(seq_id=0, prompt=tuple(PROMPT),
+                            sampling=sp or SamplingParams(**GREEDY),
+                            **kw)
+
+    assert entry().unsafe_reason() is None
+    assert entry(SamplingParams(**SEEDED)).unsafe_reason() is None
+    # unseeded sampling → unsafe
+    assert "deterministic" in entry(SamplingParams(
+        temperature=0.8, max_tokens=8)).unsafe_reason()
+    assert "multimodal" in entry(mm=True).unsafe_reason()
+    assert "disagg" in entry(disagg=True).unsafe_reason()
+    assert "stop strings" in entry(SamplingParams(
+        temperature=0.0, max_tokens=8, stop=["x"])).unsafe_reason()
+    assert "prompt logprobs" in entry(SamplingParams(
+        temperature=0.0, max_tokens=8,
+        prompt_logprobs=3)).unsafe_reason()
+    # plain per-token logprobs stay safe (they continue token-wise)
+    assert entry(SamplingParams(temperature=0.0, max_tokens=8,
+                                logprobs=2)).unsafe_reason() is None
+    # the api_server tool-stream veto
+    h = RequestHandle(0, len(PROMPT))
+    h.replay_safe = False
+    e = entry()
+    e.handle = h
+    assert "tool-call" in e.unsafe_reason()
+
+
+# ---- the acceptance headline: latch → rebuild → replay, byte-identical -----
+
+@pytest.mark.chaos
+def test_latch_rebuild_replay_byte_identical_streams(tiny_ckpt, engines):
+    """A step-failure burst latches the engine; the supervisor rebuilds
+    it in-process; the in-flight GREEDY and SEEDED requests replay from
+    their committed prefix and the FULL streams (pre-crash chunks +
+    post-rebuild chunks) are byte-identical to a clean engine's — and
+    /readyz returns to ready with zero process restarts."""
+    want_g = make_llm(tiny_ckpt).generate(
+        prompt_token_ids=[list(PROMPT)],
+        sampling_params=SamplingParams(**GREEDY))[0].output_token_ids
+    want_s = make_llm(tiny_ckpt).generate(
+        prompt_token_ids=[[9, 9, 3, 77]],
+        sampling_params=SamplingParams(**SEEDED))[0].output_token_ids
+
+    llm = make_recovering(tiny_ckpt, max_step_failures=1)
+    eng = engines(llm)
+    hg = eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    hs = eng.submit([9, 9, 3, 77], SamplingParams(**SEEDED))
+    # let a few tokens stream, then the failure latches (threshold 1)
+    # and hands the lifecycle to the supervisor — the in-flight batch's
+    # streams stay open for replay instead of dying with error chunks
+    wait_until(lambda: hg.chunks.qsize() >= 3, what="pre-crash tokens")
+    FAULTS.arm("step_exception:0:1")
+    chunks_g, chunks_s = collect(hg), collect(hs)
+    assert chunks_g[-1].finish_reason == "length"
+    assert chunks_s[-1].finish_reason == "length"
+    assert toks(chunks_g) == want_g, "greedy stream diverged"
+    assert toks(chunks_s) == want_s, "seeded stream diverged"
+    # the replica recovered in-process: ready again, same ServingEngine
+    wait_until(lambda: eng.readiness() == (True, "ok"),
+               what="post-recovery readiness")
+    assert eng.supervisor.recoveries == 1
+    assert eng.health()["unhealthy_reason"] is None
+    # and it still serves fresh requests correctly
+    hc = eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    assert toks(collect(hc)) == want_g
+
+
+@pytest.mark.chaos
+def test_engine_hard_crash_recovers_and_replays(tiny_ckpt, engines):
+    """engine_hard_crash kills the loop OUTSIDE the quarantine try (the
+    unhandled-runner-fault shape); the supervisor rebuilds and the
+    greedy in-flight request completes byte-identically."""
+    want = make_llm(tiny_ckpt).generate(
+        prompt_token_ids=[list(PROMPT)],
+        sampling_params=SamplingParams(**GREEDY))[0].output_token_ids
+    llm = make_recovering(tiny_ckpt)
+    eng = engines(llm)
+    h = eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    wait_until(lambda: h.chunks.qsize() >= 2, what="pre-crash tokens")
+    FAULTS.arm("engine_hard_crash:0:1")
+    chunks = collect(h)
+    assert chunks[-1].finish_reason == "length"
+    assert toks(chunks) == want
+    wait_until(lambda: eng.readiness() == (True, "ok"),
+               what="post-recovery readiness")
+    assert FAULTS.hits.get("engine_hard_crash") == 1
+    assert eng.supervisor.recoveries == 1
+
+
+@pytest.mark.chaos
+def test_readyz_transitions_and_crash_loop_latch(tiny_ckpt, engines):
+    """ready → recovering → unhealthy: K injected rebuild_fail faults
+    spend the crash-loop budget and latch today's permanent-unhealthy
+    state; the parked stream gets a terminal error chunk; the reason
+    class reads crash_loop on health() and the info metric."""
+    llm = make_recovering(tiny_ckpt, max_step_failures=1, max_rebuilds=3)
+    eng = engines(llm)
+    assert eng.readiness() == (True, "ok")
+    FAULTS.arm("step_exception:0:1,rebuild_fail:0:3")
+    h = eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    wait_until(lambda: not eng.readiness()[0], what="readiness flip")
+    # the ladder: recovering while rebuilds burn, then the latch
+    wait_until(lambda: eng.readiness() == (False, "unhealthy"),
+               what="crash-loop latch")
+    assert eng.is_alive                    # liveness stays up
+    assert FAULTS.hits.get("rebuild_fail") == 3
+    assert eng.supervisor.rebuilds_failed == 3
+    assert eng.supervisor.recoveries == 0
+    health = eng.health()
+    assert health["unhealthy_reason"] == "crash_loop"
+    assert se._M_UNHEALTHY_REASON.get(reason="crash_loop") == 1
+    assert se._M_UNHEALTHY_REASON.get(reason="step_failures") == 0
+    chunks = collect(h)
+    assert chunks[-1].finish_reason == "error"
+    assert "crash-loop" in (chunks[-1].error or "")
+    with pytest.raises(RequestRejected) as ei:
+        eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    assert ei.value.status == 503
+
+
+@pytest.mark.chaos
+def test_readyz_recovering_state_visible(tiny_ckpt, engines):
+    """ready → recovering → ready observed on the readiness surface
+    (the rebuild window is real wall time, so the intermediate state is
+    pollable), with Retry-After > 0 while recovering."""
+    llm = make_recovering(tiny_ckpt, max_step_failures=1)
+    eng = engines(llm)
+    seen = []
+
+    def watch():
+        while True:
+            r = eng.readiness()
+            if not seen or seen[-1] != r:
+                seen.append(r)
+            if len(seen) >= 3 and r[0]:
+                return
+            time.sleep(0.002)
+
+    t = threading.Thread(target=watch, daemon=True)
+    t.start()
+    FAULTS.arm("step_exception:0:1")
+    h = eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    collect(h)
+    t.join(60)
+    assert not t.is_alive(), f"never returned to ready (saw {seen})"
+    assert (False, "recovering") in seen, seen
+    assert seen[0] == (True, "ok") and seen[-1] == (True, "ok")
+    # while recovering, admission rejects with reason + retry hint
+    FAULTS.arm("step_exception:0:1")
+    h2 = eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    wait_until(lambda: eng.readiness()[1] == "recovering",
+               what="recovering state")
+    assert eng.retry_after_s() > 0
+    with pytest.raises(RequestRejected) as ei:
+        eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    assert ei.value.reason == "recovering" and ei.value.status == 503
+    collect(h2)
+    wait_until(lambda: eng.readiness() == (True, "ok"),
+               what="recovered again")
+    assert eng.supervisor.recoveries == 2
+
+
+@pytest.mark.chaos
+def test_unsafe_requests_dropped_with_retry_after(tiny_ckpt, engines):
+    """Across a recovery, an UNSEEDED sampled request cannot replay: it
+    ends with a terminal error chunk carrying Retry-After, while the
+    greedy sibling replays and completes — no handle ever hangs."""
+    llm = make_recovering(tiny_ckpt, max_step_failures=1)
+    eng = engines(llm)
+    hu = eng.submit(list(PROMPT), SamplingParams(
+        temperature=0.8, max_tokens=24, ignore_eos=True))
+    hg = eng.submit([9, 9, 3, 77], SamplingParams(**GREEDY))
+    wait_until(lambda: hg.chunks.qsize() >= 2, what="pre-crash tokens")
+    FAULTS.arm("step_exception:0:1")
+    chunks_u = collect(hu)
+    assert chunks_u[-1].finish_reason == "error"
+    assert chunks_u[-1].retry_after and chunks_u[-1].retry_after > 0
+    assert "not replay-safe" in (chunks_u[-1].error or "")
+    chunks_g = collect(hg)
+    assert chunks_g[-1].finish_reason == "length"
+    wait_until(lambda: eng.readiness() == (True, "ok"),
+               what="post-recovery readiness")
+
+
+@pytest.mark.chaos
+def test_watchdog_hard_stall_abandons_wedged_thread(tiny_ckpt, engines):
+    """A dispatch stall past watchdog_hard_stall_s escalates to the
+    supervised rebuild: the wedged engine thread is abandoned behind
+    the generation bump (it may wake much later — it must never touch
+    the rebuilt engine's streams) and the replica returns to ready."""
+    want = make_llm(tiny_ckpt).generate(
+        prompt_token_ids=[list(PROMPT)],
+        sampling_params=SamplingParams(**GREEDY))[0].output_token_ids
+    # the HARD threshold sits above the rebuilt engine's cold first
+    # step (compile, ~1s on CPU — the doc's "set S above your longest
+    # legitimate blocking operation"), and the injected wedge (10s)
+    # sits above the supervisor's stall-class 1s join so the thread is
+    # genuinely ABANDONED, not waited out
+    llm = make_recovering(tiny_ckpt, watchdog_stall_s=1.0,
+                          watchdog_hard_stall_s=3.0)
+    eng = engines(llm)
+    # warm first so the stall hits a steady loop, not compile
+    collect(eng.submit(list(PROMPT), SamplingParams(**GREEDY)))
+    wait_until(lambda: eng.readiness() == (True, "ok"), timeout=10.0,
+               what="post-warmup readiness")
+    FAULTS.stall_s = 10.0
+    FAULTS.arm("dispatch_stall:0:1")
+    h = eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    wait_until(lambda: eng.supervisor.recoveries >= 1, timeout=60.0,
+               what="hard-stall recovery")
+    chunks = collect(h)
+    assert chunks[-1].finish_reason == "length"
+    assert toks(chunks) == want
+    wait_until(lambda: eng.readiness() == (True, "ok"),
+               what="post-recovery readiness")
+    # liveness never dropped (the external supervisor must not restart
+    # the process while the internal one rebuilds)
+    assert eng.is_alive
+
+
+# ---- integration edges -----------------------------------------------------
+
+@pytest.mark.chaos
+def test_abort_during_recovery_cancels_replay(tiny_ckpt, engines):
+    llm = make_recovering(tiny_ckpt, max_step_failures=1,
+                          rebuild_backoff_s=0.2, rebuild_backoff_max_s=0.4)
+    eng = engines(llm)
+    FAULTS.arm("step_exception:0:1,rebuild_fail:0:1")  # slow the ladder
+    h = eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    wait_until(lambda: h.seq_id in eng._pending_replay, timeout=30.0,
+               what="request parked for replay")
+    eng.abort(h.seq_id)
+    chunks = collect(h)
+    assert chunks[-1].finish_reason == "abort"
+    wait_until(lambda: eng.readiness() == (True, "ok"),
+               what="post-recovery readiness")
+    assert not eng._handles and not eng._pending_replay
+
+
+@pytest.mark.chaos
+def test_second_crash_replays_again_from_longer_prefix(tiny_ckpt,
+                                                       engines):
+    """The journal re-keys replayed entries: a SECOND latch mid-stream
+    replays the same request again, committed tokens accumulated across
+    both rebuilds, still byte-identical."""
+    want = make_llm(tiny_ckpt).generate(
+        prompt_token_ids=[list(PROMPT)],
+        sampling_params=SamplingParams(**GREEDY))[0].output_token_ids
+    llm = make_recovering(tiny_ckpt, max_step_failures=1)
+    eng = engines(llm)
+    h = eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    wait_until(lambda: h.chunks.qsize() >= 2, what="tokens before crash 1")
+    FAULTS.arm("step_exception:0:1")
+    wait_until(lambda: eng.supervisor.recoveries >= 1, what="recovery 1")
+    wait_until(lambda: eng.readiness() == (True, "ok"), what="ready 1")
+    wait_until(lambda: h.chunks.qsize() >= 6, what="tokens before crash 2")
+    FAULTS.arm("step_exception:0:1")
+    chunks = collect(h)
+    assert chunks[-1].finish_reason == "length"
+    assert toks(chunks) == want
+    wait_until(lambda: eng.supervisor.recoveries >= 2, what="recovery 2")
+
+
+def test_recovery_off_latch_is_permanent(tiny_ckpt, engines):
+    """Flag off = today's behavior byte for byte: the latch is one-way,
+    no supervisor exists, streams end with error chunks."""
+    llm = make_llm(tiny_ckpt, max_step_failures=1)
+    eng = engines(llm)
+    assert eng.supervisor is None and eng._journal is None
+    FAULTS.arm("step_exception:0:1")
+    h = eng.submit(list(PROMPT), SamplingParams(**GREEDY))
+    chunks = collect(h)
+    assert chunks[-1].finish_reason == "error"
+    wait_until(lambda: eng.readiness() == (False, "unhealthy"),
+               what="permanent latch")
+    time.sleep(0.3)
+    assert eng.readiness() == (False, "unhealthy")   # stays latched
+    assert eng.health()["unhealthy_reason"] == "step_failures"
+
+
+def test_config_validation():
+    cfg = EngineConfig(engine_recovery=True)
+    cfg.validate()
+    with pytest.raises(ValueError):
+        EngineConfig(max_rebuilds=0).validate()
+    with pytest.raises(ValueError):
+        EngineConfig(rebuild_backoff_s=5.0,
+                     rebuild_backoff_max_s=1.0).validate()
+    with pytest.raises(ValueError):
+        # hard stall needs recovery + a watchdog
+        EngineConfig(watchdog_hard_stall_s=1.0).validate()
+    with pytest.raises(ValueError):
+        EngineConfig(engine_recovery=True,
+                     watchdog_hard_stall_s=1.0).validate()
+    with pytest.raises(ValueError):
+        EngineConfig(engine_recovery=True, watchdog_stall_s=2.0,
+                     watchdog_hard_stall_s=1.0).validate()
+    EngineConfig(engine_recovery=True, watchdog_stall_s=1.0,
+                 watchdog_hard_stall_s=2.0).validate()
+
+
+# ---- HTTP surface ----------------------------------------------------------
+
+@pytest.mark.chaos
+def test_http_readyz_carries_reason_and_retry_after(tiny_ckpt):
+    """Satellite: the 503 /readyz body names the latch reason class so
+    routers/supervisors can distinguish step-failure latch vs watchdog
+    stall vs crash-loop (the old body was opaque)."""
+    import http.client
+    import json
+    from gllm_tpu.entrypoints.api_server import serve
+    llm = make_llm(tiny_ckpt, max_step_failures=1)
+    httpd = serve(llm, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        FAULTS.arm("step_exception:0:inf")
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/v1/completions", body=json.dumps(
+            {"model": "m", "prompt": PROMPT, "max_tokens": 4,
+             "ignore_eos": True, "temperature": 0.0}),
+            headers={"Content-Type": "application/json"})
+        conn.getresponse().read()
+        conn.close()
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/readyz")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        headers = dict(resp.getheaders())
+        conn.close()
+        assert resp.status == 503
+        assert body["reason"] == "unhealthy"
+        assert body["unhealthy_reason"] == "step_failures"
+        assert "consecutive step failures" in body["detail"]
+        assert int(headers["Retry-After"]) >= 1
+    finally:
+        httpd.shutdown()
+        httpd.state.engine.shutdown()

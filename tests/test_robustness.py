@@ -562,10 +562,12 @@ def test_flags_off_token_stream_matches_offline_generate(tiny_ckpt,
 def test_every_fault_point_has_a_chaos_test():
     """New faults.py injection points cannot land untested: each name
     must appear in the body of at least one @pytest.mark.chaos test in
-    the chaos suites (this file + the kvstore tier chaos tests)."""
+    the chaos suites (this file + the kvstore tier chaos tests + the
+    self-healing recovery suite)."""
     chaos_bodies = []
     here = os.path.dirname(__file__)
-    for fname in (__file__, os.path.join(here, "test_kvstore.py")):
+    for fname in (__file__, os.path.join(here, "test_kvstore.py"),
+                  os.path.join(here, "test_recovery.py")):
         src = open(fname).read()
         tree = ast.parse(src)
         for node in ast.walk(tree):

@@ -1,0 +1,199 @@
+"""Deterministic chaos SOAK (ISSUE 14 satellite; markers: soak + slow).
+
+A multi-minute sustained run driving the three headline fault classes —
+``engine_hard_crash`` (supervised in-process rebuild),
+``disk_read_corrupt`` (prefix-tier poison-drop degradation), and
+``peer_flap`` (per-peer circuit breaker) — under CONCURRENT traffic,
+with acceptance on what production cares about:
+
+- zero leaked KV pages and zero dangling handles/journal entries at
+  the end of the run;
+- every stream terminates (replayed byte-identical, or a terminal
+  chunk — no hang);
+- bounded recovery time per supervised rebuild;
+- the engine ends /readyz-ready without a process restart.
+
+Excluded from tier-1 (slow); run explicitly:
+
+    pytest tests/test_soak_chaos.py -m soak
+
+A guard asserts the marker discipline (soak ⇒ slow) so the suite can
+never leak into tier-1.
+"""
+
+import threading
+import time
+
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.engine.serving_engine import ServingEngine
+from gllm_tpu.faults import FAULTS
+from gllm_tpu.sampling_params import SamplingParams
+
+TINY = dict(
+    vocab_size=128, hidden_size=64, num_hidden_layers=2,
+    num_attention_heads=4, num_key_value_heads=2, intermediate_size=96,
+    max_position_embeddings=512, rms_norm_eps=1e-6, rope_theta=10000.0,
+    tie_word_embeddings=False, eos_token_id=0, bos_token_id=1,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(11)
+    model = LlamaForCausalLM(LlamaConfig(**TINY, attention_bias=False))
+    d = tmp_path_factory.mktemp("soak_model")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+def test_soak_marker_discipline():
+    """Tier-1 runs '-m not slow': a soak test without the slow marker
+    would leak a multi-minute run into every CI pass."""
+    import ast
+    src = open(__file__).read()
+    for node in ast.walk(ast.parse(src)):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        decs = [ast.unparse(d) for d in node.decorator_list]
+        if any("soak" in d for d in decs):
+            assert any("slow" in d for d in decs), (
+                f"{node.name} is soak-marked but not slow-marked")
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+def test_soak_sustained_chaos_under_traffic(tiny_ckpt, tmp_path):
+    """~2 minutes of deterministic chaos: repeated engine hard crashes
+    + disk-tier corruption + a flapping prefix peer, under concurrent
+    greedy/seeded traffic."""
+    cfg = EngineConfig(
+        model=tiny_ckpt, dtype="float32", max_model_len=256,
+        scheduler=SchedulerConfig(),
+        cache=CacheConfig(page_size=4, num_pages=128,
+                          enable_prefix_caching=True,
+                          kv_host_pool_pages=32,
+                          kv_disk_path=str(tmp_path / "kvdisk"),
+                          kv_disk_gb=0.5),
+        engine_recovery=True, max_step_failures=2,
+        rebuild_backoff_s=0.05, rebuild_backoff_max_s=0.5,
+        max_rebuilds=5, rebuild_window_s=20.0)
+    cfg.validate()
+    llm = LLM(config=cfg)
+    baseline_free = llm.memory_manager.allocator.num_free
+    eng = ServingEngine(llm)
+
+    # a flapping peer on the side: the breaker must hold its cost to
+    # one probe per window while the serving plane churns
+    from gllm_tpu.kvstore.peer import PrefixClient
+    geometry = llm.prefix_tiers.geometry
+    srv = llm.prefix_tiers.server or llm.prefix_tiers.start_server(
+        host="127.0.0.1", port=0)
+    peer = PrefixClient([f"127.0.0.1:{srv.port}"], geometry,
+                        backoff_s=0.5, backoff_max_s=2.0,
+                        fail_threshold=1, jitter=0.0)
+
+    deadline = time.monotonic() + 110.0
+    results = {"ok": 0, "dropped": 0, "hung": 0}
+    res_lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(idx):
+        import numpy as np
+        rng = np.random.default_rng(idx)
+        while not stop.is_set() and time.monotonic() < deadline:
+            prompt = rng.integers(1, 120, size=int(
+                rng.integers(4, 24))).tolist()
+            seeded = idx % 2 == 0
+            sp = SamplingParams(
+                temperature=0.8 if seeded else 0.0,
+                seed=int(rng.integers(0, 1 << 30)) if seeded else None,
+                max_tokens=int(rng.integers(8, 32)), ignore_eos=True)
+            try:
+                h = eng.submit(prompt, sp)
+            except Exception:
+                time.sleep(0.05)           # rejected while recovering
+                continue
+            got_terminal = False
+            t0 = time.monotonic()
+            for c in h:
+                if c.finish_reason is not None:
+                    got_terminal = True
+                    with res_lock:
+                        if c.finish_reason == "length":
+                            results["ok"] += 1
+                        else:
+                            results["dropped"] += 1
+                    break
+                if time.monotonic() - t0 > 120:
+                    break
+            if not got_terminal:
+                with res_lock:
+                    results["hung"] += 1
+                return
+
+    workers = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(4)]
+    for w in workers:
+        w.start()
+
+    crashes = 0
+    digest = b"\x07" * 32
+    while time.monotonic() < deadline:
+        time.sleep(6.0)
+        # one hard crash per window, plus tier corruption + peer flap
+        FAULTS.arm("engine_hard_crash:0:1")
+        FAULTS.arm("disk_read_corrupt:0:1")
+        FAULTS.arm("peer_flap:0:1")
+        peer.fetch(digest, list(range(8)))       # drives the breaker
+        crashes += 1
+        # wait for the recovery to complete before the next injection
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0:
+            if eng.readiness() == (True, "ok") and \
+                    FAULTS.hits.get("engine_hard_crash", 0) >= crashes:
+                break
+            time.sleep(0.1)
+    stop.set()
+    for w in workers:
+        w.join(timeout=150)
+        assert not w.is_alive(), "client thread hung"
+
+    # drain: the engine must return to ready and idle
+    limit = time.monotonic() + 60
+    while time.monotonic() < limit and (
+            eng.llm.has_unfinished or not eng.readiness()[0]):
+        time.sleep(0.1)
+    assert eng.readiness() == (True, "ok"), eng.health()
+    assert results["hung"] == 0, results
+    assert results["ok"] > 0, results
+    # bounded recovery: every supervised rebuild completed promptly
+    assert eng.supervisor.recoveries >= 1
+    assert eng.supervisor.last_recovery_s is not None
+    assert eng.supervisor.last_recovery_s < 30.0
+    # zero leaks: pages all free on the CURRENT llm, no dangling
+    # handles/journal entries/pending replays
+    llm_now = eng.llm
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 30 and \
+            llm_now.memory_manager.allocator.num_free != baseline_free:
+        time.sleep(0.1)
+    assert llm_now.memory_manager.allocator.num_free == baseline_free
+    assert not eng._handles and not eng._pending_replay
+    assert len(eng._journal) == 0
+    # the flapped peer is breaker-accounted, never a stall
+    health = peer.peer_health()[f"127.0.0.1:{srv.port}"]
+    assert health["opens"] >= 1
+    peer.close()
+    eng.shutdown()

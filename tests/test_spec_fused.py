@@ -392,3 +392,46 @@ def test_fused_metrics_counter_moves():
     after = sum(m.get(kind=k) for k in ("accepted", "rejected",
                                         "correction"))
     assert after > before
+
+
+# ---- quarantine under spec-fused chains (ISSUE 14 satellite) ---------------
+
+@pytest.mark.chaos
+def test_chaos_step_failure_inside_spec_fused_chain_unwinds_clean():
+    """A step exception while a --spec-fused multi-step block is in
+    flight: quarantine must unwind the FutureMap in-flight entries AND
+    the per-slot spec ring state (the ring rides the handle aux — a
+    cleared entry must never splice into the next chain) without
+    leaking a page, and a fresh run on the same engine must be
+    byte-identical to a clean engine's."""
+    from gllm_tpu import faults
+    llm = mk(num_pages=64, **FUSED, decode_slot_batching=True,
+             ondevice_finish=True, pipelined_loop=True)
+    baseline = llm.memory_manager.allocator.num_free
+    want = run(mk(num_pages=64))
+    for p in PROMPTS:
+        llm.add_seq(llm._allocate_seq(list(p), SamplingParams(
+            temperature=0.0, max_tokens=24, ignore_eos=True)))
+    # let spec chains form and run ahead, then poison one step
+    for _ in range(3):
+        llm.step()
+    assert llm._in_flight, "no spec chain in flight — test is inert"
+    faults.FAULTS.arm("step_exception:0:1")
+    try:
+        with pytest.raises(faults.InjectedFault):
+            for _ in range(80):
+                llm.step()
+    finally:
+        faults.FAULTS.reset()
+    dropped = llm.quarantine_step_failure()
+    assert dropped
+    # FutureMap in-flight entries unwound, chain/spec carry cleared
+    assert not llm._in_flight and llm._chain_tip is None
+    assert not llm.has_unfinished
+    # zero leaked pages (slot holes, verify-row strides, spec
+    # over-promise headroom all returned)
+    assert llm.memory_manager.allocator.num_free == baseline
+    # the SAME engine serves a fresh workload byte-identically — the
+    # per-slot recent-token ring re-seeds from committed tokens at the
+    # next chain root, never from the quarantined block's carry
+    assert run(llm) == want
