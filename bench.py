@@ -1095,6 +1095,146 @@ def main():
             f"({faulted['recoveries']} recoveries, recovery_s="
             f"{chaos_result['recovery_s']})")
 
+    # Fleet failover lever (ISSUE 15, GLLM_BENCH_FLEET=1): two
+    # in-process replicas — real HTTP api_servers — behind the front
+    # router core; a clean pass, then a pass with a time-based mid-pass
+    # REPLICA KILL (engine + server torn down). Greedy ignore_eos makes
+    # every stream replay-safe, so every stream on the dead replica
+    # must MIGRATE and the client-side token count must not drop:
+    # lost_tokens is asserted 0 — the cost of losing a replica shows up
+    # as wall clock and failover_s, never as lost output.
+    fleet_result = None
+    if os.environ.get("GLLM_BENCH_FLEET", "0") not in ("", "0"):
+        phase("fleet_pass")
+        import threading as _th
+        from gllm_tpu.entrypoints.api_server import serve as _serve
+        from gllm_tpu.router import FrontRouter
+        from gllm_tpu.router import core as _rcore
+        n_fleet = min(n_requests, 8 if args.tiny else 16)
+        fl_prompts = [list(p) for p in prompts[:n_fleet]]
+        fl_tokens = [min(p.max_tokens, 64) for p in params[:n_fleet]]
+
+        class _Sink:
+            # FrontRouter.stream's downstream surface, minus the HTTP
+            # hop — the router core + replica HTTP path is the measured
+            # object; one SSE event per token makes counting exact
+            def __init__(self):
+                self.started = False
+                self.tokens = 0
+                self.finish = None
+                self.error = None
+
+            def start(self):
+                self.started = True
+
+            def send(self, ev):
+                if "choices" in ev:
+                    # one SSE event per generated token; the finish
+                    # reason rides the LAST token's chunk, so events
+                    # count tokens exactly
+                    self.tokens += 1
+                    fin = ev["choices"][0].get("finish_reason")
+                    if fin:
+                        self.finish = fin
+                        if fin in ("error", "abort"):
+                            self.error = f"finish={fin}"
+                elif "error" in ev:
+                    self.error = ev["error"].get("message")
+
+            def done(self):
+                pass
+
+            def fail_json(self, status, obj, headers):
+                self.error = f"{status}: {obj}"
+
+        def fleet_arm(kill_delay_s=None):
+            reps = []
+            for _ in range(2):
+                llm_r = LLM(config=engine_cfg, model_cfg=model_cfg)
+                httpd = _serve(llm_r, "127.0.0.1", 0)
+                _th.Thread(target=httpd.serve_forever,
+                           daemon=True).start()
+                reps.append(httpd)
+            fr = FrontRouter(
+                [f"127.0.0.1:{h.server_address[1]}" for h in reps],
+                probe_interval_s=0.1, breaker_base_s=0.5,
+                breaker_jitter=0.0, stream_idle_timeout_s=300.0)
+            fo_before = _rcore._M_FAILOVERS.get(outcome="ok")
+            _, fs_sum0, fs_n0 = _rcore._M_FAILOVER_S.snapshot()
+            sinks = [_Sink() for _ in range(n_fleet)]
+            timer = None
+            try:
+                t0 = time.monotonic()
+                if kill_delay_s is not None:
+                    def kill():
+                        reps[0].state.engine.shutdown()
+                        reps[0].shutdown()
+                        reps[0].server_close()
+                    timer = _th.Timer(kill_delay_s, kill)
+                    timer.daemon = True
+                    timer.start()
+                threads = [_th.Thread(
+                    target=fr.stream,
+                    args=("completion",
+                          {"prompt": p, "max_tokens": mt,
+                           "temperature": 0, "ignore_eos": True,
+                           "stream": True}, s),
+                    daemon=True)
+                    for p, mt, s in zip(fl_prompts, fl_tokens, sinks)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=600)
+                    assert not t.is_alive(), "fleet-arm stream hung"
+                dt_arm = time.monotonic() - t0
+            finally:
+                if timer is not None:
+                    timer.cancel()
+                fr.close()
+                for h in reps:
+                    try:
+                        h.shutdown()
+                        h.state.engine.shutdown()
+                    except Exception:
+                        pass        # the killed replica is already down
+            _, fs_sum1, fs_n1 = _rcore._M_FAILOVER_S.snapshot()
+            migrated = _rcore._M_FAILOVERS.get(outcome="ok") - fo_before
+            errors = [s.error for s in sinks if s.error]
+            assert not errors, f"fleet-arm stream errors: {errors[:3]}"
+            return {"tok": sum(s.tokens for s in sinks), "dt": dt_arm,
+                    "migrated": int(migrated),
+                    "failover_s": (round((fs_sum1 - fs_sum0)
+                                         / (fs_n1 - fs_n0), 3)
+                                   if fs_n1 > fs_n0 else None)}
+
+        clean = fleet_arm(None)
+        assert clean["tok"] == sum(fl_tokens), (
+            "clean fleet arm dropped tokens", clean["tok"],
+            sum(fl_tokens))
+        faulted = fleet_arm(max(0.05, 0.4 * clean["dt"]))
+        lost = clean["tok"] - faulted["tok"]
+        assert lost == 0, (
+            "replica kill lost tokens despite journal-backed failover "
+            f"({faulted['tok']} vs {clean['tok']})")
+        assert faulted["migrated"] > 0, \
+            "the mid-pass kill migrated no stream"
+        tps_clean = clean["tok"] / clean["dt"]
+        tps_fault = faulted["tok"] / faulted["dt"]
+        fleet_result = {
+            "requests": n_fleet,
+            "replicas": 2,
+            "output_tok_s": round(tps_fault, 2),
+            "output_tok_s_clean": round(tps_clean, 2),
+            "degradation_frac": round(1.0 - tps_fault / tps_clean, 4),
+            "streams_migrated": faulted["migrated"],
+            "failover_s": faulted["failover_s"],
+            "lost_tokens": int(lost),
+        }
+        log(f"fleet pass: {tps_clean:.1f} tok/s clean -> "
+            f"{tps_fault:.1f} tok/s across a mid-pass replica kill "
+            f"({faulted['migrated']} streams migrated, failover_s="
+            f"{faulted['failover_s']}, lost_tokens=0)")
+
     phase("report")
     # MFU: every processed token (prompt + output) makes one forward pass.
     total_proc = total_in + total_out
@@ -1182,6 +1322,12 @@ def main():
         # throughput under an injected hard crash vs clean, and the
         # latch-to-ready recovery wall — first-class
         result["chaos"] = chaos_result
+    if fleet_result is not None:
+        # fleet failover (ISSUE 15, GLLM_BENCH_FLEET=1): two replicas
+        # behind the front router, a mid-pass replica kill — throughput
+        # degradation, streams migrated, failover wall, and the
+        # zero-lost-tokens contract — first-class
+        result["fleet"] = fleet_result
     print(json.dumps(result))
 
 

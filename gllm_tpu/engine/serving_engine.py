@@ -485,6 +485,80 @@ class ServingEngine:
         self._wake.set()
         return handle
 
+    def _alloc_committed(self, llm, prompt_ids, committed_ids,
+                         sampling_params):
+        """Allocate a sequence that CONTINUES from a committed prefix:
+        prompt + committed resubmitted with the ORIGINAL prompt_len
+        (num_output_tokens counts the committed tokens, so max_tokens /
+        min_tokens / penalties and the seeded sampling out_step all
+        continue exactly), committed output text re-detokenized so the
+        handle's char cursor lines up and only NEW deltas stream. The
+        ONE definition of replay adoption — the in-process recovery
+        path (_adopt_llm) and the cross-replica continuation path
+        (submit_continuation) must never drift apart. Caller holds
+        self._lock."""
+        seq = llm._allocate_seq(list(prompt_ids) + list(committed_ids),
+                                sampling_params)
+        seq.prompt_len = len(prompt_ids)
+        if llm.tokenizer is not None and committed_ids:
+            seq.detok_prefix_offset = max(0, len(prompt_ids) - 6)
+            seq.detok_read_offset = len(prompt_ids)
+            llm._stream_detokenize(seq)
+            self._emitted[seq.seq_id] = len(seq.output_text)
+        return seq
+
+    def submit_continuation(self, prompt_ids: List[int],
+                            committed_ids: List[int],
+                            sampling_params: SamplingParams,
+                            deadline_s: Optional[float] = None,
+                            target_dp: Optional[int] = None
+                            ) -> RequestHandle:
+        """Cross-replica failover continuation (docs/robustness.md#fleet
+        -topology--failover): resume a retry-safe stream another replica
+        started, from its committed prefix. Rides EXACTLY the replay
+        semantics ``_adopt_llm`` proved in-process — ``prompt +
+        committed`` resubmitted with the ORIGINAL prompt_len, so
+        num_output_tokens counts the committed tokens and max_tokens /
+        min_tokens / penalties / the seeded sampling out_step all
+        continue where the dead replica's stream stopped. The committed
+        output text is re-detokenized so the handle's char cursor lines
+        up and only NEW deltas stream. The front router is the caller
+        (via the api_server ``gllm_continuation`` path); the safety
+        predicate (greedy or seeded, no mm/disagg/stop-strings/
+        prompt_logprobs) is enforced router-side before resubmission."""
+        sampling_params.validate()
+        self._admit()
+        prompt_ids = [int(t) for t in prompt_ids]
+        committed_ids = [int(t) for t in committed_ids]
+        ttl = (deadline_s if deadline_s is not None
+               else sampling_params.deadline_s
+               if sampling_params.deadline_s is not None
+               else self.request_deadline_s)
+        with self._lock:
+            seq = self._alloc_committed(self.llm, prompt_ids,
+                                        committed_ids, sampling_params)
+            if target_dp is not None:
+                seq.target_dp = target_dp
+            handle = RequestHandle(seq.seq_id, len(prompt_ids),
+                                   engine=self)
+            self._handles[seq.seq_id] = handle
+            self._seqs[seq.seq_id] = seq
+            if ttl and ttl > 0:
+                self._deadlines[seq.seq_id] = time.monotonic() + ttl
+            if self._journal is not None:
+                # journal as prompt + already-committed so a LOCAL crash
+                # after adoption replays the same request again
+                self._journal.record(seq.seq_id, prompt_ids,
+                                     sampling_params,
+                                     target_dp=target_dp)
+                for t in committed_ids:
+                    self._journal.commit(seq.seq_id, t)
+            _M_SUBMITTED.inc()
+            _M_ACTIVE.set(len(self._handles))
+        self._intake.put(seq)
+        self._wake.set()
+        return handle
+
     def abort(self, seq_id: int) -> None:
         entry = self._pending_replay.get(seq_id)
         if entry is not None:
@@ -880,25 +954,14 @@ class ServingEngine:
             sp = copy.deepcopy(entry.sampling)
             with self._lock:
                 # prompt + committed resubmits with the ORIGINAL
-                # prompt_len: num_output_tokens counts the committed
-                # tokens, so max_tokens / min_tokens / penalties and
-                # the seeded sampling out_step all continue exactly
-                # where the delivered stream stopped — byte-identical
-                # continuation for greedy and seeded requests
-                seq = llm._allocate_seq(
-                    list(entry.prompt) + list(entry.committed), sp)
-                seq.prompt_len = len(entry.prompt)
+                # prompt_len — byte-identical continuation for greedy
+                # and seeded requests (_alloc_committed is the shared
+                # adoption recipe; the router's cross-replica
+                # continuation path rides the same one)
+                seq = self._alloc_committed(llm, entry.prompt,
+                                            entry.committed, sp)
                 if entry.target_dp is not None:
                     seq.target_dp = entry.target_dp
-                if llm.tokenizer is not None and entry.committed:
-                    # reconstruct the committed output text so the
-                    # handle's char cursor (and final_text) line up
-                    # with what was already streamed
-                    seq.detok_prefix_offset = max(
-                        0, len(entry.prompt) - 6)
-                    seq.detok_read_offset = len(entry.prompt)
-                    llm._stream_detokenize(seq)
-                    self._emitted[seq.seq_id] = len(seq.output_text)
                 h.seq_id = seq.seq_id
                 self._handles[seq.seq_id] = h
                 self._seqs[seq.seq_id] = seq

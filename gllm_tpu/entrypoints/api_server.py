@@ -18,12 +18,16 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
+import socket
 import threading
 import time
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import gllm_tpu
+from gllm_tpu import faults
 from gllm_tpu.config import (CacheConfig, EngineConfig, ParallelConfig,
                              SchedulerConfig)
 from gllm_tpu.engine.llm import LLM
@@ -36,7 +40,8 @@ logger = logging.getLogger(__name__)
 class ServerState:
     def __init__(self, llm: LLM, served_model: str,
                  tool_parser: Optional[str] = None, engine=None,
-                 pin_dp: Optional[int] = None):
+                 pin_dp: Optional[int] = None,
+                 replica_id: Optional[str] = None):
         from gllm_tpu.entrypoints.tool_parsers import get_tool_parser
         self._llm = llm
         self.engine = engine if engine is not None else ServingEngine(llm)
@@ -45,6 +50,14 @@ class ServerState:
         # pinned to replica ``pin_dp`` (reference --endpoint-per-dp)
         self.pin_dp = pin_dp
         self.start_time = time.time()
+        # fleet identity (docs/robustness.md#fleet-topology--failover):
+        # replica_id is stable for the life of THIS process; together
+        # with start_time + the supervised-recovery engine generation it
+        # lets a front router detect a silent restart (same address, new
+        # process) explicitly instead of inferring it from lost streams
+        self.replica_id = (replica_id
+                           or os.environ.get("GLLM_REPLICA_ID")
+                           or uuid.uuid4().hex[:12])
         # jax.profiler state: _profile_mu makes every check+transition
         # atomic across the legacy /start_profile//stop_profile pair
         # and the POST /profile one-shot; _profiling_oneshot marks a
@@ -309,9 +322,24 @@ class Handler(BaseHTTPRequestHandler):
                 "created": int(st.start_time), "owned_by": "gllm-tpu"}]})
         elif self.path == "/server_info":
             cfg = st.llm.config
+            eng = st.engine
+            sup = getattr(eng, "supervisor", None)
             self._json({
                 "model": cfg.model,
                 "uptime_s": round(time.time() - st.start_time, 1),
+                # explicit restart detection for the front router
+                # (docs/robustness.md#fleet-topology--failover): a new
+                # replica_id or start_time at the same address is a
+                # process restart (journaled streams are gone); a bumped
+                # engine_generation is a SUPERVISED in-process recovery
+                # (streams replay locally, the router need not act)
+                "replica": {
+                    "replica_id": st.replica_id,
+                    "start_time": round(st.start_time, 3),
+                    "engine_generation": getattr(eng, "_gen", 0),
+                    "recoveries": (sup.recoveries
+                                   if sup is not None else 0),
+                },
                 "max_model_len": cfg.max_model_len,
                 "schedule_method": cfg.scheduler.schedule_method,
                 "page_size": cfg.cache.page_size,
@@ -360,6 +388,8 @@ class Handler(BaseHTTPRequestHandler):
                 self._profile(False)
             elif self.path.split("?", 1)[0] == "/profile":
                 self._profile_oneshot()
+            elif self.path == "/fault_inject":
+                self._fault_inject()
             else:
                 self._json(proto.error_response("not found", 404), code=404)
         except proto.ProtocolError as e:
@@ -459,6 +489,10 @@ class Handler(BaseHTTPRequestHandler):
                     first_err = first_err or c[1]
                     continue
                 self._sse(make_chunk(c.text or "", c.finish_reason, i))
+                if c.finish_reason in ("error", "abort", "deadline") \
+                        and (c.error or c.retry_after is not None):
+                    self._sse(proto.stream_error_event(
+                        c.error, c.finish_reason, c.retry_after))
             if first_err is not None:
                 # a choice died mid-stream: abort the rest and close the
                 # connection without [DONE] so the client sees a broken
@@ -500,11 +534,72 @@ class Handler(BaseHTTPRequestHandler):
         tok = self.state.llm.tokenizer
         return tok.decode([token_id]) if tok is not None else str(token_id)
 
+    def _fault_inject(self):
+        """Admin fault arming over the wire (chaos harnesses / soak
+        rigs only): POST {"spec": "point[:after_n[:count]]"} arms
+        gllm_tpu.faults points on this live server, {"reset": true}
+        disarms everything. 404 unless GLLM_FAULT_INJECT_HTTP=1 — a
+        production server must not expose a self-sabotage endpoint."""
+        if os.environ.get("GLLM_FAULT_INJECT_HTTP", "0") in ("", "0"):
+            self._json(proto.error_response("not found", 404), code=404)
+            return
+        body = self._read_json()
+        if body.get("reset"):
+            faults.FAULTS.reset()
+        spec = body.get("spec", "")
+        if spec:
+            try:
+                faults.FAULTS.arm(spec)
+            except ValueError as e:
+                raise proto.ProtocolError(str(e))
+        self._json({"status": "ok",
+                    "armed": {p: list(v) for p, v in
+                              faults.FAULTS.armed_state().items()},
+                    "hits": dict(faults.FAULTS.hits)})
+
+    def _router_preamble(self, rid, ids, sp, mm, disagg):
+        """First SSE event of a router-proxied stream
+        (docs/robustness.md#fleet-topology--failover): the prompt token
+        ids the router needs to journal the stream for cross-replica
+        continuation, this replica's identity, and the PR 14 replay-
+        safety verdict (None = the stream may fail over mid-flight)."""
+        from gllm_tpu.engine.recovery import JournalEntry
+        entry = JournalEntry(seq_id=0, prompt=tuple(ids), sampling=sp,
+                             mm=mm, disagg=disagg)
+        return {"gllm": {
+            "prompt_token_ids": [int(t) for t in ids],
+            "request_id": rid,
+            "replica_id": self.state.replica_id,
+            "unsafe_reason": entry.unsafe_reason(),
+        }}
+
     def _chat(self):
         st = self.state
+        body = self._read_json()
+        # internal front-router extension (gllm_tpu/router/): never set
+        # by OpenAI clients; asks for the journaling preamble +
+        # per-token ids, and carries the committed prefix when this
+        # request CONTINUES a stream a dead replica started
+        router = body.pop("gllm_router", None)
+        cont = (router or {}).get("continuation")
         req = proto.ChatCompletionRequest.from_dict(
-            self._read_json(), default_max_tokens=256)
-        ids, mm_input = st.encode_chat(req)
+            body, default_max_tokens=256)
+        if cont is not None:
+            # continuation prompts arrive as the original token ids —
+            # re-encoding (and multimodal processing) is skipped; the
+            # safety predicate already vetoed mm/disagg router-side
+            ids, mm_input = [int(t) for t in
+                             cont.get("prompt_token_ids", [])], None
+            if not ids:
+                raise proto.ProtocolError(
+                    "gllm_router.continuation needs prompt_token_ids")
+        else:
+            ids, mm_input = st.encode_chat(req)
+        if cont is not None and (not req.stream or req.n != 1):
+            # the n>1 path would silently drop committed_token_ids and
+            # stream fresh generations off the bare continuation prompt
+            raise proto.ProtocolError(
+                "gllm_router.continuation requires stream=true, n=1")
         if not req.stream:
             results, usage = self._run_choices(req, ids, mm_input)
             choices = []
@@ -547,10 +642,15 @@ class Handler(BaseHTTPRequestHandler):
                               chat_completion_chunk(rid, req.model, text,
                                                     fin, index=i))
             return
-        handle = st.engine.submit(list(ids), req.sampling,
-                                  mm_input=mm_input,
-                                  disagg_items=disagg_items,
-                                  target_dp=st.pin_dp)
+        if cont is not None:
+            handle = st.engine.submit_continuation(
+                ids, cont.get("committed_token_ids", []), req.sampling,
+                target_dp=st.pin_dp)
+        else:
+            handle = st.engine.submit(list(ids), req.sampling,
+                                      mm_input=mm_input,
+                                      disagg_items=disagg_items,
+                                      target_dp=st.pin_dp)
         if req.stream and parse_tools:
             # Incremental tool streaming (reference streams tool deltas):
             # text deltas flow through live; only potential-markup suffixes
@@ -580,35 +680,66 @@ class Handler(BaseHTTPRequestHandler):
                     self._sse(chunk)
 
             fin = None
+            err_ev = None
             try:
                 for chunk_out in handle:
                     emit(*stream.feed(chunk_out.text or ""))
                     fin = chunk_out.finish_reason or fin
+                    if fin in ("error", "abort", "deadline") and (
+                            chunk_out.error
+                            or chunk_out.retry_after is not None):
+                        err_ev = proto.stream_error_event(
+                            chunk_out.error, fin, chunk_out.retry_after)
                 emit(*stream.finish())
                 if stream.saw_tool_calls:
                     fin = "tool_calls"
                 self._sse(proto.chat_completion_chunk(rid, req.model, None,
                                                       fin))
+                if err_ev is not None:
+                    self._sse(err_ev)
                 self.wfile.write(b"data: [DONE]\n\n")
                 self.wfile.flush()
             except (BrokenPipeError, ConnectionResetError):
                 st.engine.abort(handle.seq_id)
         elif req.stream:
-            rid = proto.new_request_id(chat=True)
-            if not self._sse_open(
-                    [handle], proto.chat_completion_chunk(
-                        rid, req.model, None, None, role=True)):
+            rid = ((router or {}).get("request_id")
+                   or proto.new_request_id(chat=True))
+            preamble = []
+            if router is not None:
+                preamble.append(self._router_preamble(
+                    rid, ids, req.sampling, mm_input is not None,
+                    disagg_items is not None))
+            if cont is None:
+                # a continuation's client already holds the role chunk
+                # from the replica that started the stream
+                preamble.append(proto.chat_completion_chunk(
+                    rid, req.model, None, None, role=True))
+            if not self._sse_open([handle], *preamble):
                 return
             self._stream(handle, lambda text, fin: proto.
-                         chat_completion_chunk(rid, req.model, text, fin))
+                         chat_completion_chunk(rid, req.model, text, fin),
+                         router=router is not None)
 
     def _completion(self):
         st = self.state
+        body = self._read_json()
+        router = body.pop("gllm_router", None)
+        cont = (router or {}).get("continuation")
         req = proto.CompletionRequest.from_dict(
-            self._read_json(), default_max_tokens=256)
-        ids = st.encode_completion(req)
+            body, default_max_tokens=256)
+        if cont is not None:
+            if not req.stream or req.n != 1:
+                raise proto.ProtocolError(
+                    "gllm_router.continuation requires stream=true, n=1")
+            ids = [int(t) for t in cont.get("prompt_token_ids", [])]
+            if not ids:
+                raise proto.ProtocolError(
+                    "gllm_router.continuation needs prompt_token_ids")
+        else:
+            ids = st.encode_completion(req)
         if req.stream:
-            rid = proto.new_request_id(chat=False)
+            rid = ((router or {}).get("request_id")
+                   or proto.new_request_id(chat=False))
             # submit before the SSE headers (see _chat): submit errors
             # still get a JSON error response
             if req.n > 1:
@@ -620,12 +751,22 @@ class Handler(BaseHTTPRequestHandler):
                                                    text or "", fin,
                                                    index=i))
                 return
-            handle = st.engine.submit(ids, req.sampling,
-                                      target_dp=st.pin_dp)
-            if not self._sse_open([handle]):
+            if cont is not None:
+                handle = st.engine.submit_continuation(
+                    ids, cont.get("committed_token_ids", []),
+                    req.sampling, target_dp=st.pin_dp)
+            else:
+                handle = st.engine.submit(ids, req.sampling,
+                                          target_dp=st.pin_dp)
+            preamble = []
+            if router is not None:
+                preamble.append(self._router_preamble(
+                    rid, ids, req.sampling, False, False))
+            if not self._sse_open([handle], *preamble):
                 return
             self._stream(handle, lambda text, fin: proto.completion_chunk(
-                rid, req.model, text or "", fin))
+                rid, req.model, text or "", fin),
+                router=router is not None)
             return
         results, usage = self._run_choices(req, ids)
         choices = []
@@ -671,14 +812,38 @@ class Handler(BaseHTTPRequestHandler):
         return {"text": text, "finish": finish,
                 "usage": usage, "lp": lp or None, "plp": plp}
 
-    def _stream(self, handle, make_chunk):
+    def _stream(self, handle, make_chunk, router: bool = False):
         try:
             for chunk in handle:
+                # chaos points (docs/robustness.md#fleet): replica_kill
+                # hard-closes the connection mid-stream — from a front
+                # router's side this is the serving process dying;
+                # replica_hang stalls before the next chunk (the wedged
+                # replica the router's idle timeout must catch)
+                if faults.FAULTS.fire("replica_kill"):
+                    self.state.engine.abort(handle.seq_id)
+                    try:
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.close_connection = True
+                    return
+                faults.FAULTS.maybe_stall("replica_hang")
                 # one SSE event per generated token (even when incremental
                 # detokenization held text back) — clients measure ITL from
                 # event arrivals
-                self._sse(make_chunk(chunk.text or "",
-                                     chunk.finish_reason))
+                ev = make_chunk(chunk.text or "", chunk.finish_reason)
+                if router and chunk.token_id is not None:
+                    # per-token ids for the front router's stream
+                    # journal (stripped before the client sees them)
+                    ev["gllm"] = {"token_id": int(chunk.token_id)}
+                self._sse(ev)
+                if chunk.finish_reason in ("error", "abort", "deadline") \
+                        and (chunk.error
+                             or chunk.retry_after is not None):
+                    self._sse(proto.stream_error_event(
+                        chunk.error, chunk.finish_reason,
+                        chunk.retry_after))
             self.wfile.write(b"data: [DONE]\n\n")
             self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
@@ -1054,6 +1219,12 @@ def make_parser() -> argparse.ArgumentParser:
                         "engine thread; needs --engine-recovery and "
                         "--watchdog-stall-s; 0 = soft readiness flips "
                         "only)")
+    p.add_argument("--replica-id", default=None,
+                   help="stable fleet identity advertised on "
+                        "/server_info (with start_time + engine "
+                        "generation) so a front router detects silent "
+                        "process restarts; default: random per process "
+                        "(env GLLM_REPLICA_ID)")
     p.add_argument("--fault-inject", default="",
                    help="deterministic fault injection spec "
                         "'point[:after_n[:count]][,...]' "
@@ -1097,10 +1268,12 @@ def serve(llm: LLM, host: str, port: int,
           served_model: Optional[str] = None,
           tool_parser: Optional[str] = None,
           pin_dp: Optional[int] = None,
-          engine=None) -> ThreadingHTTPServer:
+          engine=None,
+          replica_id: Optional[str] = None) -> ThreadingHTTPServer:
     """Build the HTTP server (caller decides foreground vs thread)."""
     state = ServerState(llm, served_model or llm.config.model, tool_parser,
-                        engine=engine, pin_dp=pin_dp)
+                        engine=engine, pin_dp=pin_dp,
+                        replica_id=replica_id)
     handler = type("BoundHandler", (Handler,), {"state": state})
     httpd = ThreadingHTTPServer((host, port), handler)
     httpd.state = state
@@ -1205,7 +1378,8 @@ def main(argv=None):
     else:
         httpd = serve(llm, args.host, args.port,
                       args.served_model_name or args.model,
-                      tool_parser=args.tool_call_parser)
+                      tool_parser=args.tool_call_parser,
+                      replica_id=args.replica_id)
     logger.info("serving %s on %s:%d", args.model, args.host, args.port)
     try:
         httpd.serve_forever()

@@ -289,5 +289,20 @@ def error_response(message: str, code: int = 400) -> dict:
                       "code": code}}
 
 
+def stream_error_event(message, finish_reason: str = "error",
+                       retry_after=None) -> dict:
+    """Terminal SSE error event: emitted after the finish chunk when a
+    stream ends with a server-side failure, carrying the failure detail
+    and — for transient failures — the ``retry_after`` backoff hint
+    (the StreamChunk.retry_after the plain-chunk rendering used to
+    drop). Routers and backoff-aware clients key on it; ordinary
+    clients that stop at the finish_reason chunk are unaffected."""
+    err = {"message": message or finish_reason, "type": "server_error",
+           "code": finish_reason}
+    if retry_after is not None:
+        err["retry_after"] = round(float(retry_after), 3)
+    return {"error": err}
+
+
 def new_request_id(chat: bool) -> str:
     return _id("chatcmpl" if chat else "cmpl")

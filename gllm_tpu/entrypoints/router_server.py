@@ -1,0 +1,297 @@
+"""Fleet front-router HTTP server (docs/robustness.md#fleet-topology--
+failover).
+
+OpenAI-compatible frontend over N api_server replicas::
+
+    python -m gllm_tpu.entrypoints.router_server \\
+        --replicas host1:8000,host2:8000 --port 8080
+
+Routes:
+
+- ``POST /v1/chat/completions`` / ``POST /v1/completions`` — placed on a
+  ready replica (session/prefix affinity); streaming requests are
+  journaled and fail over across replica death mid-stream
+- ``GET /v1/models`` — proxied from a ready replica
+- ``GET /healthz`` — router process liveness (always 200)
+- ``GET /readyz`` — 200 iff ≥ 1 replica is in rotation, else 503 +
+  Retry-After (soonest breaker-window / replica Retry-After expiry)
+- ``GET /metrics`` — the router's own gllm_router_* metrics
+  (Prometheus text)
+- ``GET /router_info`` — replica states, breaker health, active streams
+- ``POST /admin/drain`` / ``/admin/undrain`` — {"replica": "host:port"}:
+  take a replica out of rotation (in-flight streams finish or, if it
+  dies while draining, migrate) / put it back
+
+Stdlib-only and jax-free: the router deploys on frontend nodes with no
+accelerator.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from gllm_tpu.entrypoints import protocol as proto
+from gllm_tpu.router import FrontRouter
+from gllm_tpu.router.core import ClientGone
+
+logger = logging.getLogger(__name__)
+
+
+class _SSEOut:
+    """The downstream surface FrontRouter.stream drives: lazy SSE
+    headers (a submit-time error can still be a clean JSON response),
+    event writes that surface client disconnects as ClientGone."""
+
+    def __init__(self, handler: "RouterHandler"):
+        self._h = handler
+        self.started = False
+
+    def start(self) -> None:
+        if self.started:
+            return
+        self.started = True
+        h = self._h
+        h.send_response(200)
+        h.send_header("Content-Type", "text/event-stream")
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("Connection", "close")
+        h.end_headers()
+
+    def send(self, obj: dict) -> None:
+        self.start()
+        try:
+            self._h.wfile.write(b"data: "
+                                + json.dumps(obj).encode() + b"\n\n")
+            self._h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError) as e:
+            raise ClientGone(str(e))
+
+    def done(self) -> None:
+        try:
+            self._h.wfile.write(b"data: [DONE]\n\n")
+            self._h.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError) as e:
+            raise ClientGone(str(e))
+
+    def fail_json(self, status: int, obj: dict, headers: dict) -> None:
+        assert not self.started, "SSE already started"
+        self._h._json(obj, code=status, headers=headers)
+
+
+class RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    router: FrontRouter = None  # injected by serve_router
+
+    def log_message(self, fmt, *args):
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    # ---- helpers ----------------------------------------------------------
+
+    def _json(self, obj, code=200, headers=None):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            d = json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise proto.ProtocolError(f"invalid JSON body: {e}") from e
+        if not isinstance(d, dict):
+            raise proto.ProtocolError("request body must be a JSON object")
+        return d
+
+    def _session(self, body: dict):
+        """Affinity key: explicit header beats the OpenAI ``user``
+        field; absent = no stickiness."""
+        return (self.headers.get("X-Session-Id")
+                or body.get("user") or None)
+
+    def _forward(self, result) -> None:
+        status, raw, headers = result
+        self.send_response(status)
+        self.send_header("Content-Type",
+                         headers.get("Content-Type", "application/json"))
+        self.send_header("Content-Length", str(len(raw)))
+        for k, v in headers.items():
+            if k.lower() not in ("content-type", "content-length"):
+                self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(raw)
+
+    # ---- routes -----------------------------------------------------------
+
+    def do_GET(self):
+        r = self.router
+        if self.path in ("/health", "/healthz"):
+            self._json({"status": "ok"})
+        elif self.path == "/readyz":
+            h = r.health()
+            if h["ready"]:
+                self._json({"status": "ok",
+                            "replicas_in_rotation":
+                                h["replicas_in_rotation"]})
+            else:
+                self._json(
+                    {"status": "unavailable",
+                     "reason": "no replica in rotation"},
+                    code=503,
+                    headers={"Retry-After":
+                             str(int(h["retry_after_s"] or 5))})
+        elif self.path == "/metrics":
+            from gllm_tpu.obs import metrics as obs_metrics
+            body = obs_metrics.render().encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/router_info":
+            self._json(r.health())
+        elif self.path == "/v1/models":
+            self._forward(r.proxy("GET", "/v1/models", kind="models"))
+        else:
+            self._json(proto.error_response("not found", 404), code=404)
+
+    def do_POST(self):
+        r = self.router
+        try:
+            if self.path in ("/v1/chat/completions", "/v1/completions"):
+                kind = ("chat" if self.path == "/v1/chat/completions"
+                        else "completion")
+                body = self._read_json()
+                # the gllm_router extension is the ROUTER's internal
+                # plane: a client-forged copy must never reach a
+                # replica (it could smuggle a fake continuation)
+                body.pop("gllm_router", None)
+                session = self._session(body)
+                if body.get("stream"):
+                    r.stream(kind, body, _SSEOut(self), session=session)
+                else:
+                    self._forward(r.proxy("POST", self.path, body=body,
+                                          session=session, kind=kind))
+            elif self.path in ("/admin/drain", "/admin/undrain"):
+                body = self._read_json()
+                addr = body.get("replica", "")
+                on = self.path.endswith("/drain")
+                if not r.replicas.drain(addr, on=on):
+                    self._json(proto.error_response(
+                        f"unknown replica {addr!r}", 404), code=404)
+                    return
+                rep = r.replicas.get(addr)
+                self._json({"status": "ok", "replica": addr,
+                            "draining": on,
+                            "active_streams": rep.active_streams})
+            else:
+                self._json(proto.error_response("not found", 404),
+                           code=404)
+        except proto.ProtocolError as e:
+            self._json(proto.error_response(str(e)), code=400)
+        except ClientGone:
+            pass
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # pragma: no cover
+            logger.exception("router request failed")
+            try:
+                self._json(proto.error_response(
+                    f"internal error: {e}", 500), code=500)
+            except Exception:
+                pass
+
+
+def serve_router(router: FrontRouter, host: str,
+                 port: int) -> ThreadingHTTPServer:
+    """Build the router HTTP server (caller decides foreground vs
+    thread)."""
+    handler = type("BoundRouterHandler", (RouterHandler,),
+                   {"router": router})
+    httpd = ThreadingHTTPServer((host, port), handler)
+    httpd.router = router
+    return httpd
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="gllm-tpu fleet front router")
+    p.add_argument("--replicas", required=True,
+                   help="comma-separated host:port of api_server "
+                        "replicas")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=8080)
+    p.add_argument("--probe-interval-s", type=float, default=1.0,
+                   help="health-poll period per replica (/readyz + "
+                        "/server_info)")
+    p.add_argument("--probe-timeout-s", type=float, default=2.0)
+    p.add_argument("--stream-idle-timeout-s", type=float, default=60.0,
+                   help="max silence on an upstream stream before the "
+                        "router treats the replica as wedged and fails "
+                        "the stream over; must exceed the longest "
+                        "legitimate inter-token gap (compiles!)")
+    p.add_argument("--request-timeout-s", type=float, default=600.0,
+                   help="whole-response budget for non-streaming "
+                        "proxying")
+    p.add_argument("--max-failovers", type=int, default=2,
+                   help="mid-stream migrations per request before the "
+                        "router gives up with a terminal error chunk")
+    p.add_argument("--no-session-affinity", action="store_true",
+                   help="disable sticky sessions (X-Session-Id header / "
+                        "OpenAI user field)")
+    p.add_argument("--prefix-affinity", action="store_true",
+                   help="probe candidate replicas' prefix stores with "
+                        "the prompt's chained page digests and place on "
+                        "the deepest hit (token-array prompts; needs "
+                        "replicas serving --prefix-serve-port)")
+    p.add_argument("--breaker-base-s", type=float, default=1.0,
+                   help="per-replica circuit-breaker backoff base; "
+                        "doubles per trip up to --breaker-max-s "
+                        "(a dead replica costs one probe per window)")
+    p.add_argument("--breaker-max-s", type=float, default=30.0)
+    p.add_argument("--breaker-fails", type=int, default=1,
+                   help="consecutive probe failures to open the breaker")
+    return p
+
+
+def main(argv=None):
+    logging.basicConfig(level=logging.INFO)
+    args = make_parser().parse_args(argv)
+    router = FrontRouter(
+        [a for a in args.replicas.split(",") if a.strip()],
+        probe_interval_s=args.probe_interval_s,
+        probe_timeout_s=args.probe_timeout_s,
+        stream_idle_timeout_s=args.stream_idle_timeout_s,
+        request_timeout_s=args.request_timeout_s,
+        max_failovers=args.max_failovers,
+        session_affinity=not args.no_session_affinity,
+        prefix_affinity=args.prefix_affinity,
+        breaker_base_s=args.breaker_base_s,
+        breaker_max_s=args.breaker_max_s,
+        breaker_fails=args.breaker_fails)
+    httpd = serve_router(router, args.host, args.port)
+    ready = len(router.replicas.in_rotation())
+    logger.info("front router on %s:%d over %d replicas (%d ready)",
+                args.host, args.port, len(router.replicas.replicas),
+                ready)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.shutdown()
+        router.close()
+
+
+if __name__ == "__main__":
+    main()

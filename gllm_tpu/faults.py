@@ -53,6 +53,16 @@ Points and their wired sites:
                          behave as a transport failure → drives the
                          per-peer circuit breaker (open → half-open →
                          closed) deterministically
+- ``replica_kill``       hard-closes the HTTP connection mid-SSE-stream
+                         in ``api_server._stream`` (and aborts the
+                         sequence) — from the front router's side this
+                         is indistinguishable from the serving process
+                         dying → exercises journal-backed cross-replica
+                         stream failover (docs/robustness.md#fleet)
+- ``replica_hang``       stalls ``api_server._stream`` for
+                         ``FAULTS.stall_s`` before the next SSE chunk —
+                         the wedged-replica shape → exercises the
+                         router's stream idle-timeout failover path
 
 Firing a point records a ``fault`` event on the steptrace ring. Everything
 here is stdlib-only and cheap when disarmed: ``fire()`` is one attribute
@@ -84,6 +94,8 @@ POINTS = (
     "engine_hard_crash",
     "rebuild_fail",
     "peer_flap",
+    "replica_kill",
+    "replica_hang",
 )
 
 

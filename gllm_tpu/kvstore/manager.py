@@ -141,10 +141,20 @@ class TieredPrefixManager:
             return self.disk.get_payload(digest)
         return None
 
+    def contains(self, digest: bytes) -> bool:
+        """Cheap membership for the peer ``has`` placement probe: index
+        lookups only — no page export, no pack, no disk read (the probe
+        sits on the router's request-placement path)."""
+        with self.pool.lock:
+            if digest in self.pool.hash_to_page:
+                return True
+        return self.disk is not None and self.disk.contains(digest)
+
     def start_server(self, host: str = "0.0.0.0",
                      port: int = 0) -> "PeerPrefixServer":
         self.server = PeerPrefixServer(self.serve, self.geometry,
-                                       host=host, port=port)
+                                       host=host, port=port,
+                                       contains=self.contains)
         return self.server
 
     # ---- lifecycle --------------------------------------------------------

@@ -55,6 +55,7 @@ import numpy as np
 from gllm_tpu.faults import FAULTS
 from gllm_tpu.kvstore import stats
 from gllm_tpu.kvstore.pagefmt import verify_payload
+from gllm_tpu.utils import CircuitBreaker
 
 logger = logging.getLogger(__name__)
 
@@ -136,91 +137,13 @@ def _env_f(name: str, default: float) -> float:
         return default
 
 
-class PeerBreaker:
-    """Per-peer circuit breaker (docs/robustness.md#peer-breakers).
-
-    closed → (``threshold`` consecutive failures) → open for
-    ``base_s · 2^(trips-1)`` seconds ±``jitter`` (capped at ``max_s``)
-    → half-open: exactly ONE probe is admitted — success closes and
-    resets the backoff ladder, failure re-opens with the next-longer
-    window. The jitter de-synchronizes a fleet of replicas hammering
-    the same recovering peer.
-
-    Single-threaded by contract (the engine thread owns all probing);
-    ``now`` injection keeps the chaos tests clock-free.
-    """
-
-    def __init__(self, base_s: float = 30.0, max_s: float = 300.0,
-                 threshold: int = 1, jitter: float = 0.1):
-        self.base_s = max(0.001, float(base_s))
-        self.max_s = max(self.base_s, float(max_s))
-        self.threshold = max(1, int(threshold))
-        self.jitter = max(0.0, min(1.0, float(jitter)))
-        self.state = "closed"            # closed | open | half_open
-        self.trips = 0                   # consecutive opens (backoff rung)
-        self._fails = 0                  # consecutive failures while closed
-        self._until = 0.0                # open-state expiry (monotonic)
-        # lifetime health counters (surfaced by PrefixClient.peer_health)
-        self.failures = 0
-        self.successes = 0
-        self.opens = 0
-        self.probes = 0                  # half-open recovery probes
-
-    def allow(self, now: Optional[float] = None) -> bool:
-        """May the caller probe this peer now? The True returned after
-        an open window expires IS the single half-open probe — further
-        calls return False until success()/failure() resolves it."""
-        if self.state == "closed":
-            return True
-        if self.state == "half_open":
-            return False
-        now = time.monotonic() if now is None else now
-        if now >= self._until:
-            self.state = "half_open"
-            self.probes += 1
-            return True
-        return False
-
-    def success(self) -> None:
-        self.successes += 1
-        self.state = "closed"
-        self._fails = 0
-        self.trips = 0
-
-    def failure(self, now: Optional[float] = None) -> None:
-        self.failures += 1
-        if self.state == "half_open":
-            self._open(now)              # the recovery probe failed
-            return
-        if self.state == "open":
-            return                       # already backing off
-        self._fails += 1
-        if self._fails >= self.threshold:
-            self._open(now)
-
-    def _open(self, now: Optional[float]) -> None:
-        now = time.monotonic() if now is None else now
-        self.trips += 1
-        self._fails = 0
-        self.opens += 1
-        self.state = "open"
-        back = min(self.max_s, self.base_s * (2 ** (self.trips - 1)))
-        if self.jitter:
-            import random
-            back *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
-        self._until = now + back
-
-    def down_for(self, now: Optional[float] = None) -> float:
-        if self.state != "open":
-            return 0.0
-        now = time.monotonic() if now is None else now
-        return max(0.0, self._until - now)
-
-    def health(self) -> dict:
-        return {"state": self.state, "trips": self.trips,
-                "failures": self.failures, "successes": self.successes,
-                "opens": self.opens, "probes": self.probes,
-                "down_for_s": round(self.down_for(), 2)}
+# The per-peer circuit breaker is the shared gllm_tpu.utils ladder:
+# the fleet front router (gllm_tpu/router/) runs the exact same
+# closed → open (exponential backoff ± jitter) → half-open-single-probe
+# state machine per serving replica, so the class lives where both
+# planes can reach it. The PeerBreaker name stays as the kvstore-facing
+# alias (docs/robustness.md#peer-breakers).
+PeerBreaker = CircuitBreaker
 
 
 def parse_peer_addr(addr: str) -> Tuple[str, int]:
@@ -247,8 +170,12 @@ class PeerPrefixServer:
     IDLE_S = 60.0
 
     def __init__(self, provider: Provider, geometry: dict,
-                 host: str = "0.0.0.0", port: int = 0):
+                 host: str = "0.0.0.0", port: int = 0,
+                 contains: Optional[Callable[[bytes], bool]] = None):
         self._provider = provider
+        # cheap membership for the ``has`` placement probe; falls back
+        # to materializing via the provider when the owner has no index
+        self._contains = contains
         self._geometry = geometry
         outer = self
 
@@ -288,6 +215,24 @@ class PeerPrefixServer:
         op = msg.get("op")
         if op == "hello":
             _send_frame(sock, {"geometry": self._geometry})
+        elif op == "has":
+            # membership probe (no payload): the front router's
+            # prefix-affinity placement asks each candidate replica
+            # which of a prompt's chained page digests it holds
+            # (gllm_tpu/router/placement.py) — the item-4 digest-probe
+            # placement skeleton. Index lookups only when the owner
+            # supplied a ``contains`` callback (the manager does) —
+            # this sits on the router's placement path and must never
+            # export/pack a page or touch the disk payload.
+            try:
+                digest = bytes.fromhex(msg.get("digest", ""))
+                if self._contains is not None:
+                    hit = bool(self._contains(digest))
+                else:
+                    hit = self._provider(digest) is not None
+            except Exception:
+                hit = False
+            _send_frame(sock, {"hit": hit})
         elif op == "get":
             try:
                 digest = bytes.fromhex(msg.get("digest", ""))

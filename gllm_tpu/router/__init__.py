@@ -1,0 +1,37 @@
+"""Health-aware front router with journal-backed cross-replica stream
+failover (docs/robustness.md#fleet-topology--failover).
+
+The reference frames gLLM as a frontend driving a fleet of replicas
+(PAPER.md §1); this package is that frontend's reliability spine. It
+places OpenAI-compatible requests over N api_server replicas using each
+replica's /readyz state behind per-replica circuit breakers
+(gllm_tpu.utils.CircuitBreaker — the same ladder kvstore/peer.py runs
+per prefix peer), keeps a stream journal (immutable submission +
+delivered token ids, mirroring engine/recovery.RequestJournal), and on
+replica death / crash-loop / mid-stream disconnect resumes retry-safe
+streams on a surviving replica byte-identically via the api_server
+continuation path — the client observes one uninterrupted stream.
+
+Pieces:
+
+- :mod:`gllm_tpu.router.journal` — per-stream journal + the router-side
+  half of the PR 14 replay-safety predicate
+- :mod:`gllm_tpu.router.replica` — replica registry: /readyz +
+  /server_info health poller, breaker ladder, restart detection
+- :mod:`gllm_tpu.router.placement` — rotation filter, session affinity,
+  prefix-affinity digest probes (the item-4 placement skeleton)
+- :mod:`gllm_tpu.router.core` — :class:`FrontRouter`: SSE proxy loop +
+  failover state machine
+- ``gllm_tpu/entrypoints/router_server.py`` — the HTTP entrypoint
+
+No jax imports anywhere in the package: the router is a pure host
+process and deploys on frontend nodes with no accelerator.
+"""
+
+from gllm_tpu.router.core import FrontRouter                 # noqa: F401
+from gllm_tpu.router.journal import (StreamEntry,            # noqa: F401
+                                     StreamJournal,
+                                     router_unsafe_reason)
+from gllm_tpu.router.placement import (Placement,            # noqa: F401
+                                       PrefixAffinity)
+from gllm_tpu.router.replica import Replica, ReplicaSet      # noqa: F401

@@ -1,0 +1,549 @@
+"""FrontRouter: the SSE proxy loop + cross-replica failover machine.
+
+One :class:`FrontRouter` fronts N api_server replicas. Streaming
+requests are proxied with the ``gllm_router`` body extension: the
+replica's preamble event hands back the tokenized prompt + the PR 14
+replay-safety verdict, and every token chunk carries its token id for
+the journal. When the upstream dies mid-stream — connection drop, idle
+timeout (wedged replica), a replica-side terminal ``error``/``abort``
+chunk, or a detected silent restart — the router resubmits the request
+to a surviving replica with ``gllm_router.continuation`` (prompt +
+committed token ids), and the replica's
+``ServingEngine.submit_continuation`` resumes generation from exactly
+the committed prefix: the client observes ONE uninterrupted,
+byte-identical stream. Streams the safety predicate vetoes (unseeded
+sampling, mm, stop strings, multi-choice, tool deltas …) never fail
+over once content was delivered; they end with a terminal error chunk
+carrying ``retry_after``.
+
+Failure-detection / decision table (docs/robustness.md#fleet-topology--
+failover):
+
+====================================  =================================
+upstream symptom                      router action
+====================================  =================================
+connect refused / submit error        try next replica (nothing lost)
+HTTP 429/503 on submit                try next replica (capacity race)
+socket error / EOF mid-stream         failover if safe, else error chunk
+read idle > stream_idle_timeout_s     same (the wedged-replica shape)
+chunk finish_reason error/abort       same (engine failed server-side)
+upstream terminal ``error`` event     same, honoring its retry_after
+silent restart (identity changed)     poller closes the upstream socket
+                                      → surfaces as a socket error
+finish_reason stop/length/deadline…   terminal: forward, never failover
+====================================  =================================
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+import http.client
+
+from gllm_tpu.entrypoints import protocol as proto
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.router.journal import (StreamEntry, StreamJournal,
+                                     router_unsafe_reason)
+from gllm_tpu.router.placement import Placement, PrefixAffinity
+from gllm_tpu.router.replica import ReplicaSet
+
+logger = logging.getLogger(__name__)
+
+_M_REQS = obs.counter(
+    "gllm_router_requests_total",
+    "requests through the router by kind and outcome (ok; error = "
+    "terminal error delivered; rejected = no replica could take it; "
+    "client_gone = the client disconnected first)",
+    ("kind", "outcome"))
+_M_STREAMS = obs.gauge(
+    "gllm_router_streams_active",
+    "streams currently proxied (journaled) by the router")
+_M_FAILOVERS = obs.counter(
+    "gllm_router_failovers_total",
+    "mid-stream failover attempts by outcome (ok = stream resumed on a "
+    "surviving replica; unsafe = vetoed by the replay-safety predicate; "
+    "exhausted = no surviving replica / attempt budget spent)",
+    ("outcome",))
+_M_FAILOVER_S = obs.histogram(
+    "gllm_router_failover_seconds",
+    "failure detection to first continuation chunk forwarded")
+
+
+class UpstreamFailed(Exception):
+    """One upstream attempt died; carries the replica's retry_after
+    hint when its terminal error event supplied one.
+    ``replica_suspect=False`` marks a CAPACITY answer (429/503
+    admission rejection) — the replica is healthy, just busy: try
+    elsewhere without prodding its health state. Suspect failures
+    trigger an immediate poller re-probe instead of tripping the
+    breaker from the handler thread: the POLLER is the breaker's
+    single prober (gllm_tpu.utils.CircuitBreaker contract), and a
+    transient per-stream fault (replica_kill) must not eject a healthy
+    replica from rotation for a whole backoff window."""
+
+    def __init__(self, why: str, retry_after: Optional[float] = None,
+                 replica_suspect: bool = True):
+        super().__init__(why)
+        self.retry_after = retry_after
+        self.replica_suspect = replica_suspect
+
+
+class ClientGone(Exception):
+    """The downstream client disconnected; abort the upstream and stop."""
+
+
+class FrontRouter:
+    """Health-aware placement + journal-backed stream failover over a
+    fleet of api_server replicas. Thread-safe: one handler thread per
+    client stream, one poller thread, shared journal/placement."""
+
+    def __init__(self, replica_addrs, *,
+                 probe_interval_s: float = 1.0,
+                 probe_timeout_s: float = 2.0,
+                 stream_idle_timeout_s: float = 60.0,
+                 request_timeout_s: float = 600.0,
+                 max_failovers: int = 2,
+                 session_affinity: bool = True,
+                 prefix_affinity: bool = False,
+                 prefix_probe_timeout_s: float = 0.25,
+                 breaker_base_s: float = 1.0,
+                 breaker_max_s: float = 30.0,
+                 breaker_fails: int = 1,
+                 breaker_jitter: float = 0.1,
+                 start_poller: bool = True,
+                 initial_probe: bool = True):
+        self.journal = StreamJournal()
+        self.replicas = ReplicaSet(
+            list(replica_addrs),
+            probe_interval_s=probe_interval_s,
+            probe_timeout_s=probe_timeout_s,
+            breaker_base_s=breaker_base_s,
+            breaker_max_s=breaker_max_s,
+            breaker_fails=breaker_fails,
+            breaker_jitter=breaker_jitter,
+            on_restart=self._on_restart,
+            start_poller=start_poller,
+            initial_probe=initial_probe)
+        self.placement = Placement(
+            self.replicas, session_affinity=session_affinity,
+            prefix_affinity=(PrefixAffinity(prefix_probe_timeout_s)
+                             if prefix_affinity else None))
+        self.stream_idle_timeout_s = float(stream_idle_timeout_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.max_failovers = max(0, int(max_failovers))
+        self._lock = threading.Lock()
+        self._conns: Dict[str, http.client.HTTPConnection] = {}
+
+    # ---- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        self.replicas.close()
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+
+    def _on_restart(self, rep) -> None:
+        """A silent process restart forgot every stream it held: close
+        those upstream sockets so their reader threads fail over NOW
+        instead of waiting out the idle timeout."""
+        for entry in self.journal.by_replica(rep.addr):
+            with self._lock:
+                conn = self._conns.get(entry.rid)
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    # ---- router health (for the router's own /readyz) ----------------------
+
+    def health(self) -> dict:
+        rotation = self.replicas.in_rotation()
+        return {
+            "ready": bool(rotation),
+            "replicas_in_rotation": len(rotation),
+            "replicas": self.replicas.health(),
+            "active_streams": len(self.journal),
+            "retry_after_s": (None if rotation
+                              else round(self.replicas.min_retry_after(),
+                                         2)),
+        }
+
+    # ---- non-streaming proxy -----------------------------------------------
+
+    def proxy(self, method: str, path: str, body: Optional[dict] = None,
+              session: Optional[str] = None, kind: str = "proxy"
+              ) -> tuple:
+        """(status, body_bytes, headers_subset). Nothing streams, so
+        nothing was delivered before a failure — ANY request may retry
+        on the next replica (a deterministic one re-derives the same
+        answer; a sampled one re-samples, which a from-scratch client
+        retry would do too)."""
+        exclude: set = set()
+        last = (503, json.dumps(proto.error_response(
+            "no replica in rotation", 503)).encode(), {})
+        for _ in range(len(self.replicas.replicas)):
+            rep = self.placement.pick(session, exclude=exclude)
+            if rep is None:
+                break
+            exclude.add(rep.addr)
+            try:
+                conn = http.client.HTTPConnection(
+                    rep.host, rep.port, timeout=self.request_timeout_s)
+                try:
+                    conn.request(
+                        method, path,
+                        body=(json.dumps(body).encode()
+                              if body is not None else None),
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    raw = resp.read()
+                    headers = {k: v for k, v in resp.getheaders()
+                               if k.lower() in ("content-type",
+                                                "retry-after")}
+                finally:
+                    conn.close()
+            except (OSError, http.client.HTTPException) as e:
+                self.replicas.request_probe()
+                last = (503, json.dumps(proto.error_response(
+                    f"replica {rep.addr} unreachable: {e}", 503)
+                ).encode(), {})
+                continue
+            if resp.status in (429, 503):
+                # capacity race (the poller will catch up) — try the
+                # next replica, remember this answer as the fallback
+                last = (resp.status, raw, headers)
+                continue
+            _M_REQS.inc(kind=kind, outcome="ok" if resp.status < 500
+                        else "error")
+            return resp.status, raw, headers
+        status, raw, headers = last
+        headers.setdefault("Retry-After", str(int(
+            self.replicas.min_retry_after())))
+        _M_REQS.inc(kind=kind, outcome="rejected")
+        return status, raw, headers
+
+    # ---- streaming proxy + failover ---------------------------------------
+
+    def stream(self, kind: str, body: dict, sse,
+               session: Optional[str] = None) -> None:
+        """Proxy one streaming request. ``sse`` is the downstream
+        surface: ``.started`` (bool), ``.start()`` (send SSE headers,
+        idempotent), ``.send(obj)`` (one event; raises
+        :class:`ClientGone`), ``.done()`` ([DONE]), ``.fail_json(status,
+        obj, headers)`` (only legal before ``start``)."""
+        rid = proto.new_request_id(chat=(kind == "chat"))
+        entry = self.journal.open(StreamEntry(
+            rid=rid, kind=kind, body=body, session=session,
+            unsafe_reason=router_unsafe_reason(body, kind)))
+        _M_STREAMS.set(len(self.journal))
+        exclude: set = set()
+        last_failed: Optional[str] = None
+        give_up_why, give_up_retry = "no replica in rotation", None
+        try:
+            while True:
+                token_hint = entry.prompt_token_ids
+                if token_hint is None and kind == "completion" \
+                        and isinstance(body.get("prompt"), list):
+                    token_hint = body["prompt"]
+                rep = self.placement.pick(session, token_ids=token_hint,
+                                          exclude=exclude)
+                if rep is None and exclude:
+                    # every in-rotation replica already failed once for
+                    # THIS stream (e.g. a fault that follows the stream
+                    # around): transient per-connection failures must
+                    # not exhaust an otherwise-healthy fleet — re-admit
+                    # everything, preferring not-the-most-recent
+                    # failure; a rotation of ONE may retry the same
+                    # replica (a continuation there succeeds after a
+                    # transient drop). The migration/attempt budgets
+                    # still bound the loop, and a really-dead replica
+                    # leaves rotation via the nudged re-probe.
+                    rep = self.placement.pick(
+                        session, token_ids=token_hint,
+                        exclude={last_failed} if last_failed else ())
+                    if rep is None:
+                        rep = self.placement.pick(session,
+                                                  token_ids=token_hint)
+                if rep is None:
+                    give_up_retry = self.replicas.min_retry_after()
+                    if entry.fail_detected_at is not None:
+                        _M_FAILOVERS.inc(outcome="exhausted")
+                    break
+                entry.replica = rep.addr
+                entry.attempts += 1
+                with self._lock:
+                    # handler threads race on this counter and a lost
+                    # update would skew least-loaded placement forever
+                    rep.active_streams += 1
+                try:
+                    outcome = self._stream_from(rep, entry, sse)
+                    _M_REQS.inc(kind=kind, outcome=outcome)
+                    return
+                except UpstreamFailed as e:
+                    if e.replica_suspect:
+                        # the poller (the breaker's single prober)
+                        # decides whether this replica is really down
+                        self.replicas.request_probe()
+                    exclude.add(rep.addr)
+                    last_failed = rep.addr
+                    logger.warning("upstream %s failed for %s: %s",
+                                   rep.addr, rid, e)
+                    if entry.finished:
+                        # the upstream died BETWEEN the finish chunk and
+                        # [DONE]: the stream is complete — close it out;
+                        # a continuation would re-finish and duplicate
+                        try:
+                            sse.done()
+                        except ClientGone:
+                            pass
+                        _M_REQS.inc(kind=kind, outcome="ok")
+                        return
+                    give_up_why = str(e)
+                    give_up_retry = e.retry_after
+                    if entry.delivered_events > 0:
+                        # a MID-STREAM migration attempt: charge the
+                        # failover budget and check the safety veto
+                        if entry.fail_detected_at is None:
+                            entry.fail_detected_at = time.monotonic()
+                        entry.migration_attempts += 1
+                        if not entry.replay_safe:
+                            _M_FAILOVERS.inc(outcome="unsafe")
+                            give_up_why = (
+                                "replica failed mid-stream and this "
+                                "request is not replay-safe "
+                                f"({entry.unsafe_reason})")
+                            break
+                        if entry.migration_attempts > self.max_failovers:
+                            _M_FAILOVERS.inc(outcome="exhausted")
+                            break
+                    elif entry.attempts > max(
+                            2 * len(self.replicas.replicas),
+                            self.max_failovers + 1):
+                        # nothing delivered yet: submit-time failures
+                        # are free retries across the fleet, bounded
+                        # only by this loop-termination backstop
+                        break
+                    continue
+                except ClientGone:
+                    _M_REQS.inc(kind=kind, outcome="client_gone")
+                    return
+                finally:
+                    with self._lock:
+                        rep.active_streams -= 1
+            # give-up: terminal error to the client
+            retry = give_up_retry if give_up_retry is not None \
+                else self.replicas.min_retry_after()
+            self._fail_client(entry, sse, give_up_why, retry)
+        finally:
+            self.journal.close(rid)
+            _M_STREAMS.set(len(self.journal))
+            with self._lock:
+                self._conns.pop(rid, None)
+
+    def _fail_client(self, entry: StreamEntry, sse, message: str,
+                     retry_after: float) -> None:
+        retry_after = max(1.0, float(retry_after))
+        if not sse.started:
+            _M_REQS.inc(kind=entry.kind, outcome="rejected")
+            sse.fail_json(503, proto.error_response(message, 503),
+                          {"Retry-After": str(int(round(retry_after)))})
+            return
+        _M_REQS.inc(kind=entry.kind, outcome="error")
+        model = entry.body.get("model") or ""
+        try:
+            if entry.kind == "chat":
+                sse.send(proto.chat_completion_chunk(
+                    entry.rid, model, None, "error"))
+            else:
+                sse.send(proto.completion_chunk(
+                    entry.rid, model, "", "error"))
+            sse.send(proto.stream_error_event(message, "error",
+                                              retry_after))
+            sse.done()
+        except ClientGone:
+            pass
+
+    # ---- one upstream attempt ---------------------------------------------
+
+    def _path(self, kind: str) -> str:
+        return ("/v1/chat/completions" if kind == "chat"
+                else "/v1/completions")
+
+    def _stream_from(self, rep, entry: StreamEntry, sse) -> str:
+        """Run the stream against one replica until it FINISHES
+        (returns the request outcome label) or fails (raises
+        UpstreamFailed / ClientGone)."""
+        body_up = dict(entry.body)
+        body_up["stream"] = True
+        if entry.replay_safe:
+            ext: dict = {"request_id": entry.rid}
+            cont = entry.continuation_payload()
+            if cont is not None:
+                ext["continuation"] = cont
+            body_up["gllm_router"] = ext
+        conn = http.client.HTTPConnection(
+            rep.host, rep.port, timeout=self.stream_idle_timeout_s)
+        with self._lock:
+            self._conns[entry.rid] = conn
+        try:
+            try:
+                conn.request("POST", self._path(entry.kind),
+                             body=json.dumps(body_up).encode(),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+            except (OSError, http.client.HTTPException) as e:
+                raise UpstreamFailed(f"submit to {rep.addr} failed: {e}")
+            if resp.status != 200:
+                raw = resp.read()
+                try:
+                    retry = float(resp.getheader("Retry-After") or 0)
+                except (TypeError, ValueError):
+                    retry = 0
+                if resp.status in (429, 503):
+                    raise UpstreamFailed(
+                        f"{rep.addr} rejected admission "
+                        f"({resp.status})", retry_after=retry or None,
+                        replica_suspect=False)
+                if entry.delivered_events:
+                    raise UpstreamFailed(
+                        f"{rep.addr} refused continuation "
+                        f"({resp.status})")
+                # a request-shaped error (400 …) is the client's to see
+                try:
+                    parsed = json.loads(raw)
+                except ValueError:
+                    parsed = proto.error_response(
+                        raw.decode(errors="replace"), resp.status)
+                sse.fail_json(resp.status, parsed, {})
+                return "error"
+            self._relay(rep, entry, resp, sse)
+            return "ok"
+        finally:
+            with self._lock:
+                self._conns.pop(entry.rid, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _relay(self, rep, entry: StreamEntry, resp, sse) -> None:
+        pending_err: Optional[dict] = None
+        for ev in self._iter_sse(resp, rep.addr):
+            if ev is _DONE:
+                if entry.finished:
+                    sse.done()
+                    return
+                if pending_err is not None:
+                    raise UpstreamFailed(
+                        pending_err.get("message")
+                        or "replica-side stream failure",
+                        retry_after=pending_err.get("retry_after"))
+                raise UpstreamFailed(
+                    f"{rep.addr} closed the stream without a finish")
+            if "choices" not in ev:
+                g = ev.get("gllm")
+                if g is not None:
+                    # preamble: prompt ids + the replica's replay-safety
+                    # verdict (the half only it can compute)
+                    if entry.prompt_token_ids is None and \
+                            g.get("prompt_token_ids") is not None:
+                        entry.prompt_token_ids = [
+                            int(t) for t in g["prompt_token_ids"]]
+                    if entry.unsafe_reason is None \
+                            and g.get("unsafe_reason"):
+                        entry.unsafe_reason = g["unsafe_reason"]
+                    entry.replica_identity = g.get("replica_id")
+                    continue
+                if "error" in ev:
+                    if entry.finished:
+                        # a terminal hint for an ALREADY-finished
+                        # stream (deadline finishes carry retry_after):
+                        # forward it — backoff-aware clients behind the
+                        # router must see what direct clients see
+                        sse.send(ev)
+                        entry.delivered_events += 1
+                        continue
+                    # terminal error event (satellite: carries
+                    # retry_after) — the [DONE] after it resolves
+                    pending_err = ev["error"]
+                    continue
+                continue              # unknown control event: drop
+            g = ev.pop("gllm", None)
+            fin = (ev.get("choices") or [{}])[0].get("finish_reason")
+            if fin in ("error", "abort"):
+                # replica-side failure finish: hold it back — the
+                # continuation replaces it; keep reading for the error
+                # event so a retry_after hint is honored
+                pending_err = {"message": f"upstream finish={fin}"}
+                continue
+            if entry.fail_detected_at is not None:
+                # first chunk of a continuation: the migration worked
+                entry.last_failover_s = (time.monotonic()
+                                        - entry.fail_detected_at)
+                entry.fail_detected_at = None
+                entry.failovers += 1
+                _M_FAILOVERS.inc(outcome="ok")
+                _M_FAILOVER_S.observe(entry.last_failover_s)
+                logger.warning(
+                    "stream %s resumed on %s after %.3fs (%d tokens "
+                    "committed)", entry.rid, rep.addr,
+                    entry.last_failover_s, len(entry.committed))
+            sse.start()
+            sse.send(ev)
+            entry.delivered_events += 1
+            if g is not None and g.get("token_id") is not None:
+                entry.committed.append(int(g["token_id"]))
+            delta = (ev.get("choices") or [{}])[0].get("delta")
+            if isinstance(delta, dict):
+                entry.committed_text_len += len(delta.get("content")
+                                                or "")
+            elif "text" in (ev.get("choices") or [{}])[0]:
+                entry.committed_text_len += len(
+                    ev["choices"][0].get("text") or "")
+            if fin is not None:
+                entry.finished = True
+                entry.finish_reason = fin
+        raise UpstreamFailed(f"{rep.addr} disconnected mid-stream")
+
+    def _iter_sse(self, resp, addr: str):
+        """Yield parsed SSE data events (dicts) and the _DONE sentinel;
+        transport trouble (including the idle timeout) surfaces as
+        UpstreamFailed. Client-side errors (ClientGone from sse.send)
+        pass through untouched — they are raised by the CALLER's send,
+        never in here."""
+        while True:
+            try:
+                line = resp.readline()
+            except OSError as e:
+                raise UpstreamFailed(
+                    f"{addr} read failed mid-stream: {e}")
+            if not line:
+                return                    # EOF
+            line = line.strip()
+            if not line or not line.startswith(b"data:"):
+                continue
+            payload = line[5:].strip()
+            if payload == b"[DONE]":
+                yield _DONE
+                return
+            try:
+                yield json.loads(payload)
+            except ValueError:
+                raise UpstreamFailed(f"{addr} sent a garbled SSE event")
+
+
+class _Done:
+    pass
+
+
+_DONE = _Done()

@@ -1,0 +1,133 @@
+"""Router-side stream journal (docs/robustness.md#fleet-topology--failover).
+
+Mirrors the in-process ``engine/recovery.RequestJournal`` contract one
+level up: per proxied stream, the IMMUTABLE submission (the client's
+request body + the prompt token ids the first replica reported) plus the
+output token ids actually FORWARDED to the client. Forwarded = committed:
+a token the dead replica generated but the router never relayed is not
+committed and will be regenerated identically by the continuation; a
+token the router relayed is committed and is never regenerated — zero
+lost, zero duplicated tokens across a failover.
+
+The safety predicate is split across the two planes that each know half
+of it:
+
+- :func:`router_unsafe_reason` vetoes what only the router can see in
+  the request body — multi-choice streams (``n``/``best_of`` > 1
+  interleave by index and cannot be re-spliced) and tool-call streaming
+  (structured deltas must not re-emit);
+- the replica's preamble event carries ``unsafe_reason`` computed by the
+  PR 14 :class:`~gllm_tpu.engine.recovery.JournalEntry` predicate
+  (greedy or seeded only, no mm / disagg / stop strings /
+  prompt_logprobs), which needs the tokenized prompt and parsed
+  sampling params only the replica has.
+
+Either veto ⇒ the stream never fails over once content was delivered;
+it ends with a terminal error chunk carrying ``retry_after`` instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def router_unsafe_reason(body: dict, kind: str) -> Optional[str]:
+    """The router-side half of the replay-safety predicate — vetoes the
+    request shapes whose SSE streams cannot be resumed by resubmitting
+    prompt + committed ids. None = no router-side veto (the replica
+    preamble may still veto on sampling/mm grounds)."""
+    try:
+        n = int(body.get("n") or 1)
+        best_of = int(body.get("best_of") or n)
+    except (TypeError, ValueError):
+        return "malformed n/best_of"
+    if n != 1 or best_of != 1:
+        return "multi-choice streams interleave by index"
+    if kind == "chat" and body.get("tools") \
+            and body.get("tool_choice") != "none":
+        return "tool-call streams may not re-emit structured deltas"
+    return None
+
+
+@dataclasses.dataclass
+class StreamEntry:
+    """One proxied stream's journal record."""
+
+    rid: str                              # router-owned request id
+    kind: str                             # "chat" | "completion"
+    body: dict                            # client body, verbatim
+    session: Optional[str] = None         # affinity key, if any
+    # None until the replica preamble arrives (or a router-side veto
+    # set it at open); non-None vetoes mid-stream failover
+    unsafe_reason: Optional[str] = None
+    prompt_token_ids: Optional[List[int]] = None
+    committed: List[int] = dataclasses.field(default_factory=list)
+    committed_text_len: int = 0           # chars forwarded (diagnostics)
+    delivered_events: int = 0             # SSE events forwarded
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    replica: Optional[str] = None         # current upstream address
+    replica_identity: Optional[tuple] = None
+    attempts: int = 0                     # upstream attempts so far
+    migration_attempts: int = 0           # failures AFTER delivery began
+    failovers: int = 0                    # successful migrations
+    opened_at: float = dataclasses.field(default_factory=time.monotonic)
+    # failover timing: detection → first continuation event forwarded
+    fail_detected_at: Optional[float] = None
+    last_failover_s: Optional[float] = None
+
+    @property
+    def replay_safe(self) -> bool:
+        return self.unsafe_reason is None
+
+    @property
+    def can_restart(self) -> bool:
+        """A stream that delivered NOTHING yet can always restart from
+        scratch on another replica — determinism only matters once the
+        client holds part of the answer."""
+        return self.delivered_events == 0
+
+    def continuation_payload(self) -> Optional[dict]:
+        """The ``gllm_router.continuation`` object for a resubmission,
+        or None when the stream must restart from scratch (nothing
+        delivered yet — the fresh submit path re-encodes)."""
+        if self.delivered_events == 0 or self.prompt_token_ids is None:
+            return None
+        return {"prompt_token_ids": list(self.prompt_token_ids),
+                "committed_token_ids": list(self.committed)}
+
+
+class StreamJournal:
+    """Thread-safe registry of the streams currently in flight through
+    the router (each HTTP handler thread owns one entry; the health
+    poller reads the registry for restart-triggered failover and
+    /router_info)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[str, StreamEntry] = {}
+
+    def open(self, entry: StreamEntry) -> StreamEntry:
+        with self._lock:
+            self._entries[entry.rid] = entry
+        return entry
+
+    def close(self, rid: str) -> Optional[StreamEntry]:
+        with self._lock:
+            return self._entries.pop(rid, None)
+
+    def active(self) -> List[StreamEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def by_replica(self, addr: str) -> List[StreamEntry]:
+        with self._lock:
+            return [e for e in self._entries.values()
+                    if e.replica == addr]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -1,0 +1,167 @@
+"""Placement: rotation filter, session affinity, prefix-digest probes.
+
+The ROADMAP item-4 deployment shape wants the front router to place
+requests where their KV prefix already lives. This module is that
+placement skeleton, in three layers the router composes per request:
+
+1. **rotation filter** — only replicas whose last ``/readyz`` probe said
+   ready, that are not admin-drained, and whose breaker is closed are
+   candidates (ReplicaSet.in_rotation);
+2. **session affinity** — a request carrying a session key (the
+   ``X-Session-Id`` header, or the OpenAI ``user`` field) sticks to the
+   replica that served the session before, while that replica stays in
+   rotation — a conversation's prefix cache (and KV) stays resident on
+   one replica (the reference --endpoint-per-dp motivation, one level
+   up);
+3. **prefix affinity** — for requests whose prompt token ids are known
+   up front (token-array completions), chained page digests
+   (``memory_manager.prefix_digests`` — replica-independent by design)
+   are probed against each candidate's prefix-store serve port with the
+   peer protocol's ``has`` op; the deepest hit wins. Bounded: at most
+   ``max_probes`` digests per replica, one short deadline each, failures
+   score 0 and never stall placement.
+
+Ties (and the no-affinity case) break least-loaded by active router
+streams.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import threading
+import time
+from collections import OrderedDict
+from typing import List, Optional
+
+from gllm_tpu.kvstore.peer import _recv_frame, _send_frame
+from gllm_tpu.memory_manager import prefix_digests
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.router.replica import Replica, ReplicaSet
+
+logger = logging.getLogger(__name__)
+
+_M_AFFINITY = obs.counter(
+    "gllm_router_placements_total",
+    "placement decisions by rule (session = sticky session hit; "
+    "prefix = digest-probe winner; load = least-loaded fallback)",
+    ("rule",))
+
+_SESSION_CAP = 4096
+
+
+class PrefixAffinity:
+    """Digest probes against each replica's prefix store (the item-4
+    placement skeleton). Stateless per call; sockets are per-probe
+    (placement is rare relative to token traffic, and a cached socket
+    to a dying replica is exactly the stall this module must never
+    take)."""
+
+    def __init__(self, timeout_s: float = 0.25, max_probes: int = 4):
+        self.timeout_s = float(timeout_s)
+        self.max_probes = max(1, int(max_probes))
+
+    def score(self, rep: Replica, token_ids: List[int]) -> int:
+        """Number of whole prefix pages ``rep`` holds for this prompt
+        (deepest chained digest it answers ``has`` for); 0 on any
+        failure or when the replica advertises no prefix serve port."""
+        store = (rep.info or {}).get("prefix_store") or {}
+        port = store.get("serve_port")
+        page_size = (rep.info or {}).get("page_size")
+        if not port or not page_size:
+            return 0
+        try:
+            # inside the try: a malformed prompt (str entries, ints
+            # past 4 bytes) raises from the digest hash — any scoring
+            # failure is a 0, never a router 500 (the replica will
+            # reject a bad prompt with its own clean 400)
+            digests = prefix_digests(list(token_ids), len(token_ids),
+                                     int(page_size))
+            if not digests:
+                return 0
+            # deepest-first: the first hit bounds every shallower
+            # digest (chained digests are prefix-closed), so one hit
+            # answers all
+            probe = digests[-self.max_probes:]
+            with socket.create_connection((rep.host, int(port)),
+                                          timeout=self.timeout_s) as sock:
+                sock.settimeout(self.timeout_s)
+                for depth in range(len(digests), len(digests) -
+                                   len(probe), -1):
+                    digest = digests[depth - 1][0]
+                    _send_frame(sock, {"op": "has",
+                                       "digest": digest.hex()})
+                    reply = _recv_frame(sock)
+                    if reply and reply.get("hit"):
+                        return depth
+        except (OSError, ValueError, TypeError, AttributeError,
+                OverflowError):
+            return 0
+        return 0
+
+
+class Placement:
+    """Per-request replica choice. Thread-safe: handler threads call
+    pick() concurrently; the session map is the only shared state."""
+
+    def __init__(self, replica_set: ReplicaSet, *,
+                 session_affinity: bool = True,
+                 prefix_affinity: Optional[PrefixAffinity] = None):
+        self.replicas = replica_set
+        self.session_affinity = session_affinity
+        self.prefix_affinity = prefix_affinity
+        self._lock = threading.Lock()
+        self._sessions: "OrderedDict[str, str]" = OrderedDict()
+
+    def _remember(self, session: Optional[str], addr: str) -> None:
+        if not session:
+            return
+        with self._lock:
+            self._sessions[session] = addr
+            self._sessions.move_to_end(session)
+            while len(self._sessions) > _SESSION_CAP:
+                self._sessions.popitem(last=False)
+
+    def session_replica(self, session: Optional[str]) -> Optional[str]:
+        if not session:
+            return None
+        with self._lock:
+            return self._sessions.get(session)
+
+    def pick(self, session: Optional[str] = None,
+             token_ids: Optional[List[int]] = None,
+             exclude=()) -> Optional[Replica]:
+        """The replica for one placement (None = nothing in rotation).
+        ``exclude`` removes replicas this stream already failed on (the
+        failover path must not bounce straight back)."""
+        candidates = [r for r in self.replicas.in_rotation()
+                      if r.addr not in exclude]
+        if not candidates:
+            return None
+        if self.session_affinity and session:
+            sticky = self.session_replica(session)
+            for r in candidates:
+                if r.addr == sticky:
+                    # refresh the LRU slot: an ACTIVE session must not
+                    # age out just because it placed long ago
+                    self._remember(session, r.addr)
+                    _M_AFFINITY.inc(rule="session")
+                    return r
+        if self.prefix_affinity is not None and token_ids:
+            t0 = time.monotonic()
+            scored = [(self.prefix_affinity.score(r, token_ids), r)
+                      for r in candidates]
+            best = max(s for s, _ in scored)
+            if best > 0:
+                rep = min((r for s, r in scored if s == best),
+                          key=lambda r: r.active_streams)
+                logger.debug("prefix placement: %s holds %d pages "
+                             "(probe %.1fms)", rep.addr, best,
+                             1e3 * (time.monotonic() - t0))
+                self._remember(session, rep.addr)
+                _M_AFFINITY.inc(rule="prefix")
+                return rep
+        rep = min(candidates, key=lambda r: r.active_streams)
+        self._remember(session, rep.addr)
+        _M_AFFINITY.inc(rule="load")
+        return rep

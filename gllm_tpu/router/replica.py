@@ -1,0 +1,309 @@
+"""Replica registry: health poller, breaker ladder, restart detection.
+
+Each serving replica is polled on its existing health surface (PR 7/14):
+``/readyz`` drives rotation membership — ready / recovering(+Retry-
+After) / draining / unhealthy with the latch reason class (crash_loop
+etc.) all come back on the JSON body — and ``/server_info`` carries the
+fleet identity (``replica.replica_id`` + ``start_time`` + supervised-
+recovery ``engine_generation``) plus the prefix-store coordinates the
+placement layer probes.
+
+Probing is gated by a per-replica :class:`~gllm_tpu.utils.
+CircuitBreaker` (the same ladder kvstore/peer.py runs per prefix peer):
+a dead or crash-looping replica costs the poller at most ONE connection
+attempt per backoff window — the fleet-level analogue of the
+peer-breaker probe bound.
+
+Restart detection is explicit, not inferred: a changed ``replica_id``
+or ``start_time`` at the same address means the PROCESS restarted and
+every stream it held is gone — the poller flags those streams so the
+router fails them over immediately instead of waiting for the idle
+timeout. A bumped ``engine_generation`` alone is a supervised
+in-process recovery (PR 14): the replica replays its own streams and
+the router must NOT interfere.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from gllm_tpu.kvstore.peer import parse_peer_addr
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.utils import CircuitBreaker
+
+logger = logging.getLogger(__name__)
+
+_M_PROBES = obs.counter(
+    "gllm_router_probes_total",
+    "replica health probes by outcome (ok = replica answered; fail = "
+    "connection/transport error; skipped = breaker open)", ("outcome",))
+_M_BREAKER_OPENS = obs.counter(
+    "gllm_router_breaker_opens_total",
+    "replica circuit-breaker open transitions, per replica", ("replica",))
+_M_READY = obs.gauge(
+    "gllm_router_replicas_ready",
+    "replicas currently in rotation (ready and breaker closed)")
+_M_RESTARTS = obs.counter(
+    "gllm_router_restarts_detected_total",
+    "silent replica process restarts detected via the /server_info "
+    "identity (replica_id/start_time change)", ("replica",))
+
+
+def http_get_json(host: str, port: int, path: str,
+                  timeout: float = 2.0) -> tuple:
+    """(status, parsed body or None, headers dict). Raises OSError on
+    transport failure; a non-JSON body parses to None."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        raw = resp.read()
+        try:
+            body = json.loads(raw) if raw else None
+        except (ValueError, UnicodeDecodeError):
+            body = None
+        return resp.status, body, dict(resp.getheaders())
+    finally:
+        conn.close()
+
+
+class Replica:
+    """One serving replica's router-side state. Mutated by the poller
+    thread; read by placement/handler threads (GIL-atomic field reads;
+    the poller is the single writer)."""
+
+    def __init__(self, addr: str, breaker: Optional[CircuitBreaker] = None):
+        self.addr = addr.strip()
+        self.host, self.port = parse_peer_addr(self.addr)
+        self.breaker = breaker or CircuitBreaker()
+        self.state = "unknown"   # ready|recovering|draining|unhealthy|down
+        self.reason = ""         # /readyz reason / unhealthy class detail
+        self.retry_after_s = 0.0
+        self.draining_admin = False   # router-side drain (leaves rotation)
+        self.identity = None          # (replica_id, start_time)
+        self.engine_generation = 0
+        self.restarts = 0             # identity changes observed
+        self.last_probe_t = 0.0
+        self.last_ok_t = 0.0
+        self.active_streams = 0       # maintained by FrontRouter
+        self.info: dict = {}          # last /server_info body
+
+    @property
+    def in_rotation(self) -> bool:
+        # breaker open ⇒ out, even when the last probe's state is a
+        # stale "ready": a stream-level transport failure can open the
+        # breaker between polls, and the poller SKIPS open-breaker
+        # probes — without this gate the stale state would keep routing
+        # streams at a dead replica for a whole backoff window
+        return (self.state == "ready" and not self.draining_admin
+                and self.breaker.state != "open")
+
+    def health(self) -> dict:
+        return {"addr": self.addr, "state": self.state,
+                "reason": self.reason or None,
+                "in_rotation": self.in_rotation,
+                "draining_admin": self.draining_admin,
+                "active_streams": self.active_streams,
+                "replica_id": self.identity[0] if self.identity else None,
+                "engine_generation": self.engine_generation,
+                "restarts_detected": self.restarts,
+                "breaker": self.breaker.health()}
+
+
+class ReplicaSet:
+    """Owns the replicas and the poller thread. ``on_restart(replica)``
+    fires when a silent process restart is detected (the router flags
+    that replica's journaled streams for immediate failover)."""
+
+    def __init__(self, addrs: List[str], *,
+                 probe_interval_s: float = 1.0,
+                 probe_timeout_s: float = 2.0,
+                 breaker_base_s: float = 1.0,
+                 breaker_max_s: float = 30.0,
+                 breaker_fails: int = 1,
+                 breaker_jitter: float = 0.1,
+                 on_restart: Optional[Callable] = None,
+                 start_poller: bool = True,
+                 initial_probe: bool = True):
+        if not addrs:
+            raise ValueError("router needs at least one replica address")
+        self.replicas: Dict[str, Replica] = {}
+        for a in addrs:
+            if not a.strip():
+                continue
+            rep = Replica(a, CircuitBreaker(
+                breaker_base_s, breaker_max_s, breaker_fails,
+                breaker_jitter))
+            self.replicas[rep.addr] = rep
+        if not self.replicas:
+            raise ValueError("router needs at least one replica address")
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.on_restart = on_restart
+        self._stop = False
+        self._wake = threading.Event()
+        self._thread = None
+        if initial_probe:
+            self.probe_all()
+        if start_poller:
+            self._thread = threading.Thread(target=self._poll_loop,
+                                            daemon=True,
+                                            name="gllm-router-poller")
+            self._thread.start()
+
+    # ---- probing (poller thread; also callable synchronously in tests) -----
+
+    def probe_all(self) -> None:
+        reps = list(self.replicas.values())
+        if len(reps) == 1:
+            self.probe_one(reps[0])
+        else:
+            # concurrent probes: one timeout-class (SYN-blackholed)
+            # replica must not head-of-line-block every other
+            # replica's health update for probe_timeout_s. Each
+            # replica's breaker/state still has exactly one writer per
+            # tick (its probe thread), and ticks serialize on the join.
+            threads = [threading.Thread(target=self.probe_one,
+                                        args=(r,), daemon=True)
+                       for r in reps]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        self._set_ready_gauge()
+
+    def probe_one(self, rep: Replica) -> None:
+        if not rep.breaker.allow():
+            # open breaker: the replica costs NOTHING this tick — at
+            # most one probe per backoff window reaches the wire
+            _M_PROBES.inc(outcome="skipped")
+            return
+        rep.last_probe_t = time.monotonic()
+        try:
+            status, body, headers = http_get_json(
+                rep.host, rep.port, "/readyz",
+                timeout=self.probe_timeout_s)
+        except (OSError, http.client.HTTPException):
+            was_open = rep.breaker.state == "open"
+            rep.breaker.failure()
+            if rep.breaker.state == "open" and not was_open:
+                _M_BREAKER_OPENS.inc(replica=rep.addr)
+                logger.warning(
+                    "replica %s breaker OPEN for %.1fs (%d trips)",
+                    rep.addr, rep.breaker.down_for(), rep.breaker.trips)
+            rep.state = "down"
+            rep.reason = "unreachable"
+            _M_PROBES.inc(outcome="fail")
+            return
+        # ANY well-formed HTTP answer is a live process: close the
+        # breaker; rotation membership is decided by the readiness body
+        if rep.breaker.state != "closed":
+            logger.info("replica %s recovered (probe succeeded)",
+                        rep.addr)
+        rep.breaker.success()
+        rep.last_ok_t = time.monotonic()
+        _M_PROBES.inc(outcome="ok")
+        if status == 200:
+            rep.state, rep.reason, rep.retry_after_s = "ready", "", 0.0
+        else:
+            body = body or {}
+            rep.state = body.get("reason", "unhealthy")
+            rep.reason = (body.get("unhealthy_reason")
+                          or body.get("detail") or rep.state)
+            try:
+                rep.retry_after_s = float(headers.get("Retry-After", 0))
+            except (TypeError, ValueError):
+                rep.retry_after_s = 0.0
+        self._probe_info(rep)
+
+    def _probe_info(self, rep: Replica) -> None:
+        """/server_info: fleet identity + prefix-store coordinates. A
+        failure here never flips rotation (readiness already answered);
+        the previous info is kept."""
+        try:
+            status, body, _ = http_get_json(
+                rep.host, rep.port, "/server_info",
+                timeout=self.probe_timeout_s)
+        except (OSError, http.client.HTTPException):
+            return
+        if status != 200 or not isinstance(body, dict):
+            return
+        rep.info = body
+        ident = body.get("replica") or {}
+        new = (ident.get("replica_id"), ident.get("start_time"))
+        rep.engine_generation = int(ident.get("engine_generation") or 0)
+        if new[0] is None:
+            return
+        old = rep.identity
+        rep.identity = new
+        if old is not None and old != new:
+            rep.restarts += 1
+            _M_RESTARTS.inc(replica=rep.addr)
+            logger.warning(
+                "replica %s silently restarted (%s -> %s): its journaled "
+                "streams fail over now", rep.addr, old[0], new[0])
+            if self.on_restart is not None:
+                try:
+                    self.on_restart(rep)
+                except Exception:   # pragma: no cover - callback guard
+                    logger.exception("on_restart callback failed")
+
+    def _set_ready_gauge(self) -> None:
+        _M_READY.set(sum(1 for r in self.replicas.values()
+                         if r.in_rotation))
+
+    def _poll_loop(self) -> None:
+        while not self._stop:
+            self._wake.wait(timeout=self.probe_interval_s)
+            self._wake.clear()
+            if self._stop:
+                return
+            self.probe_all()
+
+    # ---- queries (any thread) ----------------------------------------------
+
+    def request_probe(self) -> None:
+        """Nudge the poller to re-probe NOW (a handler thread just saw
+        a replica fail). The poller stays the breaker's single prober;
+        handler threads never mutate breaker state directly."""
+        self._wake.set()
+
+    def get(self, addr: str) -> Optional[Replica]:
+        return self.replicas.get(addr)
+
+    def in_rotation(self) -> List[Replica]:
+        return [r for r in self.replicas.values() if r.in_rotation]
+
+    def min_retry_after(self, default: float = 5.0) -> float:
+        """Retry-After hint when nothing is in rotation: the soonest a
+        replica might return (breaker window expiry or its own
+        Retry-After), floored at 1s."""
+        etas = []
+        for r in self.replicas.values():
+            if r.breaker.state == "open":
+                etas.append(r.breaker.down_for())
+            elif r.retry_after_s > 0:
+                etas.append(r.retry_after_s)
+        return max(1.0, min(etas) if etas else default)
+
+    def drain(self, addr: str, on: bool = True) -> bool:
+        rep = self.replicas.get(addr)
+        if rep is None:
+            return False
+        rep.draining_admin = on
+        self._set_ready_gauge()
+        return True
+
+    def health(self) -> List[dict]:
+        return [r.health() for r in self.replicas.values()]
+
+    def close(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

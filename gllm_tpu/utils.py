@@ -1,14 +1,112 @@
-"""Small shared helpers (shape bucketing, math).
+"""Small shared helpers (shape bucketing, math, circuit breaking).
 
 The bucketing helpers implement the static-shape discipline XLA wants: every
 jit-compiled step function sees only a small set of padded shapes, mirroring the
 reference engine's power-of-two CUDA-graph buckets
 (/root/reference/gllm/model_runner.py:471-489).
+
+:class:`CircuitBreaker` is the shared per-remote failure ladder: the
+prefix-peer client (kvstore/peer.py) and the fleet front router
+(gllm_tpu/router/) both talk to remotes that can die, flap, or
+crash-loop, and both need the same guarantee — a broken remote costs at
+most one probe per backoff window, never a per-request stall.
 """
 
 from __future__ import annotations
 
 import os
+import time
+from typing import Optional
+
+
+class CircuitBreaker:
+    """Per-remote circuit breaker (docs/robustness.md#peer-breakers).
+
+    closed → (``threshold`` consecutive failures) → open for
+    ``base_s · 2^(trips-1)`` seconds ±``jitter`` (capped at ``max_s``)
+    → half-open: exactly ONE probe is admitted — success closes and
+    resets the backoff ladder, failure re-opens with the next-longer
+    window. The jitter de-synchronizes a fleet of replicas hammering
+    the same recovering remote.
+
+    Single-threaded by contract (one prober owns each instance —
+    the engine thread for prefix peers, the router's health poller for
+    serving replicas); ``now`` injection keeps the chaos tests
+    clock-free.
+    """
+
+    def __init__(self, base_s: float = 30.0, max_s: float = 300.0,
+                 threshold: int = 1, jitter: float = 0.1):
+        self.base_s = max(0.001, float(base_s))
+        self.max_s = max(self.base_s, float(max_s))
+        self.threshold = max(1, int(threshold))
+        self.jitter = max(0.0, min(1.0, float(jitter)))
+        self.state = "closed"            # closed | open | half_open
+        self.trips = 0                   # consecutive opens (backoff rung)
+        self._fails = 0                  # consecutive failures while closed
+        self._until = 0.0                # open-state expiry (monotonic)
+        # lifetime health counters (surfaced on /server_info and
+        # /router_info)
+        self.failures = 0
+        self.successes = 0
+        self.opens = 0
+        self.probes = 0                  # half-open recovery probes
+
+    def allow(self, now: Optional[float] = None) -> bool:
+        """May the caller probe this remote now? The True returned after
+        an open window expires IS the single half-open probe — further
+        calls return False until success()/failure() resolves it."""
+        if self.state == "closed":
+            return True
+        if self.state == "half_open":
+            return False
+        now = time.monotonic() if now is None else now
+        if now >= self._until:
+            self.state = "half_open"
+            self.probes += 1
+            return True
+        return False
+
+    def success(self) -> None:
+        self.successes += 1
+        self.state = "closed"
+        self._fails = 0
+        self.trips = 0
+
+    def failure(self, now: Optional[float] = None) -> None:
+        self.failures += 1
+        if self.state == "half_open":
+            self._open(now)              # the recovery probe failed
+            return
+        if self.state == "open":
+            return                       # already backing off
+        self._fails += 1
+        if self._fails >= self.threshold:
+            self._open(now)
+
+    def _open(self, now: Optional[float]) -> None:
+        now = time.monotonic() if now is None else now
+        self.trips += 1
+        self._fails = 0
+        self.opens += 1
+        self.state = "open"
+        back = min(self.max_s, self.base_s * (2 ** (self.trips - 1)))
+        if self.jitter:
+            import random
+            back *= 1.0 + self.jitter * (2.0 * random.random() - 1.0)
+        self._until = now + back
+
+    def down_for(self, now: Optional[float] = None) -> float:
+        if self.state != "open":
+            return 0.0
+        now = time.monotonic() if now is None else now
+        return max(0.0, self._until - now)
+
+    def health(self) -> dict:
+        return {"state": self.state, "trips": self.trips,
+                "failures": self.failures, "successes": self.successes,
+                "opens": self.opens, "probes": self.probes,
+                "down_for_s": round(self.down_for(), 2)}
 
 
 def cdiv(a: int, b: int) -> int:

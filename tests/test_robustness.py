@@ -563,11 +563,12 @@ def test_every_fault_point_has_a_chaos_test():
     """New faults.py injection points cannot land untested: each name
     must appear in the body of at least one @pytest.mark.chaos test in
     the chaos suites (this file + the kvstore tier chaos tests + the
-    self-healing recovery suite)."""
+    self-healing recovery suite + the fleet router suite)."""
     chaos_bodies = []
     here = os.path.dirname(__file__)
     for fname in (__file__, os.path.join(here, "test_kvstore.py"),
-                  os.path.join(here, "test_recovery.py")):
+                  os.path.join(here, "test_recovery.py"),
+                  os.path.join(here, "test_router.py")):
         src = open(fname).read()
         tree = ast.parse(src)
         for node in ast.walk(tree):

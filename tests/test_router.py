@@ -1,0 +1,740 @@
+"""Fleet front-router suite (docs/robustness.md#fleet-topology--failover).
+
+The router unit ladder (breaker / placement / affinity / journal) plus
+the multi-replica failover chaos harness: 2 in-process ServingEngines
+behind real HTTP api_servers behind an in-process FrontRouter, driven
+deterministically through the ``replica_kill`` / ``replica_hang`` fault
+points:
+
+- mid-stream replica kill → the stream fails over to the surviving
+  replica and the CLIENT observes one uninterrupted stream,
+  byte-identical to a clean run, greedy AND seeded, zero lost or
+  duplicated tokens (the acceptance headline);
+- a wedged replica (hang) is caught by the stream idle timeout and the
+  stream migrates the same way;
+- non-retry-safe streams (unseeded sampling) terminate with an error
+  chunk carrying retry_after instead of failing over;
+- a dead replica costs the router at most ONE probe per breaker window;
+- an admin-drained replica leaves rotation without dropping in-flight
+  streams; a silent replica restart is detected via the /server_info
+  identity;
+- the api_server satellites: /server_info replica identity,
+  POST /fault_inject (env-gated), SSE error events carrying retry_after,
+  and the ServingEngine continuation path's byte-identity.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+import torch
+
+from gllm_tpu.config import CacheConfig, EngineConfig
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.engine.serving_engine import ServingEngine
+from gllm_tpu.entrypoints.api_server import serve
+from gllm_tpu.entrypoints.router_server import serve_router
+from gllm_tpu.faults import FAULTS
+from gllm_tpu.router import FrontRouter
+from gllm_tpu.router.journal import (StreamEntry, StreamJournal,
+                                     router_unsafe_reason)
+from gllm_tpu.router.placement import Placement, PrefixAffinity
+from gllm_tpu.router.replica import Replica, ReplicaSet
+from gllm_tpu.sampling_params import SamplingParams
+from gllm_tpu.utils import CircuitBreaker
+
+PROMPT = [5, 17, 93, 41]
+GREEDY = {"temperature": 0, "max_tokens": 24, "ignore_eos": True}
+SEEDED = {"temperature": 0.8, "top_p": 0.9, "seed": 1234,
+          "max_tokens": 24, "ignore_eos": True}
+
+
+class StubTokenizer:
+    """One char per token id: text equality ⇔ token-stream equality."""
+    eos_token_id = 0
+
+    def encode(self, text):
+        return [min(ord(c), 120) for c in text][:64]
+
+    def decode(self, ids, skip_special_tokens=False):
+        return "".join(chr(max(32, i % 127)) for i in ids)
+
+    def apply_chat_template(self, messages, add_generation_prompt=True,
+                            **kw):
+        text = " ".join(str(m.get("content", "")) for m in messages)
+        return self.encode(text or "hi")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=96, max_position_embeddings=256,
+        eos_token_id=0, attention_bias=False))
+    d = tmp_path_factory.mktemp("router_model")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def make_llm(ckpt, **over):
+    cfg = EngineConfig(model=ckpt, dtype="float32", max_model_len=128,
+                       cache=CacheConfig(page_size=4, num_pages=128))
+    for k, v in over.items():
+        setattr(cfg, k, v)
+    cfg.validate()
+    return LLM(config=cfg, tokenizer=StubTokenizer())
+
+
+def start_replica(ckpt, replica_id=None, **over):
+    llm = make_llm(ckpt, **over)
+    httpd = serve(llm, "127.0.0.1", 0, replica_id=replica_id)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    # warm the prefill buckets a failover continuation will need (4-,
+    # 8-, 16-token prompts) so the compile pause can't trip the
+    # router's idle timeout in the hang test
+    for p in (PROMPT, list(range(2, 10)), list(range(2, 14))):
+        for c in httpd.state.engine.submit(
+                list(p), SamplingParams(temperature=0.0, max_tokens=2,
+                                        ignore_eos=True)):
+            pass
+    return {"httpd": httpd, "port": port, "llm": llm,
+            "addr": f"127.0.0.1:{port}"}
+
+
+@pytest.fixture(scope="module")
+def fleet(tiny_ckpt):
+    reps = [start_replica(tiny_ckpt), start_replica(tiny_ckpt)]
+    yield reps
+    for r in reps:
+        r["httpd"].shutdown()
+        r["httpd"].state.engine.shutdown()
+
+
+@pytest.fixture
+def router(fleet):
+    made = []
+
+    def make(**kw):
+        kw.setdefault("probe_interval_s", 0.1)
+        kw.setdefault("breaker_base_s", 0.2)
+        kw.setdefault("breaker_max_s", 2.0)
+        kw.setdefault("breaker_jitter", 0.0)
+        fr = FrontRouter([r["addr"] for r in fleet], **kw)
+        httpd = serve_router(fr, "127.0.0.1", 0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        made.append((fr, httpd))
+        return fr, httpd.server_address[1]
+
+    yield make
+    for fr, httpd in made:
+        httpd.shutdown()
+        fr.close()
+
+
+# ---- HTTP helpers ----------------------------------------------------------
+
+def post_json(port, path, body, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, raw, headers
+
+
+def get_json(port, path, timeout=10):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    raw = resp.read()
+    headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, (json.loads(raw) if raw else None), headers
+
+
+def sse_stream(port, path, body, timeout=120, headers=None):
+    """POST a streaming request, return (status, [parsed events]) —
+    events end at [DONE] or EOF."""
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    conn.request("POST", path, body=json.dumps(body), headers=hdrs)
+    resp = conn.getresponse()
+    if resp.status != 200:
+        raw = resp.read()
+        conn.close()
+        return resp.status, [json.loads(raw)] if raw else []
+    events = []
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if not line.startswith(b"data:"):
+            continue
+        payload = line[5:].strip()
+        if payload == b"[DONE]":
+            break
+        events.append(json.loads(payload))
+    conn.close()
+    return 200, events
+
+
+def completion_text(events):
+    return "".join((e.get("choices") or [{}])[0].get("text") or ""
+                   for e in events if "choices" in e)
+
+
+def chat_text(events):
+    out = []
+    for e in events:
+        if "choices" not in e:
+            continue
+        delta = e["choices"][0].get("delta") or {}
+        out.append(delta.get("content") or "")
+    return "".join(out)
+
+
+def finish_of(events):
+    for e in events:
+        if "choices" in e and e["choices"][0].get("finish_reason"):
+            return e["choices"][0]["finish_reason"]
+    return None
+
+
+def error_events(events):
+    return [e for e in events if "error" in e and "choices" not in e]
+
+
+# ---- unit ladder: breaker / journal / placement / affinity -----------------
+
+def test_shared_breaker_class():
+    """The kvstore peer breaker and the router breaker are ONE class in
+    gllm_tpu.utils (the PR 15 generalization)."""
+    from gllm_tpu.kvstore.peer import PeerBreaker
+    assert PeerBreaker is CircuitBreaker
+    b = CircuitBreaker(base_s=1.0, max_s=8.0, threshold=2, jitter=0.0)
+    assert b.allow()
+    b.failure(now=0.0)
+    assert b.state == "closed"          # threshold 2
+    b.failure(now=0.0)
+    assert b.state == "open" and not b.allow(now=0.5)
+    assert b.allow(now=1.5) and b.state == "half_open"
+    assert not b.allow(now=1.5)         # single half-open probe
+    b.failure(now=1.5)
+    assert b.state == "open" and b.down_for(now=1.5) > 1.5  # doubled
+    assert b.allow(now=4.0)
+    b.success()
+    assert b.state == "closed" and b.trips == 0
+
+
+def test_router_unsafe_reason_vetoes():
+    assert router_unsafe_reason({}, "completion") is None
+    assert router_unsafe_reason({"n": 1, "best_of": 1}, "chat") is None
+    assert "multi-choice" in router_unsafe_reason({"n": 2}, "completion")
+    assert "multi-choice" in router_unsafe_reason(
+        {"best_of": 3}, "completion")
+    assert "tool-call" in router_unsafe_reason(
+        {"tools": [{}], "tool_choice": "auto"}, "chat")
+    assert router_unsafe_reason(
+        {"tools": [{}], "tool_choice": "none"}, "chat") is None
+
+
+def test_stream_journal_semantics():
+    j = StreamJournal()
+    e = j.open(StreamEntry(rid="r1", kind="completion",
+                           body={"prompt": [1]}, replica="a:1"))
+    j.open(StreamEntry(rid="r2", kind="chat", body={}, replica="b:2"))
+    assert len(j) == 2
+    assert [x.rid for x in j.by_replica("a:1")] == ["r1"]
+    # nothing delivered → restartable, but no continuation payload
+    assert e.can_restart and e.continuation_payload() is None
+    e.prompt_token_ids = [1, 2]
+    e.delivered_events = 3
+    e.committed.extend([7, 8])
+    cp = e.continuation_payload()
+    assert cp == {"prompt_token_ids": [1, 2],
+                  "committed_token_ids": [7, 8]}
+    assert not e.can_restart
+    assert j.close("r1") is e and len(j) == 1 and j.close("rX") is None
+
+
+def _fake_set(states):
+    rs = ReplicaSet([f"127.0.0.1:{10000 + i}"
+                     for i in range(len(states))],
+                    start_poller=False, initial_probe=False)
+    for rep, st in zip(rs.replicas.values(), states):
+        rep.state = st
+    return rs
+
+
+def test_placement_rotation_and_load():
+    rs = _fake_set(["ready", "recovering", "ready", "down"])
+    reps = list(rs.replicas.values())
+    reps[0].active_streams = 3
+    reps[2].active_streams = 1
+    p = Placement(rs)
+    # only ready replicas are candidates; least-loaded wins
+    assert p.pick() is reps[2]
+    # exclusion (failover must not bounce back)
+    assert p.pick(exclude={reps[2].addr}) is reps[0]
+    # draining leaves rotation
+    rs.drain(reps[2].addr)
+    assert p.pick() is reps[0]
+    rs.drain(reps[2].addr, on=False)
+    assert p.pick() is reps[2]
+    # nothing ready → None
+    for rep in reps:
+        rep.state = "down"
+    assert p.pick() is None
+
+
+def test_placement_session_affinity_sticky():
+    rs = _fake_set(["ready", "ready"])
+    reps = list(rs.replicas.values())
+    p = Placement(rs)
+    first = p.pick(session="alice")
+    # load now favors the other replica, but the session sticks
+    first.active_streams = 5
+    assert p.pick(session="alice") is first
+    assert p.pick(session="bob") is not first
+    # stickiness breaks when the replica leaves rotation
+    first.state = "down"
+    assert p.pick(session="alice") is not first
+
+
+def test_prefix_affinity_digest_probe():
+    """The item-4 placement skeleton: chained page digests probed over
+    the peer protocol's ``has`` op pick the replica holding the deepest
+    prefix."""
+    from gllm_tpu.kvstore.peer import PeerPrefixServer
+    from gllm_tpu.memory_manager import prefix_digests
+    page = 4
+    tokens = list(range(1, 13))          # 12 tokens → 2 whole pages
+    digests = prefix_digests(tokens, len(tokens), page)
+    assert len(digests) == 2
+    held = {digests[0][0]}               # replica holds page 1 only
+    srv = PeerPrefixServer(
+        lambda d: b"x" if d in held else None, {"page_size": page},
+        host="127.0.0.1", port=0)
+    try:
+        rep = Replica("127.0.0.1:9")     # port unused by the probe
+        rep.info = {"page_size": page,
+                    "prefix_store": {"serve_port": srv.port}}
+        aff = PrefixAffinity(timeout_s=1.0)
+        assert aff.score(rep, tokens) == 1     # depth of deepest hit
+        held.add(digests[1][0])
+        assert aff.score(rep, tokens) == 2
+        bare = Replica("127.0.0.1:9")          # no store advertised
+        bare.info = {"page_size": page, "prefix_store": {}}
+        assert aff.score(bare, tokens) == 0
+    finally:
+        srv.close()
+
+
+# ---- api_server satellites --------------------------------------------------
+
+def test_server_info_replica_identity(fleet):
+    status, info, _ = get_json(fleet[0]["port"], "/server_info")
+    assert status == 200
+    rep = info["replica"]
+    assert rep["replica_id"] == fleet[0]["httpd"].state.replica_id
+    assert rep["start_time"] > 0
+    assert rep["engine_generation"] == 0
+    assert rep["recoveries"] == 0
+
+
+def test_fault_inject_endpoint_gated(fleet, monkeypatch):
+    port = fleet[0]["port"]
+    # off by default: the endpoint does not exist
+    status, _, _ = post_json(port, "/fault_inject", {"spec": ""})
+    assert status == 404
+    monkeypatch.setenv("GLLM_FAULT_INJECT_HTTP", "1")
+    status, raw, _ = post_json(port, "/fault_inject",
+                               {"spec": "intake_burst:0:1"})
+    assert status == 200
+    assert json.loads(raw)["armed"] == {"intake_burst": [0, 1]}
+    # the armed point really fires on the live server
+    status, _, _ = post_json(port, "/v1/completions", {
+        "prompt": PROMPT, "max_tokens": 2, "temperature": 0})
+    assert status == 429
+    status, raw, _ = post_json(port, "/fault_inject", {"reset": True})
+    assert status == 200 and json.loads(raw)["armed"] == {}
+    status, raw, _ = post_json(port, "/fault_inject", {"spec": "bogus"})
+    assert status == 400
+
+
+def test_engine_continuation_byte_identity(fleet):
+    """ServingEngine.submit_continuation resumes prompt+committed with
+    the original prompt_len — the engine-level contract the router's
+    failover rides (greedy and seeded)."""
+    eng = fleet[0]["httpd"].state.engine
+    for params in (GREEDY, SEEDED):
+        sp = SamplingParams(**params)
+        want_ids, want_text = [], []
+        for c in eng.submit(list(PROMPT), SamplingParams(**params)):
+            if c.token_id is not None:
+                want_ids.append(c.token_id)
+            want_text.append(c.text)
+        k = 5
+        got_ids, got_text = [], []
+        h = eng.submit_continuation(list(PROMPT), want_ids[:k], sp)
+        assert h.prompt_len == len(PROMPT)
+        for c in h:
+            if c.token_id is not None:
+                got_ids.append(c.token_id)
+            got_text.append(c.text)
+        assert got_ids == want_ids[k:], params
+        assert "".join(got_text) == "".join(want_text)[k:], params
+
+
+# ---- the acceptance headline: mid-stream kill → byte-identical failover ----
+
+def _clean_completion(fleet, params):
+    body = {"prompt": PROMPT, "stream": True, **params}
+    status, events = sse_stream(fleet[0]["port"], "/v1/completions", body)
+    assert status == 200 and finish_of(events) == "length"
+    return events
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("params", [GREEDY, SEEDED],
+                         ids=["greedy", "seeded"])
+def test_failover_mid_stream_kill_byte_identical(fleet, router, params):
+    """replica_kill hard-closes the serving connection mid-stream (the
+    process-death shape); the router resumes the stream on the
+    surviving replica via the continuation path and the client observes
+    ONE stream, byte-identical to a clean run — zero lost, zero
+    duplicated tokens."""
+    want = _clean_completion(fleet, params)
+    want_text = completion_text(want)
+    assert len(want_text) == params["max_tokens"]   # stub: 1 char/token
+    fr, port = router()
+    FAULTS.arm("replica_kill:3:1")
+    body = {"prompt": PROMPT, "stream": True, **params}
+    status, events = sse_stream(port, "/v1/completions", body)
+    assert status == 200
+    assert FAULTS.hits.get("replica_kill") == 1, "kill never fired"
+    assert finish_of(events) == "length"
+    assert not error_events(events)
+    got_text = completion_text(events)
+    assert got_text == want_text, (
+        f"stream diverged across failover: {got_text!r} vs "
+        f"{want_text!r}")
+    # one event per token: count equality = zero lost/duplicated
+    assert len([e for e in events if "choices" in e]) == \
+        len([e for e in want if "choices" in e])
+
+
+@pytest.mark.chaos
+def test_failover_survives_kills_on_every_replica(fleet, router):
+    """A fault that follows the stream around (replica_kill fires once
+    on EACH replica) must not exhaust the fleet: after every replica
+    failed once, the router re-admits all but the most recent failure
+    (the attempt budget still bounds the loop) — the stream completes
+    byte-identically with TWO migrations."""
+    want_text = completion_text(_clean_completion(fleet, GREEDY))
+    fr, port = router()
+    FAULTS.arm("replica_kill:3:2")     # fires on A, then again on B
+    body = {"prompt": PROMPT, "stream": True, **GREEDY}
+    status, events = sse_stream(port, "/v1/completions", body)
+    assert status == 200
+    assert FAULTS.hits.get("replica_kill") == 2
+    assert finish_of(events) == "length"
+    assert completion_text(events) == want_text
+    assert not error_events(events)
+
+
+@pytest.mark.chaos
+def test_failover_chat_stream_role_not_duplicated(fleet, router):
+    """Chat failover: the continuation must not re-emit the role
+    preamble chunk; the merged stream carries exactly one."""
+    body = {"messages": [{"role": "user", "content": "hello fleet"}],
+            "stream": True, **GREEDY}
+    status, want = sse_stream(fleet[0]["port"], "/v1/chat/completions",
+                              body)
+    assert status == 200
+    fr, port = router()
+    FAULTS.arm("replica_kill:4:1")
+    status, events = sse_stream(port, "/v1/chat/completions", body)
+    assert status == 200
+    assert FAULTS.hits.get("replica_kill") == 1
+    assert chat_text(events) == chat_text(want)
+    roles = [e for e in events if "choices" in e
+             and (e["choices"][0].get("delta") or {}).get("role")]
+    assert len(roles) == 1
+    assert finish_of(events) == "length"
+
+
+@pytest.mark.chaos
+def test_failover_on_replica_hang_idle_timeout(fleet, router):
+    """replica_hang stalls the upstream mid-stream; the router's idle
+    timeout declares the replica wedged and migrates the stream —
+    byte-identical, no client-visible stall beyond the timeout."""
+    want_text = completion_text(_clean_completion(fleet, GREEDY))
+    fr, port = router(stream_idle_timeout_s=1.5)
+    FAULTS.stall_s = 8.0
+    try:
+        FAULTS.arm("replica_hang:3:1")
+        body = {"prompt": PROMPT, "stream": True, **GREEDY}
+        t0 = time.monotonic()
+        status, events = sse_stream(port, "/v1/completions", body)
+        dt = time.monotonic() - t0
+        assert status == 200
+        assert FAULTS.hits.get("replica_hang") == 1
+        assert completion_text(events) == want_text
+        assert finish_of(events) == "length"
+        # the client never waited out the full 8s stall
+        assert dt < 7.0, f"hang failover took {dt:.1f}s"
+    finally:
+        FAULTS.stall_s = 2.0
+
+
+@pytest.mark.chaos
+def test_unsafe_stream_gets_terminal_error_with_retry_after(fleet,
+                                                            router):
+    """An unseeded sampled stream (replica preamble vetoes replay)
+    killed mid-stream must NOT fail over: the client gets a terminal
+    error chunk + an error event carrying retry_after."""
+    fr, port = router()
+    FAULTS.arm("replica_kill:2:1")
+    body = {"prompt": PROMPT, "stream": True, "temperature": 0.9,
+            "max_tokens": 24, "ignore_eos": True}
+    status, events = sse_stream(port, "/v1/completions", body)
+    assert status == 200
+    assert FAULTS.hits.get("replica_kill") == 1
+    assert finish_of(events) == "error"
+    errs = error_events(events)
+    assert errs, "terminal error event missing"
+    err = errs[-1]["error"]
+    assert "not replay-safe" in err["message"]
+    assert err.get("retry_after", 0) >= 1.0
+
+
+@pytest.mark.chaos
+def test_fresh_request_restarts_even_when_unsafe(fleet, router):
+    """An unsafe request that delivered NOTHING yet may still move to
+    another replica (nothing to contradict): kill the connection before
+    the first chunk is forwarded and the stream completes elsewhere."""
+    fr, port = router()
+    FAULTS.arm("replica_kill:0:1")     # fires before the first chunk
+    body = {"prompt": PROMPT, "stream": True, "temperature": 0.9,
+            "max_tokens": 8, "ignore_eos": True}
+    status, events = sse_stream(port, "/v1/completions", body)
+    assert status == 200
+    assert finish_of(events) == "length"
+    assert len(completion_text(events)) == 8
+
+
+# ---- breaker-bounded probe cost / drain / restart detection ----------------
+
+@pytest.mark.chaos
+def test_dead_replica_costs_one_probe_per_window():
+    """A crash-looping/dead replica costs the router at most ONE
+    connection attempt per breaker window (the peer-breaker bound,
+    fleet edition)."""
+    import socket as _socket
+    lst = _socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+    port = lst.getsockname()[1]
+    conns = []
+
+    def accept_and_slam():
+        while True:
+            try:
+                s, _ = lst.accept()
+            except OSError:
+                return
+            conns.append(1)
+            s.close()                  # RemoteDisconnected for the probe
+
+    t = threading.Thread(target=accept_and_slam, daemon=True)
+    t.start()
+    rs = ReplicaSet([f"127.0.0.1:{port}"], probe_interval_s=0.02,
+                    probe_timeout_s=0.5, breaker_base_s=10.0,
+                    breaker_jitter=0.0)
+    try:
+        time.sleep(0.5)                # ~25 poll ticks
+        rep = next(iter(rs.replicas.values()))
+        assert rep.breaker.state == "open"
+        assert rep.state == "down"
+        assert len(conns) == 1, (
+            f"{len(conns)} probes hit a dead replica inside one "
+            "breaker window")
+        assert not rep.in_rotation
+    finally:
+        rs.close()
+        lst.close()
+
+
+def test_drain_leaves_rotation_without_dropping_streams(fleet, router):
+    """Admin drain takes a replica out of rotation; its in-flight
+    stream finishes untouched and new requests land elsewhere."""
+    want_text = completion_text(_clean_completion(fleet, GREEDY))
+    fr, port = router()
+    target = fleet[0]["addr"]
+    box = {}
+
+    def run_stream():
+        box["resp"] = sse_stream(port, "/v1/completions",
+                                 {"prompt": PROMPT, "stream": True,
+                                  **GREEDY})
+
+    t = threading.Thread(target=run_stream, daemon=True)
+    t.start()
+    status, raw, _ = post_json(port, "/admin/drain", {"replica": target})
+    assert status == 200 and json.loads(raw)["draining"]
+    t.join(timeout=60)
+    assert not t.is_alive()
+    status, events = box["resp"]
+    assert status == 200 and finish_of(events) == "length"
+    assert completion_text(events) == want_text
+    # drained replica is out of rotation; requests still served
+    rep = fr.replicas.get(target)
+    assert not rep.in_rotation and rep.state == "ready"
+    status, events = sse_stream(port, "/v1/completions",
+                                {"prompt": PROMPT, "stream": True,
+                                 **GREEDY})
+    assert status == 200 and completion_text(events) == want_text
+    status, raw, _ = post_json(port, "/admin/undrain",
+                               {"replica": target})
+    assert status == 200 and not json.loads(raw)["draining"]
+    assert fr.replicas.get(target).in_rotation
+    status, raw, _ = post_json(port, "/admin/drain",
+                               {"replica": "nonsense:1"})
+    assert status == 404
+
+
+def test_silent_restart_detected_via_identity(fleet, router):
+    """A changed replica_id at the same address (process restart) is
+    detected explicitly and counted; a mere engine-generation bump (a
+    supervised in-process recovery) is not a restart."""
+    fr, port = router(probe_interval_s=0.05)
+    rep = fr.replicas.get(fleet[1]["addr"])
+    deadline = time.monotonic() + 5
+    while rep.identity is None and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert rep.identity is not None
+    old_id = fleet[1]["httpd"].state.replica_id
+    try:
+        fleet[1]["httpd"].state.replica_id = "restarted-process"
+        deadline = time.monotonic() + 5
+        while rep.restarts == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rep.restarts == 1
+        status, info, _ = get_json(port, "/router_info")
+        rh = [r for r in info["replicas"]
+              if r["addr"] == fleet[1]["addr"]][0]
+        assert rh["restarts_detected"] == 1
+        assert rh["replica_id"] == "restarted-process"
+    finally:
+        fleet[1]["httpd"].state.replica_id = old_id
+
+
+# ---- router health surface / proxying --------------------------------------
+
+def test_router_readyz_and_info(fleet, router):
+    fr, port = router()
+    status, body, _ = get_json(port, "/healthz")
+    assert status == 200
+    status, body, _ = get_json(port, "/readyz")
+    assert status == 200 and body["replicas_in_rotation"] == 2
+    status, info, _ = get_json(port, "/router_info")
+    assert info["ready"] and len(info["replicas"]) == 2
+    for r in info["replicas"]:
+        assert r["breaker"]["state"] == "closed"
+    # all drained → not ready, Retry-After present
+    for r in fleet:
+        fr.replicas.drain(r["addr"])
+    status, body, headers = get_json(port, "/readyz")
+    assert status == 503 and "Retry-After" in headers
+    for r in fleet:
+        fr.replicas.drain(r["addr"], on=False)
+
+
+def test_router_nonstream_proxy_and_failover(fleet, router):
+    """Non-streaming requests proxy through; a dead first-choice
+    replica is skipped (nothing was delivered, any request may
+    retry)."""
+    fr, port = router()
+    body = {"prompt": PROMPT, **GREEDY}
+    status, raw, _ = post_json(port, "/v1/completions", body)
+    assert status == 200
+    d = json.loads(raw)
+    assert d["choices"][0]["finish_reason"] == "length"
+    want = d["choices"][0]["text"]
+    # models proxy
+    status, raw, _ = post_json(port, "/v1/completions", body)
+    status, mraw, _ = get_json(port, "/v1/models")
+    assert status == 200 and mraw["data"][0]["object"] == "model"
+    # force first-choice replica down: mark state down router-side and
+    # verify the OTHER replica answers identically
+    first = fr.placement.pick()
+    first.state = "down"
+    try:
+        status, raw, _ = post_json(port, "/v1/completions", body)
+        assert status == 200
+        assert json.loads(raw)["choices"][0]["text"] == want
+    finally:
+        first.state = "ready"
+
+
+def test_sse_error_event_carries_retry_after_over_http(tiny_ckpt):
+    """Satellite: the api_server SSE error path surfaces
+    StreamChunk.retry_after — an unsafe stream dropped during a
+    supervised recovery ends with an error event carrying the hint."""
+    llm = make_llm(tiny_ckpt, engine_recovery=True, max_step_failures=1,
+                   rebuild_backoff_s=0.02, rebuild_backoff_max_s=0.2)
+    httpd = serve(llm, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        # warm, then stream an UNSEEDED sampled request (not
+        # replay-safe) and crash the engine under it
+        for c in httpd.state.engine.submit(
+                list(PROMPT), SamplingParams(temperature=0.0,
+                                             max_tokens=2,
+                                             ignore_eos=True)):
+            pass
+        box = {}
+
+        def run():
+            box["resp"] = sse_stream(
+                port, "/v1/completions",
+                {"prompt": PROMPT, "stream": True, "temperature": 0.9,
+                 "max_tokens": 64, "ignore_eos": True})
+
+        th = threading.Thread(target=run, daemon=True)
+        th.start()
+        time.sleep(0.2)               # let a few tokens stream
+        FAULTS.arm("step_exception:0:1")
+        th.join(timeout=60)
+        assert not th.is_alive()
+        status, events = box["resp"]
+        assert status == 200
+        assert finish_of(events) == "error"
+        errs = error_events(events)
+        assert errs and errs[-1]["error"].get("retry_after", 0) > 0
+        assert "not replay-safe" in errs[-1]["error"]["message"]
+    finally:
+        httpd.shutdown()
+        httpd.state.engine.shutdown()
