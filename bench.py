@@ -1235,6 +1235,163 @@ def main():
             f"({faulted['migrated']} streams migrated, failover_s="
             f"{faulted['failover_s']}, lost_tokens=0)")
 
+    # Disaggregated prefill/decode lever (ISSUE 17, GLLM_BENCH_PD=1):
+    # one prefill-role + one decode-role in-process replica behind the
+    # front router core. Every stream prefills on the prefill pool, the
+    # prefix KV chain is PUSHED to the decode replica at first token,
+    # and the stream migrates there via the journaled continuation path.
+    # Asserted invariants: reprefill_tokens == 0 (every pushed page is
+    # claimed as cached tokens by the decode side — the decode pool
+    # never recomputes the prompt) and lost_tokens == 0 — including
+    # under a drain-triggered scale-down of the decode replica mid-pass.
+    pd_result = None
+    if os.environ.get("GLLM_BENCH_PD", "0") not in ("", "0"):
+        phase("pd_pass")
+        import copy as _copy
+        import statistics as _stats
+        import threading as _th
+        from gllm_tpu.entrypoints.api_server import serve as _serve
+        from gllm_tpu.kvstore import stats as _kvs
+        from gllm_tpu.router import FrontRouter
+        n_pd = min(n_requests, 4 if args.tiny else 8)
+        pd_prompts = [list(p) for p in prompts[:n_pd]]
+        pd_tokens = [min(p.max_tokens, 32) for p in params[:n_pd]]
+        page = engine_cfg.cache.page_size
+        # full prefix pages per prompt — the zero-re-prefill ledger
+        pd_pages = [max(0, (len(p) - 1) // page) for p in pd_prompts]
+
+        def _pd_cfg(role):
+            cfg = _copy.deepcopy(engine_cfg)
+            cfg.scheduler.pool_role = role
+            cfg.cache.enable_prefix_caching = True
+            cfg.cache.kv_host_pool_pages = max(
+                256, 2 * sum(pd_pages) + 8)
+            cfg.cache.prefix_serve_port = 0
+            cfg.validate()
+            return cfg
+
+        class _PdSink:
+            def __init__(self):
+                self.started = False
+                self.tokens = 0
+                self.error = None
+                self.t0 = None
+                self.ttft = None
+
+            def start(self):
+                self.started = True
+
+            def send(self, ev):
+                if "choices" in ev:
+                    if self.ttft is None and self.t0 is not None:
+                        self.ttft = time.monotonic() - self.t0
+                    self.tokens += 1
+                    fin = ev["choices"][0].get("finish_reason")
+                    if fin in ("error", "abort"):
+                        self.error = f"finish={fin}"
+                elif "error" in ev:
+                    self.error = ev["error"].get("message")
+
+            def done(self):
+                pass
+
+            def fail_json(self, status, obj, headers):
+                self.error = f"{status}: {obj}"
+
+        def pd_arm(drain_decode_frac=None, clean_dt=None):
+            reps = []
+            for role in ("prefill", "decode"):
+                llm_r = LLM(config=_pd_cfg(role), model_cfg=model_cfg)
+                httpd = _serve(llm_r, "127.0.0.1", 0)
+                _th.Thread(target=httpd.serve_forever,
+                           daemon=True).start()
+                reps.append(httpd)
+            addrs = [f"127.0.0.1:{h.server_address[1]}" for h in reps]
+            fr = FrontRouter(addrs, probe_interval_s=0.1,
+                             breaker_base_s=0.5, breaker_jitter=0.0,
+                             stream_idle_timeout_s=300.0)
+            push0 = _kvs.PUSH_PAGES.get()
+            hit0 = obs_metrics.REGISTRY.get(
+                "gllm_prefix_cache_hit_tokens_total").get()
+            sinks = [_PdSink() for _ in range(n_pd)]
+            timer = None
+            try:
+                t0 = time.monotonic()
+                if drain_decode_frac is not None:
+                    delay = max(0.05, drain_decode_frac * clean_dt)
+                    timer = _th.Timer(
+                        delay,
+                        lambda: fr.drain_replica(addrs[1], migrate=True))
+                    timer.daemon = True
+                    timer.start()
+
+                def run(p, mt, s):
+                    s.t0 = time.monotonic()
+                    fr.stream("completion",
+                              {"prompt": p, "max_tokens": mt,
+                               "temperature": 0, "ignore_eos": True,
+                               "stream": True}, s)
+
+                threads = [_th.Thread(target=run, args=(p, mt, s),
+                                      daemon=True)
+                           for p, mt, s in zip(pd_prompts, pd_tokens,
+                                               sinks)]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=600)
+                    assert not t.is_alive(), "pd-arm stream hung"
+                dt_arm = time.monotonic() - t0
+            finally:
+                if timer is not None:
+                    timer.cancel()
+                fr.close()
+                for h in reps:
+                    h.shutdown()
+                    h.state.engine.shutdown()
+            errors = [s.error for s in sinks if s.error]
+            assert not errors, f"pd-arm stream errors: {errors[:3]}"
+            pushed = int(_kvs.PUSH_PAGES.get() - push0)
+            hit_tok = int(obs_metrics.REGISTRY.get(
+                "gllm_prefix_cache_hit_tokens_total").get() - hit0)
+            return {"tok": sum(s.tokens for s in sinks), "dt": dt_arm,
+                    "pushed": pushed, "hit_tok": hit_tok,
+                    "ttft_p50": round(_stats.median(
+                        s.ttft for s in sinks if s.ttft is not None), 4)}
+
+        clean = pd_arm()
+        want_tok = sum(pd_tokens)
+        want_pages = sum(pd_pages)
+        assert clean["tok"] == want_tok, (
+            "clean pd arm dropped tokens", clean["tok"], want_tok)
+        # zero re-prefill: the push moved EVERY full prefix page, and
+        # the decode side claimed every pushed token as cached
+        assert clean["pushed"] == want_pages, (
+            "push moved fewer pages than the prompts' prefix chains",
+            clean["pushed"], want_pages)
+        reprefill = max(0, want_pages * page - clean["hit_tok"])
+        assert reprefill == 0, (
+            f"decode pool re-prefilled {reprefill} pushed tokens")
+        # drain-triggered scale-down mid-pass: the decode replica is
+        # admin-drained with migrate=True while streams run on it —
+        # journal-backed migration keeps every client stream whole
+        drained = pd_arm(drain_decode_frac=0.4, clean_dt=clean["dt"])
+        lost = want_tok - drained["tok"]
+        assert lost == 0, (
+            "drain-triggered scale-down lost tokens "
+            f"({drained['tok']} vs {want_tok})")
+        pd_result = {
+            "requests": n_pd,
+            "ttft_p50": clean["ttft_p50"],
+            "pushed_pages": clean["pushed"],
+            "reprefill_tokens": int(reprefill),
+            "lost_tokens": int(lost),
+            "drain_ttft_p50": drained["ttft_p50"],
+        }
+        log(f"pd pass: ttft_p50={clean['ttft_p50']}s, "
+            f"{clean['pushed']} pages pushed, reprefill_tokens=0, "
+            f"lost_tokens=0 across a mid-pass decode drain")
+
     phase("report")
     # MFU: every processed token (prompt + output) makes one forward pass.
     total_proc = total_in + total_out
@@ -1328,6 +1485,12 @@ def main():
         # degradation, streams migrated, failover wall, and the
         # zero-lost-tokens contract — first-class
         result["fleet"] = fleet_result
+    if pd_result is not None:
+        # disaggregated prefill/decode (ISSUE 17, GLLM_BENCH_PD=1): one
+        # prefill + one decode replica behind the router — TTFT, pages
+        # pushed, and the zero-re-prefill / zero-lost-tokens contracts
+        # (the latter across a drain-triggered scale-down) — first-class
+        result["pd"] = pd_result
     print(json.dumps(result))
 
 

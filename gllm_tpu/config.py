@@ -30,6 +30,12 @@ class SchedulerConfig:
     # KV free-ratio reserve used by token throttling's prefill budget ramp
     # (reference scheduler.py:613-696).
     throttle_reserve: float = 0.2
+    # pd-pool topology role this replica advertises on /server_info
+    # (--pool-role, docs/pd_pools.md): the front router places new
+    # prompts on the prefill pool and migrates streams to the decode
+    # pool at first token. "mixed" (default) keeps the replica eligible
+    # for both phases — the single-replica and legacy-fleet behavior.
+    pool_role: str = "mixed"              # prefill | decode | mixed
 
 
 @dataclasses.dataclass
@@ -456,6 +462,10 @@ class EngineConfig:
         ):
             raise ValueError(
                 f"unknown schedule_method {self.scheduler.schedule_method!r}")
+        if self.scheduler.pool_role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"unknown pool_role {self.scheduler.pool_role!r} "
+                "(choices: prefill, decode, mixed)")
         if self.quantization not in (None, "int8", "fp8", "int4",
                                      "w8a8", "fp8_block"):
             raise ValueError(

@@ -490,6 +490,54 @@ class LLM:
         mm._seq_chain.clear()
         return moved
 
+    def export_prefix_chain(self, token_ids) -> list:
+        """Pack one prompt's finished prefix KV chain for a pd-pool push
+        (docs/pd_pools.md): ``[(digest, canary_tokens, payload), ...]``
+        in chain order, covering the whole-page prefix of ``token_ids``
+        (the same ``(len-1)//page_size`` pages ``prefix_digests``
+        addresses). Pages still HBM-only are spilled host-side first —
+        a targeted ``demote_prefix_cache`` that copies without dropping
+        any key, so this replica's own cache is untouched. ENGINE
+        THREAD ONLY: the spill drains through ``apply``/
+        ``_materialize`` exactly like a dispatch would. Returns [] when
+        the host tier is off; a chain gap truncates (a child page is
+        useless to the receiver without its parents)."""
+        mm, sw = self.memory_manager, self.swap_manager
+        if sw is None or self.prefix_tiers is None:
+            return []
+        from gllm_tpu.kvswap.host_pool import CANARY_TOKENS
+        from gllm_tpu.memory_manager import prefix_digests
+        digests = prefix_digests(list(token_ids), len(token_ids),
+                                 self.config.cache.page_size)
+        queued = False
+        for digest, _toks in digests:
+            with sw.pool.lock:
+                if digest in sw.pool.hash_to_page:
+                    continue             # already host-resident
+            page = mm.hash_to_page.get(digest)
+            if page is None:
+                continue
+            meta = mm.page_meta.get(page)
+            if meta is None or meta[0] != digest:
+                continue
+            sw.spill_prefix(page, digest, meta[1],
+                            parent=mm._digest_parent.get(digest))
+            queued = True
+        if queued:
+            # land the copies NOW (the usual double buffer has no next
+            # step to ride; export refuses still-pinned pages)
+            self.runner.kv = sw.apply(self.runner.kv)
+            sw._materialize()
+        out = []
+        for digest, toks in digests:
+            payload = self.prefix_tiers.serve(digest)
+            if payload is None:
+                break
+            out.append((digest,
+                        tuple(int(t) for t in toks[:CANARY_TOKENS]),
+                        payload))
+        return out
+
     def close(self) -> None:
         """Release the resources a SUCCESSOR engine needs to re-adopt
         (docs/robustness.md#recovery-lifecycle): stop serving prefix

@@ -255,6 +255,12 @@ class ServingEngine:
             faults.FAULTS.arm(cfg.fault_inject)
 
         self._intake: "queue.Queue" = queue.Queue()
+        # pd-pool push tickets (docs/pd_pools.md): handler threads
+        # enqueue (prompt_ids, target_addr, ticket) via push_prefix();
+        # the engine loop drains them — the KV spill/export must run on
+        # the engine thread — and hands the socket send to a daemon
+        # thread that resolves the ticket.
+        self._push_work: "queue.Queue" = queue.Queue()
         self._handles: dict[int, RequestHandle] = {}
         self._seqs: dict[int, object] = {}
         self._emitted: dict[int, int] = {}   # seq_id → chars streamed
@@ -559,6 +565,56 @@ class ServingEngine:
         self._wake.set()
         return handle
 
+    def push_prefix(self, prompt_ids: List[int], target_addr: str,
+                    wait_s: float = 5.0) -> int:
+        """pd-pool KV handoff (docs/pd_pools.md): ship ``prompt_ids``'s
+        finished prefix KV chain to ``target_addr`` (a decode replica's
+        prefix serve port). Any thread may call this; the KV export runs
+        on the engine thread (queued here, drained each loop pass) and
+        the socket send on a daemon thread, so neither the caller nor
+        the step loop can stall on the other. Returns the number of
+        pages the target ACCEPTED — 0 on any failure or timeout (a
+        failed push costs the decode side a re-prefill, never more)."""
+        ticket = {"done": threading.Event(), "pages": 0}
+        self._push_work.put(([int(t) for t in prompt_ids],
+                             str(target_addr), ticket))
+        self._wake.set()
+        ticket["done"].wait(timeout=wait_s)
+        return int(ticket["pages"])
+
+    def _drain_push_work(self, llm) -> None:
+        """Engine-thread half of :meth:`push_prefix`: spill + pack the
+        chain (device-ordering-safe only here), then hand the payloads
+        to a shipper thread."""
+        while True:
+            try:
+                ids, addr, ticket = self._push_work.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                pages = llm.export_prefix_chain(ids)
+            except Exception:
+                logger.exception("prefix export for pd push failed")
+                pages = []
+            if not pages:
+                ticket["done"].set()
+                continue
+            geometry = llm.prefix_tiers.geometry
+
+            def _ship(pages=pages, addr=addr, ticket=ticket,
+                      geometry=geometry):
+                from gllm_tpu.kvstore.peer import PrefixPusher
+                try:
+                    ticket["pages"] = PrefixPusher(geometry).push(
+                        addr, pages)
+                except Exception:   # pragma: no cover - push never raises
+                    logger.exception("pd prefix push failed")
+                finally:
+                    ticket["done"].set()
+
+            threading.Thread(target=_ship, daemon=True,
+                             name="gllm-kv-push").start()
+
     def abort(self, seq_id: int) -> None:
         entry = self._pending_replay.get(seq_id)
         if entry is not None:
@@ -663,6 +719,7 @@ class ServingEngine:
                 except ValueError as e:
                     self._deliver_error(seq.seq_id, "error", str(e))
                 drained = True
+            self._drain_push_work(llm)
             self._expire_deadlines()
             if not llm.has_unfinished:
                 if not drained:

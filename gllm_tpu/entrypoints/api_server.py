@@ -342,6 +342,9 @@ class Handler(BaseHTTPRequestHandler):
                 },
                 "max_model_len": cfg.max_model_len,
                 "schedule_method": cfg.scheduler.schedule_method,
+                # pd-pool topology (docs/pd_pools.md): the router's
+                # placement layer keys on this role
+                "pool_role": cfg.scheduler.pool_role,
                 "page_size": cfg.cache.page_size,
                 "num_pages": st.llm.runner.num_pages,
                 "prefix_caching": cfg.cache.enable_prefix_caching,
@@ -718,7 +721,10 @@ class Handler(BaseHTTPRequestHandler):
                 return
             self._stream(handle, lambda text, fin: proto.
                          chat_completion_chunk(rid, req.model, text, fin),
-                         router=router is not None)
+                         router=router is not None,
+                         push_to=(None if cont is not None else
+                                  (router or {}).get("push_to")),
+                         prompt_ids=ids)
 
     def _completion(self):
         st = self.state
@@ -766,7 +772,10 @@ class Handler(BaseHTTPRequestHandler):
                 return
             self._stream(handle, lambda text, fin: proto.completion_chunk(
                 rid, req.model, text or "", fin),
-                router=router is not None)
+                router=router is not None,
+                push_to=(None if cont is not None else
+                         (router or {}).get("push_to")),
+                prompt_ids=ids)
             return
         results, usage = self._run_choices(req, ids)
         choices = []
@@ -812,7 +821,9 @@ class Handler(BaseHTTPRequestHandler):
         return {"text": text, "finish": finish,
                 "usage": usage, "lp": lp or None, "plp": plp}
 
-    def _stream(self, handle, make_chunk, router: bool = False):
+    def _stream(self, handle, make_chunk, router: bool = False,
+                push_to=None, prompt_ids=None):
+        pushed_pages = None
         try:
             for chunk in handle:
                 # chaos points (docs/robustness.md#fleet): replica_kill
@@ -837,6 +848,15 @@ class Handler(BaseHTTPRequestHandler):
                     # per-token ids for the front router's stream
                     # journal (stripped before the client sees them)
                     ev["gllm"] = {"token_id": int(chunk.token_id)}
+                    if push_to and pushed_pages is None:
+                        # pd-pool handoff (docs/pd_pools.md): the first
+                        # sampled token means prefill is done — ship the
+                        # prompt's prefix KV chain to the router-picked
+                        # decode replica and report the accepted count
+                        # so the router can migrate with zero re-prefill
+                        pushed_pages = self.state.engine.push_prefix(
+                            prompt_ids or [], push_to)
+                        ev["gllm"]["pushed_pages"] = int(pushed_pages)
                 self._sse(ev)
                 if chunk.finish_reason in ("error", "abort", "deadline") \
                         and (chunk.error
@@ -983,6 +1003,7 @@ def build_engine_config(args) -> EngineConfig:
             iter_smooth=args.iterp,
             init_new_token_ratio=args.init_new_token_ratio,
             min_new_token_ratio=args.min_new_token_ratio,
+            pool_role=args.pool_role,
         ),
         enforce_eager=args.enforce_eager,
         cache=CacheConfig(
@@ -1031,6 +1052,14 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--maxp", type=int, default=2048)
     p.add_argument("--minp", type=int, default=128)
     p.add_argument("--iterp", type=int, default=16)
+    p.add_argument("--pool-role", default="mixed",
+                   choices=["prefill", "decode", "mixed"],
+                   help="pd-pool role advertised on /server_info "
+                        "(docs/pd_pools.md): the front router places "
+                        "new prompts on prefill replicas and migrates "
+                        "each stream to a decode replica at first "
+                        "token, pushing the prefix KV chain ahead of "
+                        "it; mixed (default) serves both phases")
     p.add_argument("--init-new-token-ratio", type=float, default=0.7,
                    help="adaptive KV admission ramp start (reference "
                         "--init-new-token-ratio)")

@@ -186,6 +186,22 @@ class RouterHandler(BaseHTTPRequestHandler):
                 body = self._read_json()
                 addr = body.get("replica", "")
                 on = self.path.endswith("/drain")
+                if on and body.get("migrate"):
+                    # scale-down drain (docs/pd_pools.md): in-flight
+                    # replayable streams migrate off the replica NOW
+                    # (zero lost tokens) instead of waiting to finish
+                    res = r.drain_replica(addr, migrate=True)
+                    if not res.get("ok"):
+                        self._json(proto.error_response(
+                            f"unknown replica {addr!r}", 404), code=404)
+                        return
+                    rep = r.replicas.get(addr)
+                    self._json({"status": "ok", "replica": addr,
+                                "draining": True,
+                                "migrating_streams":
+                                    res["migrating_streams"],
+                                "active_streams": rep.active_streams})
+                    return
                 if not r.replicas.drain(addr, on=on):
                     self._json(proto.error_response(
                         f"unknown replica {addr!r}", 404), code=404)
@@ -261,6 +277,16 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--breaker-max-s", type=float, default=30.0)
     p.add_argument("--breaker-fails", type=int, default=1,
                    help="consecutive probe failures to open the breaker")
+    p.add_argument("--slo-ttft-s", type=float, default=2.0,
+                   help="TTFT SLO target feeding the per-pool "
+                        "autoscale verdicts on /router_info "
+                        "(docs/pd_pools.md)")
+    p.add_argument("--slo-tpot-s", type=float, default=0.5,
+                   help="per-token latency SLO target for the decode "
+                        "pool's autoscale verdict")
+    p.add_argument("--autoscale-interval-s", type=float, default=5.0,
+                   help="min seconds between /metrics scrapes per "
+                        "replica for the SLO window")
     return p
 
 
@@ -278,7 +304,10 @@ def main(argv=None):
         prefix_affinity=args.prefix_affinity,
         breaker_base_s=args.breaker_base_s,
         breaker_max_s=args.breaker_max_s,
-        breaker_fails=args.breaker_fails)
+        breaker_fails=args.breaker_fails,
+        slo_ttft_s=args.slo_ttft_s,
+        slo_tpot_s=args.slo_tpot_s,
+        autoscale_interval_s=args.autoscale_interval_s)
     httpd = serve_router(router, args.host, args.port)
     ready = len(router.replicas.in_rotation())
     logger.info("front router on %s:%d over %d replicas (%d ready)",

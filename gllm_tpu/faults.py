@@ -63,6 +63,15 @@ Points and their wired sites:
                          ``FAULTS.stall_s`` before the next SSE chunk —
                          the wedged-replica shape → exercises the
                          router's stream idle-timeout failover path
+- ``kv_push_fail``       makes one ``PrefixPusher.push`` behave as if
+                         the push plane were down → the pd-pool KV
+                         handoff ships nothing and the decode replica
+                         falls back to pull-then-recompute
+                         (docs/pd_pools.md), never a stall
+- ``pool_migrate_fail``  makes one router prefill→decode pool handoff
+                         behave as a placement failure → the stream
+                         stays where it is / falls back to normal
+                         placement with zero lost tokens
 
 Firing a point records a ``fault`` event on the steptrace ring. Everything
 here is stdlib-only and cheap when disarmed: ``fire()`` is one attribute
@@ -96,6 +105,8 @@ POINTS = (
     "peer_flap",
     "replica_kill",
     "replica_hang",
+    "kv_push_fail",
+    "pool_migrate_fail",
 )
 
 

@@ -36,7 +36,8 @@ import numpy as np
 
 from gllm_tpu.kvstore import stats
 from gllm_tpu.kvstore.disk import DiskPrefixStore
-from gllm_tpu.kvstore.pagefmt import pack_page, pool_geometry
+from gllm_tpu.kvstore.pagefmt import (pack_page, pool_geometry,
+                                      verify_payload)
 from gllm_tpu.kvstore.peer import PeerPrefixServer, PrefixClient
 
 logger = logging.getLogger(__name__)
@@ -141,6 +142,39 @@ class TieredPrefixManager:
             return self.disk.get_payload(digest)
         return None
 
+    def accept_push(self, digest: bytes, tokens, payload: bytes) -> bool:
+        """Server-side sink of the peer ``push`` op (pd-pool KV
+        handoff): verify the payload against LOCAL geometry + digest +
+        canary, then stage it into the host pool exactly like a lower-
+        tier probe hit — the next ``match_prefix`` walk hits host tier
+        and restores through the normal intent queue, zero re-prefill.
+        Runs on a server handler thread; staging holds the pool lock.
+        False = rejected (corrupt, pool full) — the pusher's problem is
+        never this replica's problem."""
+        try:
+            leaves, parent = verify_payload(payload, self.geometry,
+                                            digest, tokens)
+        except (ValueError, KeyError):
+            stats.POISON.inc(tier="peer")
+            return False
+        # the whole stage runs under the pool RLock: accept runs on a
+        # server handler thread while the engine thread allocates from
+        # the same free list / LRU
+        with self.pool.lock:
+            if digest in self.pool.hash_to_page:
+                return True              # already resident: idempotent
+            host = self.pool.allocate(1)
+            if host is None:
+                return False             # pool full of pinned pages
+            page = host[0]
+            for store, leaf in zip(self.pool.store, leaves):
+                store[page] = leaf
+            self.pool.put_prefix(page, digest,
+                                 tuple(int(t) for t in
+                                       tokens[:self._canary_len()]),
+                                 parent=parent)
+        return True
+
     def contains(self, digest: bytes) -> bool:
         """Cheap membership for the peer ``has`` placement probe: index
         lookups only — no page export, no pack, no disk read (the probe
@@ -154,7 +188,8 @@ class TieredPrefixManager:
                      port: int = 0) -> "PeerPrefixServer":
         self.server = PeerPrefixServer(self.serve, self.geometry,
                                        host=host, port=port,
-                                       contains=self.contains)
+                                       contains=self.contains,
+                                       accept=self.accept_push)
         return self.server
 
     # ---- lifecycle --------------------------------------------------------

@@ -163,20 +163,37 @@ def parse_peer_addr(addr: str) -> Tuple[str, int]:
 
 
 class PeerPrefixServer:
-    """Read-only prefix-page endpoint over this replica's host + disk
-    tiers. One of these per serving replica (``--prefix-serve-port``);
-    other replicas point ``--prefix-peers`` at it."""
+    """Prefix-page endpoint over this replica's host + disk tiers. One
+    of these per serving replica (``--prefix-serve-port``); other
+    replicas point ``--prefix-peers`` at it. Pull ops (``has``/``get``)
+    are read-only; the ``push`` op (pd-pool KV handoff,
+    docs/pd_pools.md) accepts pages INTO the host pool through the
+    owner-supplied ``accept`` callback, which verifies digest + canary
+    against local geometry before a byte touches the pool."""
 
     IDLE_S = 60.0
 
     def __init__(self, provider: Provider, geometry: dict,
                  host: str = "0.0.0.0", port: int = 0,
-                 contains: Optional[Callable[[bytes], bool]] = None):
+                 contains: Optional[Callable[[bytes], bool]] = None,
+                 accept: Optional[Callable[[bytes, list, bytes],
+                                           bool]] = None):
         self._provider = provider
         # cheap membership for the ``has`` placement probe; falls back
         # to materializing via the provider when the owner has no index
         self._contains = contains
+        # push sink: (digest, tokens, payload) -> accepted. None keeps
+        # the endpoint pull-only (pushes are rejected, not errors).
+        self._accept = accept
         self._geometry = geometry
+        from gllm_tpu.kvstore.pagefmt import geometry_bytes
+        try:
+            self._push_limit = geometry_bytes(geometry) + 4096
+        except (KeyError, TypeError):
+            # hello only ever COMPARES geometry, so pull-only servers
+            # (placement `has` probes) may run on an opaque dict; with
+            # no page-size budget derivable, pushes stay frame-capped
+            self._push_limit = _MAX_FRAME
         outer = self
 
         class _Handler(socketserver.BaseRequestHandler):
@@ -250,6 +267,32 @@ class PeerPrefixServer:
                 stats.BYTES.inc(len(payload), tier="peer", dir="write")
             _send_frame(sock, {"hit": payload is not None},
                         raw=payload or b"")
+        elif op == "push":
+            # pd-pool KV handoff (docs/pd_pools.md): the control frame
+            # carries digest + canary tokens, the raw frame the pagefmt
+            # payload. The payload frame is consumed even when the
+            # control frame is malformed — otherwise the byte stream
+            # desynchronizes and every later op on this connection
+            # parses garbage.
+            payload = _recv_payload(sock, self._push_limit)
+            if payload is None:
+                raise OSError("push payload missing")
+            ok = False
+            try:
+                digest = bytes.fromhex(msg.get("digest", ""))
+                tokens = [int(t) for t in msg.get("tokens") or []]
+                if digest and self._accept is not None:
+                    ok = bool(self._accept(digest, tokens, payload))
+            except Exception:      # accepting must never kill the conn
+                logger.exception("prefix push accept failed for %s",
+                                 msg.get("digest"))
+                ok = False
+            if ok:
+                stats.PUSH_PAGES.inc()
+                stats.PUSH_BYTES.inc(len(payload))
+            else:
+                stats.PUSH_REJECTS.inc()
+            _send_frame(sock, {"ok": ok})
 
     def close(self) -> None:
         self._srv.shutdown()
@@ -463,3 +506,62 @@ class PrefixClient:
             self._closed = True
             for addr, st in self._peers.items():
                 self._drop(addr, st, backoff=False)
+
+
+class PrefixPusher:
+    """Push-by-digest toward a single target replica's prefix store
+    (the pd-pool KV handoff, docs/pd_pools.md). Stateless per call:
+    one fresh connection, one hello geometry negotiation, then the
+    whole chain under ONE wall-clock deadline — a dead or slow decode
+    target costs at most ``timeout_s`` and the push is simply dropped
+    (the decode replica falls back to pull-then-recompute; a push
+    failure must never stall or fail the stream that triggered it)."""
+
+    def __init__(self, geometry: dict, timeout_s: Optional[float] = None):
+        self.geometry = geometry
+        self.timeout_s = (timeout_s if timeout_s is not None else _env_f(
+            "GLLM_PREFIX_PEER_TIMEOUT_S", 2.0))
+
+    def push(self, addr: str,
+             pages: Sequence[Tuple[bytes, Sequence[int], bytes]]) -> int:
+        """Ship ``(digest, canary_tokens, payload)`` pages to
+        ``addr`` (``host:port`` of the target's prefix serve port).
+        Returns how many the target ACCEPTED (verified + staged);
+        any transport/negotiation failure returns the partial count."""
+        if not pages:
+            return 0
+        if FAULTS.fire("kv_push_fail"):
+            # chaos point (docs/robustness.md): the push plane is down —
+            # the handoff degrades to re-prefill on the decode side,
+            # the client stream is untouched
+            return 0
+        accepted = 0
+        deadline = time.monotonic() + self.timeout_s
+        try:
+            host, port = parse_peer_addr(addr)
+            with socket.create_connection(
+                    (host, port), timeout=self.timeout_s) as sock:
+                sock.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
+                _send_frame(sock, {"op": "hello"})
+                reply = _recv_frame(sock, deadline=deadline)
+                if reply is None or reply.get("geometry") != self.geometry:
+                    logger.warning(
+                        "prefix push target %s refused: geometry "
+                        "mismatch — push dropped", addr)
+                    return 0
+                for digest, tokens, payload in pages:
+                    _send_frame(sock, {"op": "push",
+                                       "digest": digest.hex(),
+                                       "tokens": [int(t)
+                                                  for t in tokens]},
+                                raw=payload)
+                    ack = _recv_frame(sock, deadline=deadline)
+                    if ack is None:
+                        raise OSError("push target closed mid-reply")
+                    if ack.get("ok"):
+                        accepted += 1
+        except (OSError, ValueError):
+            logger.warning("prefix push to %s failed after %d/%d pages",
+                           addr, accepted, len(pages))
+        return accepted

@@ -52,3 +52,17 @@ PEER_BREAKER_OPENS = obs.counter(
 PEER_BREAKER_OPEN = obs.gauge(
     "gllm_kvstore_peer_breaker_open",
     "peers currently held open (skipped) by their circuit breaker")
+PUSH_PAGES = obs.counter(
+    "gllm_kvstore_peer_push_pages_total",
+    "prefix pages accepted into the host pool via the peer push op "
+    "(pd-pool KV handoff: each accepted page is one page of decode-side "
+    "re-prefill avoided)")
+PUSH_BYTES = obs.counter(
+    "gllm_kvstore_peer_push_bytes_total",
+    "payload bytes accepted via the peer push op (int8 pages are about "
+    "half the bf16 bytes)")
+PUSH_REJECTS = obs.counter(
+    "gllm_kvstore_peer_push_rejects_total",
+    "pushed pages refused by the receiving replica (verification "
+    "failure, malformed frame, or host pool full — the decode side "
+    "falls back to pull-then-recompute, never a stall)")

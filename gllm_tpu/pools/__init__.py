@@ -1,0 +1,18 @@
+"""Disaggregated prefill/decode pool topology (docs/pd_pools.md).
+
+The pool layer sits between the front router (gllm_tpu/router/) and the
+serving replicas: each replica advertises a ``pool_role`` on
+``/server_info`` (``--pool-role prefill|decode|mixed``), placement
+routes new prompts to the prefill pool and migrates each stream to the
+decode pool at first token via the journaled continuation path, and
+:class:`PoolAutoscaler` turns the fleet's health surfaces into per-pool
+scale verdicts. Everything here is jax-free — it runs inside the
+router process, never the serving replicas.
+"""
+
+from __future__ import annotations
+
+from gllm_tpu.pools.autoscaler import (POOL_ROLES, PoolAutoscaler,
+                                       replica_role)
+
+__all__ = ["POOL_ROLES", "PoolAutoscaler", "replica_role"]

@@ -1,0 +1,223 @@
+"""SLO-driven autoscaling signals for the prefill/decode pools.
+
+Jax-free and replica-passive: the autoscaler never commands anything —
+it derives per-pool **scale verdicts** from surfaces every replica
+already exposes (``/readyz`` state via the router's poller,
+``/server_info`` queue depths, and the ``gllm_request_ttft_seconds`` /
+``gllm_request_tpot_seconds`` histograms on ``/metrics``) and publishes
+them on ``/router_info``. An external operator (or a human) reads the
+verdicts and adds/drains replicas; scale-DOWN goes through the router's
+``drain_replica`` so in-flight decode streams migrate with zero lost
+tokens (docs/pd_pools.md#autoscaling).
+
+Signal definitions (per pool):
+
+- ``queue_depth``   Σ waiting sequences across the pool's ready replicas
+- ``ttft_mean_s``   windowed mean of the TTFT histogram deltas — the
+                    prefill pool's SLO axis (a prompt burst shows up
+                    here first)
+- ``tpot_mean_s``   windowed mean of the TPOT histogram deltas — the
+                    decode pool's SLO axis (a decode pool at capacity
+                    stretches inter-token latency before anything else)
+- ``slo_headroom``  ``1 - latency/slo`` on the pool's axis, in [-inf, 1]
+
+Verdict rules, in order: no ready replica → ``scale_up``; SLO headroom
+< 0 or queue depth per ready replica above ``queue_high`` →
+``scale_up``; pool idle (no queue, no running work) with more than
+``min_replicas`` ready and headroom > 0.5 → ``scale_down``; otherwise
+``hold``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+import re
+import threading
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+POOL_ROLES = ("prefill", "decode", "mixed")
+
+# prom text sample: name{labels} value  — labels optional; the TTFT/
+# TPOT families are unlabeled but the parser tolerates labels so a
+# future label add cannot silently zero the autoscaler's signals.
+_SAMPLE_RE = re.compile(
+    r"^(gllm_request_(?:ttft|tpot)_seconds_(?:sum|count))"
+    r"(?:\{[^}]*\})?\s+([0-9.eE+-]+|NaN)\s*$")
+
+
+def replica_role(rep) -> str:
+    """The pool role a router-side ``Replica`` last advertised on
+    ``/server_info`` (``mixed`` until the first probe lands — an
+    unknown replica must stay eligible for every pool)."""
+    role = (rep.info or {}).get("pool_role")
+    return role if role in POOL_ROLES else "mixed"
+
+
+def _fetch_metrics_text(host: str, port: int,
+                        timeout: float) -> Optional[str]:
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    try:
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        raw = resp.read()
+        if resp.status != 200:
+            return None
+        return raw.decode("utf-8", "replace")
+    except (OSError, http.client.HTTPException):
+        return None
+    finally:
+        conn.close()
+
+
+def parse_latency_samples(text: str) -> Dict[str, float]:
+    """``{ttft_sum, ttft_count, tpot_sum, tpot_count}`` out of a
+    Prometheus text exposition (missing families read as 0)."""
+    out = {"ttft_sum": 0.0, "ttft_count": 0.0,
+           "tpot_sum": 0.0, "tpot_count": 0.0}
+    for line in text.splitlines():
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, val = m.group(1), m.group(2)
+        try:
+            v = float(val)
+        except ValueError:
+            continue
+        axis = "ttft" if "_ttft_" in name else "tpot"
+        kind = "sum" if name.endswith("_sum") else "count"
+        out[f"{axis}_{kind}"] += v
+    return out
+
+
+class PoolAutoscaler:
+    """Per-pool scale verdicts from the fleet's health surfaces.
+
+    ``observe(rep)`` is wired as the ReplicaSet's ``info_hook`` — it
+    runs on the poller's probe threads right after each replica's
+    ``/server_info`` refresh, scraping ``/metrics`` at most once per
+    ``interval_s`` per replica and keeping windowed histogram deltas.
+    ``verdicts(replicas)`` is called by handler threads serving
+    ``/router_info``; it only reads the latest snapshots.
+    """
+
+    def __init__(self, *,
+                 slo_ttft_s: float = 2.0,
+                 slo_tpot_s: float = 0.5,
+                 queue_high: float = 4.0,
+                 min_replicas: int = 1,
+                 interval_s: float = 5.0,
+                 scrape_timeout_s: float = 2.0):
+        self.slo_ttft_s = float(slo_ttft_s)
+        self.slo_tpot_s = float(slo_tpot_s)
+        self.queue_high = float(queue_high)
+        self.min_replicas = max(0, int(min_replicas))
+        self.interval_s = float(interval_s)
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self._lock = threading.Lock()
+        # addr -> {t, totals, window} — totals are the last scrape's
+        # cumulative samples, window the delta means derived from them
+        self._seen: Dict[str, dict] = {}
+
+    # ---- scraping (poller probe threads) ----------------------------------
+
+    def observe(self, rep) -> None:
+        now = time.monotonic()
+        with self._lock:
+            st = self._seen.setdefault(rep.addr, {
+                "t": 0.0, "totals": None,
+                "window": {"ttft_mean_s": None, "tpot_mean_s": None}})
+            if now - st["t"] < self.interval_s:
+                return
+            st["t"] = now
+        text = _fetch_metrics_text(rep.host, rep.port,
+                                   self.scrape_timeout_s)
+        if text is None:
+            return
+        totals = parse_latency_samples(text)
+        with self._lock:
+            prev = st["totals"]
+            st["totals"] = totals
+            if prev is None:
+                return
+            window = {}
+            for axis in ("ttft", "tpot"):
+                dc = totals[f"{axis}_count"] - prev[f"{axis}_count"]
+                ds = totals[f"{axis}_sum"] - prev[f"{axis}_sum"]
+                if dc < 0 or ds < 0:       # replica restarted: resync
+                    window[f"{axis}_mean_s"] = None
+                elif dc > 0:
+                    window[f"{axis}_mean_s"] = ds / dc
+                else:
+                    window[f"{axis}_mean_s"] = None
+            st["window"] = window
+
+    def window_means(self, addr: str) -> dict:
+        with self._lock:
+            st = self._seen.get(addr)
+            return dict(st["window"]) if st else {
+                "ttft_mean_s": None, "tpot_mean_s": None}
+
+    # ---- verdicts (handler threads, read-only) ----------------------------
+
+    def verdicts(self, replicas) -> Dict[str, dict]:
+        """``{pool: signals+verdict}`` over the current replica list.
+        Mixed replicas count toward BOTH pools (they serve either
+        phase), so a mixed-only fleet reports two healthy pools rather
+        than two empty ones."""
+        out: Dict[str, dict] = {}
+        for pool in ("prefill", "decode"):
+            members = [r for r in replicas
+                       if replica_role(r) in (pool, "mixed")]
+            if not members:
+                continue
+            ready = [r for r in members if r.in_rotation]
+            queue = sum(int((r.info or {}).get("waiting") or 0)
+                        for r in ready)
+            running = sum(int((r.info or {}).get("running") or 0)
+                          for r in ready)
+            streams = sum(r.active_streams for r in members)
+            axis = "ttft" if pool == "prefill" else "tpot"
+            slo = self.slo_ttft_s if pool == "prefill" else self.slo_tpot_s
+            means = [self.window_means(r.addr)[f"{axis}_mean_s"]
+                     for r in ready]
+            means = [m for m in means if m is not None]
+            lat = max(means) if means else None
+            headroom = None if lat is None else 1.0 - lat / slo
+            verdict, why = "hold", "within SLO and queue bounds"
+            if not ready:
+                verdict, why = "scale_up", "no ready replica in pool"
+            elif headroom is not None and headroom < 0.0:
+                verdict = "scale_up"
+                why = (f"{axis} {lat:.3f}s over SLO {slo:.3f}s")
+            elif queue / max(1, len(ready)) > self.queue_high:
+                verdict = "scale_up"
+                why = (f"queue depth {queue} over "
+                       f"{self.queue_high:g}/replica")
+            elif (queue == 0 and running == 0 and streams == 0
+                  and len(ready) > self.min_replicas
+                  and (headroom is None or headroom > 0.5)):
+                verdict, why = "scale_down", "pool idle above min size"
+            out[pool] = {
+                "replicas": len(members),
+                "ready": len(ready),
+                "queue_depth": queue,
+                "running": running,
+                "active_streams": streams,
+                "ttft_mean_s": (max(
+                    (m for m in (self.window_means(r.addr)["ttft_mean_s"]
+                                 for r in ready) if m is not None),
+                    default=None) if ready else None),
+                "tpot_mean_s": (max(
+                    (m for m in (self.window_means(r.addr)["tpot_mean_s"]
+                                 for r in ready) if m is not None),
+                    default=None) if ready else None),
+                "slo_s": slo,
+                "slo_headroom": headroom,
+                "verdict": verdict,
+                "why": why,
+            }
+        return out

@@ -45,7 +45,9 @@ from typing import Dict, Optional
 import http.client
 
 from gllm_tpu.entrypoints import protocol as proto
+from gllm_tpu.faults import FAULTS
 from gllm_tpu.obs import metrics as obs
+from gllm_tpu.pools import PoolAutoscaler, replica_role
 from gllm_tpu.router.journal import (StreamEntry, StreamJournal,
                                      router_unsafe_reason)
 from gllm_tpu.router.placement import Placement, PrefixAffinity
@@ -71,6 +73,16 @@ _M_FAILOVERS = obs.counter(
 _M_FAILOVER_S = obs.histogram(
     "gllm_router_failover_seconds",
     "failure detection to first continuation chunk forwarded")
+_M_POOL_HANDOFFS = obs.counter(
+    "gllm_router_pool_handoffs_total",
+    "prefill->decode pool stream migrations by outcome (ok = stream "
+    "resumed on the decode pool; fallback = handoff vetoed/failed, the "
+    "stream continued through normal placement — zero lost tokens "
+    "either way; docs/pd_pools.md)", ("outcome",))
+_M_POOL_HANDOFF_S = obs.histogram(
+    "gllm_router_pool_handoff_seconds",
+    "pd handoff raised (first prefill token forwarded) to first decode-"
+    "pool chunk forwarded")
 
 
 class UpstreamFailed(Exception):
@@ -96,6 +108,15 @@ class ClientGone(Exception):
     """The downstream client disconnected; abort the upstream and stop."""
 
 
+class PoolHandoff(Exception):
+    """Internal control flow (docs/pd_pools.md): the first sampled
+    token was forwarded from a prefill-pool replica and a decode target
+    is picked — leave this upstream and resume the stream on the decode
+    pool via the normal continuation path. Deliberately NOT an
+    UpstreamFailed: the prefill replica did nothing wrong and the
+    failover budget/metrics must not move."""
+
+
 class FrontRouter:
     """Health-aware placement + journal-backed stream failover over a
     fleet of api_server replicas. Thread-safe: one handler thread per
@@ -114,9 +135,18 @@ class FrontRouter:
                  breaker_max_s: float = 30.0,
                  breaker_fails: int = 1,
                  breaker_jitter: float = 0.1,
+                 slo_ttft_s: float = 2.0,
+                 slo_tpot_s: float = 0.5,
+                 autoscale_interval_s: float = 5.0,
                  start_poller: bool = True,
                  initial_probe: bool = True):
         self.journal = StreamJournal()
+        # per-pool scale verdicts (docs/pd_pools.md#autoscaling): fed by
+        # the poller via info_hook, read by /router_info
+        self.autoscaler = PoolAutoscaler(
+            slo_ttft_s=slo_ttft_s, slo_tpot_s=slo_tpot_s,
+            interval_s=autoscale_interval_s,
+            scrape_timeout_s=probe_timeout_s)
         self.replicas = ReplicaSet(
             list(replica_addrs),
             probe_interval_s=probe_interval_s,
@@ -126,6 +156,7 @@ class FrontRouter:
             breaker_fails=breaker_fails,
             breaker_jitter=breaker_jitter,
             on_restart=self._on_restart,
+            info_hook=self.autoscaler.observe,
             start_poller=start_poller,
             initial_probe=initial_probe)
         self.placement = Placement(
@@ -176,7 +207,53 @@ class FrontRouter:
             "retry_after_s": (None if rotation
                               else round(self.replicas.min_retry_after(),
                                          2)),
+            # per-pool autoscaling signals + scale verdicts
+            # (docs/pd_pools.md#autoscaling)
+            "pools": self.autoscaler.verdicts(
+                list(self.replicas.replicas.values())),
         }
+
+    # ---- pd pools (docs/pd_pools.md) ---------------------------------------
+
+    def _pd_active(self) -> bool:
+        """Handoffs happen only when BOTH strict pools are present in
+        rotation — a mixed/legacy fleet keeps the single-replica stream
+        shape, byte-identical to PR 15."""
+        roles = {replica_role(r) for r in self.replicas.in_rotation()}
+        return "prefill" in roles and "decode" in roles
+
+    def _push_addr(self, rep) -> Optional[str]:
+        """``host:serve_port`` of a replica's prefix store, or None when
+        it doesn't serve one (the handoff still migrates; the decode
+        side just re-prefills)."""
+        store = (rep.info or {}).get("prefix_store") or {}
+        port = store.get("serve_port")
+        return f"{rep.host}:{int(port)}" if port else None
+
+    def drain_replica(self, addr: str, migrate: bool = False) -> dict:
+        """Admin drain (scale-down, docs/pd_pools.md#autoscaling): take
+        ``addr`` out of rotation and — with ``migrate`` — close its
+        proxied upstream connections so each replay-safe (or
+        not-yet-delivering) stream fails over to a surviving replica
+        through the journaled continuation path with zero lost tokens.
+        Unsafe mid-stream entries are left to FINISH IN PLACE: the
+        replica keeps serving them (drain only blocks new placement),
+        which is the whole point of drain vs kill."""
+        ok = self.replicas.drain(addr, True)
+        moved = 0
+        if ok and migrate:
+            for entry in self.journal.by_replica(addr):
+                if not (entry.replay_safe or entry.can_restart):
+                    continue
+                with self._lock:
+                    conn = self._conns.get(entry.rid)
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    moved += 1
+        return {"ok": ok, "migrating_streams": moved}
 
     # ---- non-streaming proxy -----------------------------------------------
 
@@ -248,6 +325,7 @@ class FrontRouter:
         _M_STREAMS.set(len(self.journal))
         exclude: set = set()
         last_failed: Optional[str] = None
+        pinned: Optional[str] = None    # decode target after a pd handoff
         give_up_why, give_up_retry = "no replica in rotation", None
         try:
             while True:
@@ -255,8 +333,33 @@ class FrontRouter:
                 if token_hint is None and kind == "completion" \
                         and isinstance(body.get("prompt"), list):
                     token_hint = body["prompt"]
-                rep = self.placement.pick(session, token_ids=token_hint,
-                                          exclude=exclude)
+                pd = self._pd_active()
+                # pool preference (docs/pd_pools.md): fresh streams go
+                # to the prefill pool, post-handoff continuations to
+                # the decode pool; a fallen-back handoff (pd_migrated
+                # with no target) reverts to normal placement. Always a
+                # preference, never a constraint — placement degrades
+                # to the whole rotation when the pool is empty.
+                role = None
+                if pd and not entry.pd_migrated:
+                    role = "prefill"
+                elif pd and entry.pd_target:
+                    role = "decode"
+                rep = None
+                if pinned is not None:
+                    cand = self.replicas.get(pinned)
+                    if cand is not None and cand.in_rotation \
+                            and cand.addr not in exclude:
+                        rep = cand
+                    else:
+                        # the decode target died/drained between the
+                        # handoff and the dispatch: the PR 15 failover
+                        # path takes over via normal placement
+                        pinned = None
+                if rep is None:
+                    rep = self.placement.pick(session,
+                                              token_ids=token_hint,
+                                              exclude=exclude, role=role)
                 if rep is None and exclude:
                     # every in-rotation replica already failed once for
                     # THIS stream (e.g. a fault that follows the stream
@@ -279,6 +382,24 @@ class FrontRouter:
                     if entry.fail_detected_at is not None:
                         _M_FAILOVERS.inc(outcome="exhausted")
                     break
+                # pd handoff arming: a fresh, replay-safe stream landing
+                # on a non-decode replica gets a decode target picked
+                # NOW (load-based, strictly decode-pool) so the replica
+                # can push the prefix KV at first token and the router
+                # can migrate the stream after it (docs/pd_pools.md)
+                entry.pd_target = None
+                if pd and pinned is None and not entry.pd_migrated \
+                        and entry.replay_safe \
+                        and entry.delivered_events == 0 \
+                        and replica_role(rep) != "decode":
+                    decs = [r for r in self.replicas.in_rotation()
+                            if replica_role(r) == "decode"
+                            and r.addr != rep.addr
+                            and r.addr not in exclude]
+                    if decs:
+                        entry.pd_target = min(
+                            decs,
+                            key=lambda r: r.active_streams).addr
                 entry.replica = rep.addr
                 entry.attempts += 1
                 with self._lock:
@@ -289,6 +410,26 @@ class FrontRouter:
                     outcome = self._stream_from(rep, entry, sse)
                     _M_REQS.inc(kind=kind, outcome=outcome)
                     return
+                except PoolHandoff:
+                    # the prefill replica delivered the first token (and
+                    # pushed the prefix KV): migrate the stream to the
+                    # decode pool via the same journaled continuation
+                    # path a failover uses — one byte-identical client
+                    # stream either way. NOT a failure: no breaker, no
+                    # exclude, no failover budget charge.
+                    entry.pd_migrated = True
+                    if FAULTS.fire("pool_migrate_fail") \
+                            or not entry.pd_target:
+                        # chaos / lost target: fall back to normal
+                        # placement — the continuation still resumes
+                        # byte-identically, just not on the decode pool
+                        _M_POOL_HANDOFFS.inc(outcome="fallback")
+                        entry.pd_handoff_at = None
+                        entry.pd_target = None
+                        pinned = None
+                    else:
+                        pinned = entry.pd_target
+                    continue
                 except UpstreamFailed as e:
                     if e.replica_suspect:
                         # the poller (the breaker's single prober)
@@ -390,6 +531,13 @@ class FrontRouter:
             cont = entry.continuation_payload()
             if cont is not None:
                 ext["continuation"] = cont
+            elif entry.pd_target:
+                # fresh dispatch with a decode target armed: tell the
+                # prefill replica where to push the prefix KV (the
+                # target's prefix-store serve addr, not its HTTP addr)
+                pa = self._push_addr(self.replicas.get(entry.pd_target))
+                if pa:
+                    ext["push_to"] = pa
             body_up["gllm_router"] = ext
         conn = http.client.HTTPConnection(
             rep.host, rep.port, timeout=self.stream_idle_timeout_s)
@@ -498,6 +646,19 @@ class FrontRouter:
                     "stream %s resumed on %s after %.3fs (%d tokens "
                     "committed)", entry.rid, rep.addr,
                     entry.last_failover_s, len(entry.committed))
+            elif entry.pd_handoff_at is not None and entry.pd_migrated:
+                # first chunk after a pd handoff: the stream now runs
+                # on the decode pool (deliberately separate from the
+                # failover metrics — a handoff is routine, not a fault)
+                _M_POOL_HANDOFFS.inc(outcome="ok")
+                _M_POOL_HANDOFF_S.observe(time.monotonic()
+                                          - entry.pd_handoff_at)
+                entry.pd_handoff_at = None
+                logger.info(
+                    "stream %s handed off to decode replica %s "
+                    "(%d pages pushed, %d tokens committed)",
+                    entry.rid, rep.addr, entry.pushed_pages,
+                    len(entry.committed))
             sse.start()
             sse.send(ev)
             entry.delivered_events += 1
@@ -513,6 +674,19 @@ class FrontRouter:
             if fin is not None:
                 entry.finished = True
                 entry.finish_reason = fin
+            if g is not None and g.get("pushed_pages") is not None:
+                entry.pushed_pages = int(g["pushed_pages"])
+            if entry.pd_target and not entry.pd_migrated \
+                    and fin is None and entry.replay_safe \
+                    and entry.prompt_token_ids is not None \
+                    and g is not None \
+                    and g.get("token_id") is not None:
+                # the first sampled token (and its piggybacked KV push)
+                # has been forwarded: migrate to the decode pool. The
+                # chunk is already committed, so the continuation
+                # resumes right after it — byte-identical either way.
+                entry.pd_handoff_at = time.monotonic()
+                raise PoolHandoff()
         raise UpstreamFailed(f"{rep.addr} disconnected mid-stream")
 
     def _iter_sse(self, resp, addr: str):

@@ -78,6 +78,14 @@ class StreamEntry:
     # failover timing: detection → first continuation event forwarded
     fail_detected_at: Optional[float] = None
     last_failover_s: Optional[float] = None
+    # pd-pool handoff (docs/pd_pools.md): the decode replica picked at
+    # dispatch (its prefix serve addr got the KV push), whether the
+    # stream already migrated pools, how many pages the decode side
+    # accepted, and when the handoff was raised (timing histogram)
+    pd_target: Optional[str] = None
+    pd_migrated: bool = False
+    pushed_pages: int = 0
+    pd_handoff_at: Optional[float] = None
 
     @property
     def replay_safe(self) -> bool:

@@ -130,12 +130,22 @@ class Placement:
 
     def pick(self, session: Optional[str] = None,
              token_ids: Optional[List[int]] = None,
-             exclude=()) -> Optional[Replica]:
+             exclude=(), role: Optional[str] = None) -> Optional[Replica]:
         """The replica for one placement (None = nothing in rotation).
         ``exclude`` removes replicas this stream already failed on (the
-        failover path must not bounce straight back)."""
+        failover path must not bounce straight back). ``role`` prefers
+        the pd pool of that name (docs/pd_pools.md) — replicas
+        advertising ``role`` or ``mixed`` — but degrades to the whole
+        rotation when the pool is empty: a pool outage must cost
+        latency, never availability."""
         candidates = [r for r in self.replicas.in_rotation()
                       if r.addr not in exclude]
+        if role is not None:
+            from gllm_tpu.pools import replica_role
+            pooled = [r for r in candidates
+                      if replica_role(r) in (role, "mixed")]
+            if pooled:
+                candidates = pooled
         if not candidates:
             return None
         if self.session_affinity and session:

@@ -112,7 +112,21 @@ class Replica:
                 "replica_id": self.identity[0] if self.identity else None,
                 "engine_generation": self.engine_generation,
                 "restarts_detected": self.restarts,
-                "breaker": self.breaker.health()}
+                "breaker": self.breaker.health(),
+                # when the breaker would admit a probe again (0 when
+                # closed) — operators and the pool autoscaler both need
+                # the recovery ETA, not just the state word
+                "breaker_eta_s": round(self.breaker.down_for(), 3),
+                "pool_role": (self.info or {}).get("pool_role")
+                or "mixed",
+                # engine-side queue depths from the last /server_info
+                # (the router-side active_streams above counts proxied
+                # streams, which misses direct-to-replica traffic)
+                "load": {
+                    "waiting": int((self.info or {}).get("waiting")
+                                   or 0),
+                    "running": int((self.info or {}).get("running")
+                                   or 0)}}
 
 
 class ReplicaSet:
@@ -128,6 +142,7 @@ class ReplicaSet:
                  breaker_fails: int = 1,
                  breaker_jitter: float = 0.1,
                  on_restart: Optional[Callable] = None,
+                 info_hook: Optional[Callable] = None,
                  start_poller: bool = True,
                  initial_probe: bool = True):
         if not addrs:
@@ -145,6 +160,9 @@ class ReplicaSet:
         self.probe_interval_s = float(probe_interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
         self.on_restart = on_restart
+        # called with each replica after a successful probe (the pool
+        # autoscaler scrapes /metrics here, off the handler threads)
+        self.info_hook = info_hook
         self._stop = False
         self._wake = threading.Event()
         self._thread = None
@@ -220,6 +238,11 @@ class ReplicaSet:
             except (TypeError, ValueError):
                 rep.retry_after_s = 0.0
         self._probe_info(rep)
+        if self.info_hook is not None:
+            try:
+                self.info_hook(rep)
+            except Exception:   # pragma: no cover - hook guard
+                logger.exception("info_hook failed for %s", rep.addr)
 
     def _probe_info(self, rep: Replica) -> None:
         """/server_info: fleet identity + prefix-store coordinates. A

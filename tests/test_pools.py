@@ -1,0 +1,669 @@
+"""Disaggregated prefill/decode pools (docs/pd_pools.md).
+
+The pd-pool ladder:
+
+- units: pool-role parsing, role-preferring placement, the autoscaler's
+  prom-text parsing and per-pool scale verdicts;
+- push round-trip at the engine level: a prefix chain exported by
+  engine A lands in engine B's host pool via the peer ``push`` op —
+  f32 AND int8 geometry (int8 payloads at roughly half the bytes), a
+  corrupted canary is rejected (once) without poisoning the rest of the
+  batch;
+- the acceptance headline: a prompt prefilled on the prefill pool
+  decodes on the decode pool with ZERO re-prefill (pushed pages == full
+  prefix pages) and the CLIENT observes one stream byte-identical to a
+  single-replica control, greedy AND seeded;
+- chaos: a dropped push (``kv_push_fail``) degrades to pull-then-
+  recompute — never a stall; a vetoed migration (``pool_migrate_fail``)
+  falls back to normal placement; a decode replica killed after the
+  handoff fails over through the PR 15 journal path;
+- drain-based scale-down: ``/admin/drain {migrate: true}`` moves
+  in-flight decode streams with zero lost tokens.
+"""
+
+import http.client
+import json
+import threading
+import time
+
+import pytest
+import torch
+
+from gllm_tpu.config import (CacheConfig, EngineConfig, SchedulerConfig)
+from gllm_tpu.engine.llm import LLM
+from gllm_tpu.entrypoints.api_server import serve
+from gllm_tpu.entrypoints.router_server import serve_router
+from gllm_tpu.faults import FAULTS
+from gllm_tpu.kvstore import stats as kv_stats
+from gllm_tpu.memory_manager import prefix_digests
+from gllm_tpu.obs import metrics as obs
+from gllm_tpu.pools import PoolAutoscaler, replica_role
+from gllm_tpu.router import FrontRouter
+from gllm_tpu.router import core as rcore
+from gllm_tpu.router.placement import Placement
+from gllm_tpu.router.replica import ReplicaSet
+from gllm_tpu.sampling_params import SamplingParams
+
+PAGE = 4
+GREEDY = {"temperature": 0, "max_tokens": 24, "ignore_eos": True}
+SEEDED = {"temperature": 0.8, "top_p": 0.9, "seed": 1234,
+          "max_tokens": 24, "ignore_eos": True}
+
+
+class StubTokenizer:
+    """One char per token id: text equality ⇔ token-stream equality."""
+    eos_token_id = 0
+
+    def encode(self, text):
+        return [min(ord(c), 120) for c in text][:64]
+
+    def decode(self, ids, skip_special_tokens=False):
+        return "".join(chr(max(32, i % 127)) for i in ids)
+
+    def apply_chat_template(self, messages, add_generation_prompt=True,
+                            **kw):
+        text = " ".join(str(m.get("content", "")) for m in messages)
+        return self.encode(text or "hi")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.reset()
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=96, max_position_embeddings=256,
+        eos_token_id=0, attention_bias=False))
+    d = tmp_path_factory.mktemp("pools_model")
+    model.save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def make_llm(ckpt, pool_role="mixed", peers=None, serve_prefix=True):
+    cfg = EngineConfig(
+        model=ckpt, dtype="float32", max_model_len=128,
+        scheduler=SchedulerConfig(pool_role=pool_role),
+        cache=CacheConfig(page_size=PAGE, num_pages=128,
+                          enable_prefix_caching=True,
+                          kv_host_pool_pages=64,
+                          prefix_peers=peers,
+                          prefix_serve_port=0 if serve_prefix
+                          else None))
+    cfg.validate()
+    return LLM(config=cfg, tokenizer=StubTokenizer())
+
+
+def start_replica(ckpt, pool_role, peers=None):
+    llm = make_llm(ckpt, pool_role=pool_role, peers=peers)
+    httpd = serve(llm, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    # warm the prefill buckets (4/8/16-token prompts) so compile pauses
+    # cannot trip the router's idle timeout mid-test
+    for p in ([3, 5, 7, 9], list(range(2, 10)), list(range(2, 18))):
+        for c in httpd.state.engine.submit(
+                list(p), SamplingParams(temperature=0.0, max_tokens=2,
+                                        ignore_eos=True)):
+            pass
+    return {"httpd": httpd, "port": port, "llm": llm,
+            "addr": f"127.0.0.1:{port}",
+            "serve_port": llm.prefix_tiers.server.port}
+
+
+@pytest.fixture(scope="module")
+def pd_fleet(tiny_ckpt):
+    """1 prefill + 1 decode replica; the decode replica peers back to
+    the prefill replica's prefix store (the pull-then-recompute
+    fallback a dropped push degrades to)."""
+    pre = start_replica(tiny_ckpt, "prefill")
+    dec = start_replica(tiny_ckpt, "decode",
+                        peers=f"127.0.0.1:{pre['serve_port']}")
+    reps = [pre, dec]
+    yield reps
+    for r in reps:
+        r["httpd"].shutdown()
+        r["httpd"].state.engine.shutdown()
+
+
+@pytest.fixture
+def pd_router(pd_fleet):
+    made = []
+
+    def make(**kw):
+        kw.setdefault("probe_interval_s", 0.1)
+        kw.setdefault("breaker_base_s", 0.2)
+        kw.setdefault("breaker_max_s", 2.0)
+        kw.setdefault("breaker_jitter", 0.0)
+        fr = FrontRouter([r["addr"] for r in pd_fleet], **kw)
+        httpd = serve_router(fr, "127.0.0.1", 0)
+        t = threading.Thread(target=httpd.serve_forever, daemon=True)
+        t.start()
+        made.append((fr, httpd))
+        return fr, httpd.server_address[1]
+
+    yield make
+    for fr, httpd in made:
+        httpd.shutdown()
+        fr.close()
+
+
+# ---- HTTP helpers ----------------------------------------------------------
+
+def post_json(port, path, body, timeout=60):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    return resp.status, raw
+
+
+def get_json(port, path, timeout=10):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    raw = resp.read()
+    conn.close()
+    return resp.status, (json.loads(raw) if raw else None)
+
+
+def sse_stream(port, path, body, timeout=120):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("POST", path, body=json.dumps(body),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    if resp.status != 200:
+        raw = resp.read()
+        conn.close()
+        return resp.status, [json.loads(raw)] if raw else []
+    events = []
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if not line.startswith(b"data:"):
+            continue
+        payload = line[5:].strip()
+        if payload == b"[DONE]":
+            break
+        events.append(json.loads(payload))
+    conn.close()
+    return 200, events
+
+
+def completion_text(events):
+    return "".join((e.get("choices") or [{}])[0].get("text") or ""
+                   for e in events if "choices" in e)
+
+
+def finish_of(events):
+    for e in events:
+        if "choices" in e and e["choices"][0].get("finish_reason"):
+            return e["choices"][0]["finish_reason"]
+    return None
+
+
+def error_events(events):
+    return [e for e in events if "error" in e and "choices" not in e]
+
+
+def full_pages(prompt):
+    return (len(prompt) - 1) // PAGE
+
+
+# ---- units: roles / placement / autoscaler ---------------------------------
+
+def _fake_set(role_states):
+    """[(pool_role, state)] → ReplicaSet with advertised roles."""
+    rs = ReplicaSet([f"127.0.0.1:{11000 + i}"
+                     for i in range(len(role_states))],
+                    start_poller=False, initial_probe=False)
+    for rep, (role, st) in zip(rs.replicas.values(), role_states):
+        rep.state = st
+        rep.info = {"pool_role": role}
+    return rs
+
+
+def test_replica_role_defaults_to_mixed():
+    rs = _fake_set([("prefill", "ready"), ("decode", "ready"),
+                    ("mixed", "ready")])
+    reps = list(rs.replicas.values())
+    assert [replica_role(r) for r in reps] == \
+        ["prefill", "decode", "mixed"]
+    # unknown / unprobed roles stay eligible for every pool
+    reps[0].info = {}
+    assert replica_role(reps[0]) == "mixed"
+    reps[0].info = {"pool_role": "bogus"}
+    assert replica_role(reps[0]) == "mixed"
+
+
+def test_placement_role_preference_and_degradation():
+    rs = _fake_set([("prefill", "ready"), ("decode", "ready"),
+                    ("mixed", "ready")])
+    pre, dec, mix = list(rs.replicas.values())
+    p = Placement(rs)
+    # role prefers the pool (+ mixed); least-loaded inside it
+    dec.active_streams = 5
+    assert p.pick(role="decode") is mix
+    mix.active_streams = 9
+    assert p.pick(role="decode") is dec
+    assert p.pick(role="prefill") is pre
+    # the pool being excluded/down degrades to the whole rotation —
+    # a pool outage costs latency, never availability
+    dec.state = "down"
+    assert p.pick(role="decode", exclude={mix.addr}) is pre
+    # no role = plain least-loaded over everything
+    dec.state = "ready"
+    dec.active_streams = 0
+    pre.active_streams = 1
+    assert p.pick() is dec
+
+
+def test_parse_latency_samples():
+    from gllm_tpu.pools.autoscaler import parse_latency_samples
+    text = "\n".join([
+        "# HELP gllm_request_ttft_seconds time to first token",
+        "# TYPE gllm_request_ttft_seconds histogram",
+        'gllm_request_ttft_seconds_bucket{le="0.1"} 3',
+        "gllm_request_ttft_seconds_sum 1.25",
+        "gllm_request_ttft_seconds_count 5",
+        'gllm_request_tpot_seconds_sum{shard="0"} 0.5',
+        'gllm_request_tpot_seconds_count{shard="0"} 10',
+        "gllm_other_metric_total 99",
+    ])
+    s = parse_latency_samples(text)
+    assert s == {"ttft_sum": 1.25, "ttft_count": 5.0,
+                 "tpot_sum": 0.5, "tpot_count": 10.0}
+    # missing families read as zero, never KeyError
+    assert parse_latency_samples("")["tpot_count"] == 0.0
+
+
+def test_autoscaler_verdicts():
+    asc = PoolAutoscaler(queue_high=4.0, min_replicas=1)
+    rs = _fake_set([("prefill", "ready"), ("decode", "ready"),
+                    ("decode", "ready")])
+    pre, d1, d2 = list(rs.replicas.values())
+    # idle decode pool above min size → scale_down; single-replica
+    # prefill pool holds
+    v = asc.verdicts(list(rs.replicas.values()))
+    assert set(v) == {"prefill", "decode"}
+    assert v["decode"]["verdict"] == "scale_down"
+    assert v["prefill"]["verdict"] == "hold"
+    assert v["decode"]["ready"] == 2
+    # deep queue → scale_up
+    d1.info = {"pool_role": "decode", "waiting": 11, "running": 2}
+    v = asc.verdicts(list(rs.replicas.values()))
+    assert v["decode"]["verdict"] == "scale_up"
+    assert v["decode"]["queue_depth"] == 11
+    # the whole pool out of rotation → scale_up
+    d1.state = d2.state = "down"
+    v = asc.verdicts(list(rs.replicas.values()))
+    assert v["decode"]["verdict"] == "scale_up"
+    assert v["decode"]["ready"] == 0
+    # a pool nobody advertises is absent, not fabricated
+    v = asc.verdicts([pre])
+    assert "decode" not in v and v["prefill"]["replicas"] == 1
+
+
+def test_autoscaler_mixed_counts_in_both_pools():
+    asc = PoolAutoscaler()
+    rs = _fake_set([("mixed", "ready")])
+    v = asc.verdicts(list(rs.replicas.values()))
+    assert v["prefill"]["ready"] == 1 and v["decode"]["ready"] == 1
+    # min_replicas floors scale_down even when idle
+    assert v["prefill"]["verdict"] == "hold"
+
+
+# ---- push round-trip (engine level, f32 + int8 geometry) -------------------
+
+def _push_llms(kv_dtype):
+    from gllm_tpu.models.config import ModelConfig
+    mk = dict(architecture="LlamaForCausalLM", vocab_size=512,
+              hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+              head_dim=16, intermediate_size=128, max_position=256)
+
+    def mk_llm():
+        cfg = EngineConfig(
+            load_format="dummy", dtype="float32", max_model_len=128,
+            cache=CacheConfig(page_size=PAGE, num_pages=64,
+                              kv_cache_dtype=kv_dtype,
+                              enable_prefix_caching=True,
+                              kv_host_pool_pages=32,
+                              prefix_serve_port=0))
+        cfg.validate()
+        return LLM(config=cfg, model_cfg=ModelConfig(**mk))
+
+    return mk_llm(), mk_llm()
+
+
+_PUSHED_BYTES = {}
+
+
+@pytest.mark.parametrize("kv_dtype", ["auto", "int8"])
+def test_push_roundtrip_geometry(kv_dtype):
+    """Engine A pushes a finished prefix chain into engine B's host
+    pool over the peer push op; B's next generate claims every pushed
+    page (zero re-prefill) and is token-identical."""
+    from gllm_tpu.kvstore.peer import PrefixPusher
+    a, b = _push_llms(kv_dtype)
+    try:
+        prompt = list(range(40, 58))             # 18 tokens → 4 pages
+        sp = SamplingParams(temperature=0.0, max_tokens=8,
+                            ignore_eos=True)
+        want = a.generate(prompt_token_ids=[list(prompt)],
+                          sampling_params=sp)[0].output_token_ids
+        chain = a.export_prefix_chain(prompt)
+        digests = prefix_digests(prompt, len(prompt), PAGE)
+        assert len(chain) == len(digests) == full_pages(prompt)
+        pages0 = kv_stats.PUSH_PAGES.get()
+        bytes0 = kv_stats.PUSH_BYTES.get()
+        pusher = PrefixPusher(a.prefix_tiers.geometry)
+        addr = f"127.0.0.1:{b.prefix_tiers.server.port}"
+        assert pusher.push(addr, chain) == len(chain)
+        assert kv_stats.PUSH_PAGES.get() - pages0 == len(chain)
+        pushed_bytes = kv_stats.PUSH_BYTES.get() - bytes0
+        assert pushed_bytes == sum(len(p) for _, _, p in chain)
+        _PUSHED_BYTES[kv_dtype] = pushed_bytes
+        if kv_dtype == "int8" and "auto" in _PUSHED_BYTES:
+            # int8 pages ride at roughly half the f32 bytes for the
+            # same chain (quantized leaves + per-page scales)
+            assert pushed_bytes < 0.75 * _PUSHED_BYTES["auto"]
+        # every pushed digest is host-resident on B
+        with b.swap_manager.pool.lock:
+            for digest, _ in digests:
+                assert digest in b.swap_manager.pool.hash_to_page
+        # B decodes the same prompt token-identically, claiming the
+        # pushed pages instead of re-prefilling them
+        hit0 = obs.REGISTRY.get(
+            "gllm_prefix_cache_hit_tokens_total").get()
+        got = b.generate(prompt_token_ids=[list(prompt)],
+                         sampling_params=sp)[0].output_token_ids
+        assert got == want
+        assert obs.REGISTRY.get(
+            "gllm_prefix_cache_hit_tokens_total").get() - hit0 \
+            == len(chain) * PAGE
+    finally:
+        a.close()
+        b.close()
+
+
+def test_push_corrupt_canary_rejected_once():
+    """A pushed page whose canary tokens do not match the payload is
+    rejected (poison + reject counters) WITHOUT killing the rest of the
+    batch; re-pushing the page with the right tokens succeeds."""
+    from gllm_tpu.kvstore.peer import PrefixPusher
+    a, b = _push_llms("auto")
+    try:
+        prompt = list(range(70, 83))             # 13 tokens → 3 pages
+        sp = SamplingParams(temperature=0.0, max_tokens=4,
+                            ignore_eos=True)
+        a.generate(prompt_token_ids=[list(prompt)], sampling_params=sp)
+        chain = a.export_prefix_chain(prompt)
+        assert len(chain) == 3
+        bad = [(chain[0][0], tuple(t + 1 for t in chain[0][1]),
+                chain[0][2])] + chain[1:]
+        rej0 = kv_stats.PUSH_REJECTS.get()
+        pusher = PrefixPusher(a.prefix_tiers.geometry)
+        addr = f"127.0.0.1:{b.prefix_tiers.server.port}"
+        # page 1 rejected once; pages 2..3 still accepted on the same
+        # connection (the reply was well-formed, not a transport fault)
+        assert pusher.push(addr, bad) == 2
+        assert kv_stats.PUSH_REJECTS.get() - rej0 == 1
+        with b.swap_manager.pool.lock:
+            assert chain[0][0] not in b.swap_manager.pool.hash_to_page
+            assert chain[1][0] in b.swap_manager.pool.hash_to_page
+        # clean retry lands page 1; re-pushing resident pages is
+        # idempotent-accepted
+        assert pusher.push(addr, chain) == 3
+        with b.swap_manager.pool.lock:
+            assert chain[0][0] in b.swap_manager.pool.hash_to_page
+    finally:
+        a.close()
+        b.close()
+
+
+@pytest.mark.chaos
+def test_push_fault_point_drops_whole_push():
+    """kv_push_fail at the pusher: the push is dropped before the wire
+    and the caller sees 0 accepted pages — the decode side simply never
+    hears about the chain (fallback is its pull/recompute path)."""
+    from gllm_tpu.kvstore.peer import PrefixPusher
+    a, b = _push_llms("auto")
+    try:
+        prompt = list(range(90, 103))
+        a.generate(prompt_token_ids=[list(prompt)],
+                   sampling_params=SamplingParams(
+                       temperature=0.0, max_tokens=4, ignore_eos=True))
+        chain = a.export_prefix_chain(prompt)
+        FAULTS.arm("kv_push_fail:0:1")
+        pusher = PrefixPusher(a.prefix_tiers.geometry)
+        addr = f"127.0.0.1:{b.prefix_tiers.server.port}"
+        assert pusher.push(addr, chain) == 0
+        assert FAULTS.hits.get("kv_push_fail") == 1
+        with b.swap_manager.pool.lock:
+            assert chain[0][0] not in b.swap_manager.pool.hash_to_page
+        # the armed window is spent: the retry goes through
+        assert pusher.push(addr, chain) == len(chain)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---- the acceptance headline: prefill → decode handoff ---------------------
+
+def _control(pd_fleet, prompt, params):
+    """Single-replica control stream, direct to the prefill replica."""
+    status, events = sse_stream(pd_fleet[0]["port"], "/v1/completions",
+                                {"prompt": prompt, "stream": True,
+                                 **params})
+    assert status == 200 and finish_of(events) == "length"
+    return events
+
+
+@pytest.mark.parametrize(
+    "params,prompt",
+    [(GREEDY, [7, 3, 9, 2, 8, 4, 6, 1, 5, 3, 7, 2]),
+     (SEEDED, [11, 5, 3, 9, 1, 7, 2, 8, 4, 6, 10, 12])],
+    ids=["greedy", "seeded"])
+def test_pd_handoff_byte_identical_zero_reprefill(pd_fleet, pd_router,
+                                                  params, prompt):
+    """A prompt routed at the pd fleet prefills on the prefill replica,
+    its prefix KV chain is pushed to the decode replica, and the stream
+    migrates there — ONE client stream, byte-identical to the
+    single-replica control; pushed pages == full prefix pages and the
+    decode side restores every one instead of re-prefilling."""
+    want = _control(pd_fleet, prompt, params)
+    want_text = completion_text(want)
+    fr, port = pd_router()
+    push0 = kv_stats.PUSH_PAGES.get()
+    rest0 = obs.REGISTRY.get(
+        "gllm_kvswap_prefix_restore_pages_total").get()
+    ok0 = rcore._M_POOL_HANDOFFS.get(outcome="ok")
+    status, events = sse_stream(port, "/v1/completions",
+                                {"prompt": prompt, "stream": True,
+                                 **params})
+    assert status == 200
+    assert finish_of(events) == "length"
+    assert not error_events(events)
+    got_text = completion_text(events)
+    assert got_text == want_text, (
+        f"stream diverged across the pd handoff: {got_text!r} vs "
+        f"{want_text!r}")
+    # one event per token: count equality = zero lost/duplicated
+    assert len([e for e in events if "choices" in e]) == \
+        len([e for e in want if "choices" in e])
+    # zero re-prefill: EVERY full prefix page was pushed, landed in the
+    # decode replica's host pool, and rode the host→device restore path
+    pages = full_pages(prompt)
+    assert kv_stats.PUSH_PAGES.get() - push0 == pages
+    assert obs.REGISTRY.get(
+        "gllm_kvswap_prefix_restore_pages_total").get() - rest0 == pages
+    assert rcore._M_POOL_HANDOFFS.get(outcome="ok") - ok0 == 1
+    with pd_fleet[1]["llm"].swap_manager.pool.lock:
+        for digest, _ in prefix_digests(prompt, len(prompt), PAGE):
+            assert digest in \
+                pd_fleet[1]["llm"].swap_manager.pool.hash_to_page
+
+
+@pytest.mark.chaos
+def test_pd_push_drop_degrades_to_pull_not_stall(pd_fleet, pd_router):
+    """kv_push_fail drops the KV push on the wire; the handoff still
+    happens and the decode replica falls back to PULLING the prefix
+    from the prefill replica's store (its --prefix-peers) — the client
+    stream is byte-identical and never stalls."""
+    prompt = [21, 13, 9, 17, 5, 3, 11, 7, 19, 2, 23, 4]
+    want_text = completion_text(_control(pd_fleet, prompt, GREEDY))
+    fr, port = pd_router()
+    peer0 = obs.REGISTRY.get("gllm_kvstore_hits_total").get(tier="peer")
+    FAULTS.arm("kv_push_fail:0:1")
+    status, events = sse_stream(port, "/v1/completions",
+                                {"prompt": prompt, "stream": True,
+                                 **GREEDY})
+    assert status == 200
+    assert FAULTS.hits.get("kv_push_fail") == 1, "push drop never fired"
+    assert finish_of(events) == "length"
+    assert not error_events(events)
+    assert completion_text(events) == want_text
+    # the decode replica pulled the prefix over the peer tier instead
+    # of recomputing from scratch
+    assert obs.REGISTRY.get(
+        "gllm_kvstore_hits_total").get(tier="peer") - peer0 >= 1
+
+
+@pytest.mark.chaos
+def test_pd_migrate_fault_falls_back_to_normal_placement(pd_fleet,
+                                                         pd_router):
+    """pool_migrate_fail vetoes the handoff at migration time: the
+    stream continues through normal placement (fallback outcome) and
+    the client still sees one byte-identical stream."""
+    prompt = [31, 3, 5, 29, 7, 11, 2, 13, 17, 19, 23, 6]
+    want_text = completion_text(_control(pd_fleet, prompt, GREEDY))
+    fr, port = pd_router()
+    fb0 = rcore._M_POOL_HANDOFFS.get(outcome="fallback")
+    FAULTS.arm("pool_migrate_fail:0:1")
+    status, events = sse_stream(port, "/v1/completions",
+                                {"prompt": prompt, "stream": True,
+                                 **GREEDY})
+    assert status == 200
+    assert FAULTS.hits.get("pool_migrate_fail") == 1
+    assert finish_of(events) == "length"
+    assert not error_events(events)
+    assert completion_text(events) == want_text
+    assert rcore._M_POOL_HANDOFFS.get(outcome="fallback") - fb0 == 1
+
+
+@pytest.mark.chaos
+def test_pd_decode_killed_mid_handoff_fails_over(pd_fleet, pd_router):
+    """The decode replica dies AFTER the stream handed off to it:
+    replica_kill hard-closes its serving connection and the stream
+    fails over through the PR 15 journal path (back to the prefill
+    replica's continuation) — byte-identical, zero lost tokens."""
+    prompt = [41, 2, 43, 3, 5, 37, 7, 11, 13, 4, 17, 8]
+    want_text = completion_text(_control(pd_fleet, prompt, GREEDY))
+    fr, port = pd_router()
+    fo0 = rcore._M_FAILOVERS.get(outcome="ok")
+    # fires on the 7th streamed chunk — past the first-token handoff,
+    # so the kill lands on the DECODE replica's connection
+    FAULTS.arm("replica_kill:6:1")
+    status, events = sse_stream(port, "/v1/completions",
+                                {"prompt": prompt, "stream": True,
+                                 **GREEDY})
+    assert status == 200
+    assert FAULTS.hits.get("replica_kill") == 1, "kill never fired"
+    assert finish_of(events) == "length"
+    assert not error_events(events)
+    assert completion_text(events) == want_text
+    assert rcore._M_FAILOVERS.get(outcome="ok") - fo0 == 1
+
+
+# ---- drain-based scale-down -------------------------------------------------
+
+def test_pd_drain_scale_down_zero_lost_tokens(pd_fleet, pd_router):
+    """Scale-down is an admin drain with migrate=true: the decode
+    replica leaves rotation and its in-flight streams migrate NOW —
+    the client stream completes byte-identically (zero lost tokens)."""
+    prompt = [53, 2, 3, 47, 5, 7, 59, 11, 13, 6, 17, 9]
+    long_greedy = dict(GREEDY, max_tokens=64)
+    want_text = completion_text(_control(pd_fleet, prompt, long_greedy))
+    fr, port = pd_router()
+    ok0 = rcore._M_POOL_HANDOFFS.get(outcome="ok")
+    decode_addr = pd_fleet[1]["addr"]
+    box = {}
+
+    def run_stream():
+        box["resp"] = sse_stream(port, "/v1/completions",
+                                 {"prompt": prompt, "stream": True,
+                                  **long_greedy})
+
+    t = threading.Thread(target=run_stream, daemon=True)
+    t.start()
+    # wait until the stream has handed off to the decode replica, then
+    # drain it out from under the stream
+    deadline = time.monotonic() + 30
+    while rcore._M_POOL_HANDOFFS.get(outcome="ok") - ok0 < 1:
+        assert time.monotonic() < deadline, "handoff never happened"
+        time.sleep(0.01)
+    status, raw = post_json(port, "/admin/drain",
+                            {"replica": decode_addr, "migrate": True})
+    assert status == 200
+    body = json.loads(raw)
+    assert body["draining"] and body["migrating_streams"] >= 0
+    t.join(timeout=60)
+    assert not t.is_alive()
+    status, events = box["resp"]
+    assert status == 200 and finish_of(events) == "length"
+    assert not error_events(events)
+    assert completion_text(events) == want_text, \
+        "drain-triggered scale-down lost or duplicated tokens"
+    rep = fr.replicas.get(decode_addr)
+    assert rep.draining_admin and not rep.in_rotation
+    # undrain for the rest of the module
+    status, _ = post_json(port, "/admin/undrain",
+                          {"replica": decode_addr})
+    assert status == 200
+    # unknown replica still 404s with migrate set
+    status, _ = post_json(port, "/admin/drain",
+                          {"replica": "nonsense:1", "migrate": True})
+    assert status == 404
+
+
+# ---- surfaces: /server_info, /router_info ----------------------------------
+
+def test_server_info_advertises_pool_role(pd_fleet):
+    status, info = get_json(pd_fleet[0]["port"], "/server_info")
+    assert status == 200 and info["pool_role"] == "prefill"
+    status, info = get_json(pd_fleet[1]["port"], "/server_info")
+    assert status == 200 and info["pool_role"] == "decode"
+
+
+def test_router_info_pools_and_replica_load(pd_fleet, pd_router):
+    fr, port = pd_router()
+    status, info = get_json(port, "/router_info")
+    assert status == 200
+    # per-replica: breaker ETA, advertised role, engine-side load
+    by_addr = {r["addr"]: r for r in info["replicas"]}
+    pre = by_addr[pd_fleet[0]["addr"]]
+    dec = by_addr[pd_fleet[1]["addr"]]
+    assert pre["pool_role"] == "prefill"
+    assert dec["pool_role"] == "decode"
+    for r in (pre, dec):
+        assert r["breaker_eta_s"] == 0.0        # breaker closed
+        assert set(r["load"]) == {"waiting", "running"}
+    # per-pool autoscale verdicts
+    pools = info["pools"]
+    assert set(pools) == {"prefill", "decode"}
+    for pool in pools.values():
+        assert pool["ready"] == 1
+        assert pool["verdict"] in ("scale_up", "scale_down", "hold")
+        assert "slo_headroom" in pool and "why" in pool

@@ -563,12 +563,14 @@ def test_every_fault_point_has_a_chaos_test():
     """New faults.py injection points cannot land untested: each name
     must appear in the body of at least one @pytest.mark.chaos test in
     the chaos suites (this file + the kvstore tier chaos tests + the
-    self-healing recovery suite + the fleet router suite)."""
+    self-healing recovery suite + the fleet router suite + the pd-pool
+    suite)."""
     chaos_bodies = []
     here = os.path.dirname(__file__)
     for fname in (__file__, os.path.join(here, "test_kvstore.py"),
                   os.path.join(here, "test_recovery.py"),
-                  os.path.join(here, "test_router.py")):
+                  os.path.join(here, "test_router.py"),
+                  os.path.join(here, "test_pools.py")):
         src = open(fname).read()
         tree = ast.parse(src)
         for node in ast.walk(tree):
