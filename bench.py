@@ -61,6 +61,56 @@ BASELINE_TOK_S = 2000.0
 # supervisor deadline while full was already cache-warm).
 PROFILES = ("minimal", "full", "conservative")
 
+# Regression gate (ISSUE 20, GLLM_BENCH_BASELINE=<committed BENCH JSON>):
+# the efficiency metrics a perf PR must not silently give back, with the
+# direction that counts as better. Gated with tolerance — these are
+# measured quantities, not counters.
+GATE_METRICS = (
+    ("bubble_frac", "lower"),
+    ("mfu", "higher"),
+    ("tokens_per_dispatch", "higher"),
+)
+
+
+def check_bench_regression(result, baseline, rel_tol=0.10, abs_tol=0.02):
+    """Compare a measured result dict against a committed baseline BENCH
+    JSON. Returns a list of human-readable offender strings, each naming
+    the regressed metric — empty when the run holds the line. A metric
+    absent from either side is skipped (profiles differ in what they
+    measure), never failed: the gate flags regressions, not coverage."""
+    failures = []
+    for name, direction in GATE_METRICS:
+        base, got = baseline.get(name), result.get(name)
+        if base is None or got is None:
+            continue
+        slack = max(abs(base) * rel_tol, abs_tol)
+        if direction == "lower" and got > base + slack:
+            failures.append(
+                f"{name} regressed: {got} vs baseline {base} "
+                f"(lower is better, tolerance {slack:.4f})")
+        elif direction == "higher" and got < base - slack:
+            failures.append(
+                f"{name} regressed: {got} vs baseline {base} "
+                f"(higher is better, tolerance {slack:.4f})")
+    return failures
+
+
+def run_bench_gate(result, baseline_path):
+    """GLLM_BENCH_BASELINE gate: compare the measured pass against the
+    committed baseline, record the verdict in the result JSON, and
+    return the process exit code (nonzero on regression, with every
+    offending metric named on stderr)."""
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    failures = check_bench_regression(result, baseline)
+    result["baseline_gate"] = {
+        "baseline": os.path.abspath(baseline_path),
+        "failures": failures,
+    }
+    for m in failures:
+        log(f"[bench] REGRESSION {m}")
+    return 1 if failures else 0
+
 
 def log(msg):
     print(msg, file=sys.stderr, flush=True)
@@ -222,6 +272,28 @@ def supervise(args, argv):
                 if best is None:
                     last_tail = tail[-1500:]
             else:
+                # a baseline-gate failure is a COMPLETED measurement with
+                # a regression verdict, not a crash: the child printed its
+                # full result JSON (baseline_gate.failures non-empty) and
+                # then exited nonzero.  Forward both verbatim — no salvage,
+                # no retry (a retry would re-measure and could mask the
+                # regression behind run-to-run noise).
+                for line in reversed(proc.stdout.strip().splitlines()):
+                    line = line.strip()
+                    if line.startswith("{"):
+                        try:
+                            parsed = json.loads(line)
+                        except json.JSONDecodeError:
+                            continue
+                        if (parsed.get("metric") == METRIC
+                                and parsed.get("baseline_gate", {})
+                                          .get("failures")):
+                            parsed["profile"] = profile
+                            log("[bench supervisor] baseline gate failed; "
+                                "propagating nonzero exit")
+                            print(json.dumps(parsed))
+                            return proc.returncode
+                        break
                 crashed = True
                 last_rc = proc.returncode
                 last_tail = tail[-1500:]
@@ -578,6 +650,26 @@ def main():
     engine_cfg.tracing = (os.environ.get("GLLM_BENCH_TRACING", "1")
                           not in ("", "0"))
 
+    # pp topology lever (ISSUE 20, GLLM_BENCH_PP=2): run the measured
+    # pass over a pp-stage pipeline — the fast-path flags (pipelined +
+    # unified) now ride per-stage dispatch. Fused speculation and the
+    # slot/fused-block machinery are single-program features the config
+    # rejects / the engine ignores under pp, so the pp arm switches them
+    # off EXPLICITLY here (the bench choosing its config, loudly — never
+    # the engine dropping a flag).
+    bench_pp = int(os.environ.get("GLLM_BENCH_PP", "1") or "1")
+    if bench_pp > 1:
+        engine_cfg.parallel.pp = bench_pp
+        engine_cfg.spec_fused = False
+        engine_cfg.spec_decode = None
+        engine_cfg.multi_step_decode = 1
+        engine_cfg.decode_slot_batching = False
+        engine_cfg.ondevice_finish = False
+        engine_cfg.chain_under_prefill = 0
+        log(f"[bench] GLLM_BENCH_PP={bench_pp}: pp pipeline arm "
+            f"(spec_fused / fused-block / slot levers off — "
+            f"single-program features)")
+
     phase("backend_init")
     log(f"backend={jax.default_backend()} devices={jax.devices()} "
         f"profile={args.profile}")
@@ -753,6 +845,35 @@ def main():
                 "pipelined_loop": True,
                 **bubble_delta,
             }), flush=True)
+
+    # Tiny-mode pp A/B (ISSUE 20, GLLM_BENCH_PP=2): the same measured
+    # workload on a LEGACY pp engine (sync drain-per-pass loop: no
+    # overlap, no pipelined re-forms, split dispatch families) in the
+    # same process — the pipelined+unified pp arm must hold bubble_frac
+    # no worse than the legacy pp pipeline (the no-inter-stage-bubble
+    # claim, measured, not asserted from structure).
+    pp_ab = None
+    if args.tiny and bench_pp > 1 and engine_cfg.pipelined_loop:
+        phase("pp_ab_pass")
+        import dataclasses as _dc
+        leg_cfg = _dc.replace(engine_cfg, overlap_scheduling=False,
+                              pipelined_loop=False, unified_step=False)
+        leg = LLM(config=leg_cfg, model_cfg=model_cfg)
+        leg.generate(prompt_token_ids=prompts,
+                     sampling_params=params)           # warm the buckets
+        l_mark = TRACE.mark()
+        leg.generate(prompt_token_ids=prompts, sampling_params=params)
+        l_summary = summarize(TRACE.events(since=l_mark))
+        b_on = step_summary.get("bubble_frac")
+        b_off = l_summary.get("bubble_frac")
+        pp_ab = {"pp": bench_pp, "bubble_frac": b_on,
+                 "bubble_frac_legacy": b_off}
+        log(f"pp A/B: bubble_frac {b_off} (legacy pp) -> {b_on} "
+            f"(pipelined+unified pp)")
+        if b_on is not None and b_off is not None:
+            assert b_on <= b_off + 0.05, (
+                f"pp fast path worsened bubble_frac vs legacy pp: "
+                f"{b_on} vs {b_off}")
 
     # Tiny-mode unified-step A/B (ISSUE 12): the headline pass submits
     # every request up front, so the prefill/decode phase split barely
@@ -1458,6 +1579,12 @@ def main():
         "tokens_per_dispatch": step_summary.get("tokens_per_dispatch"),
         "metrics": metrics_snapshot,
     }
+    if bench_pp > 1:
+        # pp topology arm (ISSUE 20, GLLM_BENCH_PP): tag the JSON so pp
+        # and single-runner rungs never get compared as like-for-like
+        result["parallel_pp"] = bench_pp
+    if pp_ab is not None:
+        result["pp_ab"] = pp_ab
     if bubble_delta is not None:
         result.update(bubble_delta)
     if unified_ab is not None:
@@ -1491,7 +1618,25 @@ def main():
         # pushed, and the zero-re-prefill / zero-lost-tokens contracts
         # (the latter across a drain-triggered scale-down) — first-class
         result["pd"] = pd_result
+    # Regression gate (ISSUE 20, GLLM_BENCH_BASELINE=<path>): compare
+    # the measured pass against a committed BENCH JSON — the verdict
+    # rides the result JSON either way; a regression exits nonzero AFTER
+    # the JSON lands (the number is never lost to the gate).
+    gate_rc = 0
+    baseline_path = os.environ.get("GLLM_BENCH_BASELINE", "")
+    if baseline_path and args.profile == "minimal":
+        # the minimal rung's shorter-context workload is not comparable
+        # to a committed full/conservative baseline (see PROFILES) — a
+        # gate verdict here would be noise, and failing it would stop
+        # the supervisor ladder before the rung that matters
+        log("[bench] GLLM_BENCH_BASELINE set but profile=minimal is "
+            "not comparable; gate deferred to the full rung")
+        baseline_path = ""
+    if baseline_path:
+        gate_rc = run_bench_gate(result, baseline_path)
     print(json.dumps(result))
+    if gate_rc:
+        sys.exit(gate_rc)
 
 
 if __name__ == "__main__":

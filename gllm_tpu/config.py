@@ -395,24 +395,45 @@ class EngineConfig:
         if self.overlap_depth < 1:
             raise ValueError("overlap_depth (--inflight-depth) must be "
                              ">= 1")
+        if (self.pipelined_loop or self.unified_step) \
+                and self.parallel.pp > 1 and self.parallel.dp > 1:
+            # Each fast path composes with pp OR dp, but the combined
+            # grid would need per-replica stage pipelines driven by the
+            # run-ahead loop — refuse loudly rather than silently fall
+            # back to the legacy sync dispatch
+            # (docs/overlap_scheduling.md#topology-matrix).
+            raise ValueError(
+                "--pipelined-loop/--unified-step compose with pp>1 OR "
+                "dp>1, not both at once: run pp with dp=1 or dp with "
+                "pp=1, or drop the flags for the legacy sync pipeline")
         if self.spec_fused:
             if self.spec_decode != "ngram":
                 raise ValueError(
                     "spec_fused (--spec-fused) requires "
                     "spec_decode='ngram'")
-            if self.parallel.pp > 1 or self.parallel.dp > 1:
-                # topology-inert cases KNOWN at config time clear the
-                # flag BEFORE its side effects (implied overlap, the
-                # chain-length lift below) so the command behaves
-                # exactly like the same command without the flag; the
-                # model-dependent gates (hybrid GDN, multimodal) live in
-                # the engine and only disable the fused path itself
-                import logging
-                logging.getLogger(__name__).warning(
-                    "--spec-fused is inert for pp/dp > 1: host-driven "
-                    "speculation retained")
-                self.spec_fused = False
-            elif not self.overlap_scheduling:
+            if self.parallel.pp > 1:
+                # The fused draft+verify block is ONE device program (a
+                # while_loop over sub-steps spanning the whole layer
+                # stack); pipeline stages are separate per-device
+                # programs, so the block cannot span them. A loud error
+                # replaces the old warn-and-clear (flags must never
+                # silently no-op); host-driven speculation
+                # (--spec-decode ngram without --spec-fused) works
+                # under pp.
+                raise ValueError(
+                    "--spec-fused is not supported with pp > 1: the "
+                    "fused block cannot span pipeline stages — drop "
+                    "--spec-fused to keep host-driven speculation")
+            if self.parallel.dp > 1:
+                # The dp fast path runs lockstep super-steps over ONE
+                # stacked program; fused spec blocks would need stacked
+                # per-replica carry state (not yet built). Loud error,
+                # same rationale as the pp case above.
+                raise ValueError(
+                    "--spec-fused is not supported with dp > 1: fused "
+                    "blocks are single-replica — drop --spec-fused to "
+                    "keep host-driven speculation")
+            if not self.overlap_scheduling:
                 # fused draft+verify lives in the chained dispatch body —
                 # lifting the flag keeps "--spec-fused" a one-flag opt-in
                 # (same discipline as pipelined_loop)

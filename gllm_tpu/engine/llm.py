@@ -33,7 +33,7 @@ from gllm_tpu.sampling_params import SamplingParams
 from gllm_tpu.scheduler import Scheduler, SeqOutput
 from gllm_tpu.sequence import Sequence
 from gllm_tpu.engine.detokenizer import detokenize_incrementally
-from gllm_tpu.engine.pipeline import FutureMap, InFlight
+from gllm_tpu.engine.pipeline import DPBatches, FutureMap, InFlight
 
 logger = logging.getLogger(__name__)
 
@@ -281,23 +281,25 @@ class LLM:
         # rows (reason="spec" breaks retired), the runner's block driver
         # drafts from a device-resident recent-token ring and verifies
         # in-loop, and one dispatch emits up to K·(spec_k+1) tokens.
-        # Inert (host-driven speculation retained, warned) for hybrid
-        # GDN (cumulative SSM state), multimodal (mrope not in the spec
-        # carry), pp>1 and dp>1 (no chained block path there).
+        # Genuinely incompatible model families refuse LOUDLY (flags
+        # never silently no-op): hybrid GDN (cumulative SSM state cannot
+        # replay a discarded block) and multimodal (mrope is not in the
+        # spec carry). Topology gates (pp/dp > 1) already errored in
+        # config.validate().
         self.spec_fused = (bool(getattr(config, "spec_fused", False))
-                           and config.spec_decode == "ngram"
-                           and not model_cfg.use_hybrid
-                           and not model_cfg.use_mm
-                           and config.parallel.pp == 1 and self.dp == 1)
-        if getattr(config, "spec_fused", False) and not self.spec_fused:
-            logger.warning(
-                "--spec-fused is inert for %s: host-driven speculation "
-                "retained",
-                "hybrid (GDN) models" if model_cfg.use_hybrid
-                else "multimodal models" if model_cfg.use_mm
-                else "pp/dp > 1" if (config.parallel.pp > 1
-                                     or self.dp > 1)
-                else "this configuration")
+                           and config.spec_decode == "ngram")
+        if self.spec_fused and model_cfg.use_hybrid:
+            raise ValueError(
+                "--spec-fused is not supported for hybrid (GDN) models: "
+                "the cumulative SSM state cannot replay a discarded "
+                "fused block — drop --spec-fused to keep host-driven "
+                "speculation")
+        if self.spec_fused and model_cfg.use_mm:
+            raise ValueError(
+                "--spec-fused is not supported for multimodal models: "
+                "mrope position state is not part of the fused spec "
+                "carry — drop --spec-fused to keep host-driven "
+                "speculation")
         # worst-case tokens one spec sub-step may emit (drafts + the
         # correction/bonus token) — the scheduler's token-unit stride
         self.spec_mult = (config.spec_k + 1) if self.spec_fused else 1
@@ -705,16 +707,31 @@ class LLM:
                 time.sleep(0.002)
                 return []
         if self.dp > 1:
+            # dp fast path (docs/overlap_scheduling.md#topology-matrix):
+            # the stacked program forces replica lockstep (donated
+            # stacked KV), so run-ahead happens in SUPER-STEPS — one
+            # dp-wide chained re-form per pass. Requires the pipelined
+            # loop's reform machinery; overlap alone keeps the legacy
+            # sync dp loop.
+            if self.pipelined and self.config.overlap_scheduling:
+                return self._step_dp_overlap()
             return self._step_dp()
-        depth = max(1, self.config.pp_pipeline_depth
-                    or self.config.parallel.pp)
-        overlap = (self.config.overlap_scheduling
-                   and self.config.parallel.pp == 1)
+        pp = self.config.parallel.pp
+        depth = max(1, self.config.pp_pipeline_depth or pp)
+        overlap = self.config.overlap_scheduling
         if overlap:
             # --inflight-depth is honored exactly: depth 1 is the
-            # serialized launch-collect control arm (no run-ahead)
-            depth = max(1, self.config.overlap_depth)
-        multi = self.config.multi_step_decode if overlap else 1
+            # serialized launch-collect control arm (no run-ahead).
+            # Under pp > 1 the pipeline must stay at least pp deep or
+            # the stages drain between passes (bubbles) — the depth is
+            # whichever constraint is larger.
+            depth = (max(depth, self.config.overlap_depth) if pp > 1
+                     else max(1, self.config.overlap_depth))
+        # Multi-step fused blocks are ONE device program spanning the
+        # whole layer stack — they cannot cross per-stage programs, so
+        # pp > 1 chains are single-step re-forms scheduled ahead to the
+        # pipeline depth instead (that IS the no-bubble pp loop).
+        multi = self.config.multi_step_decode if overlap and pp == 1 else 1
         slot_mode = overlap and self.config.decode_slot_batching
         cup = self.config.chain_under_prefill if overlap else 0
         # Pipelined loop: run ahead across chain breaks via speculative
@@ -1054,7 +1071,11 @@ class LLM:
                                                allow_prefill=mixed)
         if batch is None:
             reason = self.scheduler.reform_fail_reason
-            self._note_stall("pages" if reason == "pages"
+            # pp_budget gets its own stall row: the per-stage throttled
+            # decode share shrank below the promised row count, so the
+            # sync pass must re-balance the stage batches — distinct
+            # from waiting on readback (docs/observability.md).
+            self._note_stall(reason if reason in ("pages", "pp_budget")
                              else "readback")
             return False
         promises = FutureMap.promised_ids(batch)
@@ -1100,7 +1121,8 @@ class LLM:
     def _note_stall(self, reason: str, **fields) -> None:
         """One loop_stall steptrace event (pipelined loop only): why the
         fill pass failed to run further ahead — readback / rebuild /
-        pages / depth (docs/observability.md event catalog)."""
+        pages / depth / pp_budget (docs/observability.md event
+        catalog)."""
         TRACE.record("loop_stall", reason=reason,
                      depth=len(self._in_flight), **fields)
 
@@ -1537,6 +1559,15 @@ class LLM:
         if self.tracing:
             for b in live:
                 self._record_spans(b, t_dispatch, now)
+        outs = self._dp_process_outputs(batches, rows, auxes)
+        self._check_stop_strings(outs)
+        self._observe_outputs(outs)
+        return outs
+
+    def _dp_process_outputs(self, batches, rows, auxes) -> List[SeqOutput]:
+        """Per-replica commit tail shared by the sync dp loop and the dp
+        super-step pipelined loop: logprobs, host-driven speculation,
+        process_output against each replica's own scheduler."""
         outs: List[SeqOutput] = []
         for sched, b, row, aux in zip(self.schedulers, batches, rows,
                                       auxes):
@@ -1563,9 +1594,142 @@ class LLM:
             else:
                 outs.extend(sched.process_output(b, row.tolist(),
                                                  self.eos_token_ids))
-        self._check_stop_strings(outs)
-        self._observe_outputs(outs)
         return outs
+
+    def _step_dp_overlap(self) -> List[SeqOutput]:
+        """dp fast path (docs/overlap_scheduling.md#topology-matrix):
+        the stacked replica program forces lockstep (it donates the
+        stacked KV), so the pipelined loop runs ahead in dp-wide
+        SUPER-STEPS — each fill pass either re-forms EVERY live replica
+        off its promised token counts (one chained stacked dispatch,
+        spliced per replica from the previous super-step's on-device
+        tokens) or drains to the sync path. Replicas idle at the chain
+        root admit committed-state work as non-chained rows riding the
+        same super-step. An entry's promises are the union over
+        replicas; reconciliation invalidates whole super-steps
+        (conservative — one replica's divergence costs the others a
+        rebuild, never correctness), and greedy/seeded streams stay
+        byte-identical to the sync dp loop for the usual reason:
+        context- resp. (seed, out_step)-determined draws."""
+        depth = max(1, self.config.overlap_depth)
+        unified = self.unified
+        ran_dry = False
+        while len(self._in_flight) < depth:
+            t_enter = time.monotonic()
+            tip = self._in_flight[-1] if self._in_flight else None
+            if tip is not None and tip.invalid:
+                # an invalidated super-step can never be a tip — the
+                # rebuild must root from committed state
+                tip = None
+            if tip is not None:
+                prev_batches = tip.batch.batches
+                nxt = [None] * self.dp
+                stall = None
+                promises = frozenset()
+                for r, sched in enumerate(self.schedulers):
+                    prev_r = prev_batches[r]
+                    if prev_r is None:
+                        # replica idle since the chain root: admissions
+                        # and prefill from committed state ride the
+                        # super-step as non-chained rows (src_rows None)
+                        nxt[r] = sched.schedule_once()
+                        continue
+                    b = sched.schedule_reform(prev_r,
+                                              allow_prefill=unified)
+                    if b is None:
+                        reason = sched.reform_fail_reason
+                        stall = (reason
+                                 if reason in ("pages", "pp_budget")
+                                 else "readback")
+                        break
+                    nxt[r] = b
+                    promises |= FutureMap.promised_ids(b)
+                if stall is not None \
+                        or not any(b is not None for b in nxt):
+                    # replica lockstep: one refusal drains the whole
+                    # super-step chain — unwind the replicas already
+                    # scheduled this pass, fall to the sync path
+                    for r, b in enumerate(nxt):
+                        if b is not None:
+                            self.schedulers[r].discard_batch(b)
+                    self._note_stall(stall or "readback")
+                    ran_dry = True
+                    break
+                t_sched = time.monotonic()
+                entry = InFlight(DPBatches(nxt),
+                                 self.runner.step_async_dp(
+                                     nxt, prev_handle=tip.handle),
+                                 time.monotonic(),
+                                 self._entry_phases(t_enter, t_sched),
+                                 chained=True, promises=promises)
+                self._in_flight.append(entry)
+                continue
+            batches = [s.schedule_once() for s in self.schedulers]
+            if all(b is None for b in batches):
+                if (self._in_flight
+                        and any(s.has_unfinished
+                                for s in self.schedulers)):
+                    self._note_stall("readback")
+                ran_dry = True
+                break
+            t_sched = time.monotonic()
+            entry = InFlight(DPBatches(batches),
+                             self.runner.step_async_dp(batches),
+                             time.monotonic(),
+                             self._entry_phases(t_enter, t_sched),
+                             roots=True)
+            self._in_flight.append(entry)
+        _M_INFLIGHT.set(len(self._in_flight))
+        if not ran_dry and len(self._in_flight) >= depth:
+            self._note_stall("depth")
+        if not self._in_flight:
+            return []
+        faults.FAULTS.maybe_stall("dispatch_stall")
+        faults.FAULTS.maybe_raise("step_exception")
+        entry = self._in_flight.popleft()
+        batches = entry.batch.batches
+        if entry.invalid:
+            # reconciliation discard: unwind per-replica bookkeeping
+            # without committing tokens; the sync super-step rebuilds
+            # from committed state (same contract as the single-runner
+            # pipelined loop)
+            for sched, b in zip(self.schedulers, batches):
+                if b is not None:
+                    sched.discard_batch(b)
+            return []
+        t0 = time.monotonic()
+        rows, auxes = self.runner.collect_dp(entry.handle)
+        live = [b for b in batches if b is not None]
+        now = time.monotonic()
+        decode_only = all(b.num_decode == b.num_seqs for b in live)
+        kind = ("unified_step" if self.unified
+                else "decode" if decode_only else "prefill")
+        tokens = sum(b.total_tokens for b in live)
+        _M_STEP_LAT.observe(now - t0, kind=kind)
+        _M_RTT.observe(now - entry.t_dispatch, kind=kind)
+        _M_STEPS.inc(kind=kind)
+        _M_STEP_TOKENS.inc(tokens, kind=kind)
+        if decode_only:
+            _M_DECODE_STEPS.inc(fused="false")
+        ph = entry.phases or {}
+        ev = dict(num_seqs=sum(b.num_seqs for b in live), tokens=tokens,
+                  wall_ms=round((now - t0) * 1e3, 3),
+                  rtt_ms=round((now - entry.t_dispatch) * 1e3, 3),
+                  dp=len(live), inflight=len(self._in_flight) + 1)
+        if self.unified:
+            ev["mix"] = "decode" if decode_only else "mixed"
+        flops = (sum(self._step_flops(b) for b in live)
+                 if self._peak_flops else 0.0)
+        rd = (ph.get("kv_bytes", 0)
+              + getattr(self.runner, "param_bytes", 0))
+        self._attach_attribution(ev, ph, now - t0, now,
+                                 entry.t_dispatch, flops, rd)
+        TRACE.record(kind, **ev)
+        if self.tracing:
+            for b in live:
+                self._record_spans(b, entry.t_dispatch, now)
+        outs = self._dp_process_outputs(batches, rows, auxes)
+        return self._commit_outputs(outs)
 
     def _record_logprobs(self, batch, aux) -> None:
         """Attach per-token logprobs from the step's aux arrays to their

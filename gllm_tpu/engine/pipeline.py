@@ -67,6 +67,18 @@ class InFlight:
         return self.batch, self.handle
 
 
+@dataclasses.dataclass
+class DPBatches:
+    """Per-replica batch list for one dp SUPER-STEP entry (the dp
+    pipelined loop, docs/overlap_scheduling.md#topology-matrix):
+    ``batches[r]`` is replica r's ScheduledBatch or None (idle dummy).
+    A dedicated holder — NOT a plain list — so the fused-chain
+    ``isinstance(batch, list)`` checks elsewhere never mistake a
+    dp-wide entry for a multi-step chain."""
+
+    batches: list
+
+
 class FutureMap:
     """Promise registry + reconciliation for the pipelined loop.
 

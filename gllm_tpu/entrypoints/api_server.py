@@ -370,8 +370,24 @@ class Handler(BaseHTTPRequestHandler):
                         and st.llm.prefix_tiers.client is not None
                         else None),
                 },
-                "parallel": {"tp": cfg.parallel.tp, "dp": cfg.parallel.dp,
-                             "pp": cfg.parallel.pp},
+                "parallel": {
+                    "tp": cfg.parallel.tp, "dp": cfg.parallel.dp,
+                    "pp": cfg.parallel.pp,
+                    # per-stage [first, last) layer assignment — None on
+                    # the single-runner (pp == 1)
+                    "stage_layers": ([list(b) for b in getattr(
+                        st.llm.runner, "stage_bounds", [])] or None),
+                    # which fast-path flags this topology actually runs
+                    # (docs/overlap_scheduling.md#topology-matrix) — the
+                    # router/operator sees the lifted combinations, not
+                    # just the raw grid
+                    "fast_path": {
+                        "overlap_scheduling": cfg.overlap_scheduling,
+                        "pipelined_loop": cfg.pipelined_loop,
+                        "unified_step": cfg.unified_step,
+                        "spec_fused": cfg.spec_fused,
+                    },
+                },
                 "attention_impl": st.llm.runner.attn_impl,
                 "waiting": len(st.llm.scheduler.waiting),
                 "running": len(st.llm.scheduler.running),

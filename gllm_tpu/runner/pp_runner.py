@@ -58,6 +58,11 @@ logger = logging.getLogger(__name__)
 _M_MICROBATCH = obs.counter(
     "gllm_pp_microbatches_total",
     "microbatches dispatched through the stage pipeline")
+_M_STAGE_INFLIGHT = obs.gauge(
+    "gllm_pp_stage_inflight",
+    "microbatches dispatched but not yet collected, per pipeline stage "
+    "(dispatch-side: a microbatch occupies every stage of its replica's "
+    "chain until its collect)", ("stage",))
 
 
 def split_layers(num_layers: int, pp: int,
@@ -153,6 +158,25 @@ class PPModelRunner(ModelRunner):
                     "KV layout (head_dim ×pack % 128 == 0)")
         self.kv_pack = pack if impl == "pallas" else 1
         self.attn_impl = impl
+        # Unified mixed-batch step under pp (--unified-step): every
+        # stage program routes attention through the ONE ragged kernel
+        # (same rule as the single runner — the nested tp shard_map
+        # binds each stage's context mesh, ops/attention.py), so the
+        # per-stage throttled mixed batches the scheduler feeds the
+        # pipeline dispatch as one family on every stage.
+        self.fwd_attn_impl = (
+            "unified" if (getattr(config, "unified_step", False)
+                          and impl == "pallas"
+                          and not model_cfg.use_hybrid)
+            else impl)
+        if (getattr(config, "unified_step", False)
+                and not model_cfg.use_hybrid
+                and self.fwd_attn_impl != "unified"
+                and jax.default_backend() in ("tpu", "axon")):
+            logger.warning(
+                "--unified-step without the unified kernel (attn_impl="
+                "%s): dispatch-shape collapse is active but attention "
+                "runs the legacy path", impl)
         if self.kv_quant:
             self._check_kv_quant()
         from gllm_tpu.runner.prepare import BatchBuilder
@@ -171,6 +195,7 @@ class PPModelRunner(ModelRunner):
         self.last_phases = {}            # see ModelRunner.last_phases
         self._last_kv_read = 0
         self.param_bytes = 0             # summed over stages below
+        self._mb_inflight = 0            # feeds gllm_pp_stage_inflight
 
         if model_cfg.use_hybrid:
             from gllm_tpu.models.hybrid import period_pattern
@@ -187,6 +212,8 @@ class PPModelRunner(ModelRunner):
         bounds = split_layers(model_cfg.num_layers, pp,
                               config.parallel.assigned_layers,
                               multiple=period)
+        # surfaced by /server_info (per-stage layer assignment)
+        self.stage_bounds = bounds
 
         # Per-(replica, stage) device groups: replica r owns the
         # contiguous block devices[r*pp*tp : (r+1)*pp*tp], stage i the
@@ -387,7 +414,7 @@ class PPModelRunner(ModelRunner):
     def _make_stage_fn(self, scfg: ModelConfig):
         fwd = self.model_def.forward
         logits_fn = self.model_def.compute_logits
-        attn_impl = self.attn_impl
+        attn_impl = getattr(self, "fwd_attn_impl", self.attn_impl)
 
         @functools.partial(jax.jit,
                            static_argnames=("max_q_len", "logprobs_k",
@@ -455,9 +482,18 @@ class PPModelRunner(ModelRunner):
                                        s_src, s_dst, z, r_src, r_dst)
                 stage.kv = stage.kv._replace(conv=conv, rec=rec)
 
-    def _run_pipeline(self, stages, sched_batch, step_key):
+    def _run_pipeline(self, stages, sched_batch, step_key,
+                      prev_handle=None):
         """Launch one microbatch through one replica's stage chain; all
-        dispatch is async — returns (tokens_future, aux, num_seqs)."""
+        dispatch is async — returns (tokens_future, aux, num_seqs).
+
+        ``prev_handle``: chain this microbatch off a previous entry's
+        on-device sampled tokens (the pipelined loop under pp,
+        docs/overlap_scheduling.md#topology-matrix). Only stage 0 reads
+        ``token_ids`` (later stages consume hidden_in; positions, slots
+        and page tables are host-known from promised counts), so the
+        splice rewrites only the stage-0 placed batch — the previous
+        tokens hop last-stage → stage-0 device first."""
         import time as _time
         from gllm_tpu.parallel.mesh import mesh_context
         from gllm_tpu.runner.runner import _spec_sampled
@@ -473,9 +509,22 @@ class PPModelRunner(ModelRunner):
                             _ag(sched_batch.items))
         _M_MICROBATCH.inc()
         self._note_kv_read(sched_batch.items)
-        TRACE.record("pp_stage", stages=len(stages),
-                     num_seqs=sched_batch.num_seqs,
-                     tokens=sched_batch.total_tokens)
+        # one pp_stage event PER STAGE, carrying the dispatch family the
+        # stage ran (family) — under --unified-step + token throttling
+        # every stage must show "unified_step" (the acceptance probe the
+        # composition tests read). Dispatch-side only; summarize() skips
+        # these rows.
+        decode_only = (sched_batch.num_decode == sched_batch.num_seqs
+                       and not sched_batch.has_drafts)
+        family = ("unified_step" if self.builder.unified
+                  else "decode" if decode_only else "prefill")
+        for i in range(len(stages)):
+            TRACE.record("pp_stage", stage=i, stages=len(stages),
+                         family=family, num_seqs=sched_batch.num_seqs,
+                         tokens=sched_batch.total_tokens)
+        self._mb_inflight += 1
+        for i in range(len(stages)):
+            _M_STAGE_INFLIGHT.set(self._mb_inflight, stage=str(i))
         t_build = _time.monotonic()
         hidden = residual = None
         out = None
@@ -489,8 +538,14 @@ class PPModelRunner(ModelRunner):
             targets.append(presence)
             devices.append(last.device)
         placed = jax.device_put(targets, devices)
-        sbs = placed[:len(stages)]
+        sbs = list(placed[:len(stages)])
         presence = placed[len(stages)] if presence is not None else None
+        if prev_handle is not None:
+            prev_tokens = prev_handle[0]
+            if getattr(prev_tokens, "ndim", 1) == 2:
+                prev_tokens = prev_tokens[-1]
+            prev_tokens = jax.device_put(prev_tokens, stages[0].device)
+            sbs[0] = self._splice_prev(sbs[0], sched_batch, prev_tokens)
         for stage, sb in zip(stages, sbs):
             if hidden is not None:
                 hidden = jax.device_put(hidden, stage.device)
@@ -526,7 +581,7 @@ class PPModelRunner(ModelRunner):
                                            stage.kv.v_scale, idx)
                 stage.kv = stage.kv._replace(k_scale=ks, v_scale=vs)
 
-    def step_async(self, sched_batch):
+    def step_async(self, sched_batch, prev_handle=None):
         self._step_count += 1
         if self.model_cfg.use_mm:
             # ViT embedding on stage 0's params (visual tower lives there)
@@ -534,12 +589,16 @@ class PPModelRunner(ModelRunner):
         self._apply_ssm_intents()
         self._apply_scale_resets()
         step_key = jax.random.fold_in(self.rng_key, self._step_count)
-        return self._run_pipeline(self.stages, sched_batch, step_key)
+        return self._run_pipeline(self.stages, sched_batch, step_key,
+                                  prev_handle=prev_handle)
 
     def collect(self, handle):
         tokens, aux, n = handle
         if aux:
             aux = jax.tree.map(np.asarray, aux)
+        self._mb_inflight = max(0, self._mb_inflight - 1)
+        for i in range(len(self.stages)):
+            _M_STAGE_INFLIGHT.set(self._mb_inflight, stage=str(i))
         return np.asarray(tokens)[:n], aux
 
     def step(self, sched_batch) -> np.ndarray:
@@ -578,6 +637,9 @@ class PPModelRunner(ModelRunner):
                 auxes.append({})
                 continue
             tokens, aux, n = h
+            self._mb_inflight = max(0, self._mb_inflight - 1)
             rows.append(np.asarray(tokens)[:n])
             auxes.append(jax.tree.map(np.asarray, aux) if aux else {})
+        for i in range(len(self.stages)):
+            _M_STAGE_INFLIGHT.set(self._mb_inflight, stage=str(i))
         return rows, auxes

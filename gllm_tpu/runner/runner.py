@@ -985,13 +985,20 @@ class ModelRunner:
                 want_plp = True
         return k, want_plp
 
-    def step_async_dp(self, sched_batches):
+    def step_async_dp(self, sched_batches, prev_handle=None):
         """One step over all DP replicas in ONE program: per-replica
         batches (None → idle dummy batch) are stacked on a leading axis
         sharded over the mesh's dp axis; the vmapped step runs each
         replica's forward/sample on its own devices. No cross-replica
         lockstep barriers needed — it is a single jit program (reference
         needs dp_all_gather_meta + idle dummy batches, worker.py:750-829).
+
+        ``prev_handle``: chain this SUPER-STEP off the previous dp
+        dispatch's on-device sampled tokens (the dp pipelined loop,
+        docs/overlap_scheduling.md#topology-matrix). Replica batches
+        that carry ``src_rows`` (re-formed off promised counts) splice
+        their promised rows from ``prev_tokens[r]``; sync-scheduled
+        replica batches (src_rows None) keep their host-built tokens.
 
         Returns a handle; ``collect_dp`` yields per-replica token rows.
         """
@@ -1063,6 +1070,9 @@ class ModelRunner:
             if token_counts is not None:
                 token_counts = jax.device_put(
                     token_counts, NamedSharding(self.mesh, P("dp")))
+        if prev_handle is not None:
+            stacked = self._splice_prev_dp(stacked, sched_batches,
+                                           prev_handle[0])
 
         lp_k, want_plp = -1, False
         for b in live:
@@ -1219,6 +1229,35 @@ class ModelRunner:
             rows, np.int32))]
         return batch._replace(token_ids=jnp.asarray(batch.token_ids).at[
             jnp.asarray(np.asarray(idx, np.int32))].set(vals))
+
+    def _splice_prev_dp(self, stacked, sched_batches, prev_tokens):
+        """Dispatch-time input-token splice for a chained dp SUPER-STEP:
+        for every replica whose batch was re-formed off promised counts
+        (``src_rows`` set), scatter the previous super-step's on-device
+        sampled tokens ``prev_tokens[r]`` into that replica's row of the
+        stacked token_ids at each promised item's flat offset — the
+        per-replica analogue of :meth:`_splice_mapped_tokens`. Replicas
+        scheduled from committed state (src_rows None, including idle
+        dummies) keep their host-built tokens. prev_tokens is NOT
+        donated: the previous entry's collect still reads it."""
+        tok = jnp.asarray(stacked.token_ids)
+        prev = jnp.asarray(prev_tokens)
+        for r, b in enumerate(sched_batches):
+            if b is None or b.src_rows is None:
+                continue
+            idx, rows = [], []
+            off = 0
+            for it, src in zip(b.items, b.src_rows):
+                if src >= 0:
+                    idx.append(off)
+                    rows.append(src)
+                off += it.num_new_tokens + len(it.draft_tokens)
+            if not idx:
+                continue
+            vals = prev[r][jnp.asarray(np.asarray(rows, np.int32))]
+            tok = tok.at[r, jnp.asarray(np.asarray(idx, np.int32))
+                         ].set(vals)
+        return stacked._replace(token_ids=tok)
 
     def _splice_prev(self, batch: StepBatch, sched_batch: ScheduledBatch,
                      prev_tokens):

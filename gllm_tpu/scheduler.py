@@ -241,7 +241,7 @@ class Scheduler:
         self.chain_break_reason: Optional[str] = None
         # Why the last schedule_reform refused (pipelined loop — feeds
         # the engine's loop_stall reason classification): spec / shape /
-        # pages, or None after a successful re-form.
+        # pages / pp_budget, or None after a successful re-form.
         self.reform_fail_reason: Optional[str] = None
         # Request-span ring (obs/spans.py): the owning LLM overwrites
         # this with its per-engine instance (seq_ids restart per engine
@@ -933,9 +933,10 @@ class Scheduler:
         runner dispatches as one unified step — the chain absorbing a
         prefill chunk instead of breaking.
 
-        Returns None with ``reform_fail_reason`` ∈ spec/shape/pages when
-        re-forming needs host-committed state (the caller falls back to
-        the drain-and-sync path and records a loop_stall)."""
+        Returns None with ``reform_fail_reason`` ∈
+        spec/shape/pages/pp_budget when re-forming needs host-committed
+        state (the caller falls back to the drain-and-sync path and
+        records a loop_stall)."""
         self.reform_fail_reason = None
         if self.spec_cfg is not None:
             # speculation owns decode dispatch (drafting needs committed
@@ -983,7 +984,19 @@ class Scheduler:
         # refuses the whole re-form so the sync pass can seat it —
         # skipping it here would starve it at decode saturation
         in_batch = {seq.seq_id for seq, _, _ in base}
-        budget = self.sched_cfg.max_decode_seqs
+        # Per-stage token throttling: under pp > 1 the decode budget is
+        # the per-microbatch share (cdiv(n_decode, pp)), not the global
+        # cap, so re-formed stage batches keep the same geometry the
+        # sync scheduler feeds the pipeline. The share is recomputed
+        # from live counts, so finishes in OTHER microbatches can
+        # shrink it below the promised row count of THIS one — honoring
+        # the budget would drop promised rows (breaking the FutureMap
+        # contract), exceeding it would unbalance the stages, so the
+        # re-form refuses with its own reason and the drain-and-sync
+        # pass re-balances the stage batches.
+        budget = self._decode_budget()
+        if len(base) > budget:
+            return self._reform_fail("pp_budget")
         for s in self.running:
             if (s.num_remaining_tokens != 1 or s.num_in_flight
                     or s.seq_id in in_batch

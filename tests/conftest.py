@@ -22,6 +22,23 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def multi_device_cpu():
+    """The forced multi-device CPU host platform topology tests run on.
+
+    Guarantees the ≥4 virtual devices the pp=2 / dp=2 / tp=2 grids need
+    (the XLA_FLAGS force above must have taken effect BEFORE jax was
+    imported — if another conftest/plugin imported jax first, this fails
+    loudly instead of letting topology tests skip or mis-shard)."""
+    n = jax.device_count()
+    assert n >= 4, (
+        f"topology tests need >= 4 forced host devices, got {n}: "
+        "xla_force_host_platform_device_count was set too late")
+    return jax.devices()[:4]
+
 
 def pytest_configure(config):
     # chaos: deterministic fault-injection tests (gllm_tpu/faults.py +

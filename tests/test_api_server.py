@@ -318,3 +318,53 @@ def test_steptrace_endpoint_after_generate(server):
     assert d["events"] and "by_kind" in d["summary"]
     assert {e["kind"] for e in d["events"]} & {"prefill", "decode",
                                               "fused_block"}
+
+
+def test_server_info_advertises_topology_and_fast_path(server):
+    """/server_info carries the full topology story (ISSUE 20): the
+    pp/dp/tp grid, the per-stage layer assignment (None on the
+    single-runner), and which fast-path flags this topology runs."""
+    status, body = request(server, "GET", "/server_info")
+    info = json.loads(body)
+    par = info["parallel"]
+    assert (par["pp"], par["dp"], par["tp"]) == (1, 1, 1)
+    assert par["stage_layers"] is None
+    assert set(par["fast_path"]) == {"overlap_scheduling",
+                                     "pipelined_loop", "unified_step",
+                                     "spec_fused"}
+
+
+@pytest.mark.slow   # builds a real pp=2 engine behind a live HTTP server
+def test_server_info_pp_stage_layers(tmp_path):
+    """A pp=2 server advertises each stage's [first, last) layer block
+    and the lifted fast-path flags it actually runs."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+    from gllm_tpu.config import ParallelConfig
+    torch.manual_seed(3)
+    LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2,
+        intermediate_size=96, max_position_embeddings=256,
+        eos_token_id=0, attention_bias=False)).save_pretrained(
+            tmp_path, safe_serialization=True)
+    cfg = EngineConfig(
+        model=str(tmp_path), dtype="float32", max_model_len=128,
+        overlap_scheduling=True, unified_step=True, pipelined_loop=True,
+        cache=CacheConfig(page_size=4, num_pages=128),
+        parallel=ParallelConfig(pp=2))
+    llm = LLM(config=cfg, tokenizer=StubTokenizer())
+    httpd = serve(llm, "127.0.0.1", 0)
+    port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        status, body = request(port, "GET", "/server_info")
+        info = json.loads(body)
+        par = info["parallel"]
+        assert par["pp"] == 2
+        assert par["stage_layers"] == [[0, 2], [2, 4]]
+        fp = par["fast_path"]
+        assert fp["unified_step"] and fp["pipelined_loop"]
+        assert not fp["spec_fused"]
+    finally:
+        httpd.shutdown()
+        httpd.state.engine.shutdown()

@@ -1,0 +1,89 @@
+"""Bench regression gate (ISSUE 20): GLLM_BENCH_BASELINE=<path>.
+
+The measured pass is compared against a committed BENCH JSON; a metric
+that regresses beyond tolerance fails the run with a NONZERO exit and
+names the offender — the trajectory's perf floor is enforced, not just
+reported.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from bench import check_bench_regression, run_bench_gate  # noqa: E402
+
+BASE = {"bubble_frac": 0.10, "mfu": 0.30, "tokens_per_dispatch": 4.0}
+
+
+def test_gate_passes_at_baseline_and_within_tolerance():
+    assert check_bench_regression(dict(BASE), BASE) == []
+    # within tolerance: 10% relative (or 0.02 absolute) slack per metric
+    ok = {"bubble_frac": 0.11, "mfu": 0.28, "tokens_per_dispatch": 3.7}
+    assert check_bench_regression(ok, BASE) == []
+    # improvements never fail, however large
+    better = {"bubble_frac": 0.0, "mfu": 0.9, "tokens_per_dispatch": 9.0}
+    assert check_bench_regression(better, BASE) == []
+
+
+@pytest.mark.parametrize("metric,bad", [
+    ("bubble_frac", 0.30),          # lower-is-better metric went up
+    ("mfu", 0.20),                  # higher-is-better metric went down
+    ("tokens_per_dispatch", 2.0),
+])
+def test_gate_names_the_offending_metric(metric, bad):
+    result = dict(BASE, **{metric: bad})
+    failures = check_bench_regression(result, BASE)
+    assert len(failures) == 1
+    assert metric in failures[0]
+    assert "regressed" in failures[0]
+
+
+def test_gate_skips_metrics_absent_from_either_side():
+    # profile mismatch (e.g. a rung without spec_fused has no
+    # tokens_per_dispatch): skipped, not failed
+    assert check_bench_regression({"bubble_frac": 0.1}, BASE) == []
+    assert check_bench_regression(dict(BASE), {"mfu": 0.3}) == []
+
+
+def test_run_bench_gate_records_verdict(tmp_path):
+    bp = tmp_path / "BENCH_baseline.json"
+    bp.write_text(json.dumps(BASE))
+    ok = dict(BASE)
+    assert run_bench_gate(ok, str(bp)) == 0
+    assert ok["baseline_gate"]["failures"] == []
+    bad = dict(BASE, bubble_frac=0.5)
+    assert run_bench_gate(bad, str(bp)) == 1
+    assert any("bubble_frac" in f
+               for f in bad["baseline_gate"]["failures"])
+
+
+def test_injected_regression_exits_nonzero_naming_metric(tmp_path):
+    """The process-level contract: an injected regression makes the gate
+    exit NONZERO with the offending metric named on stderr (bench.py's
+    report tail wires run_bench_gate's rc into sys.exit)."""
+    bp = tmp_path / "BENCH_baseline.json"
+    bp.write_text(json.dumps(BASE))
+    rp = tmp_path / "result.json"
+    rp.write_text(json.dumps(dict(BASE, bubble_frac=0.5, mfu=0.05)))
+    code = (
+        "import json, sys\n"
+        "from bench import run_bench_gate\n"
+        f"result = json.load(open({str(rp)!r}))\n"
+        f"rc = run_bench_gate(result, {str(bp)!r})\n"
+        "print(json.dumps(result))\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=str(REPO),
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0
+    assert "bubble_frac" in proc.stderr and "mfu" in proc.stderr
+    assert "REGRESSION" in proc.stderr
+    # the result JSON still lands, carrying the verdict
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["baseline_gate"]["failures"]

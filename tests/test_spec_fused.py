@@ -349,19 +349,52 @@ def test_spec_fused_lifts_overlap_and_chain_len():
     assert cfg.overlap_scheduling and cfg.multi_step_decode > 1
 
 
-def test_spec_fused_inert_topologies_clear_before_side_effects():
-    """pp/dp > 1 are known at config time, so the inert flag clears
-    BEFORE its side effects: no implied overlap scheduling, no
-    chain-length lift — the command behaves exactly like the same
-    command without --spec-fused."""
+def test_spec_fused_unsupported_topologies_error_loudly():
+    """Flags never silently no-op (ISSUE 20): spec_fused × pp>1 and
+    × dp>1 are genuinely unsupported (the fused block is ONE device
+    program — it can span neither stage programs nor the stacked
+    replica carry), so config.validate() refuses with a per-combination
+    ValueError instead of the retired warn-and-clear path."""
     from gllm_tpu.config import ParallelConfig
-    for par in (ParallelConfig(pp=2), ParallelConfig(dp=2)):
+    for par, pat in ((ParallelConfig(pp=2), "pp > 1"),
+                     (ParallelConfig(dp=2), "dp > 1")):
         cfg = EngineConfig(load_format="dummy", spec_decode="ngram",
                            spec_fused=True, parallel=par)
-        cfg.validate()
-        assert not cfg.spec_fused
-        assert not cfg.overlap_scheduling
-        assert cfg.multi_step_decode == 1
+        with pytest.raises(ValueError, match=pat):
+            cfg.validate()
+
+
+def test_fast_paths_refuse_pp_times_dp():
+    """unified_step / pipelined_loop compose with pp OR dp, not the
+    combined grid — per-combination error, not a silent legacy
+    fallback."""
+    from gllm_tpu.config import ParallelConfig
+    for kw in (dict(unified_step=True), dict(pipelined_loop=True)):
+        cfg = EngineConfig(load_format="dummy",
+                           parallel=ParallelConfig(pp=2, dp=2), **kw)
+        with pytest.raises(ValueError, match="pp>1 OR\\s+dp>1"):
+            cfg.validate()
+
+
+def test_spec_fused_hybrid_model_errors_in_engine():
+    """spec_fused × hybrid GDN is the model-level genuinely-incompatible
+    case: the engine refuses with a ValueError (the SSM state cannot
+    replay a discarded block) instead of warning and running host-driven
+    speculation under a flag that claims otherwise."""
+    hybrid = ModelConfig(
+        architecture="Qwen3NextForCausalLM", vocab_size=128,
+        hidden_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, intermediate_size=96, max_position=512,
+        eos_token_id=0,
+        layer_types=("linear_attention", "full_attention"),
+        linear_num_value_heads=4, linear_num_key_heads=2,
+        linear_key_head_dim=8, linear_value_head_dim=8)
+    cfg = EngineConfig(
+        model="", load_format="dummy", dtype="float32",
+        max_model_len=256, cache=CacheConfig(page_size=4, num_pages=64),
+        **FUSED)
+    with pytest.raises(ValueError, match="hybrid"):
+        LLM(config=cfg, model_cfg=hybrid)
 
 
 def test_spec_fused_enforce_eager_clears():

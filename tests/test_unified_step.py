@@ -24,7 +24,8 @@ import pytest
 
 import jax.numpy as jnp
 
-from gllm_tpu.config import CacheConfig, EngineConfig, SchedulerConfig
+from gllm_tpu.config import (CacheConfig, EngineConfig, ParallelConfig,
+                             SchedulerConfig)
 from gllm_tpu.engine.llm import LLM
 from gllm_tpu.models.config import ModelConfig
 from gllm_tpu.obs.steptrace import TRACE, summarize
@@ -291,13 +292,14 @@ def check_no_leak(llm):
 
 
 def churn_run(model_cfg, unified, *, seeded=False, msd=1, slots=False,
-              num_pages=256, n=10, depth=2):
+              num_pages=256, n=10, depth=2, topo=None):
     """Arrivals land MID-CHAIN (the phase-boundary edge the unified step
     absorbs); optional page pressure exercises the no-preempt re-form
     fallback."""
     llm = make_llm(model_cfg, unified=unified, num_pages=num_pages,
                    multi_step_decode=msd, decode_slot_batching=slots,
-                   ondevice_finish=slots, depth=depth)
+                   ondevice_finish=slots, depth=depth,
+                   parallel=ParallelConfig(**(topo or {})))
     rng = np.random.default_rng(11)
     seqs, nseq, it = [], 0, 0
     arrivals = {0: 3, 2: 2, 5: 2, 9: 1, 14: 2}
@@ -337,6 +339,27 @@ def test_unified_matches_legacy_under_churn(model_cfg, kw):
     assert base == uni
     if kw.get("num_pages"):
         assert llm.scheduler.num_preemptions > 0
+
+
+@pytest.mark.slow       # fresh engine per arm × 6 rows — tier-1 keeps the
+                        # topology identity core in test_fast_path_topology.py
+@pytest.mark.parametrize("topo,kw", [
+    (dict(pp=2), {}),
+    (dict(pp=2), dict(slots=True)),      # slot membership rides pp
+    (dict(dp=2), {}),
+], ids=["pp2", "pp2-slots", "dp2"])
+@pytest.mark.parametrize("seeded", [False, True],
+                         ids=["greedy", "seeded"])
+def test_unified_matches_legacy_under_churn_multi_device(
+        model_cfg, multi_device_cpu, topo, kw, seeded):
+    """The churn identity matrix over topology (ISSUE 20): at pp=2 and
+    dp=2 on the forced multi-device CPU host the unified dispatch family
+    commits the same streams as the split family — both arms ride the
+    lifted pipelined loop, so this also pins reform-chaining across
+    stages / replicas against the per-topology legacy dispatch."""
+    base, _ = churn_run(model_cfg, False, seeded=seeded, topo=topo, **kw)
+    uni, _ = churn_run(model_cfg, True, seeded=seeded, topo=topo, **kw)
+    assert base == uni
 
 
 def test_unified_zero_waiting_breaks_and_mixed_steps(model_cfg):
